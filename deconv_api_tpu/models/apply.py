@@ -1,0 +1,57 @@
+"""Plain forward pass (classifier inference / training) over a ModelSpec.
+
+This is the non-deconv execution path: no switch recording (pooling uses
+`lax.reduce_window`, cheaper than the switch-recording pool), used by the
+training step and classification serving.  The deconv engine keeps its own
+forward (engine/deconv.py) because it must thread switches to the backward
+half.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.models.spec import ModelSpec
+
+
+def forward(
+    spec: ModelSpec,
+    params,
+    x: jnp.ndarray,
+    *,
+    logits: bool = False,
+) -> jnp.ndarray:
+    """Run the classifier forward. With ``logits=True`` the final dense
+    layer's softmax is skipped (stable cross-entropy path for training)."""
+    last = spec.layers[-1]
+    for l in spec.layers:
+        if l.kind == "input":
+            continue
+        if l.kind == "conv":
+            w = params[l.name]["w"].astype(x.dtype)
+            b = params[l.name]["b"].astype(x.dtype)
+            x = ops.apply_activation(
+                ops.conv2d(x, w, b, strides=l.strides, padding=l.padding),
+                l.activation,
+            )
+        elif l.kind == "pool":
+            ph, pw = l.pool_size
+            x = lax.reduce_window(
+                x,
+                -jnp.inf,
+                lax.max,
+                window_dimensions=(1, ph, pw, 1),
+                window_strides=(1, ph, pw, 1),
+                padding="VALID",
+            )
+        elif l.kind == "flatten":
+            x = ops.flatten(x)
+        elif l.kind == "dense":
+            w = params[l.name]["w"].astype(x.dtype)
+            b = params[l.name]["b"].astype(x.dtype)
+            x = ops.dense(x, w, b)
+            if not (logits and l is last and l.activation == "softmax"):
+                x = ops.apply_activation(x, l.activation)
+    return x

@@ -1,0 +1,98 @@
+"""Pretrained-weight loading: Keras .h5 / .npz / orbax → params pytree.
+
+The reference downloads ImageNet VGG16 weights at import time via
+`vgg16.VGG16(weights='imagenet')` (app/main.py:17).  This environment has no
+network egress, so loading is gated: models initialise with deterministic
+He-normal weights (models/spec.py:init_params) and upgrade in place when a
+weights file is supplied (ServerConfig.weights_path).
+
+Keras h5 layout notes: channels-last Keras stores conv kernels as HWIO and
+dense kernels as (in, out) — exactly this framework's layout, so conversion
+is a straight copy keyed by layer name.  Both the keras-2.x
+(`layer/layer/kernel:0`) and keras-1.x (`layer/layer_W:0`) dataset naming
+schemes are handled.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from deconv_api_tpu.models.spec import ModelSpec
+
+
+def load_weights(spec: ModelSpec, path: str, init_params: dict) -> dict:
+    """Load weights from `path` into a copy of `init_params`.
+
+    Formats by extension: .h5/.hdf5 (Keras), .npz (numpy archive with
+    ``<layer>/w`` and ``<layer>/b`` keys), directory (orbax checkpoint).
+    Layers missing from the file keep their init values; shape mismatches
+    raise ValueError naming the layer.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"weights file {path!r} does not exist")
+    if os.path.isdir(path):
+        from deconv_api_tpu.utils.checkpoint import restore_params
+
+        return restore_params(path, init_params)
+    if path.endswith((".h5", ".hdf5")):
+        loaded = _read_keras_h5(path)
+    elif path.endswith(".npz"):
+        archive = np.load(path)
+        loaded = {}
+        for key in archive.files:
+            layer, _, leaf = key.rpartition("/")
+            loaded.setdefault(layer, {})[leaf] = archive[key]
+    else:
+        raise ValueError(f"unsupported weights format: {path!r}")
+
+    params = {k: dict(v) for k, v in init_params.items()}
+    for name, tensors in loaded.items():
+        if name not in params:
+            continue  # classifier-less checkpoints etc.
+        for leaf in ("w", "b"):
+            if leaf not in tensors:
+                continue
+            want = params[name][leaf].shape
+            got = tensors[leaf].shape
+            if want != got:
+                raise ValueError(
+                    f"layer {name!r} {leaf}: checkpoint shape {got} != model shape {want}"
+                )
+            params[name][leaf] = jnp.asarray(
+                tensors[leaf], dtype=params[name][leaf].dtype
+            )
+    return params
+
+
+def _read_keras_h5(path: str) -> dict[str, dict[str, np.ndarray]]:
+    import h5py
+
+    out: dict[str, dict[str, np.ndarray]] = {}
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+
+        def visit(name, obj):
+            if not isinstance(obj, h5py.Dataset):
+                return
+            layer = name.split("/")[0]
+            base = name.split("/")[-1]
+            if base.startswith(("kernel", f"{layer}_W", "W")):
+                out.setdefault(layer, {})["w"] = np.asarray(obj)
+            elif base.startswith(("bias", f"{layer}_b", "b")):
+                out.setdefault(layer, {})["b"] = np.asarray(obj)
+
+        root.visititems(visit)
+    return out
+
+
+def save_npz(params: dict, path: str) -> None:
+    """Save a params pytree as a flat npz archive (layer/leaf keys)."""
+    flat = {
+        f"{layer}/{leaf}": np.asarray(v)
+        for layer, leaves in params.items()
+        for leaf, v in leaves.items()
+    }
+    np.savez(path, **flat)

@@ -1,0 +1,107 @@
+"""Max-pooling with argmax "switches" and switch-guided unpooling.
+
+The reference records switches with a 4-deep interpreted-Python loop over
+(sample, channel, row, col), tie-breaking to the first max in row-major patch
+order, and unpools via `np.kron(pooled, ones) * switch`
+(reference: app/deepdream.py:152-209) — its hot loop #1 (SURVEY §3.2).
+
+Here both directions are pure XLA: a reshape exposes each non-overlapping
+window as a trailing axis, `argmax` over that axis reproduces the reference's
+first-index row-major tie-break exactly, and a one-hot scatter-by-reshape
+materialises the switch mask.  Everything fuses; nothing leaves the device.
+
+`maxpool_switched` additionally packages the pair as a `jax.custom_vjp` so
+that autodiff-driven deconv (engine/autodeconv.py) routes cotangents through
+the exact same switch semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def maxpool_with_switches(
+    x: jnp.ndarray, pool_size: Sequence[int] = (2, 2)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Non-overlapping max-pool returning (pooled, switch).
+
+    - `pooled`: (B, H//ph, W//pw, C) window maxima.
+    - `switch`: (B, H, W, C) one-hot mask, a single 1 per window at the
+      *first* (row-major) position attaining the max — the reference's
+      tie-break (app/deepdream.py:180-187; `np.argmax` over the flattened
+      patch has identical first-occurrence semantics).
+
+    Odd trailing rows/cols are floor-dropped from pooling, matching
+    app/deepdream.py:166-167; the switch keeps the full (H, W) extent with
+    zeros there.
+    """
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, h, w, c = x.shape
+    ho, wo = h // ph, w // pw
+    xt = x[:, : ho * ph, : wo * pw, :]
+    # (B, Ho, ph, Wo, pw, C) -> (B, Ho, Wo, C, ph*pw): window as last axis.
+    windows = (
+        xt.reshape(b, ho, ph, wo, pw, c)
+        .transpose(0, 1, 3, 5, 2, 4)
+        .reshape(b, ho, wo, c, ph * pw)
+    )
+    pooled = jnp.max(windows, axis=-1)
+    idx = jnp.argmax(windows, axis=-1)  # first occurrence, row-major
+    one_hot = jax.nn.one_hot(idx, ph * pw, dtype=x.dtype)
+    switch = (
+        one_hot.reshape(b, ho, wo, c, ph, pw)
+        .transpose(0, 1, 4, 2, 5, 3)
+        .reshape(b, ho * ph, wo * pw, c)
+    )
+    if (ho * ph, wo * pw) != (h, w):
+        switch = jnp.pad(
+            switch, ((0, 0), (0, h - ho * ph), (0, w - wo * pw), (0, 0))
+        )
+    return pooled, switch
+
+
+def unpool_with_switches(
+    y: jnp.ndarray, switch: jnp.ndarray, pool_size: Sequence[int] = (2, 2)
+) -> jnp.ndarray:
+    """Kronecker-upsample `y` by the pool size and gate by the switch mask —
+    the reference's `np.kron(input, ones(tile)) * switch`
+    (app/deepdream.py:191-209), as two fused XLA broadcasts.
+    """
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, ho, wo, c = y.shape
+    h, w = switch.shape[1], switch.shape[2]
+    up = jnp.broadcast_to(
+        y[:, :, None, :, None, :], (b, ho, ph, wo, pw, c)
+    ).reshape(b, ho * ph, wo * pw, c)
+    if (ho * ph, wo * pw) != (h, w):
+        up = jnp.pad(up, ((0, 0), (0, h - ho * ph), (0, w - wo * pw), (0, 0)))
+    return up * switch
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def maxpool_switched(x: jnp.ndarray, pool_size: tuple[int, int] = (2, 2)):
+    """Max-pool whose VJP routes cotangents through deconvnet switches.
+
+    Used by the autodiff deconv path (engine/autodeconv.py) so that
+    `jax.vjp` of a whole model reproduces the reference's unpool-with-switch
+    semantics (including first-index tie-breaks, which XLA's native
+    reduce-window gradient does not guarantee).
+    """
+    pooled, _ = maxpool_with_switches(x, pool_size)
+    return pooled
+
+
+def _maxpool_switched_fwd(x, pool_size):
+    pooled, switch = maxpool_with_switches(x, pool_size)
+    return pooled, switch
+
+
+def _maxpool_switched_bwd(pool_size, switch, g):
+    return (unpool_with_switches(g, switch, pool_size),)
+
+
+maxpool_switched.defvjp(_maxpool_switched_fwd, _maxpool_switched_bwd)

@@ -1,0 +1,37 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices so that multi-chip sharding
+(parallel/, train/) is exercised without TPU hardware, per the driver
+contract.  The env vars must be set before jax initialises its backends,
+hence the assignment at module import time (pytest imports conftest before
+collecting test modules, which import jax).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# jax may already be in sys.modules (pytest plugins import it before
+# conftest); as long as no backend has been initialised, updating the config
+# still takes effect because XLA_FLAGS/platforms are read at first backend
+# construction.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices for sharding tests, got "
+    f"{jax.device_count()} — was a jax backend initialised before conftest?"
+)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,221 @@
+"""An independent NumPy implementation of the reference's deconvnet
+semantics, used as the parity oracle for the JAX engine.
+
+The reference (app/deepdream.py) has no tests; SURVEY.md §4 prescribes a
+pure-NumPy port of its algorithm as the substitute oracle.  This module
+re-implements the *semantics* documented in SURVEY.md §2 from scratch —
+including the load-bearing quirks (§2.2):
+
+- conv layers carry a fused activation that is applied in BOTH directions
+  (the "double ReLU", SURVEY §2.2.2): up = act(conv(x)); down applies the
+  flipped-kernel conv AND THEN the fused activation again.
+- a separate activation entry follows each conv/dense and applies the same
+  activation in both directions (the deconvnet backward-ReLU).
+- dense backward is W^T with zero bias and NO fused activation
+  (reference builds a fresh linear Dense for down, app/deepdream.py:295).
+- pooling records one switch per window at the first row-major argmax and
+  unpools by kron-upsample x switch.
+- `find_top_filters` keeps only positive activation sums, sorts descending
+  (stable), returns up to `top` pairs.
+- mode 'max' zeroes everything but the positions equal to the feature map's
+  global max (ties all kept); mode 'all' keeps the whole map.
+- the engine deconvolves every model layer from the requested one down to
+  the input (SURVEY §2.2.3) — replicated here so parity can be checked for
+  the full sweep.
+
+Everything is written directly from those behavioural descriptions with
+naive loops / einsum — deliberately NOT a copy of either the reference code
+or the production ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def np_relu(x):
+    return np.maximum(x, 0.0)
+
+
+def np_softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+ACTS = {"relu": np_relu, "softmax": np_softmax, "linear": lambda x: x}
+
+
+def np_conv2d_same(x, w, b=None):
+    """SAME-padded stride-1 cross-correlation via einsum over shifted pads."""
+    bsz, h, wd, cin = x.shape
+    kh, kw, _, cout = w.shape
+    ph, pw = kh // 2, kw // 2
+    xp = np.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    out = np.zeros((bsz, h, wd, cout), dtype=np.float64)
+    for di in range(kh):
+        for dj in range(kw):
+            out += np.einsum(
+                "bhwc,co->bhwo", xp[:, di : di + h, dj : dj + wd, :], w[di, dj]
+            )
+    if b is not None:
+        out = out + b
+    return out
+
+
+def np_flip_kernel(w):
+    return np.transpose(w, (0, 1, 3, 2))[::-1, ::-1, :, :]
+
+
+def np_pool_with_switch(x, ph, pw):
+    b, h, w, c = x.shape
+    ho, wo = h // ph, w // pw
+    pooled = np.zeros((b, ho, wo, c))
+    switch = np.zeros_like(x, dtype=np.float64)
+    for n in range(b):
+        for ch in range(c):
+            for i in range(ho):
+                for j in range(wo):
+                    patch = x[n, i * ph : (i + 1) * ph, j * pw : (j + 1) * pw, ch]
+                    pooled[n, i, j, ch] = patch.max()
+                    k = int(patch.argmax())  # first occurrence, row-major
+                    switch[n, i * ph + k // pw, j * pw + k % pw, ch] = 1.0
+    return pooled, switch
+
+
+def np_unpool_with_switch(y, switch, ph, pw):
+    b, ho, wo, c = y.shape
+    up = np.repeat(np.repeat(y, ph, axis=1), pw, axis=2)
+    h, w = switch.shape[1], switch.shape[2]
+    full = np.zeros_like(switch)
+    full[:, : up.shape[1], : up.shape[2], :] = up
+    return full * switch
+
+
+class _Entry:
+    """One up/down step of the deconv chain (the reference's D-layer)."""
+
+    def __init__(self, name, up, down):
+        self.name = name
+        self.up = up
+        self.down = down
+        self.up_data = None
+
+
+def build_entries(spec, params):
+    """Build the (name, up, down) chain from a model spec.
+
+    `spec` is a list of dicts: {name, kind, activation?, pool_size?} with
+    kinds 'input' | 'conv' | 'pool' | 'flatten' | 'dense'; `params` maps
+    layer name -> {'w': ..., 'b': ...}.  Mirrors the reference's stack-build
+    walk (app/deepdream.py:401-423) including the companion activation
+    entries for conv/dense.
+    """
+    entries = []
+    state = {}
+    for layer in spec:
+        name, kind = layer["name"], layer["kind"]
+        act = layer.get("activation", "linear")
+        if kind == "input":
+            entries.append(_Entry(name, lambda x: x, lambda x: x))
+        elif kind == "conv":
+            w, bb = params[name]["w"], params[name]["b"]
+
+            def up(x, w=w, bb=bb, act=act):
+                return ACTS[act](np_conv2d_same(x, w, bb))
+
+            def down(x, w=w, act=act):
+                # flipped conv, zero bias, PLUS the fused activation — the
+                # reference's double-ReLU quirk (SURVEY §2.2.2)
+                return ACTS[act](np_conv2d_same(x, np_flip_kernel(w)))
+
+            entries.append(_Entry(name, up, down))
+            a = ACTS[act]
+            entries.append(_Entry(name + "_activation", a, a))
+        elif kind == "pool":
+            ph, pw = layer.get("pool_size", (2, 2))
+
+            def up(x, ph=ph, pw=pw, name=name):
+                pooled, sw = np_pool_with_switch(x, ph, pw)
+                state[name] = sw
+                return pooled
+
+            def down(x, ph=ph, pw=pw, name=name):
+                return np_unpool_with_switch(x, state[name], ph, pw)
+
+            entries.append(_Entry(name, up, down))
+        elif kind == "flatten":
+            shape_box = {}
+
+            def up(x, shape_box=shape_box):
+                shape_box["s"] = x.shape[1:]
+                return x.reshape(x.shape[0], -1)
+
+            def down(x, shape_box=shape_box):
+                return x.reshape((x.shape[0],) + shape_box["s"])
+
+            entries.append(_Entry(name, up, down))
+        elif kind == "dense":
+            w, bb = params[name]["w"], params[name]["b"]
+
+            def up(x, w=w, bb=bb, act=act):
+                return ACTS[act](x @ w + bb)
+
+            def down(x, w=w):
+                return x @ w.T  # linear, zero bias (no fused act on the way down)
+
+            entries.append(_Entry(name, up, down))
+            a = ACTS[act]
+            entries.append(_Entry(name + "_activation", a, a))
+        else:
+            raise ValueError(f"unknown kind {kind}")
+    return entries
+
+
+def find_top_filters(output, top=8):
+    """Positive-sum filters ranked descending; stable like list.sort
+    (reference: app/deepdream.py:369-380)."""
+    axes = tuple(range(output.ndim - 1))
+    sums = output.sum(axis=axes)
+    pairs = [(i, s) for i, s in enumerate(sums) if s > 0]
+    pairs.sort(key=lambda p: p[1], reverse=True)
+    return pairs[:top]
+
+
+def visualize_all_layers(spec, params, data, layer_name, visualize_mode="all", top=8):
+    """Full-sweep deconv oracle matching reference app/deepdream.py:383-476.
+
+    Returns {model_layer_name: [np.ndarray, ...]} for every model layer from
+    `layer_name` down to (but excluding) the input, deepest first.
+    """
+    model_names = [l["name"] for l in spec]
+    truncated = spec[: model_names.index(layer_name) + 1]
+    entries = build_entries(truncated, params)
+
+    x = data
+    for e in entries:
+        x = e.up(x)
+        e.up_data = x
+
+    name_set = set(model_names)
+    vis_indices = [i for i, e in enumerate(entries) if e.name in name_set]
+    vis_indices.reverse()
+    vis_indices.pop()  # drop the input layer
+
+    out = {}
+    for i in vis_indices:
+        output = entries[i].up_data
+        results = []
+        for fidx, _ in find_top_filters(output, top):
+            fmap = output[..., fidx]
+            if visualize_mode == "max":
+                fmap = fmap * (fmap == fmap.max())
+            elif visualize_mode != "all":
+                raise ValueError("illegal visualize mode")
+            seed = np.zeros_like(output)
+            seed[..., fidx] = fmap
+            sig = entries[i].down(seed)
+            for j in range(i - 1, -1, -1):
+                sig = entries[j].down(sig)
+            results.append(np.squeeze(sig))
+        out[entries[i].name] = results
+    return out

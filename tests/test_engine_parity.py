@@ -1,0 +1,127 @@
+"""End-to-end parity: the jitted deconv engine vs the independent NumPy
+oracle, on a small VGG-shaped model with random weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deconv_api_tpu.engine import visualize, visualize_all_layers
+from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+from tests import reference_numpy as ref
+
+TINY = ModelSpec(
+    name="tiny_vgg",
+    input_shape=(16, 16, 3),
+    layers=(
+        Layer("input_1", "input"),
+        Layer("b1c1", "conv", activation="relu", filters=8),
+        Layer("b1c2", "conv", activation="relu", filters=8),
+        Layer("b1p", "pool"),
+        Layer("b2c1", "conv", activation="relu", filters=12),
+        Layer("b2p", "pool"),
+        Layer("flatten", "flatten"),
+        Layer("fc1", "dense", activation="relu", filters=20),
+        Layer("predictions", "dense", activation="softmax", filters=10),
+    ),
+)
+
+
+def _np_spec():
+    return [
+        {"name": "input_1", "kind": "input"},
+        {"name": "b1c1", "kind": "conv", "activation": "relu"},
+        {"name": "b1c2", "kind": "conv", "activation": "relu"},
+        {"name": "b1p", "kind": "pool", "pool_size": (2, 2)},
+        {"name": "b2c1", "kind": "conv", "activation": "relu"},
+        {"name": "b2p", "kind": "pool", "pool_size": (2, 2)},
+        {"name": "flatten", "kind": "flatten"},
+        {"name": "fc1", "kind": "dense", "activation": "relu"},
+        {"name": "predictions", "kind": "dense", "activation": "softmax"},
+    ]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(TINY, jax.random.PRNGKey(42))
+    np_params = jax.tree.map(lambda a: np.asarray(a, np.float64), params)
+    img = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(7), (16, 16, 3)), np.float32
+    )
+    return params, np_params, img
+
+
+@pytest.mark.parametrize("layer_name", ["b1c2", "b1p", "b2c1", "fc1", "predictions"])
+@pytest.mark.parametrize("mode", ["all", "max"])
+def test_single_layer_parity(setup, layer_name, mode):
+    params, np_params, img = setup
+    got = visualize(TINY, params, jnp.asarray(img), layer_name, mode=mode)
+    want = ref.visualize_all_layers(
+        _np_spec(), np_params, img[None].astype(np.float64), layer_name, mode
+    )[layer_name]
+    valid = np.asarray(got["valid"])
+    idxs = np.asarray(got["indices"])
+    images = np.asarray(got["images"])
+    assert valid.sum() == len(want), (
+        f"engine found {valid.sum()} positive filters, oracle {len(want)}"
+    )
+    oracle_idx = [
+        i
+        for i, _ in ref.find_top_filters(
+            _oracle_output(np_params, img, layer_name), top=8
+        )
+    ]
+    np.testing.assert_array_equal(idxs[: len(oracle_idx)], oracle_idx)
+    for k in range(int(valid.sum())):
+        np.testing.assert_allclose(
+            images[k], want[k], rtol=1e-3, atol=1e-4,
+            err_msg=f"layer {layer_name} filter rank {k}",
+        )
+
+
+def _oracle_output(np_params, img, layer_name):
+    spec = _np_spec()
+    names = [l["name"] for l in spec]
+    entries = ref.build_entries(spec[: names.index(layer_name) + 1], np_params)
+    x = img[None].astype(np.float64)
+    for e in entries:
+        x = e.up(x)
+        e.up_data = x
+    return next(e for e in entries if e.name == layer_name).up_data
+
+
+def test_all_layers_sweep_parity(setup):
+    params, np_params, img = setup
+    got = visualize_all_layers(TINY, params, jnp.asarray(img), "b2c1")
+    want = ref.visualize_all_layers(
+        _np_spec(), np_params, img[None].astype(np.float64), "b2c1", "all"
+    )
+    assert set(got) == set(want)
+    for name in want:
+        valid = np.asarray(got[name]["valid"])
+        assert valid.sum() == len(want[name])
+        for k in range(len(want[name])):
+            np.testing.assert_allclose(
+                np.asarray(got[name]["images"][k]), want[name][k],
+                rtol=1e-3, atol=1e-4, err_msg=f"{name}[{k}]",
+            )
+
+
+def test_bug_compat_off_differs(setup):
+    """bug_compat=False drops the double-ReLU — output must differ."""
+    params, _, img = setup
+    a = visualize(TINY, params, jnp.asarray(img), "b2c1", bug_compat=True)
+    b = visualize(TINY, params, jnp.asarray(img), "b2c1", bug_compat=False)
+    assert not np.allclose(np.asarray(a["images"]), np.asarray(b["images"]))
+
+
+def test_illegal_mode_raises(setup):
+    params, _, img = setup
+    with pytest.raises(ValueError, match="illegal visualize mode"):
+        visualize(TINY, params, jnp.asarray(img), "b2c1", mode="banana")
+
+
+def test_unknown_layer_raises(setup):
+    params, _, img = setup
+    with pytest.raises(KeyError, match="no layer"):
+        visualize(TINY, params, jnp.asarray(img), "nope")

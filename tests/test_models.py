@@ -1,0 +1,36 @@
+"""Model zoo structure tests."""
+
+import jax
+import numpy as np
+
+from deconv_api_tpu.models import VGG16_SPEC, init_params, layer_output_shapes
+from deconv_api_tpu.models.vgg16 import CONV_LAYER_NAMES
+
+
+def test_vgg16_layer_names_match_keras():
+    names = VGG16_SPEC.layer_names()
+    assert names[0] == "input_1"
+    assert "block5_conv1" in names
+    assert names[-3:] == ["fc1", "fc2", "predictions"]
+    assert len(CONV_LAYER_NAMES) == 13
+
+
+def test_vgg16_output_shapes():
+    shapes = layer_output_shapes(VGG16_SPEC)
+    assert shapes["block1_conv1"] == (224, 224, 64)
+    assert shapes["block3_pool"] == (28, 28, 256)
+    assert shapes["block5_conv1"] == (14, 14, 512)
+    assert shapes["block5_pool"] == (7, 7, 512)
+    assert shapes["flatten"] == (7 * 7 * 512,)
+    assert shapes["fc1"] == (4096,)
+    assert shapes["predictions"] == (1000,)
+
+
+def test_vgg16_param_shapes():
+    params = init_params(VGG16_SPEC, jax.random.PRNGKey(0))
+    assert params["block1_conv1"]["w"].shape == (3, 3, 3, 64)
+    assert params["block5_conv3"]["w"].shape == (3, 3, 512, 512)
+    assert params["fc1"]["w"].shape == (25088, 4096)
+    assert params["predictions"]["w"].shape == (4096, 1000)
+    n = sum(int(np.prod(v.shape)) for p in params.values() for v in p.values())
+    assert n == 138_357_544  # published VGG16 include_top param count

@@ -1,0 +1,158 @@
+"""Unit tests for the core ops library against straightforward NumPy math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deconv_api_tpu import ops
+
+
+def naive_conv2d_same(x, w, b):
+    """O(n^4) direct convolution (cross-correlation), SAME padding, stride 1."""
+    bsz, h, wd, cin = x.shape
+    kh, kw, _, cout = w.shape
+    ph, pw = kh // 2, kw // 2
+    xp = np.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    out = np.zeros((bsz, h, wd, cout))
+    for i in range(h):
+        for j in range(wd):
+            patch = xp[:, i : i + kh, j : j + kw, :]  # (B, kh, kw, cin)
+            out[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3], [0, 1, 2]))
+    return out + b
+
+
+def test_conv2d_matches_naive(rng):
+    x = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 3, 5)).astype(np.float32)
+    b = rng.standard_normal((5,)).astype(np.float32)
+    got = np.asarray(ops.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+    want = naive_conv2d_same(x, w, b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_input_backward_is_flipped_conv(rng):
+    """Stride-1 SAME backward == conv with channel-swapped, flipped kernel."""
+    y = rng.standard_normal((1, 8, 8, 5)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 3, 5)).astype(np.float32)
+    got = np.asarray(ops.conv2d_input_backward(jnp.asarray(y), jnp.asarray(w)))
+    wf = np.transpose(w, (0, 1, 3, 2))[::-1, ::-1, :, :]
+    want = naive_conv2d_same(y, wf, np.zeros(3, np.float32))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_input_backward_strided_is_exact_transpose(rng):
+    """Strided backward == linear transpose of the forward conv (checked via
+    the adjoint identity <conv(x), y> == <x, conv_bwd(y)>)."""
+    x = rng.standard_normal((1, 8, 8, 3)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 3, 4)).astype(np.float32)
+    for padding in ("SAME", "VALID"):
+        y_fwd = ops.conv2d(jnp.asarray(x), jnp.asarray(w), strides=(2, 2), padding=padding)
+        y = rng.standard_normal(y_fwd.shape).astype(np.float32)
+        x_bar = ops.conv2d_input_backward(
+            jnp.asarray(y), jnp.asarray(w), strides=(2, 2), padding=padding,
+            input_hw=(8, 8),
+        )
+        lhs = float(jnp.vdot(y_fwd, jnp.asarray(y)))
+        rhs = float(jnp.vdot(jnp.asarray(x), x_bar))
+        assert lhs == pytest.approx(rhs, rel=1e-4)
+
+
+def naive_pool_with_switch(x, ph, pw):
+    """Direct-translation pooling: first row-major max per window."""
+    b, h, w, c = x.shape
+    ho, wo = h // ph, w // pw
+    pooled = np.zeros((b, ho, wo, c))
+    switch = np.zeros_like(x)
+    for n in range(b):
+        for ch in range(c):
+            for i in range(ho):
+                for j in range(wo):
+                    patch = x[n, i * ph : (i + 1) * ph, j * pw : (j + 1) * pw, ch]
+                    pooled[n, i, j, ch] = patch.max()
+                    flat_idx = int(patch.argmax())  # first occurrence row-major
+                    switch[n, i * ph + flat_idx // pw, j * pw + flat_idx % pw, ch] = 1
+    return pooled, switch
+
+
+def test_maxpool_with_switches_matches_naive(rng):
+    x = rng.standard_normal((2, 6, 6, 3)).astype(np.float32)
+    pooled, switch = ops.maxpool_with_switches(jnp.asarray(x), (2, 2))
+    want_p, want_s = naive_pool_with_switch(x, 2, 2)
+    np.testing.assert_allclose(np.asarray(pooled), want_p, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(switch), want_s)
+
+
+def test_maxpool_tie_break_first_row_major():
+    """All-equal windows must put the switch at the window's top-left."""
+    x = jnp.ones((1, 4, 4, 1), jnp.float32)
+    pooled, switch = ops.maxpool_with_switches(x, (2, 2))
+    want = np.zeros((1, 4, 4, 1))
+    want[0, ::2, ::2, 0] = 1
+    np.testing.assert_array_equal(np.asarray(switch), want)
+    np.testing.assert_allclose(np.asarray(pooled), np.ones((1, 2, 2, 1)))
+
+
+def test_maxpool_odd_dims_floor_dropped(rng):
+    x = rng.standard_normal((1, 5, 7, 2)).astype(np.float32)
+    pooled, switch = ops.maxpool_with_switches(jnp.asarray(x), (2, 2))
+    assert pooled.shape == (1, 2, 3, 2)
+    assert switch.shape == (1, 5, 7, 2)
+    # dropped trailing row/cols never carry a switch
+    assert np.asarray(switch)[:, 4:, :, :].sum() == 0
+    assert np.asarray(switch)[:, :, 6:, :].sum() == 0
+
+
+def test_unpool_scatters_to_switch_positions(rng):
+    x = rng.standard_normal((2, 6, 6, 3)).astype(np.float32)
+    pooled, switch = ops.maxpool_with_switches(jnp.asarray(x), (2, 2))
+    unpooled = ops.unpool_with_switches(pooled, switch, (2, 2))
+    # kron(pooled, ones) * switch, per reference app/deepdream.py:191-209
+    want = np.zeros_like(x)
+    p, s = np.asarray(pooled), np.asarray(switch)
+    for n in range(2):
+        for ch in range(3):
+            want[n, :, :, ch] = np.kron(p[n, :, :, ch], np.ones((2, 2))) * s[n, :, :, ch]
+    np.testing.assert_allclose(np.asarray(unpooled), want, rtol=1e-6)
+
+
+def test_maxpool_switched_vjp_routes_through_switches(rng):
+    x = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
+    pooled, vjp_fn = jax.vjp(lambda a: ops.maxpool_switched(a, (2, 2)), jnp.asarray(x))
+    g = rng.standard_normal(pooled.shape).astype(np.float32)
+    (x_bar,) = vjp_fn(jnp.asarray(g))
+    _, switch = ops.maxpool_with_switches(jnp.asarray(x), (2, 2))
+    want = ops.unpool_with_switches(jnp.asarray(g), switch, (2, 2))
+    np.testing.assert_allclose(np.asarray(x_bar), np.asarray(want), rtol=1e-6)
+
+
+def test_dense_roundtrip(rng):
+    x = rng.standard_normal((3, 7)).astype(np.float32)
+    w = rng.standard_normal((7, 4)).astype(np.float32)
+    b = rng.standard_normal((4,)).astype(np.float32)
+    y = ops.dense(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(y), x @ w + b, rtol=1e-4)
+    back = ops.dense_input_backward(y, jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(y) @ w.T, rtol=1e-4)
+
+
+def test_flatten_unflatten_roundtrip(rng):
+    x = rng.standard_normal((2, 3, 4, 5)).astype(np.float32)
+    flat = ops.flatten(jnp.asarray(x))
+    assert flat.shape == (2, 60)
+    back = ops.unflatten(flat, (3, 4, 5))
+    np.testing.assert_array_equal(np.asarray(back), x)
+
+
+def test_deconv_relu_vjp_applies_relu_to_cotangent():
+    x = jnp.asarray([-2.0, -1.0, 1.0, 2.0])
+    y, vjp_fn = jax.vjp(ops.deconv_relu, x)
+    np.testing.assert_allclose(np.asarray(y), [0, 0, 1, 2])
+    (g,) = vjp_fn(jnp.asarray([-3.0, 3.0, -3.0, 3.0]))
+    # deconvnet rule: relu(g), independent of forward sign
+    np.testing.assert_allclose(np.asarray(g), [0, 3, 0, 3])
+
+
+def test_apply_activation_unknown_raises():
+    with pytest.raises(ValueError):
+        ops.apply_activation(jnp.zeros(3), "gelu6")

@@ -1,0 +1,47 @@
+"""Parity: the XLA ops against the independent NumPy oracle
+(tests/reference_numpy.py), on randomized inputs."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deconv_api_tpu import ops
+from tests import reference_numpy as ref
+
+
+def test_conv_forward_parity(rng):
+    x = rng.standard_normal((2, 9, 9, 4)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 4, 6)).astype(np.float32)
+    b = rng.standard_normal((6,)).astype(np.float32)
+    got = np.asarray(ops.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+    want = ref.np_conv2d_same(x, w, b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_backward_parity(rng):
+    y = rng.standard_normal((1, 9, 9, 6)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 4, 6)).astype(np.float32)
+    got = np.asarray(ops.conv2d_input_backward(jnp.asarray(y), jnp.asarray(w)))
+    want = ref.np_conv2d_same(y, ref.np_flip_kernel(w))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_pool_unpool_parity(rng):
+    x = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    pooled, switch = ops.maxpool_with_switches(jnp.asarray(x), (2, 2))
+    want_p, want_s = ref.np_pool_with_switch(x, 2, 2)
+    np.testing.assert_allclose(np.asarray(pooled), want_p, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(switch), want_s)
+
+    g = rng.standard_normal(pooled.shape).astype(np.float32)
+    got_u = np.asarray(ops.unpool_with_switches(jnp.asarray(g), switch, (2, 2)))
+    want_u = ref.np_unpool_with_switch(g, want_s, 2, 2)
+    np.testing.assert_allclose(got_u, want_u, rtol=1e-6)
+
+
+def test_find_top_filters_semantics(rng):
+    out = rng.standard_normal((1, 4, 4, 10)).astype(np.float64)
+    pairs = ref.find_top_filters(out, top=8)
+    assert all(s > 0 for _, s in pairs)
+    sums = [s for _, s in pairs]
+    assert sums == sorted(sums, reverse=True)
+    assert len(pairs) <= 8
