@@ -1,0 +1,281 @@
+"""The deconv service: wire-compatible routes + TPU dispatch pipeline.
+
+Wire surface (byte-compatible with reference app/main.py so its React client
+works unchanged):
+- ``GET /health-check`` → ``{"healthy": "true"}`` (string, not bool — kept,
+  app/main.py:41-43).  Liveness only, like the reference.
+- ``POST /`` with form fields ``file`` (data-URI image) and ``layer`` →
+  JSON-encoded data-URL string of the stitched top-4 grid (app/main.py:45-78).
+
+Extensions (SURVEY §5):
+- ``GET /ready`` — readiness: 200 once the model's executable is compiled.
+- ``GET /metrics`` — Prometheus text exposition.
+- ``POST /v1/deconv`` — JSON API exposing the knobs the reference hardcodes
+  (mode incl. 'max' — unreachable over HTTP in the reference, SURVEY §3.4 —
+  top_k, per-filter images instead of a stitched grid).
+
+Request flow: decode → resize → caffe-preprocess (host), then submit to the
+BatchingDispatcher, which batches concurrent requests into one padded XLA
+execution on the device (SURVEY §2.4's data-parallel request batching).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import numpy as np
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.config import ServerConfig, apply_platform, enable_compilation_cache
+from deconv_api_tpu.engine import get_visualizer
+from deconv_api_tpu.serving import codec
+from deconv_api_tpu.serving.batcher import BatchingDispatcher, pad_bucket
+from deconv_api_tpu.serving.http import HttpServer, Request, Response
+from deconv_api_tpu.serving.metrics import Metrics
+from deconv_api_tpu.utils.tracing import stage
+
+
+def _model_registry():
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    return {"vgg16": vgg16_init}
+
+
+class DeconvService:
+    """Owns the model, the dispatcher and the HTTP routes."""
+
+    def __init__(self, cfg: ServerConfig | None = None, *, spec=None, params=None):
+        self.cfg = cfg or ServerConfig.from_env()
+        apply_platform(self.cfg)
+        enable_compilation_cache(self.cfg)
+        if spec is None:
+            registry = _model_registry()
+            if self.cfg.model not in registry:
+                raise errors.UnknownModel(
+                    f"unknown model {self.cfg.model!r}; available: {sorted(registry)}"
+                )
+            spec, params = registry[self.cfg.model]()
+            if self.cfg.weights_path:
+                from deconv_api_tpu.models.weights import load_weights
+
+                params = load_weights(spec, self.cfg.weights_path, params)
+        self.spec = spec
+        self.params = params
+        self.metrics = Metrics()
+        self.ready = False
+        self.dispatcher = BatchingDispatcher(
+            self._run_batch,
+            max_batch=self.cfg.max_batch,
+            window_ms=self.cfg.batch_window_ms,
+            request_timeout_s=self.cfg.request_timeout_s,
+            metrics=self.metrics,
+        )
+        self.server = HttpServer()
+        self.server.route("GET", "/health-check")(self._health)
+        self.server.route("GET", "/ready")(self._ready)
+        self.server.route("GET", "/metrics")(self._metrics)
+        self.server.route("POST", "/")(self._deconv_compat)
+        self.server.route("POST", "/v1/deconv")(self._deconv_v1)
+
+    # ---------------------------------------------------------- device side
+
+    def _run_batch(self, key, images: list[np.ndarray]):
+        """Execute one (layer, mode, top_k) group as a single padded batch.
+
+        Runs in a worker thread (never on the event loop).  Batch is padded
+        to a power-of-two bucket so XLA compiles at most log2(max_batch)+1
+        batch shapes per key.
+        """
+        import jax.numpy as jnp
+
+        layer_name, mode, top_k = key
+        fn = get_visualizer(
+            self.spec, layer_name, top_k, mode, self.cfg.bug_compat,
+            sweep=False, batched=True,
+        )
+        bucket = pad_bucket(len(images), self.cfg.max_batch)
+        batch = np.stack(images + [images[-1]] * (bucket - len(images)))
+        out = fn(self.params, jnp.asarray(batch))[layer_name]
+        imgs = np.asarray(out["images"])  # (B, K, H, W, C)
+        valid = np.asarray(out["valid"])  # (B, K)
+        indices = np.asarray(out["indices"])
+        return [
+            {"images": imgs[i], "valid": valid[i], "indices": indices[i]}
+            for i in range(len(images))
+        ]
+
+    def warmup(self, layer_name: str | None = None) -> None:
+        """Compile a representative executable so /ready flips before traffic."""
+        names = self.spec.layer_names()
+        layer = layer_name
+        if layer is None or layer not in names:
+            # default: the flagship layer if present, else the deepest conv,
+            # else the deepest non-input layer
+            convs = [l.name for l in self.spec.layers if l.kind == "conv"]
+            layer = (
+                "block5_conv1"
+                if "block5_conv1" in names
+                else (convs[-1] if convs else names[-1])
+            )
+        img = np.zeros((self.cfg.image_size, self.cfg.image_size, 3), np.float32)
+        self._run_batch((layer, self.cfg.visualize_mode, self.cfg.top_k), [img])
+        self.ready = True
+
+    # ----------------------------------------------------------- pipeline
+
+    async def _project(self, form: dict[str, str], mode: str, top_k: int):
+        file_uri = form.get("file")
+        layer = form.get("layer")
+        if not file_uri or not layer:
+            raise errors.BadRequest("form fields 'file' and 'layer' are required")
+        if layer not in self.spec.layer_names():
+            raise errors.UnknownLayer(
+                f"model {self.spec.name!r} has no layer {layer!r}"
+            )
+        if self.spec.index(layer) == 0:
+            raise errors.UnknownLayer(
+                f"layer {layer!r} is the input layer; nothing to project"
+            )
+        with stage(self.metrics, "decode"):
+            try:
+                img = codec.decode_data_url(file_uri)
+            except codec.CodecError as e:
+                raise errors.InvalidImage(str(e)) from e
+            img = codec.resize224(img, (self.cfg.image_size, self.cfg.image_size))
+            x = codec.preprocess_vgg(img)
+
+        with stage(self.metrics, "compute"):
+            result = await self.dispatcher.submit(x, (layer, mode, top_k))
+        return result
+
+    # ------------------------------------------------------------- routes
+
+    async def _health(self, _req: Request) -> Response:
+        return Response.json({"healthy": "true"})
+
+    async def _ready(self, _req: Request) -> Response:
+        if self.ready:
+            return Response.json({"ready": True})
+        return Response.json({"ready": False}, status=503)
+
+    async def _metrics(self, _req: Request) -> Response:
+        return Response.text(self.metrics.prometheus(), content_type="text/plain; version=0.0.4")
+
+    async def _deconv_compat(self, req: Request) -> Response:
+        """POST / — the reference's endpoint, wire-compatible."""
+        t0 = time.perf_counter()
+        try:
+            form = _parse_form(req)
+            result = await self._project(
+                form, self.cfg.visualize_mode, self.cfg.top_k
+            )
+            n_valid = int(result["valid"].sum())
+            if self.cfg.strict_compat and n_valid < self.cfg.stitch_k:
+                raise errors.NoActiveFilters(
+                    f"only {n_valid} filters fired; need {self.cfg.stitch_k}"
+                )
+            tiles = [result["images"][k] for k in range(min(n_valid, self.cfg.stitch_k))]
+            with stage(self.metrics, "encode"):
+                grid = codec.stitch_grid(tiles)
+                data_url = codec.encode_data_url(codec.deprocess_image(grid))
+        except errors.DeconvError as e:
+            self.metrics.observe_request(time.perf_counter() - t0, e.code)
+            return Response.json({"error": e.code, "detail": e.message}, e.status)
+        except ValueError as e:
+            self.metrics.observe_request(time.perf_counter() - t0, "bad_request")
+            return Response.json({"error": "bad_request", "detail": str(e)}, 400)
+        self.metrics.observe_request(time.perf_counter() - t0)
+        # FastAPI JSON-encodes the returned string (reference app/main.py:78).
+        return Response.json(data_url)
+
+    async def _deconv_v1(self, req: Request) -> Response:
+        """POST /v1/deconv — JSON API over the same engine, exposing knobs."""
+        t0 = time.perf_counter()
+        try:
+            form = _parse_form(req)
+            mode = form.get("mode", self.cfg.visualize_mode)
+            if mode not in ("all", "max"):
+                raise errors.IllegalMode(f"mode must be 'all' or 'max', got {mode!r}")
+            top_k = int(form.get("top_k", self.cfg.top_k))
+            if not 1 <= top_k <= 64:
+                raise errors.BadRequest("top_k must be in [1, 64]")
+            result = await self._project(form, mode, top_k)
+            n_valid = int(result["valid"].sum())
+            images = [
+                codec.encode_data_url(codec.deprocess_image(result["images"][k]))
+                for k in range(n_valid)
+            ]
+        except errors.DeconvError as e:
+            self.metrics.observe_request(time.perf_counter() - t0, e.code)
+            return Response.json({"error": e.code, "detail": e.message}, e.status)
+        except ValueError as e:
+            self.metrics.observe_request(time.perf_counter() - t0, "bad_request")
+            return Response.json({"error": "bad_request", "detail": str(e)}, 400)
+        self.metrics.observe_request(time.perf_counter() - t0)
+        return Response.json(
+            {
+                "layer": form["layer"],
+                "mode": mode,
+                "filters": [int(i) for i in result["indices"][:n_valid]],
+                "images": images,
+            }
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self, host: str | None = None, port: int | None = None) -> int:
+        await self.dispatcher.start()
+        return await self.server.start(
+            host if host is not None else self.cfg.host,
+            self.cfg.port if port is None else port,
+        )
+
+    async def stop(self) -> None:
+        await self.server.stop()
+        await self.dispatcher.stop()
+
+
+def _parse_form(req: Request) -> dict[str, str]:
+    try:
+        return req.form()
+    except (ValueError, json.JSONDecodeError) as e:
+        raise errors.BadRequest(f"unparseable form body: {e}") from e
+
+
+async def serve_forever(cfg: ServerConfig) -> None:
+    service = DeconvService(cfg)
+    port = await service.start()
+    print(f"deconv_api_tpu serving on {service.cfg.host}:{port}", flush=True)
+    await asyncio.to_thread(service.warmup)
+    print("model warmed up; /ready now 200", flush=True)
+    await asyncio.Event().wait()
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="deconv_api_tpu server")
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--model", default=None)
+    p.add_argument("--weights", default=None)
+    p.add_argument("--platform", default=None, help="force jax backend, e.g. cpu")
+    args = p.parse_args(argv)
+    overrides = {}
+    if args.host is not None:
+        overrides["host"] = args.host
+    if args.port is not None:
+        overrides["port"] = args.port
+    if args.model is not None:
+        overrides["model"] = args.model
+    if args.weights is not None:
+        overrides["weights_path"] = args.weights
+    if args.platform is not None:
+        overrides["platform"] = args.platform
+    asyncio.run(serve_forever(ServerConfig.from_env(**overrides)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Codec tests: wire-format fidelity with the reference (SURVEY §2.2, L3)."""
+
+import base64
+from urllib.parse import unquote
+
+import numpy as np
+import pytest
+
+from deconv_api_tpu.serving import codec
+
+
+def _png_data_url(img_bgr: np.ndarray) -> str:
+    import cv2
+
+    ok, buf = cv2.imencode(".png", img_bgr)
+    assert ok
+    return "data:image/png;base64," + base64.b64encode(buf.tobytes()).decode()
+
+
+def test_decode_data_url_roundtrip(rng):
+    img = (rng.random((32, 32, 3)) * 255).astype(np.uint8)
+    out = codec.decode_data_url(_png_data_url(img))
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.uint8
+    np.testing.assert_array_equal(out, img)  # PNG is lossless
+
+
+def test_decode_bare_base64_accepted(rng):
+    img = (rng.random((16, 16, 3)) * 255).astype(np.uint8)
+    uri = _png_data_url(img).split(",", 1)[1]
+    assert codec.decode_data_url(uri).shape == (16, 16, 3)
+
+
+def test_decode_garbage_raises_codec_error():
+    with pytest.raises(codec.CodecError):
+        codec.decode_data_url("data:image/png;base64,%%%%not-base64")
+    with pytest.raises(codec.CodecError):
+        codec.decode_data_url("data:image/png;base64," + base64.b64encode(b"nope").decode())
+
+
+def test_preprocess_vgg_flips_and_subtracts():
+    img = np.zeros((2, 2, 3), np.uint8)
+    img[..., 0] = 10  # B
+    img[..., 2] = 30  # R
+    x = codec.preprocess_vgg(img)
+    # channel flip: output[...,0] is the old R channel, minus mean[0]
+    np.testing.assert_allclose(x[0, 0, 0], 30 - codec.CAFFE_MEANS_BGR[0], rtol=1e-6)
+    np.testing.assert_allclose(x[0, 0, 2], 10 - codec.CAFFE_MEANS_BGR[2], rtol=1e-6)
+
+
+def test_deprocess_image_range_and_dtype(rng):
+    x = rng.standard_normal((8, 8, 3)) * 7 + 3
+    out = codec.deprocess_image(x)
+    assert out.dtype == np.uint8
+    # mean maps to 0.5*255
+    assert 100 < out.mean() < 155
+
+
+def test_stitch_grid_2x2(rng):
+    tiles = [np.full((4, 4, 3), i, np.float32) for i in range(4)]
+    grid = codec.stitch_grid(tiles)
+    assert grid.shape == (8, 8, 3)
+    assert (grid[:4, :4] == 0).all() and (grid[:4, 4:] == 1).all()
+    assert (grid[4:, :4] == 2).all() and (grid[4:, 4:] == 3).all()
+
+
+def test_stitch_grid_pads_missing_tiles(rng):
+    tiles = [np.ones((4, 4, 3), np.float32)]
+    grid = codec.stitch_grid(tiles)
+    assert grid.shape == (8, 8, 3)
+    assert (grid[4:, :] == 0).all()  # padded tiles are zero
+
+
+def test_encode_data_url_wire_format(rng):
+    img = (rng.random((8, 8, 3)) * 255).astype(np.uint8)
+    url = codec.encode_data_url(img)
+    # the reference's mislabeled prefix + percent-quoted base64 (app/main.py:76)
+    assert url.startswith("data:image/webp;base64,")
+    payload = unquote(url.split(",", 1)[1])
+    raw = base64.b64decode(payload)
+    assert raw[:2] == b"\xff\xd8"  # actually JPEG, as in the reference

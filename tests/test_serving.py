@@ -1,0 +1,270 @@
+"""End-to-end serving tests: real HTTP over a socket against the asyncio
+server, tiny model, CPU."""
+
+import asyncio
+import base64
+import json
+import threading
+import time
+from urllib.parse import unquote
+
+import httpx
+import numpy as np
+import pytest
+
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.models.spec import init_params
+from deconv_api_tpu.serving.app import DeconvService
+from deconv_api_tpu.serving.batcher import BatchingDispatcher, pad_bucket
+from tests.test_engine_parity import TINY
+
+import jax
+
+
+class ServiceFixture:
+    """Runs the asyncio service in a background thread; exposes base_url."""
+
+    def __init__(self, cfg):
+        spec = TINY
+        params = init_params(spec, jax.random.PRNGKey(3))
+        self.service = DeconvService(cfg, spec=spec, params=params)
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._started = threading.Event()
+        self.port = None
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self.port = await self.service.start("127.0.0.1", 0)
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        self._loop.run_forever()
+
+    def __enter__(self):
+        self._thread.start()
+        assert self._started.wait(10)
+        self.service.ready = True
+        return self
+
+    def __exit__(self, *exc):
+        async def shutdown():
+            await self.service.stop()
+
+        fut = asyncio.run_coroutine_threadsafe(shutdown(), self._loop)
+        fut.result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(10)
+
+    @property
+    def base_url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = ServerConfig(
+        image_size=16, max_batch=4, batch_window_ms=1.0, compilation_cache_dir=""
+    )
+    with ServiceFixture(cfg) as s:
+        yield s
+
+
+def _data_url(rng_seed=0, size=16):
+    import cv2
+
+    rng = np.random.default_rng(rng_seed)
+    img = (rng.random((size, size, 3)) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".png", img)
+    assert ok
+    return "data:image/png;base64," + base64.b64encode(buf.tobytes()).decode()
+
+
+def test_health_check_wire_parity(server):
+    r = httpx.get(server.base_url + "/health-check")
+    assert r.status_code == 200
+    # exact reference payload: string "true", not a bool (app/main.py:43)
+    assert r.json() == {"healthy": "true"}
+    assert r.headers["access-control-allow-origin"] == "*"
+
+
+def test_post_deconv_compat_endpoint(server):
+    r = httpx.post(
+        server.base_url + "/",
+        data={"file": _data_url(), "layer": "b2c1"},
+        timeout=60,
+    )
+    assert r.status_code == 200, r.text
+    data_url = r.json()  # JSON-encoded string, like FastAPI (app/main.py:78)
+    assert isinstance(data_url, str)
+    assert data_url.startswith("data:image/webp;base64,")
+    raw = base64.b64decode(unquote(data_url.split(",", 1)[1]))
+    assert raw[:2] == b"\xff\xd8"  # JPEG magic
+    import cv2
+
+    img = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+    assert img.shape == (32, 32, 3)  # 2x2 grid of 16x16 tiles
+
+
+def test_post_multipart_also_accepted(server):
+    r = httpx.post(
+        server.base_url + "/",
+        files={"file": (None, _data_url()), "layer": (None, "b1c1")},
+        timeout=60,
+    )
+    assert r.status_code == 200, r.text
+
+
+def test_missing_fields_400(server):
+    r = httpx.post(server.base_url + "/", data={"layer": "b2c1"})
+    assert r.status_code == 400
+    assert r.json()["error"] == "bad_request"
+
+
+def test_unknown_layer_422_not_process_death(server):
+    # the reference sys.exit()s the whole server on bad layer config
+    # (app/deepdream.py:418-421); we return 422 and stay alive
+    r = httpx.post(
+        server.base_url + "/", data={"file": _data_url(), "layer": "nope"}
+    )
+    assert r.status_code == 422
+    assert r.json()["error"] == "unknown_layer"
+    assert httpx.get(server.base_url + "/health-check").status_code == 200
+
+
+def test_invalid_image_400(server):
+    r = httpx.post(
+        server.base_url + "/",
+        data={"file": "data:image/png;base64,aGVsbG8=", "layer": "b2c1"},
+    )
+    assert r.status_code == 400
+    assert r.json()["error"] == "invalid_image"
+
+
+def test_v1_deconv_json_api(server):
+    r = httpx.post(
+        server.base_url + "/v1/deconv",
+        data={"file": _data_url(), "layer": "b2c1", "mode": "max", "top_k": "3"},
+        timeout=60,
+    )
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["mode"] == "max"
+    assert len(body["filters"]) == len(body["images"]) <= 3
+
+
+def test_v1_illegal_mode_422(server):
+    r = httpx.post(
+        server.base_url + "/v1/deconv",
+        data={"file": _data_url(), "layer": "b2c1", "mode": "banana"},
+    )
+    assert r.status_code == 422
+    assert r.json()["error"] == "illegal_visualize_mode"
+
+
+def test_ready_and_metrics_endpoints(server):
+    assert httpx.get(server.base_url + "/ready").status_code == 200
+    m = httpx.get(server.base_url + "/metrics")
+    assert m.status_code == 200
+    assert "deconv_requests_total" in m.text
+
+
+def test_options_preflight_cors(server):
+    r = httpx.options(server.base_url + "/")
+    assert r.status_code == 204
+    assert r.headers["access-control-allow-origin"] == "*"
+
+
+def test_404_unknown_route(server):
+    assert httpx.get(server.base_url + "/nope").status_code == 404
+
+
+def test_concurrent_requests_are_batched(server):
+    """Fire concurrent requests; the dispatcher must coalesce them."""
+    before = server.service.metrics.snapshot()
+
+    def one(i):
+        return httpx.post(
+            server.base_url + "/",
+            data={"file": _data_url(i), "layer": "b2c1"},
+            timeout=60,
+        ).status_code
+
+    threads = []
+    results = []
+    for i in range(8):
+        t = threading.Thread(target=lambda i=i: results.append(one(i)))
+        threads.append(t)
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert results == [200] * 8
+    after = server.service.metrics.snapshot()
+    new_images = after["images_total"] - before["images_total"]
+    new_batches = after["batches_total"] - before["batches_total"]
+    assert new_images >= 8
+    assert new_batches < new_images, "expected at least one multi-request batch"
+
+
+def test_pad_bucket():
+    assert [pad_bucket(n, 8) for n in (1, 2, 3, 4, 5, 8, 9)] == [1, 2, 4, 4, 8, 8, 8]
+
+
+def test_batcher_propagates_runner_errors():
+    async def go():
+        def runner(key, images):
+            raise RuntimeError("boom")
+
+        d = BatchingDispatcher(runner, max_batch=2, window_ms=1.0, request_timeout_s=5)
+        await d.start()
+        with pytest.raises(RuntimeError, match="boom"):
+            await d.submit(np.zeros((2, 2, 3)), ("l", "all", 8))
+        await d.stop()
+
+    asyncio.run(go())
+
+
+def test_input_layer_rejected_422(server):
+    """'input_1' is a listed layer but has nothing to project — must be a
+    clean 422, not a dropped connection (code-review finding)."""
+    r = httpx.post(
+        server.base_url + "/", data={"file": _data_url(), "layer": "input_1"}
+    )
+    assert r.status_code == 422
+    assert r.json()["error"] == "unknown_layer"
+
+
+def test_handler_crash_returns_500_not_dropped_conn(server):
+    """Unexpected handler exceptions become a 500 JSON response and the
+    connection (and server) survive."""
+    orig = server.service.dispatcher._runner
+    try:
+        def boom(key, images):
+            raise RuntimeError("synthetic device failure")
+
+        server.service.dispatcher._runner = boom
+        r = httpx.post(
+            server.base_url + "/",
+            data={"file": _data_url(), "layer": "b2c1"},
+            timeout=30,
+        )
+        assert r.status_code == 500
+        assert r.json()["error"] == "internal_error"
+    finally:
+        server.service.dispatcher._runner = orig
+    assert httpx.get(server.base_url + "/health-check").status_code == 200
+
+
+def test_warmup_compiles_fallback_layer():
+    """warmup() must always compile something, even when the default layer
+    is absent from the spec (code-review finding)."""
+    cfg = ServerConfig(image_size=16, compilation_cache_dir="")
+    spec = TINY
+    params = init_params(spec, jax.random.PRNGKey(3))
+    svc = DeconvService(cfg, spec=spec, params=params)
+    assert not svc.ready
+    svc.warmup()  # no 'block5_conv1' in TINY -> deepest conv 'b2c1'
+    assert svc.ready
