@@ -1,0 +1,78 @@
+"""Benchmark harness — headline metric for the driver.
+
+Measures BASELINE config 1's throughput form: VGG16 block5_conv1 deconv
+visualizations at 224x224, batched, on the real attached chip.  Prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline"} where vs_baseline is
+value / 200 img/s — the BASELINE.json north-star for a v5e-1.
+
+The reference itself publishes no numbers (BASELINE.md): its structural
+costs (per-request Keras graph builds, interpreted-Python pool loops) put it
+at ~single-digit images/sec on CPU.
+
+Extra diagnostics go to stderr; stdout carries exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    enable_compilation_cache(ServerConfig.from_env())
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({dev.platform})")
+
+    batch = 8
+    layer = "block5_conv1"
+    spec, params = vgg16_init()
+    fn = get_visualizer(spec, layer, 8, "all", True, sweep=False, batched=True)
+
+    images = jax.random.normal(jax.random.PRNGKey(0), (batch, 224, 224, 3))
+
+    t0 = time.perf_counter()
+    out = fn(params, images)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    log(f"first call (compile+run): {compile_s:.1f}s")
+
+    # timed steady-state loop
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(params, images)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    images_per_sec = batch * iters / dt
+    p50_latency_ms = dt / iters * 1e3
+    log(
+        f"{iters} iters x batch {batch}: {dt:.3f}s -> "
+        f"{images_per_sec:.1f} img/s, {p50_latency_ms:.1f} ms/batch"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"VGG16 {layer} deconv images/sec (224x224, batch {batch})",
+                "value": round(images_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(images_per_sec / 200.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Data-parallel sharded execution of the deconv visualizer.
+
+BASELINE config 5: 256 concurrent /deconv requests spread over a v5e-8.
+The batched visualizer (engine/deconv.py, batched=True) is jitted with its
+batch axis sharded over the mesh's ``dp`` axis and params replicated — XLA
+partitions the program per-core with zero cross-core traffic in the hot
+path (each image's projection is independent; the only collectives are the
+initial param broadcast)."""
+
+from __future__ import annotations
+
+import jax
+
+from deconv_api_tpu.engine import get_visualizer
+from deconv_api_tpu.models.spec import ModelSpec
+from deconv_api_tpu.parallel.mesh import batch_sharding, replicated
+
+
+def sharded_visualizer(
+    spec: ModelSpec,
+    mesh,
+    layer_name: str,
+    top_k: int = 8,
+    mode: str = "all",
+    bug_compat: bool = True,
+):
+    """Jitted ``fn(params, batch)`` with batch sharded over ``dp``.
+
+    The per-call batch size must be a multiple of the dp axis size (the
+    serving dispatcher's power-of-two padding guarantees this once
+    max_batch >= dp)."""
+    fn = get_visualizer(
+        spec, layer_name, top_k, mode, bug_compat, sweep=False, batched=True
+    )
+    return jax.jit(
+        fn,
+        in_shardings=(replicated(mesh), batch_sharding(mesh)),
+        out_shardings=batch_sharding(mesh),
+    )

@@ -1,0 +1,37 @@
+"""Driver-contract tests: __graft_entry__.entry() traces and
+dryrun_multichip() executes on the 8-device virtual CPU mesh."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import jax
+import pytest
+
+
+def _load_graft():
+    path = Path(__file__).resolve().parent.parent / "__graft_entry__.py"
+    spec = importlib.util.spec_from_file_location("__graft_entry__", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["__graft_entry__"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_entry_is_traceable():
+    mod = _load_graft()
+    fn, args = mod.entry()
+    # trace-only check: full VGG16 compile is exercised on TPU by the driver
+    out = jax.eval_shape(fn, *args)
+    assert "block5_conv1" in out
+    assert out["block5_conv1"]["images"].shape == (8, 224, 224, 3)
+
+
+def test_dryrun_multichip_8():
+    mod = _load_graft()
+    mod.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_odd():
+    mod = _load_graft()
+    mod.dryrun_multichip(5)
