@@ -18,7 +18,7 @@ class ServerConfig:
     host: str = "0.0.0.0"
     port: int = 8000
     model: str = "vgg16"
-    image_size: int = 224
+    image_size: int = 0  # 0 = the model's native size (224 VGG/ResNet, 299 Inception)
     top_k: int = 8
     stitch_k: int = 4  # tiles in the response grid (reference: 4, 2x2)
     visualize_mode: str = "all"  # 'all' | 'max' (app/main.py:64 hardcodes 'all')

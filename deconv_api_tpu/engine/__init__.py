@@ -7,10 +7,19 @@ replacing the reference's per-request Keras-graph construction and per-layer
 predict() round-trips (reference: app/deepdream.py:383-476).
 """
 
+from deconv_api_tpu.engine.autodeconv import autodeconv_visualizer
 from deconv_api_tpu.engine.deconv import (
     get_visualizer,
     visualize,
     visualize_all_layers,
 )
+from deconv_api_tpu.engine.deepdream import deepdream, make_octave_runner
 
-__all__ = ["get_visualizer", "visualize", "visualize_all_layers"]
+__all__ = [
+    "autodeconv_visualizer",
+    "deepdream",
+    "get_visualizer",
+    "make_octave_runner",
+    "visualize",
+    "visualize_all_layers",
+]

@@ -25,3 +25,7 @@ __all__ = [
     "layer_output_shapes",
     "vgg16_init",
 ]
+
+# DAG models (params pytree + pure apply fn) import lazily from their own
+# modules: models.resnet50 (resnet50_init/resnet50_forward) and
+# models.inception_v3 (inception_v3_init/inception_v3_forward).

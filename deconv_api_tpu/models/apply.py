@@ -10,10 +10,55 @@ half.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
 
 from deconv_api_tpu import ops
 from deconv_api_tpu.models.spec import ModelSpec
+
+
+def spec_forward(spec: ModelSpec, *, logits: bool = False):
+    """Adapt a sequential ModelSpec to the DAG-model calling convention
+    ``forward_fn(params, x, rules=...) -> (out, acts)`` used by the
+    autodiff deconv and DeepDream engines — every model family shares one
+    engine interface.  With ``logits=True`` the final dense layer's softmax
+    is skipped (stable cross-entropy path for training)."""
+    from deconv_api_tpu.models.blocks import INFERENCE_RULES, Rules, maxpool
+
+    last = spec.layers[-1]
+
+    def forward_fn(params, x, rules: Rules = INFERENCE_RULES):
+        acts: dict[str, jnp.ndarray] = {}
+        for l in spec.layers:
+            if l.kind == "input":
+                pass
+            elif l.kind == "conv":
+                w = params[l.name]["w"].astype(x.dtype)
+                b = params[l.name]["b"].astype(x.dtype)
+                x = ops.conv2d(x, w, b, strides=l.strides, padding=l.padding)
+                x = (
+                    rules.relu(x)
+                    if l.activation == "relu"
+                    else ops.apply_activation(x, l.activation)
+                )
+            elif l.kind == "pool":
+                ph, pw = l.pool_size
+                assert ph == pw, "spec models use square pools"
+                x = maxpool(x, ph, ph, "VALID")
+            elif l.kind == "flatten":
+                x = ops.flatten(x)
+            elif l.kind == "dense":
+                w = params[l.name]["w"].astype(x.dtype)
+                b = params[l.name]["b"].astype(x.dtype)
+                x = ops.dense(x, w, b)
+                if logits and l is last and l.activation == "softmax":
+                    pass  # leave as logits
+                elif l.activation == "relu":
+                    x = rules.relu(x)
+                else:
+                    x = ops.apply_activation(x, l.activation)
+            acts[l.name] = x
+        return x, acts
+
+    return forward_fn
 
 
 def forward(
@@ -23,35 +68,7 @@ def forward(
     *,
     logits: bool = False,
 ) -> jnp.ndarray:
-    """Run the classifier forward. With ``logits=True`` the final dense
-    layer's softmax is skipped (stable cross-entropy path for training)."""
-    last = spec.layers[-1]
-    for l in spec.layers:
-        if l.kind == "input":
-            continue
-        if l.kind == "conv":
-            w = params[l.name]["w"].astype(x.dtype)
-            b = params[l.name]["b"].astype(x.dtype)
-            x = ops.apply_activation(
-                ops.conv2d(x, w, b, strides=l.strides, padding=l.padding),
-                l.activation,
-            )
-        elif l.kind == "pool":
-            ph, pw = l.pool_size
-            x = lax.reduce_window(
-                x,
-                -jnp.inf,
-                lax.max,
-                window_dimensions=(1, ph, pw, 1),
-                window_strides=(1, ph, pw, 1),
-                padding="VALID",
-            )
-        elif l.kind == "flatten":
-            x = ops.flatten(x)
-        elif l.kind == "dense":
-            w = params[l.name]["w"].astype(x.dtype)
-            b = params[l.name]["b"].astype(x.dtype)
-            x = ops.dense(x, w, b)
-            if not (logits and l is last and l.activation == "softmax"):
-                x = ops.apply_activation(x, l.activation)
-    return x
+    """Classifier forward (training/inference); one interpreter with
+    spec_forward so the two paths can never drift."""
+    out, _ = spec_forward(spec, logits=logits)(params, x)
+    return out

@@ -29,7 +29,6 @@ import numpy as np
 
 from deconv_api_tpu import errors
 from deconv_api_tpu.config import ServerConfig, apply_platform, enable_compilation_cache
-from deconv_api_tpu.engine import get_visualizer
 from deconv_api_tpu.serving import codec
 from deconv_api_tpu.serving.batcher import BatchingDispatcher, pad_bucket
 from deconv_api_tpu.serving.http import HttpServer, Request, Response
@@ -37,32 +36,41 @@ from deconv_api_tpu.serving.metrics import Metrics
 from deconv_api_tpu.utils.tracing import stage
 
 
-def _model_registry():
-    from deconv_api_tpu.models.vgg16 import vgg16_init
-
-    return {"vgg16": vgg16_init}
-
-
 class DeconvService:
-    """Owns the model, the dispatcher and the HTTP routes."""
+    """Owns the model bundle, the dispatcher and the HTTP routes."""
 
     def __init__(self, cfg: ServerConfig | None = None, *, spec=None, params=None):
+        from deconv_api_tpu.serving.models import REGISTRY, ModelBundle
+
         self.cfg = cfg or ServerConfig.from_env()
         apply_platform(self.cfg)
         enable_compilation_cache(self.cfg)
-        if spec is None:
-            registry = _model_registry()
-            if self.cfg.model not in registry:
+        if spec is not None:
+            # injected sequential model (tests, embedding)
+            self.bundle = ModelBundle(
+                name=spec.name,
+                params=params,
+                image_size=spec.input_shape[0],
+                preprocess=codec.preprocess_vgg,
+                layer_names=tuple(n for n in spec.layer_names()[1:]),
+                dream_layers=(),
+                forward_fn=None,
+                spec=spec,
+            )
+        else:
+            if self.cfg.model not in REGISTRY:
                 raise errors.UnknownModel(
-                    f"unknown model {self.cfg.model!r}; available: {sorted(registry)}"
+                    f"unknown model {self.cfg.model!r}; available: {sorted(REGISTRY)}"
                 )
-            spec, params = registry[self.cfg.model]()
-            if self.cfg.weights_path:
+            self.bundle = REGISTRY[self.cfg.model]()
+            if self.cfg.weights_path and self.bundle.spec is not None:
                 from deconv_api_tpu.models.weights import load_weights
 
-                params = load_weights(spec, self.cfg.weights_path, params)
-        self.spec = spec
-        self.params = params
+                self.bundle.params = load_weights(
+                    self.bundle.spec, self.cfg.weights_path, self.bundle.params
+                )
+        if self.cfg.image_size <= 0:
+            self.cfg.image_size = self.bundle.image_size
         self.metrics = Metrics()
         self.ready = False
         self.dispatcher = BatchingDispatcher(
@@ -78,26 +86,29 @@ class DeconvService:
         self.server.route("GET", "/metrics")(self._metrics)
         self.server.route("POST", "/")(self._deconv_compat)
         self.server.route("POST", "/v1/deconv")(self._deconv_v1)
+        self.server.route("POST", "/v1/dream")(self._dream_v1)
 
     # ---------------------------------------------------------- device side
 
     def _run_batch(self, key, images: list[np.ndarray]):
-        """Execute one (layer, mode, top_k) group as a single padded batch.
+        """Execute one request group as a single device dispatch.
 
-        Runs in a worker thread (never on the event loop).  Batch is padded
-        to a power-of-two bucket so XLA compiles at most log2(max_batch)+1
-        batch shapes per key.
+        Runs in a worker thread (never on the event loop).  Deconv batches
+        are padded to a power-of-two bucket so XLA compiles at most
+        log2(max_batch)+1 batch shapes per key; dream requests run one
+        multi-octave ascent per image.
         """
         import jax.numpy as jnp
 
+        if key[0] == "__dream__":
+            return self._run_dream(key, images)
         layer_name, mode, top_k = key
-        fn = get_visualizer(
-            self.spec, layer_name, top_k, mode, self.cfg.bug_compat,
-            sweep=False, batched=True,
+        fn = self.bundle.batched_visualizer(
+            layer_name, mode, top_k, self.cfg.bug_compat
         )
         bucket = pad_bucket(len(images), self.cfg.max_batch)
         batch = np.stack(images + [images[-1]] * (bucket - len(images)))
-        out = fn(self.params, jnp.asarray(batch))[layer_name]
+        out = fn(self.bundle.params, jnp.asarray(batch))[layer_name]
         imgs = np.asarray(out["images"])  # (B, K, H, W, C)
         valid = np.asarray(out["valid"])  # (B, K)
         indices = np.asarray(out["indices"])
@@ -106,18 +117,36 @@ class DeconvService:
             for i in range(len(images))
         ]
 
+    def _run_dream(self, key, images: list[np.ndarray]):
+        from deconv_api_tpu.engine import deepdream
+
+        _, layers, steps, octaves, lr = key
+        fwd = self.bundle.dream_forward(layers)
+        results = []
+        for img in images:
+            out, loss = deepdream(
+                fwd,
+                self.bundle.params,
+                np.asarray(img),
+                layers=layers,
+                steps_per_octave=steps,
+                num_octaves=octaves,
+                lr=lr,
+                min_size=self.bundle.min_dream_size,
+            )
+            results.append({"image": np.asarray(out), "loss": float(loss)})
+        return results
+
     def warmup(self, layer_name: str | None = None) -> None:
         """Compile a representative executable so /ready flips before traffic."""
-        names = self.spec.layer_names()
+        names = self.bundle.layer_names
         layer = layer_name
         if layer is None or layer not in names:
-            # default: the flagship layer if present, else the deepest conv,
-            # else the deepest non-input layer
-            convs = [l.name for l in self.spec.layers if l.kind == "conv"]
+            # flagship layer if present, else the middle of the stack
             layer = (
                 "block5_conv1"
                 if "block5_conv1" in names
-                else (convs[-1] if convs else names[-1])
+                else names[len(names) // 2]
             )
         img = np.zeros((self.cfg.image_size, self.cfg.image_size, 3), np.float32)
         self._run_batch((layer, self.cfg.visualize_mode, self.cfg.top_k), [img])
@@ -130,13 +159,10 @@ class DeconvService:
         layer = form.get("layer")
         if not file_uri or not layer:
             raise errors.BadRequest("form fields 'file' and 'layer' are required")
-        if layer not in self.spec.layer_names():
+        if layer not in self.bundle.layer_names:
             raise errors.UnknownLayer(
-                f"model {self.spec.name!r} has no layer {layer!r}"
-            )
-        if self.spec.index(layer) == 0:
-            raise errors.UnknownLayer(
-                f"layer {layer!r} is the input layer; nothing to project"
+                f"model {self.bundle.name!r} has no projectable layer {layer!r}; "
+                f"known: {list(self.bundle.layer_names)}"
             )
         with stage(self.metrics, "decode"):
             try:
@@ -144,7 +170,7 @@ class DeconvService:
             except codec.CodecError as e:
                 raise errors.InvalidImage(str(e)) from e
             img = codec.resize224(img, (self.cfg.image_size, self.cfg.image_size))
-            x = codec.preprocess_vgg(img)
+            x = self.bundle.preprocess(img)
 
         with stage(self.metrics, "compute"):
             result = await self.dispatcher.submit(x, (layer, mode, top_k))
@@ -220,6 +246,68 @@ class DeconvService:
                 "mode": mode,
                 "filters": [int(i) for i in result["indices"][:n_valid]],
                 "images": images,
+            }
+        )
+
+    async def _dream_v1(self, req: Request) -> Response:
+        """POST /v1/dream — multi-octave DeepDream (BASELINE config 3).
+
+        Form fields: file (data-URI); optional layers (comma-separated,
+        default = the model's dream_layers), steps, octaves, lr."""
+        t0 = time.perf_counter()
+        try:
+            form = _parse_form(req)
+            file_uri = form.get("file")
+            if not file_uri:
+                raise errors.BadRequest("form field 'file' is required")
+            layers = tuple(
+                s for s in form.get("layers", "").split(",") if s
+            ) or self.bundle.dream_layers
+            if not layers:
+                raise errors.BadRequest(
+                    f"model {self.bundle.name!r} has no default dream layers; "
+                    "pass 'layers' explicitly"
+                )
+            steps = int(form.get("steps", 10))
+            octaves = int(form.get("octaves", 10))
+            lr = float(form.get("lr", 0.01))
+            if not 1 <= steps <= 100 or not 1 <= octaves <= 16:
+                raise errors.BadRequest("steps must be in [1,100], octaves in [1,16]")
+            if not (0.0 < lr <= 1.0):  # also rejects NaN
+                raise errors.BadRequest("lr must be a finite value in (0, 1]")
+            with stage(self.metrics, "decode"):
+                try:
+                    img = codec.decode_data_url(file_uri)
+                except codec.CodecError as e:
+                    raise errors.InvalidImage(str(e)) from e
+                img = codec.resize224(
+                    img, (self.cfg.image_size, self.cfg.image_size)
+                )
+                x = self.bundle.preprocess(img)
+            with stage(self.metrics, "compute"):
+                try:
+                    result = await self.dispatcher.submit(
+                        x, ("__dream__", layers, steps, octaves, lr)
+                    )
+                except KeyError as e:
+                    raise errors.UnknownLayer(str(e)) from e
+            with stage(self.metrics, "encode"):
+                out = self.bundle.unpreprocess(result["image"])
+                data_url = codec.encode_data_url(out)
+        except errors.DeconvError as e:
+            self.metrics.observe_request(time.perf_counter() - t0, e.code)
+            return Response.json({"error": e.code, "detail": e.message}, e.status)
+        except ValueError as e:
+            self.metrics.observe_request(time.perf_counter() - t0, "bad_request")
+            return Response.json({"error": "bad_request", "detail": str(e)}, 400)
+        self.metrics.observe_request(time.perf_counter() - t0)
+        loss = result["loss"]
+        return Response.json(
+            {
+                "layers": list(layers),
+                # NaN/inf are not valid JSON; degrade to null
+                "loss": loss if np.isfinite(loss) else None,
+                "image": data_url,
             }
         )
 

@@ -85,6 +85,26 @@ def preprocess_vgg(img_bgr: np.ndarray) -> np.ndarray:
     return x - CAFFE_MEANS_BGR
 
 
+def preprocess_tf(img_bgr: np.ndarray) -> np.ndarray:
+    """Keras 'tf'-mode preprocessing (InceptionV3): RGB scaled to [-1, 1].
+    Input arrives BGR from the decoder, so flip first."""
+    x = img_bgr.astype(np.float32)[..., ::-1]
+    return x / 127.5 - 1.0
+
+
+def unpreprocess_vgg(x: np.ndarray) -> np.ndarray:
+    """Inverse of `preprocess_vgg`: back to BGR uint8 (for DeepDream output,
+    which lives in model-input space rather than projection space)."""
+    y = x.astype(np.float32) + CAFFE_MEANS_BGR
+    return np.clip(y[..., ::-1], 0, 255).astype(np.uint8)
+
+
+def unpreprocess_tf(x: np.ndarray) -> np.ndarray:
+    """Inverse of `preprocess_tf`: back to BGR uint8."""
+    y = (x.astype(np.float32) + 1.0) * 127.5
+    return np.clip(y[..., ::-1], 0, 255).astype(np.uint8)
+
+
 def deprocess_image(x: np.ndarray) -> np.ndarray:
     """Projection tensor → displayable uint8 (reference app/deepdream.py:483-498)."""
     x = x.astype(np.float32)

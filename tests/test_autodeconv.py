@@ -1,0 +1,108 @@
+"""Autodiff-deconv cross-validation and DAG-model smoke tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deconv_api_tpu.engine import autodeconv_visualizer, visualize
+from deconv_api_tpu.models.apply import spec_forward
+from deconv_api_tpu.models.spec import init_params
+from tests.test_engine_parity import TINY
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    params = init_params(TINY, jax.random.PRNGKey(42))
+    img = jax.random.normal(jax.random.PRNGKey(7), (16, 16, 3))
+    return params, img
+
+
+@pytest.mark.parametrize("mode", ["all", "max"])
+@pytest.mark.parametrize("layer", ["b1c2", "b2c1"])
+def test_autodeconv_matches_sequential_engine_clean_mode(tiny_setup, layer, mode):
+    """jax.vjp with deconv rules must equal the hand-built clean-mode chain
+    (bug_compat=False) — two independent formulations of Zeiler–Fergus."""
+    params, img = tiny_setup
+    fn = autodeconv_visualizer(spec_forward(TINY), layer, top_k=8, mode=mode)
+    got = fn(params, img)
+    want = visualize(TINY, params, img, layer, mode=mode, bug_compat=False)
+    np.testing.assert_array_equal(np.asarray(got["indices"]), np.asarray(want["indices"]))
+    np.testing.assert_allclose(
+        np.asarray(got["images"]), np.asarray(want["images"]), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(got["valid"]), np.asarray(want["valid"]))
+
+
+def test_autodeconv_illegal_mode():
+    with pytest.raises(ValueError, match="illegal visualize mode"):
+        autodeconv_visualizer(spec_forward(TINY), "b1c1", mode="nope")
+
+
+# ----------------------------------------------------------------- ResNet50
+
+
+@pytest.fixture(scope="module")
+def resnet():
+    from deconv_api_tpu.models.resnet50 import resnet50_forward, resnet50_init
+
+    params = resnet50_init(jax.random.PRNGKey(0), num_classes=10)
+    return params, resnet50_forward
+
+
+def test_resnet50_forward_shapes(resnet):
+    params, fwd = resnet
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 64, 3))
+    probs, acts = jax.jit(lambda p, x: fwd(p, x))(params, x)
+    assert probs.shape == (1, 10)
+    np.testing.assert_allclose(float(probs.sum()), 1.0, rtol=1e-4)
+    assert acts["conv1_relu"].shape == (1, 32, 32, 64)
+    assert acts["conv2_block3_out"].shape == (1, 16, 16, 256)
+    assert acts["conv5_block3_out"].shape == (1, 2, 2, 2048)
+
+
+def test_resnet50_param_count(resnet):
+    params, _ = resnet
+    n = sum(x.size for x in jax.tree.leaves(params))
+    # published ResNet50 (include_top, 1000 classes) ~= 25.6M; ours has
+    # 10 classes (-2.03M head) and inference-only BN (mean/var counted too)
+    assert 23e6 < n < 28e6
+
+
+def test_resnet50_autodeconv_strided_path(resnet):
+    """BASELINE config 4: deconv through strided convs + residuals, no
+    explicit switches — impossible in the reference's sequential walk."""
+    params, fwd = resnet
+    img = jax.random.normal(jax.random.PRNGKey(2), (64, 64, 3))
+    fn = autodeconv_visualizer(fwd, "conv3_block1_out", top_k=4)
+    out = fn(params, img)
+    assert out["images"].shape == (4, 64, 64, 3)
+    assert bool(jnp.isfinite(out["images"]).all())
+    assert bool(out["valid"].any())
+    # projection is input-dependent, not constant
+    img2 = jax.random.normal(jax.random.PRNGKey(3), (64, 64, 3))
+    out2 = fn(params, img2)
+    assert not np.allclose(np.asarray(out["images"]), np.asarray(out2["images"]))
+
+
+# -------------------------------------------------------------- InceptionV3
+
+
+def test_inception_v3_forward_shapes():
+    from deconv_api_tpu.models.inception_v3 import (
+        inception_v3_forward,
+        inception_v3_init,
+    )
+
+    params = inception_v3_init(jax.random.PRNGKey(0), num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 75, 75, 3))
+    probs, acts = jax.jit(lambda p, x: inception_v3_forward(p, x))(params, x)
+    assert probs.shape == (1, 10)
+    np.testing.assert_allclose(float(probs.sum()), 1.0, rtol=1e-4)
+    # channel counts must match Keras InceptionV3 exactly
+    assert acts["mixed0"].shape[-1] == 256
+    assert acts["mixed2"].shape[-1] == 288
+    assert acts["mixed3"].shape[-1] == 768
+    assert acts["mixed7"].shape[-1] == 768
+    assert acts["mixed8"].shape[-1] == 1280
+    assert acts["mixed10"].shape[-1] == 2048

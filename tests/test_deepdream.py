@@ -1,0 +1,71 @@
+"""DeepDream engine tests (tiny model for speed; InceptionV3 wiring is
+covered by test_autodeconv.py's shape checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deconv_api_tpu.engine import deepdream, make_octave_runner
+from deconv_api_tpu.engine.deepdream import activation_loss
+from deconv_api_tpu.models.apply import spec_forward
+from deconv_api_tpu.models.spec import init_params
+from tests.test_engine_parity import TINY
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    fwd = spec_forward(TINY)
+    img = jax.random.uniform(jax.random.PRNGKey(1), (16, 16, 3)) * 0.2
+    return params, fwd, img
+
+
+def test_octave_runner_increases_loss(setup):
+    params, fwd, img = setup
+    runner = make_octave_runner(fwd, ("b2c1",), steps=8, lr=0.05)
+    before = float(activation_loss(fwd, params, img[None], ("b2c1",)))
+    x, _ = runner(params, img[None])
+    after = float(activation_loss(fwd, params, x, ("b2c1",)))
+    assert after > before, f"ascent failed: {before} -> {after}"
+    assert bool(jnp.isfinite(x).all())
+
+
+def test_deepdream_multi_octave(setup):
+    params, _, img = setup
+    # octave resizing changes the flatten width, so sequential specs must be
+    # truncated below their dense head (DAG models are size-agnostic)
+    fwd = spec_forward(TINY.truncated("b2c1"))
+    out, loss = deepdream(
+        fwd,
+        params,
+        img,
+        layers=("b1c2", "b2c1"),
+        steps_per_octave=3,
+        lr=0.05,
+        num_octaves=3,
+        octave_scale=1.3,
+        min_size=8,
+    )
+    assert out.shape == img.shape
+    assert bool(jnp.isfinite(out).all())
+    assert not np.allclose(np.asarray(out), np.asarray(img))
+
+
+def test_deepdream_octave_clamp(setup):
+    """Octaves below min_size are skipped, never crash."""
+    params, _, img = setup
+    fwd = spec_forward(TINY.truncated("b2c1"))
+    out, _ = deepdream(
+        fwd, params, img,
+        layers=("b2c1",), steps_per_octave=1, lr=0.01,
+        num_octaves=10, octave_scale=2.0, min_size=8,
+    )
+    assert out.shape == img.shape
+
+
+def test_unknown_layer_raises(setup):
+    params, _, img = setup
+    fwd = spec_forward(TINY.truncated("b2c1"))
+    with pytest.raises(KeyError, match="no activation"):
+        deepdream(fwd, params, img, layers=("nope",), steps_per_octave=1, min_size=8)

@@ -266,5 +266,43 @@ def test_warmup_compiles_fallback_layer():
     params = init_params(spec, jax.random.PRNGKey(3))
     svc = DeconvService(cfg, spec=spec, params=params)
     assert not svc.ready
-    svc.warmup()  # no 'block5_conv1' in TINY -> deepest conv 'b2c1'
+    svc.warmup()  # no 'block5_conv1' in TINY -> middle of the layer list
     assert svc.ready
+
+
+def test_v1_dream_endpoint(server):
+    r = httpx.post(
+        server.base_url + "/v1/dream",
+        data={"file": _data_url(), "layers": "b2c1", "steps": "2", "octaves": "2", "lr": "0.05"},
+        timeout=120,
+    )
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["layers"] == ["b2c1"]
+    assert np.isfinite(body["loss"])
+    assert body["image"].startswith("data:image/webp;base64,")
+
+
+def test_v1_dream_unknown_layer_422(server):
+    r = httpx.post(
+        server.base_url + "/v1/dream",
+        data={"file": _data_url(), "layers": "not_a_layer", "steps": "1"},
+        timeout=60,
+    )
+    assert r.status_code == 422, r.text
+    assert r.json()["error"] == "unknown_layer"
+
+
+def test_v1_dream_no_default_layers_400(server):
+    # injected tiny bundle has no default dream layers
+    r = httpx.post(server.base_url + "/v1/dream", data={"file": _data_url()})
+    assert r.status_code == 400
+
+
+def test_model_registry_bundles():
+    from deconv_api_tpu.serving.models import REGISTRY
+
+    assert set(REGISTRY) == {"vgg16", "resnet50", "inception_v3"}
+    b = REGISTRY["vgg16"]()
+    assert b.image_size == 224 and "block5_conv1" in b.layer_names
+    assert b.spec is not None
