@@ -28,6 +28,7 @@ class ServerConfig:
     max_batch: int = 8
     batch_window_ms: float = 3.0
     request_timeout_s: float = 60.0
+    dream_timeout_s: float = 300.0  # dreams run minutes; own queue + timeout
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

@@ -35,15 +35,16 @@ def activation_loss(forward_fn, params, x, layers: tuple[str, ...]) -> jnp.ndarr
 
 
 @lru_cache(maxsize=64)
-def make_octave_runner(forward_fn, layers: tuple[str, ...], steps: int, lr: float):
+def _octave_jit(forward_fn, layers: tuple[str, ...]):
     """One jitted program running a full octave of ascent steps.
 
-    Cached on (forward_fn, layers, steps, lr): repeated dreams with the same
-    configuration reuse one jit object, so per-octave-shape executables
-    persist across requests (pair with a stable forward_fn — ModelBundle
-    caches its dream_forward closures for exactly this reason)."""
+    Cached on (forward_fn, layers) only; ``steps`` and ``lr`` are traced
+    arguments so client-chosen values never trigger recompilation (a sweep
+    over lr would otherwise compile a fresh executable per value, per
+    octave shape).  Pair with a stable forward_fn — ModelBundle caches its
+    dream_forward closures for exactly this reason."""
 
-    def run(params, x):
+    def run(params, x, steps, lr):
         loss_grad = jax.value_and_grad(
             lambda xx: activation_loss(forward_fn, params, xx, layers)
         )
@@ -54,11 +55,19 @@ def make_octave_runner(forward_fn, layers: tuple[str, ...], steps: int, lr: floa
             # gradient-magnitude normalisation keeps lr scale-free across
             # octaves/layers (standard DeepDream practice)
             g = g / (jnp.mean(jnp.abs(g)) + 1e-8)
-            return x + lr * g, loss
+            return x + lr.astype(x.dtype) * g, loss
 
         return jax.lax.fori_loop(0, steps, body, (x, jnp.asarray(0.0, x.dtype)))
 
     return jax.jit(run)
+
+
+def make_octave_runner(forward_fn, layers: tuple[str, ...], steps: int, lr: float):
+    """Bind (steps, lr) over the per-(model, layers) jitted octave program."""
+    fn = _octave_jit(forward_fn, tuple(layers))
+    steps = jnp.asarray(steps, jnp.int32)
+    lr = jnp.asarray(lr, jnp.float32)
+    return lambda params, x: fn(params, x, steps, lr)
 
 
 def _resize(x: jnp.ndarray, hw: tuple[int, int]) -> jnp.ndarray:

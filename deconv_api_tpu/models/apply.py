@@ -41,8 +41,7 @@ def spec_forward(spec: ModelSpec, *, logits: bool = False):
                 )
             elif l.kind == "pool":
                 ph, pw = l.pool_size
-                assert ph == pw, "spec models use square pools"
-                x = maxpool(x, ph, ph, "VALID")
+                x = maxpool(x, (ph, pw), (ph, pw), "VALID")
             elif l.kind == "flatten":
                 x = ops.flatten(x)
             elif l.kind == "dense":

@@ -37,16 +37,24 @@ INFERENCE_RULES = Rules(relu=ops.relu)
 DECONV_RULES = Rules(relu=ops.deconv_relu)
 
 
-def maxpool(x: jnp.ndarray, window: int = 3, stride: int = 2, padding: str = "VALID"):
+def maxpool(
+    x: jnp.ndarray,
+    window: int | tuple[int, int] = 3,
+    stride: int | tuple[int, int] = 2,
+    padding: str = "VALID",
+):
     """Overlapping max-pool (3x3/2 in both model families).  Its native XLA
     VJP routes cotangents to window argmaxes — the switch semantics for
-    overlapping windows (BASELINE config 4 wants no explicit switches)."""
+    overlapping windows (BASELINE config 4 wants no explicit switches).
+    ``window``/``stride`` accept an int or an (h, w) pair."""
+    wh, ww = (window, window) if isinstance(window, int) else window
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
     return lax.reduce_window(
         x,
         -jnp.inf,
         lax.max,
-        window_dimensions=(1, window, window, 1),
-        window_strides=(1, stride, stride, 1),
+        window_dimensions=(1, wh, ww, 1),
+        window_strides=(1, sh, sw, 1),
         padding=padding,
     )
 

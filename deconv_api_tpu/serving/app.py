@@ -40,37 +40,41 @@ class DeconvService:
     """Owns the model bundle, the dispatcher and the HTTP routes."""
 
     def __init__(self, cfg: ServerConfig | None = None, *, spec=None, params=None):
-        from deconv_api_tpu.serving.models import REGISTRY, ModelBundle
+        import dataclasses
+
+        from deconv_api_tpu.serving.models import REGISTRY, spec_bundle
 
         self.cfg = cfg or ServerConfig.from_env()
         apply_platform(self.cfg)
         enable_compilation_cache(self.cfg)
         if spec is not None:
             # injected sequential model (tests, embedding)
-            self.bundle = ModelBundle(
-                name=spec.name,
-                params=params,
-                image_size=spec.input_shape[0],
-                preprocess=codec.preprocess_vgg,
-                layer_names=tuple(n for n in spec.layer_names()[1:]),
-                dream_layers=(),
-                forward_fn=None,
-                spec=spec,
-            )
+            self.bundle = spec_bundle(spec, params)
         else:
             if self.cfg.model not in REGISTRY:
                 raise errors.UnknownModel(
                     f"unknown model {self.cfg.model!r}; available: {sorted(REGISTRY)}"
                 )
             self.bundle = REGISTRY[self.cfg.model]()
-            if self.cfg.weights_path and self.bundle.spec is not None:
+            if self.cfg.weights_path:
+                if self.bundle.spec is None:
+                    # Silently serving random-init weights would be worse
+                    # than refusing to start.
+                    raise ValueError(
+                        f"weights_path is only supported for sequential-spec "
+                        f"models (a Keras .h5 loader for {self.cfg.model!r} "
+                        "does not exist yet)"
+                    )
                 from deconv_api_tpu.models.weights import load_weights
 
                 self.bundle.params = load_weights(
                     self.bundle.spec, self.cfg.weights_path, self.bundle.params
                 )
         if self.cfg.image_size <= 0:
-            self.cfg.image_size = self.bundle.image_size
+            # resolve on a copy: the caller's config object stays untouched
+            self.cfg = dataclasses.replace(
+                self.cfg, image_size=self.bundle.image_size
+            )
         self.metrics = Metrics()
         self.ready = False
         self.dispatcher = BatchingDispatcher(
@@ -78,6 +82,16 @@ class DeconvService:
             max_batch=self.cfg.max_batch,
             window_ms=self.cfg.batch_window_ms,
             request_timeout_s=self.cfg.request_timeout_s,
+            metrics=self.metrics,
+        )
+        # Dreams run for seconds-to-minutes; a separate dispatcher keeps them
+        # from head-of-line blocking the deconv queue (the device interleaves
+        # the two streams between octave dispatches).
+        self.dream_dispatcher = BatchingDispatcher(
+            self._run_batch,
+            max_batch=1,
+            window_ms=0.0,
+            request_timeout_s=self.cfg.dream_timeout_s,
             metrics=self.metrics,
         )
         self.server = HttpServer()
@@ -273,6 +287,10 @@ class DeconvService:
             lr = float(form.get("lr", 0.01))
             if not 1 <= steps <= 100 or not 1 <= octaves <= 16:
                 raise errors.BadRequest("steps must be in [1,100], octaves in [1,16]")
+            if steps * octaves > 500:
+                raise errors.BadRequest(
+                    "steps x octaves must be <= 500 (total ascent steps)"
+                )
             if not (0.0 < lr <= 1.0):  # also rejects NaN
                 raise errors.BadRequest("lr must be a finite value in (0, 1]")
             with stage(self.metrics, "decode"):
@@ -286,7 +304,7 @@ class DeconvService:
                 x = self.bundle.preprocess(img)
             with stage(self.metrics, "compute"):
                 try:
-                    result = await self.dispatcher.submit(
+                    result = await self.dream_dispatcher.submit(
                         x, ("__dream__", layers, steps, octaves, lr)
                     )
                 except KeyError as e:
@@ -315,6 +333,7 @@ class DeconvService:
 
     async def start(self, host: str | None = None, port: int | None = None) -> int:
         await self.dispatcher.start()
+        await self.dream_dispatcher.start()
         return await self.server.start(
             host if host is not None else self.cfg.host,
             self.cfg.port if port is None else port,
@@ -323,6 +342,7 @@ class DeconvService:
     async def stop(self) -> None:
         await self.server.stop()
         await self.dispatcher.stop()
+        await self.dream_dispatcher.stop()
 
 
 def _parse_form(req: Request) -> dict[str, str]:

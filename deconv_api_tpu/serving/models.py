@@ -82,21 +82,35 @@ class ModelBundle:
         return self._vis_cache[key]
 
 
+def spec_bundle(
+    spec,
+    params,
+    *,
+    dream_layers: tuple[str, ...] = (),
+    preprocess: Callable[[np.ndarray], np.ndarray] = codec.preprocess_vgg,
+) -> ModelBundle:
+    """The one place a sequential ModelSpec becomes a ModelBundle (used by
+    both the registry and injected-spec servers, so the projectable-layer
+    rule cannot drift between them)."""
+    return ModelBundle(
+        name=spec.name,
+        params=params,
+        image_size=spec.input_shape[0],
+        preprocess=preprocess,
+        layer_names=tuple(l.name for l in spec.layers if l.kind != "input"),
+        dream_layers=dream_layers,
+        forward_fn=None,
+        spec=spec,
+    )
+
+
 def _vgg16_bundle() -> ModelBundle:
     from deconv_api_tpu.models.vgg16 import vgg16_init
 
     spec, params = vgg16_init()
-    b = ModelBundle(
-        name="vgg16",
-        params=params,
-        image_size=224,
-        preprocess=codec.preprocess_vgg,
-        layer_names=tuple(n for n in spec.layer_names() if n != "input_1"),
-        dream_layers=("block4_conv3", "block5_conv1"),
-        forward_fn=None,
+    return spec_bundle(
+        spec, params, dream_layers=("block4_conv3", "block5_conv1")
     )
-    b.spec = spec
-    return b
 
 
 def _resnet50_bundle() -> ModelBundle:

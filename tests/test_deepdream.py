@@ -69,3 +69,18 @@ def test_unknown_layer_raises(setup):
     fwd = spec_forward(TINY.truncated("b2c1"))
     with pytest.raises(KeyError, match="no activation"):
         deepdream(fwd, params, img, layers=("nope",), steps_per_octave=1, min_size=8)
+
+
+def test_octave_runner_no_recompile_across_lr_steps(setup):
+    """lr/steps are traced args: sweeping them must reuse one executable
+    (a per-value recompile would be a trivial DoS through /v1/dream)."""
+    from deconv_api_tpu.engine.deepdream import _octave_jit
+
+    params, fwd, img = setup
+    jitted = _octave_jit(fwd, ("b2c1",))
+    before = jitted._cache_size()
+    for steps, lr in ((2, 0.01), (3, 0.02), (5, 0.5)):
+        runner = make_octave_runner(fwd, ("b2c1",), steps=steps, lr=lr)
+        runner(params, img[None])
+    compiles = jitted._cache_size() - before
+    assert compiles <= 1, f"lr/steps sweep compiled {compiles} executables"

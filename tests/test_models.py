@@ -34,3 +34,26 @@ def test_vgg16_param_shapes():
     assert params["predictions"]["w"].shape == (4096, 1000)
     n = sum(int(np.prod(v.shape)) for p in params.values() for v in p.values())
     assert n == 138_357_544  # published VGG16 include_top param count
+
+
+def test_spec_forward_rectangular_pool():
+    """Non-square pool_size is valid per the spec IR and the NumPy oracle;
+    spec_forward must not narrow it (regression: square-pool assert)."""
+    import jax
+
+    from deconv_api_tpu.models.apply import forward
+    from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+
+    spec = ModelSpec(
+        name="rectpool",
+        input_shape=(8, 12, 3),
+        layers=(
+            Layer(kind="input", name="in"),
+            Layer(kind="conv", name="c1", filters=4, kernel_size=(3, 3)),
+            Layer(kind="pool", name="p1", pool_size=(2, 3)),
+        ),
+    )
+    params = init_params(spec, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 12, 3))
+    out = forward(spec, params, x)
+    assert out.shape == (2, 4, 4, 4)

@@ -306,3 +306,17 @@ def test_model_registry_bundles():
     b = REGISTRY["vgg16"]()
     assert b.image_size == 224 and "block5_conv1" in b.layer_names
     assert b.spec is not None
+
+
+def test_config_not_mutated_by_service():
+    """One ServerConfig must be reusable across services (regression:
+    DeconvService wrote the resolved image_size back into the caller's cfg)."""
+    from tests.test_engine_parity import TINY
+    from deconv_api_tpu.models.spec import init_params
+    import jax
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    cfg = ServerConfig(image_size=0, compilation_cache_dir="")
+    svc = DeconvService(cfg, spec=TINY, params=params)
+    assert cfg.image_size == 0
+    assert svc.cfg.image_size == TINY.input_shape[0]
