@@ -51,8 +51,9 @@ def _up_step(e: Entry, params, x, switches):
         # applies it again (idempotent for relu) — reference app/deepdream.py:73.
         return ops.apply_activation(y, l.activation)
     if l.kind == "pool":
-        pooled, sw = ops.maxpool_with_switches(x, l.pool_size)
-        switches[e.name] = sw
+        pooled, idx = ops.maxpool_with_argmax(x, l.pool_size)
+        # compact switch form: int8 window argmax + static input extent
+        switches[e.name] = (idx, x.shape[1:3])
         return pooled
     if l.kind == "flatten":
         return ops.flatten(x)
@@ -82,7 +83,8 @@ def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool):
             y = ops.apply_activation(y, l.activation)
         return y
     if l.kind == "pool":
-        return ops.unpool_with_switches(x, switches[e.name], l.pool_size)
+        idx, out_hw = switches[e.name]
+        return ops.unpool_with_argmax(x, idx, l.pool_size, out_hw)
     if l.kind == "flatten":
         return ops.unflatten(x, prev_shape[1:])
     if l.kind == "dense":
@@ -91,7 +93,9 @@ def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool):
     raise AssertionError(l.kind)
 
 
-def _visualize_entry(entries, params, ups, switches, i, top_k, mode, bug_compat):
+def _visualize_entry(
+    entries, params, ups, switches, i, top_k, mode, bug_compat, backward_dtype
+):
     """Top-K selection + vmapped backward projection from entry index `i`."""
     output = ups[i]
     n_chan = output.shape[-1]
@@ -110,10 +114,14 @@ def _visualize_entry(entries, params, ups, switches, i, top_k, mode, bug_compat)
             # reference app/deepdream.py:454-457.
             fmap = fmap * (fmap == jnp.max(fmap)).astype(fmap.dtype)
         x = fmap[..., None] * chan
+        if backward_dtype is not None:
+            # Mixed precision: selection ran on the exact forward; the
+            # projection chain (8/9 of the FLOPs) runs in e.g. bfloat16.
+            x = x.astype(backward_dtype)
         for j in range(i, -1, -1):
             prev_shape = ups[j - 1].shape if j > 0 else ups[0].shape
             x = _down_step(entries[j], params, x, switches, prev_shape, bug_compat)
-        return x
+        return x.astype(output.dtype)
 
     images = jax.vmap(backproject)(top_idx)  # (K, 1, H, W, C)
     return {
@@ -133,6 +141,7 @@ def get_visualizer(
     bug_compat: bool = True,
     sweep: bool = False,
     batched: bool = False,
+    backward_dtype: str | None = None,
 ):
     """Build (and cache) the jitted visualizer for a static configuration.
 
@@ -140,6 +149,9 @@ def get_visualizer(
     when ``batched`` — yielding {layer_name: {images, indices, sums, valid}}.
     With ``sweep=True`` every model layer from `layer_name` down to the input
     is projected (the reference's always-on behaviour, SURVEY §2.2.3).
+    ``backward_dtype`` (e.g. ``"bfloat16"``) runs only the backward
+    projection chain in that dtype: filter selection and switches stay
+    exact, trading a little projection precision for MXU throughput.
     """
     if mode not in ("all", "max"):
         # The reference sys.exit()s the server here (app/deepdream.py:458-460);
@@ -160,6 +172,8 @@ def get_visualizer(
     if not sweep:
         vis_indices = vis_indices[:1]
 
+    bwd_dtype = jnp.dtype(backward_dtype) if backward_dtype else None
+
     def single(params, image):
         x = image[None]
         switches: dict[str, jnp.ndarray] = {}
@@ -169,7 +183,8 @@ def get_visualizer(
             ups.append(x)
         return {
             entries[i].name: _visualize_entry(
-                entries, params, ups, switches, i, top_k, mode, bug_compat
+                entries, params, ups, switches, i, top_k, mode, bug_compat,
+                bwd_dtype,
             )
             for i in vis_indices
         }

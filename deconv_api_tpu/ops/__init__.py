@@ -24,8 +24,10 @@ from deconv_api_tpu.ops.linear import (
     unflatten,
 )
 from deconv_api_tpu.ops.pool import (
+    maxpool_with_argmax,
     maxpool_with_switches,
     maxpool_switched,
+    unpool_with_argmax,
     unpool_with_switches,
 )
 
@@ -38,8 +40,10 @@ __all__ = [
     "dense_input_backward",
     "flatten",
     "flip_kernel",
+    "maxpool_with_argmax",
     "maxpool_with_switches",
     "maxpool_switched",
+    "unpool_with_argmax",
     "relu",
     "softmax",
     "unflatten",

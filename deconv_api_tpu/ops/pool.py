@@ -24,20 +24,24 @@ import jax
 import jax.numpy as jnp
 
 
-def maxpool_with_switches(
+def maxpool_with_argmax(
     x: jnp.ndarray, pool_size: Sequence[int] = (2, 2)
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Non-overlapping max-pool returning (pooled, switch).
+    """Non-overlapping max-pool returning (pooled, window-argmax indices).
 
     - `pooled`: (B, H//ph, W//pw, C) window maxima.
-    - `switch`: (B, H, W, C) one-hot mask, a single 1 per window at the
-      *first* (row-major) position attaining the max — the reference's
-      tie-break (app/deepdream.py:180-187; `np.argmax` over the flattened
-      patch has identical first-occurrence semantics).
+    - `idx`: (B, H//ph, W//pw, C) int8, the row-major in-window position of
+      the *first* maximum — the reference's tie-break
+      (app/deepdream.py:180-187; `np.argmax` over the flattened patch has
+      identical first-occurrence semantics).
+
+    The compact int8 index IS the switch data structure: a full-resolution
+    fp32 one-hot mask (what the reference materialises) costs
+    ph*pw*4 bytes per window element and dominated live memory when threaded
+    from the forward to the backward half of the program; the index costs 1.
 
     Odd trailing rows/cols are floor-dropped from pooling, matching
-    app/deepdream.py:166-167; the switch keeps the full (H, W) extent with
-    zeros there.
+    app/deepdream.py:166-167.
     """
     ph, pw = int(pool_size[0]), int(pool_size[1])
     b, h, w, c = x.shape
@@ -50,13 +54,64 @@ def maxpool_with_switches(
         .reshape(b, ho, wo, c, ph * pw)
     )
     pooled = jnp.max(windows, axis=-1)
-    idx = jnp.argmax(windows, axis=-1)  # first occurrence, row-major
-    one_hot = jax.nn.one_hot(idx, ph * pw, dtype=x.dtype)
-    switch = (
-        one_hot.reshape(b, ho, wo, c, ph, pw)
-        .transpose(0, 1, 4, 2, 5, 3)
-        .reshape(b, ho * ph, wo * pw, c)
-    )
+    idx = jnp.argmax(windows, axis=-1).astype(jnp.int8)  # first occurrence
+    return pooled, idx
+
+
+def unpool_with_argmax(
+    y: jnp.ndarray,
+    idx: jnp.ndarray,
+    pool_size: Sequence[int] = (2, 2),
+    out_hw: tuple[int, int] | None = None,
+) -> jnp.ndarray:
+    """Scatter each pooled value to its window's argmax position — the
+    reference's `np.kron(input, ones(tile)) * switch`
+    (app/deepdream.py:191-209) with the mask reconstructed on the fly from
+    the compact index (XLA fuses the compare into the multiply; the one-hot
+    never touches HBM).
+
+    ``out_hw`` restores the original spatial extent when the pool size did
+    not divide it (trailing rows/cols come back as zeros).
+    """
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, ho, wo, c = y.shape
+    mask = _argmax_mask(idx, (ph, pw))
+    up = y[:, :, None, :, None, :] * mask.astype(y.dtype)
+    up = up.reshape(b, ho * ph, wo * pw, c)
+    if out_hw is not None and out_hw != (ho * ph, wo * pw):
+        up = jnp.pad(
+            up,
+            ((0, 0), (0, out_hw[0] - ho * ph), (0, out_hw[1] - wo * pw), (0, 0)),
+        )
+    return up
+
+
+def _argmax_mask(idx: jnp.ndarray, pool_size: tuple[int, int]) -> jnp.ndarray:
+    """(B, Ho, ph, Wo, pw, C) bool one-hot of each window's argmax position.
+
+    The single place the compact int8 index expands to a spatial mask; both
+    the compact unpool and the mask-form API go through it so the two can
+    never drift (the int8 cast on `pos` must match `idx`'s dtype exactly)."""
+    ph, pw = pool_size
+    pos = (jnp.arange(ph)[:, None] * pw + jnp.arange(pw)[None, :]).astype(idx.dtype)
+    return idx[:, :, None, :, None, :] == pos[None, None, :, None, :, None]
+
+
+def maxpool_with_switches(
+    x: jnp.ndarray, pool_size: Sequence[int] = (2, 2)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Mask-form API: (pooled, full-resolution one-hot switch mask).
+
+    Provided for parity tests and external callers that want the
+    reference-shaped (B, H, W, C) switch (app/deepdream.py:152-188); the
+    engine itself threads the compact `maxpool_with_argmax` form.
+    """
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, h, w, c = x.shape
+    ho, wo = h // ph, w // pw
+    pooled, idx = maxpool_with_argmax(x, pool_size)
+    mask = _argmax_mask(idx, (ph, pw))
+    switch = mask.astype(x.dtype).reshape(b, ho * ph, wo * pw, c)
     if (ho * ph, wo * pw) != (h, w):
         switch = jnp.pad(
             switch, ((0, 0), (0, h - ho * ph), (0, w - wo * pw), (0, 0))
@@ -67,10 +122,8 @@ def maxpool_with_switches(
 def unpool_with_switches(
     y: jnp.ndarray, switch: jnp.ndarray, pool_size: Sequence[int] = (2, 2)
 ) -> jnp.ndarray:
-    """Kronecker-upsample `y` by the pool size and gate by the switch mask —
-    the reference's `np.kron(input, ones(tile)) * switch`
-    (app/deepdream.py:191-209), as two fused XLA broadcasts.
-    """
+    """Mask-form unpool: Kronecker-upsample `y` and gate by the switch mask
+    (reference app/deepdream.py:191-209), as two fused XLA broadcasts."""
     ph, pw = int(pool_size[0]), int(pool_size[1])
     b, ho, wo, c = y.shape
     h, w = switch.shape[1], switch.shape[2]
@@ -91,17 +144,18 @@ def maxpool_switched(x: jnp.ndarray, pool_size: tuple[int, int] = (2, 2)):
     semantics (including first-index tie-breaks, which XLA's native
     reduce-window gradient does not guarantee).
     """
-    pooled, _ = maxpool_with_switches(x, pool_size)
+    pooled, _ = maxpool_with_argmax(x, pool_size)
     return pooled
 
 
 def _maxpool_switched_fwd(x, pool_size):
-    pooled, switch = maxpool_with_switches(x, pool_size)
-    return pooled, switch
+    pooled, idx = maxpool_with_argmax(x, pool_size)
+    return pooled, (idx, x.shape[1:3])
 
 
-def _maxpool_switched_bwd(pool_size, switch, g):
-    return (unpool_with_switches(g, switch, pool_size),)
+def _maxpool_switched_bwd(pool_size, res, g):
+    idx, out_hw = res
+    return (unpool_with_argmax(g, idx, pool_size, out_hw),)
 
 
 maxpool_switched.defvjp(_maxpool_switched_fwd, _maxpool_switched_bwd)

@@ -86,13 +86,15 @@ class DeconvService:
         )
         # Dreams run for seconds-to-minutes; a separate dispatcher keeps them
         # from head-of-line blocking the deconv queue (the device interleaves
-        # the two streams between octave dispatches).
+        # the two streams between octave dispatches), and a separate Metrics
+        # stream keeps minute-long dream batches out of the deconv SLO stats.
+        self.dream_metrics = Metrics(prefix="dream")
         self.dream_dispatcher = BatchingDispatcher(
             self._run_batch,
             max_batch=1,
             window_ms=0.0,
             request_timeout_s=self.cfg.dream_timeout_s,
-            metrics=self.metrics,
+            metrics=self.dream_metrics,
         )
         self.server = HttpServer()
         self.server.route("GET", "/health-check")(self._health)
@@ -118,7 +120,8 @@ class DeconvService:
             return self._run_dream(key, images)
         layer_name, mode, top_k = key
         fn = self.bundle.batched_visualizer(
-            layer_name, mode, top_k, self.cfg.bug_compat
+            layer_name, mode, top_k, self.cfg.bug_compat,
+            self.cfg.backward_dtype or None,
         )
         bucket = pad_bucket(len(images), self.cfg.max_batch)
         batch = np.stack(images + [images[-1]] * (bucket - len(images)))
@@ -201,7 +204,10 @@ class DeconvService:
         return Response.json({"ready": False}, status=503)
 
     async def _metrics(self, _req: Request) -> Response:
-        return Response.text(self.metrics.prometheus(), content_type="text/plain; version=0.0.4")
+        return Response.text(
+            self.metrics.prometheus() + self.dream_metrics.prometheus(),
+            content_type="text/plain; version=0.0.4",
+        )
 
     async def _deconv_compat(self, req: Request) -> Response:
         """POST / — the reference's endpoint, wire-compatible."""
@@ -293,7 +299,7 @@ class DeconvService:
                 )
             if not (0.0 < lr <= 1.0):  # also rejects NaN
                 raise errors.BadRequest("lr must be a finite value in (0, 1]")
-            with stage(self.metrics, "decode"):
+            with stage(self.dream_metrics, "decode"):
                 try:
                     img = codec.decode_data_url(file_uri)
                 except codec.CodecError as e:
@@ -302,23 +308,23 @@ class DeconvService:
                     img, (self.cfg.image_size, self.cfg.image_size)
                 )
                 x = self.bundle.preprocess(img)
-            with stage(self.metrics, "compute"):
+            with stage(self.dream_metrics, "compute"):
                 try:
                     result = await self.dream_dispatcher.submit(
                         x, ("__dream__", layers, steps, octaves, lr)
                     )
                 except KeyError as e:
                     raise errors.UnknownLayer(str(e)) from e
-            with stage(self.metrics, "encode"):
+            with stage(self.dream_metrics, "encode"):
                 out = self.bundle.unpreprocess(result["image"])
                 data_url = codec.encode_data_url(out)
         except errors.DeconvError as e:
-            self.metrics.observe_request(time.perf_counter() - t0, e.code)
+            self.dream_metrics.observe_request(time.perf_counter() - t0, e.code)
             return Response.json({"error": e.code, "detail": e.message}, e.status)
         except ValueError as e:
-            self.metrics.observe_request(time.perf_counter() - t0, "bad_request")
+            self.dream_metrics.observe_request(time.perf_counter() - t0, "bad_request")
             return Response.json({"error": "bad_request", "detail": str(e)}, 400)
-        self.metrics.observe_request(time.perf_counter() - t0)
+        self.dream_metrics.observe_request(time.perf_counter() - t0)
         loss = result["loss"]
         return Response.json(
             {

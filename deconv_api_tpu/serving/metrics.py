@@ -40,7 +40,8 @@ class _Reservoir:
 
 
 class Metrics:
-    def __init__(self):
+    def __init__(self, prefix: str = "deconv"):
+        self._prefix = prefix
         self._lock = threading.Lock()
         self._started = time.time()
         self.requests_total = 0
@@ -96,24 +97,25 @@ class Metrics:
             }
 
     def prometheus(self) -> str:
+        p = self._prefix
         s = self.snapshot()
         lines = [
-            "# TYPE deconv_requests_total counter",
-            f"deconv_requests_total {s['requests_total']}",
-            "# TYPE deconv_images_total counter",
-            f"deconv_images_total {s['images_total']}",
-            "# TYPE deconv_batches_total counter",
-            f"deconv_batches_total {s['batches_total']}",
-            "# TYPE deconv_request_latency_seconds summary",
-            f'deconv_request_latency_seconds{{quantile="0.5"}} {s["latency_p50_s"]:.6f}',
-            f'deconv_request_latency_seconds{{quantile="0.99"}} {s["latency_p99_s"]:.6f}',
-            "# TYPE deconv_images_per_sec gauge",
-            f"deconv_images_per_sec {s['images_per_sec']:.3f}",
+            f"# TYPE {p}_requests_total counter",
+            f"{p}_requests_total {s['requests_total']}",
+            f"# TYPE {p}_images_total counter",
+            f"{p}_images_total {s['images_total']}",
+            f"# TYPE {p}_batches_total counter",
+            f"{p}_batches_total {s['batches_total']}",
+            f"# TYPE {p}_request_latency_seconds summary",
+            f'{p}_request_latency_seconds{{quantile="0.5"}} {s["latency_p50_s"]:.6f}',
+            f'{p}_request_latency_seconds{{quantile="0.99"}} {s["latency_p99_s"]:.6f}',
+            f"# TYPE {p}_images_per_sec gauge",
+            f"{p}_images_per_sec {s['images_per_sec']:.3f}",
         ]
         for code, n in s["errors_total"].items():
-            lines.append(f'deconv_errors_total{{code="{code}"}} {n}')
+            lines.append(f'{p}_errors_total{{code="{code}"}} {n}')
         for stage, q in s["stages"].items():
             lines.append(
-                f'deconv_stage_seconds{{stage="{stage}",quantile="0.5"}} {q["p50_s"]:.6f}'
+                f'{p}_stage_seconds{{stage="{stage}",quantile="0.5"}} {q["p50_s"]:.6f}'
             )
         return "\n".join(lines) + "\n"

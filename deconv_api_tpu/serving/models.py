@@ -61,18 +61,30 @@ class ModelBundle:
         return self._dream_cache[layers]
 
     def batched_visualizer(
-        self, layer: str, mode: str, top_k: int, bug_compat: bool = True
+        self,
+        layer: str,
+        mode: str,
+        top_k: int,
+        bug_compat: bool = True,
+        backward_dtype: str | None = None,
     ):
         """fn(params, batch) -> {layer: {images, indices, sums, valid}} —
         jitted once per static configuration and cached.  ``bug_compat``
         only affects sequential models (the DAG autodiff path has no
-        double-ReLU quirk to reproduce)."""
-        key = (layer, mode, top_k, bug_compat)
+        double-ReLU quirk to reproduce).  ``backward_dtype`` defaults to
+        exact (None); the serving layer passes its configured policy.  The
+        DAG autodiff path ignores it (its backward is a vjp over the saved
+        fp32 forward residuals, so there is no separate projection chain to
+        downcast) — normalised out of the cache key there."""
+        if self.spec is None:
+            backward_dtype = None
+        key = (layer, mode, top_k, bug_compat, backward_dtype)
         if key not in self._vis_cache:
             if self.spec is not None:
                 fn = get_visualizer(
                     self.spec, layer, top_k, mode, bug_compat,
                     sweep=False, batched=True,
+                    backward_dtype=backward_dtype or None,
                 )
             else:
                 single = autodeconv_visualizer(self.forward_fn, layer, top_k, mode)

@@ -125,3 +125,28 @@ def test_unknown_layer_raises(setup):
     params, _, img = setup
     with pytest.raises(KeyError, match="no layer"):
         visualize(TINY, params, jnp.asarray(img), "nope")
+
+
+def test_mixed_precision_backward_parity():
+    """bf16 backward projection must be visually indistinguishable from
+    fp32 after deprocess quantisation (>40dB PSNR target; selection exact)."""
+    import jax
+
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving.codec import deprocess_image
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    img = jax.random.normal(jax.random.PRNGKey(7), TINY.input_shape) * 5.0
+    f32 = get_visualizer(TINY, "b2c1", 4, "all", True)
+    mix = get_visualizer(TINY, "b2c1", 4, "all", True, backward_dtype="bfloat16")
+    o32 = f32(params, img)["b2c1"]
+    omx = mix(params, img)["b2c1"]
+    np.testing.assert_array_equal(
+        np.asarray(o32["indices"]), np.asarray(omx["indices"])
+    )
+    i32 = np.stack([deprocess_image(np.asarray(x, np.float64)) for x in o32["images"]])
+    imx = np.stack([deprocess_image(np.asarray(x, np.float64)) for x in omx["images"]])
+    mse = np.mean((i32.astype(np.float64) - imx.astype(np.float64)) ** 2)
+    psnr = 10 * np.log10(255.0**2 / max(mse, 1e-12))
+    assert psnr > 40.0, f"mixed-precision PSNR {psnr:.1f} dB under target"

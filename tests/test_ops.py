@@ -156,3 +156,19 @@ def test_deconv_relu_vjp_applies_relu_to_cotangent():
 def test_apply_activation_unknown_raises():
     with pytest.raises(ValueError):
         ops.apply_activation(jnp.zeros(3), "gelu6")
+
+
+def test_argmax_form_equivalent_to_mask_form(rng):
+    """The engine's compact int8 switch form and the reference-shaped mask
+    form must agree in both directions, including odd trailing dims."""
+    import numpy as np
+
+    x = jnp.asarray(rng.standard_normal((2, 7, 9, 5)).astype(np.float32))
+    pooled_m, switch = ops.maxpool_with_switches(x, (2, 2))
+    pooled_a, idx = ops.maxpool_with_argmax(x, (2, 2))
+    assert idx.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(pooled_m), np.asarray(pooled_a))
+    g = jnp.asarray(rng.standard_normal(pooled_a.shape).astype(np.float32))
+    via_mask = ops.unpool_with_switches(g, switch, (2, 2))
+    via_idx = ops.unpool_with_argmax(g, idx, (2, 2), (7, 9))
+    np.testing.assert_array_equal(np.asarray(via_mask), np.asarray(via_idx))
