@@ -118,9 +118,30 @@ def _visualize_entry(
             # Mixed precision: selection ran on the exact forward; the
             # projection chain (8/9 of the FLOPs) runs in e.g. bfloat16.
             x = x.astype(backward_dtype)
-        for j in range(i, -1, -1):
+        j = i
+        while j >= 0:
+            e = entries[j]
+            # Peephole: a pool followed (downward) by the deconvnet
+            # backward-ReLU collapses into one fused unpool+ReLU op call.
+            # Equivalent on every dispatch path; matters for the pallas
+            # backend, whose opaque custom call would otherwise cost a
+            # full-res HBM pass for the separate elementwise ReLU.
+            if (
+                not e.is_companion_act
+                and e.layer.kind == "pool"
+                and j > 0
+                and entries[j - 1].is_companion_act
+                and entries[j - 1].layer.activation == "relu"
+            ):
+                sw_idx, out_hw = switches[e.name]
+                x = ops.unpool_with_argmax(
+                    x, sw_idx, e.layer.pool_size, out_hw, fuse_relu=True
+                )
+                j -= 2
+                continue
             prev_shape = ups[j - 1].shape if j > 0 else ups[0].shape
             x = _down_step(entries[j], params, x, switches, prev_shape, bug_compat)
+            j -= 1
         return x.astype(output.dtype)
 
     images = jax.vmap(backproject)(top_idx)  # (K, 1, H, W, C)

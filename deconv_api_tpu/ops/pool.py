@@ -45,6 +45,11 @@ def maxpool_with_argmax(
     """
     ph, pw = int(pool_size[0]), int(pool_size[1])
     b, h, w, c = x.shape
+    if h % ph == 0 and w % pw == 0:
+        from deconv_api_tpu.ops import pallas_pool
+
+        if pallas_pool.pallas_enabled("pool"):
+            return pallas_pool.maxpool_argmax(x, (ph, pw))
     ho, wo = h // ph, w // pw
     xt = x[:, : ho * ph, : wo * pw, :]
     # (B, Ho, ph, Wo, pw, C) -> (B, Ho, Wo, C, ph*pw): window as last axis.
@@ -63,6 +68,7 @@ def unpool_with_argmax(
     idx: jnp.ndarray,
     pool_size: Sequence[int] = (2, 2),
     out_hw: tuple[int, int] | None = None,
+    fuse_relu: bool = False,
 ) -> jnp.ndarray:
     """Scatter each pooled value to its window's argmax position — the
     reference's `np.kron(input, ones(tile)) * switch`
@@ -71,10 +77,23 @@ def unpool_with_argmax(
     never touches HBM).
 
     ``out_hw`` restores the original spatial extent when the pool size did
-    not divide it (trailing rows/cols come back as zeros).
+    not divide it (trailing rows/cols come back as zeros).  ``fuse_relu``
+    applies the deconvnet backward-ReLU as part of the scatter — the engine
+    uses it for the unpool+ReLU pair of the down chain; semantics hold on
+    every dispatch path (the pallas kernel folds it in; XLA fuses the
+    equivalent `relu(y)` below).
     """
     ph, pw = int(pool_size[0]), int(pool_size[1])
     b, ho, wo, c = y.shape
+    if out_hw is None or out_hw == (ho * ph, wo * pw):
+        from deconv_api_tpu.ops import pallas_pool
+
+        if pallas_pool.pallas_enabled("unpool"):
+            return pallas_pool.unpool_argmax(y, idx, (ph, pw), relu=fuse_relu)
+    if fuse_relu:
+        # relu(unpool(y)) == unpool(relu(y)): the scatter only places y
+        # values, zeros elsewhere
+        y = jnp.maximum(y, 0.0).astype(y.dtype)
     mask = _argmax_mask(idx, (ph, pw))
     up = y[:, :, None, :, None, :] * mask.astype(y.dtype)
     up = up.reshape(b, ho * ph, wo * pw, c)
