@@ -118,7 +118,7 @@ class DeconvService:
 
         if key[0] == "__dream__":
             return self._run_dream(key, images)
-        layer_name, mode, top_k = key
+        layer_name, mode, top_k, post = key
         fn = self.bundle.batched_visualizer(
             layer_name, mode, top_k, self.cfg.bug_compat,
             self.cfg.backward_dtype or None,
@@ -126,11 +126,19 @@ class DeconvService:
         bucket = pad_bucket(len(images), self.cfg.max_batch)
         batch = np.stack(images + [images[-1]] * (bucket - len(images)))
         out = fn(self.bundle.params, jnp.asarray(batch))[layer_name]
-        imgs = np.asarray(out["images"])  # (B, K, H, W, C)
         valid = np.asarray(out["valid"])  # (B, K)
         indices = np.asarray(out["indices"])
+        # Postprocess ON DEVICE so only uint8 crosses to the host — the
+        # fp32 projections are otherwise the request's dominant transfer.
+        if post == "grid":
+            grids = np.asarray(codec.stitch_grid_device(out["images"], out["valid"]))
+            return [
+                {"grid": grids[i], "valid": valid[i], "indices": indices[i]}
+                for i in range(len(images))
+            ]
+        tiles = np.asarray(codec.deprocess_tiles_device(out["images"]))
         return [
-            {"images": imgs[i], "valid": valid[i], "indices": indices[i]}
+            {"images": tiles[i], "valid": valid[i], "indices": indices[i]}
             for i in range(len(images))
         ]
 
@@ -166,12 +174,21 @@ class DeconvService:
                 else names[len(names) // 2]
             )
         img = np.zeros((self.cfg.image_size, self.cfg.image_size, 3), np.float32)
-        self._run_batch((layer, self.cfg.visualize_mode, self.cfg.top_k), [img])
+        # both route defaults, so /ready implies neither pays a first-hit
+        # compile: POST / uses (stitch_k, grid), /v1/deconv (top_k, tiles)
+        self._run_batch(
+            (layer, self.cfg.visualize_mode, self.cfg.stitch_k, "grid"), [img]
+        )
+        self._run_batch(
+            (layer, self.cfg.visualize_mode, self.cfg.top_k, "tiles"), [img]
+        )
         self.ready = True
 
     # ----------------------------------------------------------- pipeline
 
-    async def _project(self, form: dict[str, str], mode: str, top_k: int):
+    async def _project(
+        self, form: dict[str, str], mode: str, top_k: int, post: str
+    ):
         file_uri = form.get("file")
         layer = form.get("layer")
         if not file_uri or not layer:
@@ -181,16 +198,21 @@ class DeconvService:
                 f"model {self.bundle.name!r} has no projectable layer {layer!r}; "
                 f"known: {list(self.bundle.layer_names)}"
             )
-        with stage(self.metrics, "decode"):
+        def decode():
             try:
                 img = codec.decode_data_url(file_uri)
             except codec.CodecError as e:
                 raise errors.InvalidImage(str(e)) from e
             img = codec.resize224(img, (self.cfg.image_size, self.cfg.image_size))
-            x = self.bundle.preprocess(img)
+            return self.bundle.preprocess(img)
+
+        with stage(self.metrics, "decode"):
+            # off the event loop: JPEG decode is milliseconds of pure-C
+            # work per request and would serialize all concurrent requests
+            x = await asyncio.to_thread(decode)
 
         with stage(self.metrics, "compute"):
-            result = await self.dispatcher.submit(x, (layer, mode, top_k))
+            result = await self.dispatcher.submit(x, (layer, mode, top_k, post))
         return result
 
     # ------------------------------------------------------------- routes
@@ -214,18 +236,28 @@ class DeconvService:
         t0 = time.perf_counter()
         try:
             form = _parse_form(req)
+            # The reference ranks top-8 but serves tiles [0..3] (SURVEY
+            # §2.2.3/§2.2.4): the top-4 of 8 ARE the top-4, so computing
+            # stitch_k projections halves the backward work; the grid is
+            # stitched and deprocessed on device (reference order).
             result = await self._project(
-                form, self.cfg.visualize_mode, self.cfg.top_k
+                form, self.cfg.visualize_mode, self.cfg.stitch_k, "grid"
             )
             n_valid = int(result["valid"].sum())
+            if n_valid == 0:
+                # nothing fired: an all-gray grid with HTTP 200 would be a
+                # silent lie (the pre-device-stitch code 400'd here too)
+                raise errors.NoActiveFilters(
+                    f"no filters fired for layer {form['layer']!r}"
+                )
             if self.cfg.strict_compat and n_valid < self.cfg.stitch_k:
                 raise errors.NoActiveFilters(
                     f"only {n_valid} filters fired; need {self.cfg.stitch_k}"
                 )
-            tiles = [result["images"][k] for k in range(min(n_valid, self.cfg.stitch_k))]
             with stage(self.metrics, "encode"):
-                grid = codec.stitch_grid(tiles)
-                data_url = codec.encode_data_url(codec.deprocess_image(grid))
+                data_url = await asyncio.to_thread(
+                    codec.encode_data_url, result["grid"]
+                )
         except errors.DeconvError as e:
             self.metrics.observe_request(time.perf_counter() - t0, e.code)
             return Response.json({"error": e.code, "detail": e.message}, e.status)
@@ -247,12 +279,14 @@ class DeconvService:
             top_k = int(form.get("top_k", self.cfg.top_k))
             if not 1 <= top_k <= 64:
                 raise errors.BadRequest("top_k must be in [1, 64]")
-            result = await self._project(form, mode, top_k)
+            result = await self._project(form, mode, top_k, "tiles")
             n_valid = int(result["valid"].sum())
-            images = [
-                codec.encode_data_url(codec.deprocess_image(result["images"][k]))
-                for k in range(n_valid)
-            ]
+            images = await asyncio.to_thread(
+                lambda: [
+                    codec.encode_data_url(result["images"][k])
+                    for k in range(n_valid)
+                ]
+            )
         except errors.DeconvError as e:
             self.metrics.observe_request(time.perf_counter() - t0, e.code)
             return Response.json({"error": e.code, "detail": e.message}, e.status)

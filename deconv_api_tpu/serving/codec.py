@@ -146,3 +146,72 @@ def encode_data_url(img_uint8: np.ndarray) -> str:
         Image.fromarray(img_uint8[:, :, ::-1]).save(bio, format="JPEG")
         raw = bio.getvalue()
     return "data:image/webp;base64,{}".format(quote(base64.b64encode(raw).decode("ascii")))
+
+
+# --- device-side postprocessing --------------------------------------------
+# The fp32 projection stack is the largest device->host transfer of a
+# request (top_k * H * W * C * 4 bytes); deprocessing — and for the compat
+# route, stitching — ON DEVICE cuts the transfer 4-16x to one uint8 image.
+# Semantics are bit-matched to the NumPy functions above (same truncating
+# uint8 cast, same EPSILON, and the reference's stitch-THEN-deprocess
+# order, app/main.py:67-72).
+
+
+def _deprocess_jax(x):
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.float32)
+    x = x - x.mean()
+    x = x / (x.std() + EPSILON)
+    x = x * 0.1 + 0.5
+    x = jnp.clip(x, 0.0, 1.0) * 255.0
+    return jnp.clip(x, 0.0, 255.0).astype(jnp.uint8)
+
+
+import functools as _functools
+
+
+@_functools.cache
+def _deprocess_tiles_jit():
+    import jax
+
+    return jax.jit(jax.vmap(jax.vmap(_deprocess_jax)))
+
+
+def deprocess_tiles_device(images):
+    """(B, K, H, W, C) projections -> uint8, each tile normalized alone
+    (the /v1/deconv per-filter presentation).  The jitted callable is
+    memoized — pjit's trace cache keys on function identity, so a fresh
+    wrapper per call would retrace on the hot serving path."""
+    return _deprocess_tiles_jit()(images)
+
+
+@_functools.cache
+def _stitch_grid_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(images, valid):
+        b, k = images.shape[:2]
+        if k < 4:
+            pad = jnp.zeros((b, 4 - k, *images.shape[2:]), images.dtype)
+            images = jnp.concatenate([images, pad], axis=1)
+            valid = jnp.concatenate(
+                [valid, jnp.zeros((b, 4 - k), valid.dtype)], axis=1
+            )
+        tiles = images[:, :4] * valid[:, :4, None, None, None].astype(images.dtype)
+        top = jnp.concatenate([tiles[:, 0], tiles[:, 1]], axis=2)
+        bottom = jnp.concatenate([tiles[:, 2], tiles[:, 3]], axis=2)
+        grid = jnp.concatenate([top, bottom], axis=1)
+        return jax.vmap(_deprocess_jax)(grid)
+
+    return run
+
+
+def stitch_grid_device(images, valid):
+    """(B, K, H, W, C) + (B, K) validity -> (B, 2H, 2W, C) uint8: zero the
+    tiles that didn't fire, stitch 2x2, deprocess over the WHOLE grid —
+    the reference's order (stitch at app/main.py:67-69, deprocess of the
+    stitched grid at :72), which normalizes all four tiles jointly."""
+    return _stitch_grid_jit()(images, valid)

@@ -170,3 +170,34 @@ REGISTRY: dict[str, Callable[[], ModelBundle]] = {
     "resnet50": _resnet50_bundle,
     "inception_v3": _inception_v3_bundle,
 }
+
+
+def registry_info() -> list[dict]:
+    """Static metadata for each registered model — no weight init, no
+    device touch (the CLI's `models` listing must work instantly)."""
+    from deconv_api_tpu.models.inception_v3 import DREAM_LAYERS
+    from deconv_api_tpu.models.resnet50 import DECONV_LAYERS
+    from deconv_api_tpu.models.vgg16 import VGG16_SPEC as spec
+    return [
+        {
+            "model": "vgg16",
+            "image_size": 224,
+            "engine": "switch-deconv (sequential spec)",
+            "layers": [l.name for l in spec.layers if l.kind != "input"],
+            "dream_layers": ["block4_conv3", "block5_conv1"],
+        },
+        {
+            "model": "resnet50",
+            "image_size": 224,
+            "engine": "autodiff-deconv (DAG)",
+            "layers": list(DECONV_LAYERS),
+            "dream_layers": ["conv4_block3_out", "conv4_block6_out"],
+        },
+        {
+            "model": "inception_v3",
+            "image_size": 299,
+            "engine": "autodiff-deconv (DAG)",
+            "layers": [f"mixed{i}" for i in range(11)],
+            "dream_layers": list(DREAM_LAYERS),
+        },
+    ]

@@ -79,3 +79,33 @@ def test_encode_data_url_wire_format(rng):
     payload = unquote(url.split(",", 1)[1])
     raw = base64.b64decode(payload)
     assert raw[:2] == b"\xff\xd8"  # actually JPEG, as in the reference
+
+
+def test_device_postprocess_matches_host_reference():
+    """stitch_grid_device/deprocess_tiles_device must match the NumPy path
+    (same truncating uint8 cast, same stitch-then-deprocess order)."""
+    import numpy as np
+
+    from deconv_api_tpu.serving.codec import (
+        deprocess_image,
+        deprocess_tiles_device,
+        stitch_grid,
+        stitch_grid_device,
+    )
+
+    rng = np.random.default_rng(3)
+    images = rng.standard_normal((2, 4, 8, 8, 3)).astype(np.float32) * 5
+    valid = np.array([[True, True, True, True], [True, True, False, False]])
+
+    got = np.asarray(stitch_grid_device(images, valid))
+    for b in range(2):
+        tiles = [images[b, k] for k in range(4) if valid[b, k]]
+        want = deprocess_image(stitch_grid(tiles))
+        np.testing.assert_array_equal(want, got[b])
+
+    got_tiles = np.asarray(deprocess_tiles_device(images))
+    for b in range(2):
+        for k in range(4):
+            np.testing.assert_array_equal(
+                deprocess_image(images[b, k]), got_tiles[b, k]
+            )
