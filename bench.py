@@ -5,6 +5,16 @@ visualizations at 224x224, batched, on the real attached chip.  Prints ONE
 JSON line: {"metric", "value", "unit", "vs_baseline"} where vs_baseline is
 value / 200 img/s — the BASELINE.json north-star for a v5e-1.
 
+Robustness (round-2 fix): when the axon TPU tunnel is down, default
+backend init does not raise — it HANGS indefinitely (verified), so no
+in-process retry can save the round artifact.  bench.py therefore runs as
+a parent orchestrator: the actual measurement happens in a child
+subprocess under a hard timeout, retried with backoff across tunnel
+flaps, then falls back to a forced-CPU child (config-level
+`jax_platforms=cpu` override — the only form that reliably bypasses axon
+plugin init).  ANY terminal failure still emits one machine-readable JSON
+line with an "error" field; the driver never sees an unparseable artifact.
+
 Timing methodology: `jax.block_until_ready` does not reliably await remote
 execution over the axon tunnel (observed returning in ~0.1 ms for work that
 measurably takes ~70 ms), so each iteration is synchronized by fetching a
@@ -20,23 +30,140 @@ linear projection chain's bf16 rounding disappears under deprocess
 quantisation), far above the 40 dB target.  Full-bf16 forward is NOT used:
 it lands at ~38.7 dB.  DECONV_BACKWARD_DTYPE=float32 forces full fp32.
 
-Extra diagnostics go to stderr; stdout carries exactly the one JSON line.
+MFU accounting: FLOPs come from XLA's own cost analysis of the compiled
+program (fallback: analytic conv-chain model in bench/flops.py); peak is
+394 TFLOP/s bf16 for TPU v5e (the measured path's backward projections —
+where ~8/9 of the FLOPs are — run in bf16).
 """
 
 from __future__ import annotations
 
 import json
 import math
+import os
+import subprocess
 import sys
 import time
+
+# v5e chip peak: 197 TFLOP/s bf16 (394 is the int8 figure); used for the
+# MFU line when running on TPU.
+V5E_BF16_PEAK_TFLOPS = 197.0
+NORTH_STAR_IMG_S = 200.0
+METRIC_NAME = "VGG16 block5_conv1 deconv images/sec (224x224)"
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def emit(payload: dict) -> None:
+    """The one stdout JSON line the driver parses."""
+    print(json.dumps(payload), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Parent: orchestrate the measurement child under timeouts + retries.
+# --------------------------------------------------------------------------
+
+
+def _run_child(force_cpu: bool, timeout_s: float) -> dict | None:
+    """One measurement attempt in a subprocess; returns parsed JSON or None.
+
+    stderr streams through (diagnostics); stdout is captured and the last
+    JSON-parseable line is the result.
+    """
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+    if force_cpu:
+        cmd.append("--cpu")
+    try:
+        proc = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=None,  # inherit: child diagnostics land on our stderr
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        log(f"measurement child timed out after {timeout_s:.0f}s")
+        return None
+    if proc.returncode != 0:
+        log(f"measurement child failed (rc={proc.returncode})")
+        return None
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    log("measurement child produced no JSON line")
+    return None
+
+
+def main_parent(force_cpu: bool = False) -> None:
+    timeout_s = float(os.environ.get("DECONV_BENCH_TIMEOUT", "900"))
+    tries = int(os.environ.get("DECONV_BENCH_TRIES", "3"))
+    delay = 15.0
+    if not force_cpu:
+        for attempt in range(1, tries + 1):
+            log(f"bench attempt {attempt}/{tries} (default backend)")
+            result = _run_child(force_cpu=False, timeout_s=timeout_s)
+            if result is not None:
+                emit(result)
+                return
+            if attempt < tries:
+                log(f"retrying in {delay:.0f}s (tunnel flaps are transient)")
+                time.sleep(delay)
+                delay = min(delay * 2, 120.0)
+        log("default backend unusable; falling back to forced-CPU measurement")
+    result = _run_child(force_cpu=True, timeout_s=timeout_s)
+    if result is not None:
+        emit(result)
+        return
+    emit(
+        {
+            "metric": METRIC_NAME,
+            "value": None,
+            "unit": "images/sec",
+            "vs_baseline": None,
+            "error": "backend unavailable: TPU attempts timed out/failed "
+            "and CPU fallback failed",
+        }
+    )
+    sys.exit(1)
+
+
+# --------------------------------------------------------------------------
+# Child: the actual measurement.
+# --------------------------------------------------------------------------
+
+
+def _compiled_flops(fn, params, example_batch) -> float | None:
+    """Per-program FLOPs from XLA cost analysis; None if unavailable.
+
+    ``fn`` is the already-jitted visualizer, so ``fn.lower(...).compile()``
+    reuses the executable compiled by the measurement itself (no second
+    compile — first compiles over the tunnel cost tens of seconds)."""
+    try:
+        compiled = fn.lower(params, example_batch).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception as e:  # noqa: BLE001
+        log(f"cost_analysis unavailable: {e!r}")
+        return None
+
+
+def main_child(force_cpu: bool) -> None:
     import jax
+
+    if force_cpu:
+        # Config-level override — the ONLY form that reliably prevents the
+        # axon TPU plugin from initialising (env JAX_PLATFORMS does not).
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
@@ -46,13 +173,14 @@ def main() -> None:
     cfg = ServerConfig.from_env()
     enable_compilation_cache(cfg)
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    log(f"device: {dev} ({dev.platform})")
+    platform = dev.platform
+    on_tpu = platform == "tpu"
+    log(f"device: {dev} ({platform})")
 
     # Batch 64 saturates a v5e-1 with the compact int8 switch form; CPU runs
-    # (driver smoke tests) use a small batch/iter count to stay fast.
-    batch = 64 if on_tpu else 2
-    iters = 10 if on_tpu else 2
+    # (driver smoke tests / fallback) use a small batch/iter count.
+    batch = int(os.environ.get("DECONV_BENCH_BATCH", 64 if on_tpu else 2))
+    iters = int(os.environ.get("DECONV_BENCH_ITERS", 10 if on_tpu else 2))
     layer = "block5_conv1"
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
@@ -83,29 +211,72 @@ def main() -> None:
     compile_s = time.perf_counter() - t0
     log(f"first call (compile+run): {compile_s:.1f}s (checksum {val:.3e})")
 
-    t0 = time.perf_counter()
-    sums = [checksum(fn(params, b)) for b in batches]
-    vals = [float(s) for s in sums]
-    dt = time.perf_counter() - t0
+    from contextlib import nullcontext
+
+    from deconv_api_tpu.utils.tracing import profile_trace
+
+    trace_cm = (
+        profile_trace(cfg.profile_dir) if cfg.profile_dir else nullcontext()
+    )
+    with trace_cm:
+        t0 = time.perf_counter()
+        sums = [checksum(fn(params, b)) for b in batches]
+        vals = [float(s) for s in sums]
+        dt = time.perf_counter() - t0
     assert all(math.isfinite(v) for v in vals), "non-finite checksum"
     images_per_sec = batch * iters / dt
     ms_per_batch = dt / iters * 1e3
     log(
-        f"{iters} iters x batch {batch} (fwd {cfg.dtype}, bwd {cfg.backward_dtype or cfg.dtype}): {dt:.3f}s -> "
+        f"{iters} iters x batch {batch} (fwd {cfg.dtype}, bwd "
+        f"{cfg.backward_dtype or cfg.dtype}): {dt:.3f}s -> "
         f"{images_per_sec:.1f} img/s, {ms_per_batch:.1f} ms/batch"
     )
 
-    print(
-        json.dumps(
-            {
-                "metric": f"VGG16 {layer} deconv images/sec (224x224, batch {batch})",
-                "value": round(images_per_sec, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(images_per_sec / 200.0, 3),
-            }
+    # --- FLOPs / MFU ---
+    program_flops = _compiled_flops(fn, params, batches[0])
+    if program_flops is None:
+        try:
+            from deconv_api_tpu.bench.flops import vgg16_deconv_flops
+
+            program_flops = vgg16_deconv_flops(batch, layer, top_k=8)
+            log("FLOPs: analytic model (XLA cost analysis unavailable)")
+        except Exception as e:  # noqa: BLE001
+            log(f"analytic FLOPs model unavailable: {e!r}")
+    tflops_s = mfu_pct = None
+    if program_flops:
+        tflops_s = program_flops * iters / dt / 1e12
+        log(
+            f"program FLOPs: {program_flops / 1e9:.1f} GFLOP/batch "
+            f"({program_flops / batch / 1e9:.2f} GFLOP/img) -> "
+            f"{tflops_s:.1f} TFLOP/s"
         )
-    )
+        if on_tpu:
+            mfu_pct = 100.0 * tflops_s / V5E_BF16_PEAK_TFLOPS
+            log(f"MFU: {mfu_pct:.1f}% of v5e bf16 peak ({V5E_BF16_PEAK_TFLOPS} TF/s)")
+
+    suffix = "" if on_tpu else f" [{platform} fallback]"
+    payload = {
+        "metric": f"VGG16 {layer} deconv images/sec (224x224, batch {batch}){suffix}",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / NORTH_STAR_IMG_S, 3),
+    }
+    if tflops_s is not None:
+        payload["tflops"] = round(tflops_s, 2)
+    if mfu_pct is not None:
+        payload["mfu_pct"] = round(mfu_pct, 2)
+    emit(payload)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        try:
+            main_child(force_cpu="--cpu" in sys.argv)
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            log(f"child failed: {type(e).__name__}: {e}")
+            sys.exit(1)
+    else:
+        main_parent(force_cpu="--cpu" in sys.argv)

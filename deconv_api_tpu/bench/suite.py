@@ -109,11 +109,15 @@ def config2_sweep(iters: int = 5) -> dict:
         jax.random.normal(jax.random.PRNGKey(i), (8, 224, 224, 3))
         for i in range(iters)
     ]
+    # Count projected layers from the visualizer itself (the sweep projects
+    # every conv AND pool entry from block5_conv1 down — 15 for VGG16, not
+    # the 13 conv layers alone).
+    layers_projected = len(jax.eval_shape(fn, params, batches[0]))
     per_batch_s = _timed(lambda b: fn(params, b), batches, checksum)
     return {
         "config": 2,
         "batch": 8,
-        "layers_projected": 13,
+        "layers_projected": layers_projected,
         "batch_latency_ms": round(per_batch_s * 1e3, 1),
         "images_per_sec": round(8 / per_batch_s, 2),
     }

@@ -27,6 +27,10 @@ class ServerConfig:
     # batching dispatcher (fixes the reference's 1-concurrency, SURVEY §2.2.5)
     max_batch: int = 8
     batch_window_ms: float = 3.0
+    # Warm every power-of-two batch bucket at startup (the first concurrent
+    # burst otherwise pays a per-bucket XLA compile at request time); off =
+    # warm only the smallest bucket (fast dev/test startup).
+    warmup_all_buckets: bool = True
     request_timeout_s: float = 60.0
     dream_timeout_s: float = 300.0  # dreams run minutes; own queue + timeout
     # device placement

@@ -238,7 +238,16 @@ def _unpool_op(ph: int, pw: int, relu: bool = False):
         v, b = y.shape[0], y.shape[1]
         if in_batched[1]:
             idx = idx.reshape(idx.shape[0] * idx.shape[1], *idx.shape[2:])
-        # unbatched idx (switches shared across the mapped axis, e.g. the
+        elif idx.shape[0] > 1:
+            # Unbatched idx with its own batch > 1: the flattened y is
+            # vmap-axis-major (slice i = vi*b + k), so the kernel's
+            # `i // rep` index map would pair y slices with the WRONG
+            # switch blocks ({0,0,1,1,...} instead of {0,1,...,0,1,...}).
+            # Tile idx along the new leading axis so pairing stays
+            # vmap-axis-major; `rep` inside the kernel then reduces to the
+            # pre-vmap ratio and the arithmetic lines up again.
+            idx = jnp.tile(idx, (v,) + (1,) * (idx.ndim - 1))
+        # idx batch == 1 (switches shared across the mapped axis, e.g. the
         # K projected filters) passes through untouched: the kernel's grid
         # index map replays each switch block `rep` times instead of
         # materialising a K-fold broadcast in HBM

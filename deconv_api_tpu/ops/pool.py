@@ -17,7 +17,7 @@ the exact same switch semantics.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Sequence
 
 import jax
@@ -154,27 +154,43 @@ def unpool_with_switches(
     return up * switch
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
+@lru_cache(maxsize=64)
+def _maxpool_switched_op(pool_size: tuple[int, int], out_hw: tuple[int, int]):
+    """custom_vjp instance per (pool_size, input H/W).
+
+    The static output extent lives in the closure, NOT in the residual
+    pytree: residual leaves become tracers when the VJP is traced under
+    jit, and `unpool_with_argmax` needs `out_hw` concrete (tuple equality
+    + pad widths).  Shapes are always static in jax, so closing over them
+    is free; the cache keeps one op per distinct spatial extent.
+    """
+
+    @jax.custom_vjp
+    def op(x):
+        pooled, _ = maxpool_with_argmax(x, pool_size)
+        return pooled
+
+    def fwd(x):
+        pooled, idx = maxpool_with_argmax(x, pool_size)
+        return pooled, idx
+
+    def bwd(idx, g):
+        return (unpool_with_argmax(g, idx, pool_size, out_hw),)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
 def maxpool_switched(x: jnp.ndarray, pool_size: tuple[int, int] = (2, 2)):
     """Max-pool whose VJP routes cotangents through deconvnet switches.
 
-    Used by the autodiff deconv path (engine/autodeconv.py) so that
-    `jax.vjp` of a whole model reproduces the reference's unpool-with-switch
-    semantics (including first-index tie-breaks, which XLA's native
-    reduce-window gradient does not guarantee).
+    A drop-in pooling op for models that want `jax.vjp` to reproduce the
+    reference's unpool-with-switch semantics exactly — including the
+    first-index tie-break, which XLA's native reduce-window gradient does
+    not guarantee.  The DAG engine (engine/autodeconv.py) currently uses
+    the native gradient (ties are measure-zero for real-valued
+    activations); this op is the exact-tie-break alternative, exercised by
+    tests.  Safe under jit (including jit-of-grad): all static shape data
+    stays out of the residuals.
     """
-    pooled, _ = maxpool_with_argmax(x, pool_size)
-    return pooled
-
-
-def _maxpool_switched_fwd(x, pool_size):
-    pooled, idx = maxpool_with_argmax(x, pool_size)
-    return pooled, (idx, x.shape[1:3])
-
-
-def _maxpool_switched_bwd(pool_size, res, g):
-    idx, out_hw = res
-    return (unpool_with_argmax(g, idx, pool_size, out_hw),)
-
-
-maxpool_switched.defvjp(_maxpool_switched_fwd, _maxpool_switched_bwd)
+    return _maxpool_switched_op(tuple(pool_size), x.shape[1:3])(x)

@@ -16,6 +16,23 @@ from deconv_api_tpu.models.spec import ModelSpec
 from deconv_api_tpu.parallel.mesh import batch_sharding, replicated
 
 
+def shard_batched_fn(fn, mesh):
+    """Wrap any ``fn(params, batch)`` whose outputs all carry a leading
+    batch axis: params replicated, batch (in and out) sharded over ``dp``.
+
+    This is THE serving sharding rule — both the standalone
+    `sharded_visualizer` and the HTTP path (serving/models.py
+    ModelBundle.batched_visualizer with a mesh) go through it, so the two
+    cannot drift.  Per-call batch sizes must be a multiple of the dp axis
+    size; the serving dispatcher rounds its buckets up to that multiple
+    (serving/app.py:_bucket_for)."""
+    return jax.jit(
+        fn,
+        in_shardings=(replicated(mesh), batch_sharding(mesh)),
+        out_shardings=batch_sharding(mesh),
+    )
+
+
 def sharded_visualizer(
     spec: ModelSpec,
     mesh,
@@ -23,17 +40,11 @@ def sharded_visualizer(
     top_k: int = 8,
     mode: str = "all",
     bug_compat: bool = True,
+    backward_dtype: str | None = None,
 ):
-    """Jitted ``fn(params, batch)`` with batch sharded over ``dp``.
-
-    The per-call batch size must be a multiple of the dp axis size (the
-    serving dispatcher's power-of-two padding guarantees this once
-    max_batch >= dp)."""
+    """Jitted ``fn(params, batch)`` with batch sharded over ``dp``."""
     fn = get_visualizer(
-        spec, layer_name, top_k, mode, bug_compat, sweep=False, batched=True
+        spec, layer_name, top_k, mode, bug_compat, sweep=False, batched=True,
+        backward_dtype=backward_dtype,
     )
-    return jax.jit(
-        fn,
-        in_shardings=(replicated(mesh), batch_sharding(mesh)),
-        out_shardings=batch_sharding(mesh),
-    )
+    return shard_batched_fn(fn, mesh)

@@ -32,6 +32,8 @@ class ModelBundle:
     unpreprocess: Callable[[np.ndarray], np.ndarray] = codec.unpreprocess_vgg
     min_dream_size: int = 16  # smallest octave edge the trunk accepts
     spec: object = None  # ModelSpec, set for sequential models
+    mesh: object = None  # jax.sharding.Mesh — set by DeconvService when
+    # cfg.mesh_shape is configured; visualizers then run dp-sharded
     _vis_cache: dict = dataclasses.field(default_factory=dict)
     _dream_cache: dict = dataclasses.field(default_factory=dict)
 
@@ -86,9 +88,21 @@ class ModelBundle:
                     sweep=False, batched=True,
                     backward_dtype=backward_dtype or None,
                 )
+                if self.mesh is not None:
+                    from deconv_api_tpu.parallel.batch import shard_batched_fn
+
+                    fn = shard_batched_fn(fn, self.mesh)
             else:
-                single = autodeconv_visualizer(self.forward_fn, layer, top_k, mode)
-                vmapped = jax.jit(jax.vmap(single, in_axes=(None, 0)))
+                vmapped = jax.vmap(
+                    autodeconv_visualizer(self.forward_fn, layer, top_k, mode),
+                    in_axes=(None, 0),
+                )
+                if self.mesh is not None:
+                    from deconv_api_tpu.parallel.batch import shard_batched_fn
+
+                    vmapped = shard_batched_fn(vmapped, self.mesh)
+                else:
+                    vmapped = jax.jit(vmapped)
                 fn = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
             self._vis_cache[key] = fn
         return self._vis_cache[key]

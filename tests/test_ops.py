@@ -172,3 +172,19 @@ def test_argmax_form_equivalent_to_mask_form(rng):
     via_mask = ops.unpool_with_switches(g, switch, (2, 2))
     via_idx = ops.unpool_with_argmax(g, idx, (2, 2), (7, 9))
     np.testing.assert_array_equal(np.asarray(via_mask), np.asarray(via_idx))
+
+
+def test_maxpool_switched_jit_grad(rng):
+    """ADVICE r1 regression: maxpool_switched's VJP must be jit-safe — the
+    static out_hw lives in a closure, not the residual pytree (residual
+    leaves become tracers under jit and broke the unpool pad widths).
+    Odd spatial dims exercise the out_hw restore path."""
+    x = jnp.asarray(rng.standard_normal((2, 7, 9, 3)).astype(np.float32))
+
+    def loss(a):
+        return jnp.sum(ops.maxpool_switched(a, (2, 2)) ** 2)
+
+    g_eager = jax.grad(loss)(x)
+    g_jit = jax.jit(jax.grad(loss))(x)
+    assert g_jit.shape == x.shape
+    np.testing.assert_allclose(np.asarray(g_eager), np.asarray(g_jit))

@@ -101,3 +101,25 @@ def test_vmap_composition_matches_xla(rng):
         y, idx[:, None]
     )
     np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+@pytest.mark.parametrize("idx_batch,rep", [(4, 1), (4, 2)])
+def test_vmap_unbatched_idx_with_own_batch(rng, idx_batch, rep):
+    """ADVICE r1 regression: idx closed over (unbatched) by vmap while
+    carrying its own batch > 1 must pair switch blocks vmap-axis-major.
+    The old rule passed idx through raw, so the kernel's `i // rep` map
+    paired y slice vi*b+k with idx block (vi*b+k)//rep — consecutive
+    blocks — instead of replaying idx per vmap slice."""
+    import deconv_api_tpu.ops.pallas_pool as pp
+
+    x = jnp.asarray(
+        rng.standard_normal((idx_batch, 8, 8, 4)).astype(np.float32)
+    )
+    _, idx = maxpool_with_argmax(x, (2, 2))  # (idx_batch, 4, 4, 4)
+    v, b = 2, idx_batch * rep
+    y = jnp.asarray(rng.standard_normal((v, b, 4, 4, 4)).astype(np.float32))
+
+    op = pp._unpool_op(2, 2)
+    got = jax.vmap(lambda yv: op(yv, idx))(y)
+    want = jax.vmap(lambda yv: unpool_with_argmax(yv, jnp.repeat(idx, rep, 0), (2, 2)))(y)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))

@@ -320,3 +320,81 @@ def test_config_not_mutated_by_service():
     svc = DeconvService(cfg, spec=TINY, params=params)
     assert cfg.image_size == 0
     assert svc.cfg.image_size == TINY.input_shape[0]
+
+
+# ---------------------------------------------------------------- mesh serving
+
+
+def _decode_grid(data_url: str) -> np.ndarray:
+    import cv2
+
+    raw = base64.b64decode(unquote(data_url.split(",", 1)[1]))
+    return cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+
+
+def test_mesh_sharded_serving_end_to_end():
+    """VERDICT r1 next-step #2: cfg.mesh_shape routes the real HTTP path
+    through the dp-sharded visualizer.  Boots one server on an 8-device CPU
+    mesh and one single-device server with identical params, drives 32
+    concurrent POST / requests, and requires (a) all 200s, (b) pixel-equal
+    grids between the two servers, (c) dp-sharded visualizer outputs."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg_mesh = ServerConfig(
+        image_size=16,
+        max_batch=8,
+        batch_window_ms=20.0,
+        mesh_shape=(8,),
+        warmup_all_buckets=False,
+        compilation_cache_dir="",
+    )
+    cfg_single = dataclasses.replace(cfg_mesh, mesh_shape=())
+
+    def drive(cfg):
+        grids = {}
+        with ServiceFixture(cfg) as s:
+            if cfg.mesh_shape:
+                assert s.service.mesh is not None
+                # every dispatch must shard evenly over dp=8 (the batch
+                # never exceeds max_batch: the dispatcher drains at most
+                # that many requests per group)
+                assert s.service._bucket_for(1) == 8
+                assert s.service._bucket_for(8) == 8
+            def one(i):
+                r = httpx.post(
+                    s.base_url + "/",
+                    data={"file": _data_url(i), "layer": "b2c1"},
+                    timeout=120,
+                )
+                assert r.status_code == 200, r.text
+                grids[i] = _decode_grid(r.json())
+
+            threads = [
+                threading.Thread(target=lambda i=i: one(i)) for i in range(32)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            assert len(grids) == 32
+
+            if cfg.mesh_shape:
+                # the visualizer the HTTP path uses really is dp-sharded
+                fn = s.service.bundle.batched_visualizer(
+                    "b2c1", "all", 4, True, None
+                )
+                out = fn(
+                    s.service.bundle.params, jnp.zeros((8, 16, 16, 3))
+                )["b2c1"]
+                sh = out["images"].sharding
+                assert isinstance(sh, NamedSharding)
+                assert sh.spec == P("dp")
+        return grids
+
+    mesh_grids = drive(cfg_mesh)
+    single_grids = drive(cfg_single)
+    for i in range(32):
+        np.testing.assert_array_equal(mesh_grids[i], single_grids[i])
