@@ -47,16 +47,24 @@ def resnet50_init(key: jax.Array | None = None, num_classes: int = 1000) -> dict
     return params
 
 
+# Keras ResNet50 BatchNormalization uses epsilon=1.001e-5 (not the 1e-3
+# Keras default that InceptionV3's conv2d_bn inherits) — load-bearing for
+# pretrained-weight parity where running variances are small.
+_BN_EPS = 1.001e-5
+
+
 def _bottleneck(p: dict, x: jnp.ndarray, rules: B.Rules, stride: int) -> jnp.ndarray:
     """Keras-v1 bottleneck: stride sits on the first 1x1 conv and on the
     projection shortcut."""
     if "proj" in p:
-        shortcut = B.conv_bn(p["proj"], x, rules, strides=(stride, stride), relu=False)
+        shortcut = B.conv_bn(
+            p["proj"], x, rules, strides=(stride, stride), relu=False, eps=_BN_EPS
+        )
     else:
         shortcut = x
-    y = B.conv_bn(p["c1"], x, rules, strides=(stride, stride))
-    y = B.conv_bn(p["c2"], y, rules)
-    y = B.conv_bn(p["c3"], y, rules, relu=False)
+    y = B.conv_bn(p["c1"], x, rules, strides=(stride, stride), eps=_BN_EPS)
+    y = B.conv_bn(p["c2"], y, rules, eps=_BN_EPS)
+    y = B.conv_bn(p["c3"], y, rules, relu=False, eps=_BN_EPS)
     return rules.relu(y + shortcut)
 
 
@@ -70,7 +78,7 @@ def resnet50_forward(
     """Returns (output, activations).  `activations` carries the named
     endpoints the deconv/DeepDream engines seed from."""
     acts: dict[str, jnp.ndarray] = {}
-    y = B.conv_bn(params["conv1"], x, rules, strides=(2, 2))
+    y = B.conv_bn(params["conv1"], x, rules, strides=(2, 2), eps=_BN_EPS)
     acts["conv1_relu"] = y
     y = B.maxpool(y, 3, 2, padding="SAME")
     acts["pool1_pool"] = y

@@ -57,18 +57,13 @@ class DeconvService:
                 )
             self.bundle = REGISTRY[self.cfg.model]()
             if self.cfg.weights_path:
-                if self.bundle.spec is None:
-                    # Silently serving random-init weights would be worse
-                    # than refusing to start.
-                    raise ValueError(
-                        f"weights_path is only supported for sequential-spec "
-                        f"models (a Keras .h5 loader for {self.cfg.model!r} "
-                        "does not exist yet)"
-                    )
-                from deconv_api_tpu.models.weights import load_weights
+                from deconv_api_tpu.models.weights import load_model_weights
 
-                self.bundle.params = load_weights(
-                    self.bundle.spec, self.cfg.weights_path, self.bundle.params
+                self.bundle.params = load_model_weights(
+                    self.cfg.model,
+                    self.bundle.spec,
+                    self.cfg.weights_path,
+                    self.bundle.params,
                 )
         if self.cfg.image_size <= 0:
             # resolve on a copy: the caller's config object stays untouched
