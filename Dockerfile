@@ -1,0 +1,24 @@
+# Serving container — reference parity: /root/reference/Dockerfile:1-15
+# (python:3.7 + pip install + uvicorn on port 80), rebuilt for the JAX
+# stack.  Default target is CPU (works anywhere); for TPU hosts install
+# the tpu extra instead and drop DECONV_PLATFORM.
+FROM python:3.12-slim
+
+WORKDIR /srv/deconv_api_tpu
+
+COPY pyproject.toml README.md ./
+COPY deconv_api_tpu ./deconv_api_tpu
+RUN pip install --no-cache-dir ".[codecs]"
+
+# The reference serves on port 80 (Dockerfile:15); same here.
+EXPOSE 80
+ENV DECONV_HOST=0.0.0.0 \
+    DECONV_PORT=80 \
+    DECONV_MODEL=vgg16
+# On CPU images force the CPU backend so a TPU plugin probe can't stall
+# startup; unset (or set to tpu) on TPU hosts.
+ENV DECONV_PLATFORM=cpu
+# Pretrained weights: mount a Keras .h5 / .npz / orbax dir and point
+# DECONV_WEIGHTS_PATH at it (no network egress at build time).
+
+CMD ["deconv-api-tpu", "serve"]

@@ -1,0 +1,27 @@
+"""deconv_api_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework with the
+capabilities of rashanarshad/deconv_api.
+
+The reference (see /root/reference, surveyed in SURVEY.md) is a Keras 2.3/TF1
+FastAPI service serving Zeiler–Fergus deconvnet visualizations of VGG16
+(reference: app/deepdream.py, app/main.py).  This package is a from-scratch
+rebuild designed for TPU:
+
+- ``ops``      — pure-functional XLA ops: conv / transposed conv, max-pool with
+                 argmax switches, unpool, dense, activations (incl. the
+                 deconvnet backward-ReLU as a ``jax.custom_vjp``).
+- ``models``   — a layer-spec IR plus a model zoo (VGG16, ResNet50,
+                 InceptionV3) as params pytrees + pure apply functions.
+- ``engine``   — the deconv visualizer as ONE jit-compiled XLA program
+                 (forward with switch recording, in-graph top-K filter
+                 selection, vmapped masked backward projection), plus a
+                 DeepDream gradient-ascent engine (jax.grad + octaves) and an
+                 autodiff-based deconv path for DAG/strided models.
+- ``parallel`` — jax.sharding.Mesh helpers and shard_map'd data-parallel
+                 batch execution over TPU cores.
+- ``train``    — sharded (dp x tp) fine-tuning step for the model zoo.
+- ``serving``  — wire-compatible HTTP surface (GET /health-check, POST /)
+                 on a minimal asyncio server with an async batching
+                 dispatcher, image codec, metrics and tracing.
+"""
+
+__version__ = "0.1.0"

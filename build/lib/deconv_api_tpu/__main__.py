@@ -1,0 +1,3 @@
+from deconv_api_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,3 @@
+from deconv_api_tpu.bench.suite import CONFIGS, run_config
+
+__all__ = ["CONFIGS", "run_config"]

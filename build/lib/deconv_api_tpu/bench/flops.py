@@ -1,0 +1,62 @@
+"""Analytic FLOPs model for the deconv visualization workload.
+
+Used by bench.py's MFU line when XLA's compiled-program cost analysis is
+unavailable (e.g. over the axon tunnel).  The model counts multiply-add
+FLOPs (2 * MACs) for the conv/dense chain:
+
+- forward: one pass through every conv/dense layer up to the target;
+- backward: one transposed-conv chain per selected top-K filter, from the
+  target layer back to pixels.  A transposed conv moving a layer's output
+  gradient to its input costs the same MACs as the forward conv (the
+  kernel volume is identical), so each projection ~= the forward conv
+  chain cost up to that layer.
+
+Pool/unpool, activations, and top-K selection are bandwidth-bound and
+contribute <1% of FLOPs; they are ignored.  This mirrors the reference's
+work shape — forward once, then top-K backward chains per layer
+(app/deepdream.py:426-428, 441-474) — restricted to the single requested
+layer (the repo's default; SURVEY §2.2.3).
+"""
+
+from __future__ import annotations
+
+import math
+
+from deconv_api_tpu.models.spec import ModelSpec, layer_output_shapes
+
+
+def conv_chain_flops(spec: ModelSpec, layer_name: str | None = None) -> float:
+    """Per-image forward FLOPs through conv/dense layers up to layer_name
+    (inclusive; None = whole spec)."""
+    shapes = layer_output_shapes(spec)
+    stop = spec.index(layer_name) if layer_name is not None else len(spec.layers) - 1
+    shape: tuple[int, ...] = tuple(spec.input_shape)
+    total = 0.0
+    for l in spec.layers[: stop + 1]:
+        if l.kind == "conv":
+            cin = shape[-1]
+            oh, ow, cout = shapes[l.name]
+            kh, kw = l.kernel_size
+            total += 2.0 * oh * ow * cout * kh * kw * cin
+        elif l.kind == "dense":
+            din = shape[-1] if len(shape) == 1 else math.prod(shape)
+            total += 2.0 * din * l.filters
+        shape = shapes[l.name]
+    return total
+
+
+def deconv_flops_per_image(
+    spec: ModelSpec, layer_name: str, top_k: int = 8
+) -> float:
+    """Forward + top_k backward projections from layer_name, per image."""
+    fwd = conv_chain_flops(spec, layer_name)
+    # Each projection runs the transposed chain from layer_name to pixels:
+    # same MAC count as the forward chain to layer_name.
+    return fwd * (1.0 + top_k)
+
+
+def vgg16_deconv_flops(batch: int, layer_name: str, top_k: int = 8) -> float:
+    """Batch FLOPs for the headline bench config (VGG16 deconv)."""
+    from deconv_api_tpu.models.vgg16 import VGG16_SPEC
+
+    return batch * deconv_flops_per_image(VGG16_SPEC, layer_name, top_k)

@@ -1,0 +1,178 @@
+"""Command-line interface: serve / visualize / dream / bench / models.
+
+The reference has no CLI at all — every knob is a hardcoded constant
+(model at app/main.py:17, image size :53, top-4 stitch :67-69, mode :64);
+SURVEY §5's config row mandates this surface.  Every subcommand honours the
+same DECONV_* environment variables as ServerConfig.from_env.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--model", default=None, help="vgg16 | resnet50 | inception_v3")
+    p.add_argument("--platform", default=None, help="force jax backend (e.g. cpu)")
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    from deconv_api_tpu.serving.app import main as serve_main
+
+    argv = []
+    for flag in ("host", "port", "model", "weights", "platform"):
+        val = getattr(args, flag, None)
+        if val is not None:
+            argv += [f"--{flag}", str(val)]
+    serve_main(argv)
+    return 0
+
+
+def _load_service(args: argparse.Namespace):
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.serving.app import DeconvService
+
+    overrides: dict = {"compilation_cache_dir": ""}
+    if args.model:
+        overrides["model"] = args.model
+    if args.platform:
+        overrides["platform"] = args.platform
+    return DeconvService(ServerConfig.from_env(**overrides))
+
+
+def _read_image(path: str, size: int):
+    import numpy as np
+    from PIL import Image
+
+    img = Image.open(path).convert("RGB").resize((size, size))
+    # serving decodes to BGR (cv2-compatible, SURVEY §2.2.1); match it
+    return np.asarray(img)[:, :, ::-1].astype(np.float32)
+
+
+def cmd_visualize(args: argparse.Namespace) -> int:
+    import numpy as np
+    from PIL import Image
+
+    svc = _load_service(args)
+    x = svc.bundle.preprocess(_read_image(args.image, svc.cfg.image_size))
+    result = svc._run_batch((args.layer, args.mode, args.top_k, "grid"), [x])[0]
+    n_valid = int(result["valid"].sum())
+    if n_valid == 0:
+        print("no filters fired for this layer/image", file=sys.stderr)
+        return 1
+    Image.fromarray(result["grid"][:, :, ::-1]).save(args.output)
+    print(
+        json.dumps(
+            {
+                "output": args.output,
+                "layer": args.layer,
+                # the 2x2 grid shows at most 4 tiles; report exactly those
+                "filters": [int(i) for i in result["indices"][: min(n_valid, 4)]],
+            }
+        )
+    )
+    return 0
+
+
+def cmd_dream(args: argparse.Namespace) -> int:
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.engine import deepdream
+
+    svc = _load_service(args)
+    layers = (
+        tuple(s for s in args.layers.split(",") if s)
+        if args.layers
+        else svc.bundle.dream_layers
+    )
+    x = svc.bundle.preprocess(_read_image(args.image, svc.cfg.image_size))
+    fwd = svc.bundle.dream_forward(layers)
+    out, loss = deepdream(
+        fwd,
+        svc.bundle.params,
+        x,
+        layers=layers,
+        steps_per_octave=args.steps,
+        num_octaves=args.octaves,
+        lr=args.lr,
+        min_size=svc.bundle.min_dream_size,
+    )
+    img = svc.bundle.unpreprocess(np.asarray(out))
+    Image.fromarray(img[:, :, ::-1]).save(args.output)
+    print(
+        json.dumps(
+            {"output": args.output, "layers": list(layers), "loss": float(loss)}
+        )
+    )
+    return 0
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    from deconv_api_tpu.bench import CONFIGS, run_config
+
+    configs = (
+        sorted(CONFIGS) if args.config == "all" else [int(args.config)]
+    )
+    for n in configs:
+        result = run_config(n)
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+def cmd_models(_args: argparse.Namespace) -> int:
+    from deconv_api_tpu.serving.models import registry_info
+
+    for info in registry_info():
+        print(json.dumps(info))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="deconv_api_tpu",
+        description="TPU-native deconvnet visualization framework",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("serve", help="run the HTTP service")
+    s.add_argument("--host", default=None)
+    s.add_argument("--port", type=int, default=None)
+    s.add_argument("--weights", default=None)
+    _add_common(s)
+    s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("visualize", help="deconv visualization of one image")
+    s.add_argument("--image", required=True)
+    s.add_argument("--layer", required=True)
+    s.add_argument("--output", default="deconv.png")
+    s.add_argument("--mode", default="all", choices=("all", "max"))
+    s.add_argument("--top-k", type=int, default=8, dest="top_k")
+    _add_common(s)
+    s.set_defaults(fn=cmd_visualize)
+
+    s = sub.add_parser("dream", help="multi-octave DeepDream on one image")
+    s.add_argument("--image", required=True)
+    s.add_argument("--layers", default="", help="comma-separated activations")
+    s.add_argument("--output", default="dream.png")
+    s.add_argument("--steps", type=int, default=10)
+    s.add_argument("--octaves", type=int, default=10)
+    s.add_argument("--lr", type=float, default=0.01)
+    _add_common(s)
+    s.set_defaults(fn=cmd_dream)
+
+    s = sub.add_parser("bench", help="run BASELINE benchmark configs")
+    s.add_argument("--config", default="all", help="1-5 or 'all'")
+    s.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser("models", help="list registered models")
+    s.set_defaults(fn=cmd_models)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

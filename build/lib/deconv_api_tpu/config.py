@@ -1,0 +1,94 @@
+"""Config/flag system.
+
+The reference hardcodes every knob — image size (app/main.py:53), top-4
+stitch (app/main.py:67-69), model choice (app/main.py:17), visualize mode
+(app/main.py:64).  SURVEY §5 mandates a real config surface; this dataclass
+is consumed by serving, bench and the CLI, and every field can be set from
+environment variables (DECONV_<FIELD>) or CLI flags."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 8000
+    model: str = "vgg16"
+    image_size: int = 0  # 0 = the model's native size (224 VGG/ResNet, 299 Inception)
+    top_k: int = 8
+    stitch_k: int = 4  # tiles in the response grid (reference: 4, 2x2)
+    visualize_mode: str = "all"  # 'all' | 'max' (app/main.py:64 hardcodes 'all')
+    bug_compat: bool = True  # reproduce SURVEY §2.2.1/2.2.2 quirks for parity
+    strict_compat: bool = False  # also reproduce the <4-filters 500 (SURVEY §2.2.4)
+    # batching dispatcher (fixes the reference's 1-concurrency, SURVEY §2.2.5)
+    max_batch: int = 8
+    batch_window_ms: float = 3.0
+    # Warm every power-of-two batch bucket at startup (the first concurrent
+    # burst otherwise pays a per-bucket XLA compile at request time); off =
+    # warm only the smallest bucket (fast dev/test startup).
+    warmup_all_buckets: bool = True
+    request_timeout_s: float = 60.0
+    dream_timeout_s: float = 300.0  # dreams run minutes; own queue + timeout
+    # device placement
+    platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
+    mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n
+    dtype: str = "float32"  # forward/selection dtype: 'float32' | 'bfloat16'
+    # Backward-projection dtype. bfloat16 is the default: selection and
+    # switches stay exact (forward runs in `dtype`), and the projection
+    # chain's bf16 rounding is invisible after deprocess quantisation
+    # (measured ~168dB PSNR vs fp32 on VGG16) at ~1.4x the throughput.
+    backward_dtype: str = "bfloat16"  # '' | 'float32' | 'bfloat16'
+    # persistent XLA compilation cache (first compile on TPU is expensive)
+    compilation_cache_dir: str = os.path.expanduser("~/.cache/deconv_api_tpu/xla")
+    weights_path: str = ""  # optional Keras .h5 / orbax checkpoint to load
+    profile_dir: str = ""  # jax.profiler trace output ('' = disabled)
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "ServerConfig":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            env = os.environ.get(f"DECONV_{f.name.upper()}")
+            if env is not None:
+                setattr(cfg, f.name, _coerce(env, f.type, getattr(cfg, f.name)))
+        for k, v in overrides.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown config field {k!r}")
+            setattr(cfg, k, v)
+        return cfg
+
+
+def _coerce(raw: str, annotation: Any, default: Any):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    if isinstance(default, tuple):
+        return tuple(int(x) for x in raw.split(",") if x)
+    return raw
+
+
+def apply_platform(cfg: ServerConfig) -> None:
+    """Force a jax backend before first device use (e.g. 'cpu' serving on a
+    host with an unhealthy accelerator plugin)."""
+    if cfg.platform:
+        import jax
+
+        jax.config.update("jax_platforms", cfg.platform)
+
+
+def enable_compilation_cache(cfg: ServerConfig) -> None:
+    """Point XLA's persistent compilation cache at a local dir so repeated
+    server/bench starts skip the (very slow) first compile."""
+    if not cfg.compilation_cache_dir:
+        return
+    os.makedirs(cfg.compilation_cache_dir, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cfg.compilation_cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

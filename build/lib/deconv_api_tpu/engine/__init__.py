@@ -1,0 +1,25 @@
+"""Engines: deconvnet visualization, DeepDream ascent, autodiff deconv.
+
+Each engine compiles the whole reference call stack (SURVEY §3.2) into a
+single XLA program per (model, layer, mode) — forward with switch recording,
+in-graph top-K filter selection, and a vmapped masked backward projection —
+replacing the reference's per-request Keras-graph construction and per-layer
+predict() round-trips (reference: app/deepdream.py:383-476).
+"""
+
+from deconv_api_tpu.engine.autodeconv import autodeconv_visualizer
+from deconv_api_tpu.engine.deconv import (
+    get_visualizer,
+    visualize,
+    visualize_all_layers,
+)
+from deconv_api_tpu.engine.deepdream import deepdream, make_octave_runner
+
+__all__ = [
+    "autodeconv_visualizer",
+    "deepdream",
+    "get_visualizer",
+    "make_octave_runner",
+    "visualize",
+    "visualize_all_layers",
+]

@@ -1,0 +1,75 @@
+"""Deconvnet projection via autodiff, for DAG/strided models.
+
+The insight: with two custom-VJP rules —
+- `ops.deconv_relu` (backward applies ReLU to the cotangent: the
+  Zeiler–Fergus backward-ReLU, reference app/deepdream.py:230-235), and
+- max-pool's native XLA gradient (cotangent routed to window argmax — the
+  "switch" semantics, reference app/deepdream.py:152-209) —
+
+plain `jax.vjp` of a model's forward pass IS the deconvnet backward
+projection: conv VJPs are flipped-kernel (transposed for strided convs)
+convolutions with no bias, exactly the reference's hand-built backward
+models (app/deepdream.py:80-89).  This generalises Zeiler–Fergus to ANY
+model expressible in JAX — residual connections, branching, factorized and
+strided convs — where the reference's sequential D-layer walk could only
+`sys.exit()` (app/deepdream.py:418-421).
+
+Used for ResNet50 (BASELINE config 4) and InceptionV3.  The sequential
+engine (engine/deconv.py) remains the bug-compat parity path for VGG16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deconv_api_tpu.models.blocks import DECONV_RULES
+
+
+def autodeconv_visualizer(forward_fn, layer: str, top_k: int = 8, mode: str = "all"):
+    """Build a jitted ``fn(params, image) -> {images, indices, sums, valid}``.
+
+    ``forward_fn(params, x, rules=...) -> (out, acts)`` is any model forward
+    accepting execution rules (models/resnet50.py, models/inception_v3.py).
+    Selection semantics are identical to the sequential engine: positive
+    activation sums, top-K, 'all'/'max' masking.
+    """
+    if mode not in ("all", "max"):
+        raise ValueError(f"illegal visualize mode {mode!r}; expected 'all' or 'max'")
+
+    def single(params, image):
+        x = image[None]
+
+        def acts_of(xx):
+            _, acts = forward_fn(params, xx, rules=DECONV_RULES)
+            if layer not in acts:
+                raise KeyError(
+                    f"model has no activation {layer!r}; known: {sorted(acts)}"
+                )
+            return acts[layer]
+
+        act, vjp_fn = jax.vjp(acts_of, x)
+        n_chan = act.shape[-1]
+        k = min(top_k, n_chan)
+        sums = jnp.sum(act, axis=tuple(range(act.ndim - 1)))
+        masked = jnp.where(sums > 0, sums, -jnp.inf)
+        top_sums, top_idx = lax.top_k(masked, k)
+
+        def backproject(idx):
+            chan = jax.nn.one_hot(idx, n_chan, dtype=act.dtype)
+            fmap = jnp.sum(act * chan, axis=-1)
+            if mode == "max":
+                fmap = fmap * (fmap == jnp.max(fmap)).astype(fmap.dtype)
+            (x_bar,) = vjp_fn(fmap[..., None] * chan)
+            return x_bar
+
+        images = jax.vmap(backproject)(top_idx)  # (K, 1, H, W, C)
+        return {
+            "images": images[:, 0],
+            "indices": top_idx,
+            "sums": top_sums,
+            "valid": top_sums > 0,
+        }
+
+    return jax.jit(single)

@@ -1,0 +1,250 @@
+"""The deconvnet visualizer as a single jit-compiled XLA program.
+
+Reference behaviour being reproduced (app/deepdream.py:383-476, surveyed in
+SURVEY §3.2): forward through the layer stack recording max-pool switches,
+rank feature maps by total activation (positive sums only, top 8), then for
+each selected filter zero-mask the rest and project back to pixel space
+through flipped convs, switch unpooling and backward-ReLU.
+
+TPU-first design decisions:
+- The entire up+down computation is ONE traced program: no per-layer
+  round-trips, no per-request graph building (kills SURVEY §2.2.7 and hot
+  loops #1/#2 of §3.2).
+- The K backward projections are `jax.vmap`ed — on TPU they execute as one
+  batched conv chain on the MXU rather than K sequential passes.
+- Top-K selection happens in-graph (`lax.top_k` over channel sums), so the
+  whole request is a single device dispatch; the positive-only filtering of
+  the reference (app/deepdream.py:376-377) is surfaced as a `valid` mask
+  because XLA needs static shapes.
+- `layer_name`/`top_k`/`mode` are static: each combination compiles once and
+  is cached; by default only the *requested* layer is projected (fixing the
+  reference's all-layers waste, SURVEY §2.2.3), with the full sweep
+  available as `visualize_all_layers` (BASELINE config 2).
+- `bug_compat=True` reproduces the reference's double-ReLU on the backward
+  conv (SURVEY §2.2.2), which the PSNR parity target is measured against;
+  `False` gives the textbook Zeiler–Fergus projection.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.models.spec import Entry, ModelSpec, entry_chain
+
+
+def _up_step(e: Entry, params, x, switches):
+    l = e.layer
+    if e.is_companion_act:
+        return ops.apply_activation(x, l.activation)
+    if l.kind == "input":
+        return x
+    if l.kind == "conv":
+        w = params[l.name]["w"].astype(x.dtype)
+        b = params[l.name]["b"].astype(x.dtype)
+        y = ops.conv2d(x, w, b, strides=l.strides, padding=l.padding)
+        # Keras conv layers carry a fused activation; the companion entry
+        # applies it again (idempotent for relu) — reference app/deepdream.py:73.
+        return ops.apply_activation(y, l.activation)
+    if l.kind == "pool":
+        pooled, idx = ops.maxpool_with_argmax(x, l.pool_size)
+        # compact switch form: int8 window argmax + static input extent
+        switches[e.name] = (idx, x.shape[1:3])
+        return pooled
+    if l.kind == "flatten":
+        return ops.flatten(x)
+    if l.kind == "dense":
+        w = params[l.name]["w"].astype(x.dtype)
+        b = params[l.name]["b"].astype(x.dtype)
+        return ops.apply_activation(ops.dense(x, w, b), l.activation)
+    raise AssertionError(l.kind)
+
+
+def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool):
+    l = e.layer
+    if e.is_companion_act:
+        # Deconvnet backward-ReLU: same activation on the way down
+        # (reference app/deepdream.py:230-235).
+        return ops.apply_activation(x, l.activation)
+    if l.kind == "input":
+        return x
+    if l.kind == "conv":
+        w = params[l.name]["w"].astype(x.dtype)
+        y = ops.conv2d_input_backward(
+            x, w, strides=l.strides, padding=l.padding, input_hw=prev_shape[1:3]
+        )
+        if bug_compat:
+            # The reference's config-clone keeps the fused activation in the
+            # backward conv model too (SURVEY §2.2.2).
+            y = ops.apply_activation(y, l.activation)
+        return y
+    if l.kind == "pool":
+        idx, out_hw = switches[e.name]
+        return ops.unpool_with_argmax(x, idx, l.pool_size, out_hw)
+    if l.kind == "flatten":
+        return ops.unflatten(x, prev_shape[1:])
+    if l.kind == "dense":
+        # W^T, zero bias, no fused activation (reference app/deepdream.py:295).
+        return ops.dense_input_backward(x, params[l.name]["w"].astype(x.dtype))
+    raise AssertionError(l.kind)
+
+
+def _visualize_entry(
+    entries, params, ups, switches, i, top_k, mode, bug_compat, backward_dtype
+):
+    """Top-K selection + vmapped backward projection from entry index `i`."""
+    output = ups[i]
+    n_chan = output.shape[-1]
+    k = min(top_k, n_chan)
+    reduce_axes = tuple(range(output.ndim - 1))
+    sums = jnp.sum(output, axis=reduce_axes)
+    masked = jnp.where(sums > 0, sums, -jnp.inf)
+    top_sums, top_idx = lax.top_k(masked, k)
+    valid = top_sums > 0
+
+    def backproject(idx):
+        chan = jax.nn.one_hot(idx, n_chan, dtype=output.dtype)
+        fmap = jnp.sum(output * chan, axis=-1)  # == output[..., idx]
+        if mode == "max":
+            # Keep only positions equal to the global max (ties all kept),
+            # reference app/deepdream.py:454-457.
+            fmap = fmap * (fmap == jnp.max(fmap)).astype(fmap.dtype)
+        x = fmap[..., None] * chan
+        if backward_dtype is not None:
+            # Mixed precision: selection ran on the exact forward; the
+            # projection chain (8/9 of the FLOPs) runs in e.g. bfloat16.
+            x = x.astype(backward_dtype)
+        j = i
+        while j >= 0:
+            e = entries[j]
+            # Peephole: a pool followed (downward) by the deconvnet
+            # backward-ReLU collapses into one fused unpool+ReLU op call.
+            # Equivalent on every dispatch path; matters for the pallas
+            # backend, whose opaque custom call would otherwise cost a
+            # full-res HBM pass for the separate elementwise ReLU.
+            if (
+                not e.is_companion_act
+                and e.layer.kind == "pool"
+                and j > 0
+                and entries[j - 1].is_companion_act
+                and entries[j - 1].layer.activation == "relu"
+            ):
+                sw_idx, out_hw = switches[e.name]
+                x = ops.unpool_with_argmax(
+                    x, sw_idx, e.layer.pool_size, out_hw, fuse_relu=True
+                )
+                j -= 2
+                continue
+            prev_shape = ups[j - 1].shape if j > 0 else ups[0].shape
+            x = _down_step(entries[j], params, x, switches, prev_shape, bug_compat)
+            j -= 1
+        return x.astype(output.dtype)
+
+    images = jax.vmap(backproject)(top_idx)  # (K, 1, H, W, C)
+    return {
+        "images": images[:, 0],  # (K, H, W, C) — reference squeezes batch
+        "indices": top_idx,
+        "sums": top_sums,
+        "valid": valid,
+    }
+
+
+@lru_cache(maxsize=128)
+def get_visualizer(
+    spec: ModelSpec,
+    layer_name: str,
+    top_k: int = 8,
+    mode: str = "all",
+    bug_compat: bool = True,
+    sweep: bool = False,
+    batched: bool = False,
+    backward_dtype: str | None = None,
+):
+    """Build (and cache) the jitted visualizer for a static configuration.
+
+    Returns ``fn(params, image)`` where image is (H, W, C) — or (B, H, W, C)
+    when ``batched`` — yielding {layer_name: {images, indices, sums, valid}}.
+    With ``sweep=True`` every model layer from `layer_name` down to the input
+    is projected (the reference's always-on behaviour, SURVEY §2.2.3).
+    ``backward_dtype`` (e.g. ``"bfloat16"``) runs only the backward
+    projection chain in that dtype: filter selection and switches stay
+    exact, trading a little projection precision for MXU throughput.
+    """
+    if mode not in ("all", "max"):
+        # The reference sys.exit()s the server here (app/deepdream.py:458-460);
+        # we raise instead (error taxonomy, SURVEY §5).
+        raise ValueError(f"illegal visualize mode {mode!r}; expected 'all' or 'max'")
+    truncated = spec.truncated(layer_name)
+    entries = entry_chain(truncated)
+    model_names = set(spec.layer_names())
+    # Indices of model-layer entries (companion activations excluded),
+    # deepest first, input dropped — reference app/deepdream.py:431-437.
+    vis_indices = [i for i, e in enumerate(entries) if e.name in model_names]
+    vis_indices.reverse()
+    vis_indices.pop()
+    if not vis_indices:
+        raise ValueError(
+            f"layer {layer_name!r} has no projectable output (it is the input layer)"
+        )
+    if not sweep:
+        vis_indices = vis_indices[:1]
+
+    bwd_dtype = jnp.dtype(backward_dtype) if backward_dtype else None
+
+    def single(params, image):
+        x = image[None]
+        switches: dict[str, jnp.ndarray] = {}
+        ups = []
+        for e in entries:
+            x = _up_step(e, params, x, switches)
+            ups.append(x)
+        return {
+            entries[i].name: _visualize_entry(
+                entries, params, ups, switches, i, top_k, mode, bug_compat,
+                bwd_dtype,
+            )
+            for i in vis_indices
+        }
+
+    fn = jax.vmap(single, in_axes=(None, 0)) if batched else single
+    return jax.jit(fn)
+
+
+def visualize(
+    spec: ModelSpec,
+    params,
+    image,
+    layer_name: str,
+    *,
+    top_k: int = 8,
+    mode: str = "all",
+    bug_compat: bool = True,
+):
+    """Project the top-K filters of `layer_name` back to pixel space.
+
+    Single-layer by default — the request in BASELINE config 1 — computing
+    only what the API serves (unlike the reference, SURVEY §2.2.3).
+    """
+    fn = get_visualizer(spec, layer_name, top_k, mode, bug_compat, sweep=False)
+    return fn(params, image)[layer_name]
+
+
+def visualize_all_layers(
+    spec: ModelSpec,
+    params,
+    image,
+    layer_name: str,
+    *,
+    top_k: int = 8,
+    mode: str = "all",
+    bug_compat: bool = True,
+):
+    """Full sweep: every model layer from `layer_name` down to the input —
+    wire-parity with the reference's `visualize_all_layers`
+    (app/deepdream.py:383-476) and BASELINE config 2."""
+    fn = get_visualizer(spec, layer_name, top_k, mode, bug_compat, sweep=True)
+    return fn(params, image)

@@ -1,0 +1,125 @@
+"""DeepDream: multi-octave gradient ascent on layer activations.
+
+A capability extension mandated by BASELINE config 3 (InceptionV3
+mixed3–mixed5, 10 octaves).  The reference has NO DeepDream despite its
+filename (SURVEY §0.2: app/deepdream.py contains zero gradient code).
+
+TPU-first shape: each octave's entire ascent loop is ONE jitted program
+(`lax.fori_loop` over steps, `jax.grad` inside), so a 10-octave dream is 10
+device dispatches total — no per-step host round-trips.  Octave shapes are
+static; the per-shape executables cache across calls.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from deconv_api_tpu.models.blocks import INFERENCE_RULES
+
+
+def activation_loss(forward_fn, params, x, layers: tuple[str, ...]) -> jnp.ndarray:
+    """Mean squared activation of the chosen layers (the classic DeepDream
+    objective — maximised by ascent).  Uses TRUE gradients (inference rules),
+    not deconv rules: DeepDream is gradient ascent, not projection."""
+    _, acts = forward_fn(params, x, rules=INFERENCE_RULES)
+    losses = []
+    for name in layers:
+        if name not in acts:
+            raise KeyError(f"model has no activation {name!r}; known: {sorted(acts)}")
+        a = acts[name]
+        losses.append(jnp.mean(jnp.square(a)))
+    return jnp.stack(losses).mean()
+
+
+@lru_cache(maxsize=64)
+def _octave_jit(forward_fn, layers: tuple[str, ...]):
+    """One jitted program running a full octave of ascent steps.
+
+    Cached on (forward_fn, layers) only; ``steps`` and ``lr`` are traced
+    arguments so client-chosen values never trigger recompilation (a sweep
+    over lr would otherwise compile a fresh executable per value, per
+    octave shape).  Pair with a stable forward_fn — ModelBundle caches its
+    dream_forward closures for exactly this reason."""
+
+    def run(params, x, steps, lr):
+        loss_grad = jax.value_and_grad(
+            lambda xx: activation_loss(forward_fn, params, xx, layers)
+        )
+
+        def body(_, carry):
+            x, _loss = carry
+            loss, g = loss_grad(x)
+            # gradient-magnitude normalisation keeps lr scale-free across
+            # octaves/layers (standard DeepDream practice)
+            g = g / (jnp.mean(jnp.abs(g)) + 1e-8)
+            return x + lr.astype(x.dtype) * g, loss
+
+        return jax.lax.fori_loop(0, steps, body, (x, jnp.asarray(0.0, x.dtype)))
+
+    return jax.jit(run)
+
+
+def make_octave_runner(forward_fn, layers: tuple[str, ...], steps: int, lr: float):
+    """Bind (steps, lr) over the per-(model, layers) jitted octave program."""
+    fn = _octave_jit(forward_fn, tuple(layers))
+    steps = jnp.asarray(steps, jnp.int32)
+    lr = jnp.asarray(lr, jnp.float32)
+    return lambda params, x: fn(params, x, steps, lr)
+
+
+def _resize(x: jnp.ndarray, hw: tuple[int, int]) -> jnp.ndarray:
+    return jax.image.resize(
+        x, (x.shape[0], hw[0], hw[1], x.shape[-1]), method="bilinear"
+    )
+
+
+def deepdream(
+    forward_fn,
+    params,
+    image: jnp.ndarray,
+    *,
+    layers: tuple[str, ...],
+    steps_per_octave: int = 10,
+    lr: float = 0.01,
+    num_octaves: int = 10,
+    octave_scale: float = 1.4,
+    min_size: int = 75,
+):
+    """Run multi-octave DeepDream on (H, W, C) `image`; returns (dreamed
+    image (H, W, C), final-octave loss).
+
+    Octave pyramid: ascend from the smallest scale, re-injecting the detail
+    lost to downsampling at each scale jump (the canonical octave recipe).
+    Octave count is clamped so the smallest scale stays >= min_size (the
+    InceptionV3 trunk minimum).
+
+    `forward_fn` must be resolution-robust for the chosen layers: DAG models
+    (InceptionV3/ResNet50) are, their heads being global-avg-pooled;
+    sequential specs must be truncated below their flatten/dense head
+    (`spec.truncated(deepest_layer)`) before wrapping with `spec_forward`.
+    """
+    base = image[None].astype(jnp.float32)
+    h, w = base.shape[1:3]
+    shapes: list[tuple[int, int]] = []
+    for i in range(num_octaves):
+        s = octave_scale ** (num_octaves - 1 - i)
+        oh, ow = int(round(h / s)), int(round(w / s))
+        if min(oh, ow) < min_size:
+            continue
+        shapes.append((oh, ow))
+    if not shapes:
+        shapes = [(h, w)]
+
+    runner = make_octave_runner(forward_fn, tuple(layers), steps_per_octave, lr)
+
+    x = _resize(base, shapes[0])
+    loss = jnp.asarray(0.0)
+    for i, hw in enumerate(shapes):
+        if i > 0:
+            lost_detail = _resize(base, hw) - _resize(_resize(base, shapes[i - 1]), hw)
+            x = _resize(x, hw) + lost_detail
+        x, loss = runner(params, x)
+    return x[0], loss

@@ -1,0 +1,62 @@
+"""Error taxonomy.
+
+The reference's failure mode for bad input is `sys.exit()` — it kills the
+whole server process on an unknown layer type or visualize mode
+(reference: app/deepdream.py:418-421, 458-460; SURVEY §5 mandates replacing
+this with an HTTP 4xx/5xx taxonomy)."""
+
+from __future__ import annotations
+
+
+class DeconvError(Exception):
+    """Base class: maps to an HTTP status + machine-readable code."""
+
+    status = 500
+    code = "internal_error"
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class BadRequest(DeconvError):
+    status = 400
+    code = "bad_request"
+
+
+class InvalidImage(BadRequest):
+    code = "invalid_image"
+
+
+class UnknownLayer(DeconvError):
+    status = 422
+    code = "unknown_layer"
+
+
+class UnknownModel(DeconvError):
+    status = 422
+    code = "unknown_model"
+
+
+class IllegalMode(DeconvError):
+    status = 422
+    code = "illegal_visualize_mode"
+
+
+class NoActiveFilters(DeconvError):
+    """Fewer filters fired than requested; the reference IndexErrors into a
+    500 here (SURVEY §2.2.4).  Serving pads the grid instead; this error is
+    only raised in strict-compat mode."""
+
+    status = 422
+    code = "no_active_filters"
+
+
+class ModelNotReady(DeconvError):
+    status = 503
+    code = "model_not_ready"
+
+
+class RequestTimeout(DeconvError):
+    status = 504
+    code = "request_timeout"

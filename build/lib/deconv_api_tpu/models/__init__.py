@@ -1,0 +1,31 @@
+"""Model zoo: layer-spec IR plus VGG16 / ResNet50 / InceptionV3.
+
+Models are (spec, params) pairs: an immutable layer specification that the
+engine traces into a single XLA program, and a params pytree.  This replaces
+the reference's approach of introspecting a live Keras model object and
+cloning per-layer sub-models on every request (reference:
+app/deepdream.py:401-423, app/main.py:17).
+"""
+
+from deconv_api_tpu.models.spec import (
+    Layer,
+    ModelSpec,
+    entry_chain,
+    init_params,
+    layer_output_shapes,
+)
+from deconv_api_tpu.models.vgg16 import VGG16_SPEC, vgg16_init
+
+__all__ = [
+    "Layer",
+    "ModelSpec",
+    "VGG16_SPEC",
+    "entry_chain",
+    "init_params",
+    "layer_output_shapes",
+    "vgg16_init",
+]
+
+# DAG models (params pytree + pure apply fn) import lazily from their own
+# modules: models.resnet50 (resnet50_init/resnet50_forward) and
+# models.inception_v3 (inception_v3_init/inception_v3_forward).

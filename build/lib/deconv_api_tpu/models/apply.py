@@ -1,0 +1,73 @@
+"""Plain forward pass (classifier inference / training) over a ModelSpec.
+
+This is the non-deconv execution path: no switch recording (pooling uses
+`lax.reduce_window`, cheaper than the switch-recording pool), used by the
+training step and classification serving.  The deconv engine keeps its own
+forward (engine/deconv.py) because it must thread switches to the backward
+half.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.models.spec import ModelSpec
+
+
+def spec_forward(spec: ModelSpec, *, logits: bool = False):
+    """Adapt a sequential ModelSpec to the DAG-model calling convention
+    ``forward_fn(params, x, rules=...) -> (out, acts)`` used by the
+    autodiff deconv and DeepDream engines — every model family shares one
+    engine interface.  With ``logits=True`` the final dense layer's softmax
+    is skipped (stable cross-entropy path for training)."""
+    from deconv_api_tpu.models.blocks import INFERENCE_RULES, Rules, maxpool
+
+    last = spec.layers[-1]
+
+    def forward_fn(params, x, rules: Rules = INFERENCE_RULES):
+        acts: dict[str, jnp.ndarray] = {}
+        for l in spec.layers:
+            if l.kind == "input":
+                pass
+            elif l.kind == "conv":
+                w = params[l.name]["w"].astype(x.dtype)
+                b = params[l.name]["b"].astype(x.dtype)
+                x = ops.conv2d(x, w, b, strides=l.strides, padding=l.padding)
+                x = (
+                    rules.relu(x)
+                    if l.activation == "relu"
+                    else ops.apply_activation(x, l.activation)
+                )
+            elif l.kind == "pool":
+                ph, pw = l.pool_size
+                x = maxpool(x, (ph, pw), (ph, pw), "VALID")
+            elif l.kind == "flatten":
+                x = ops.flatten(x)
+            elif l.kind == "dense":
+                w = params[l.name]["w"].astype(x.dtype)
+                b = params[l.name]["b"].astype(x.dtype)
+                x = ops.dense(x, w, b)
+                if logits and l is last and l.activation == "softmax":
+                    pass  # leave as logits
+                elif l.activation == "relu":
+                    x = rules.relu(x)
+                else:
+                    x = ops.apply_activation(x, l.activation)
+            acts[l.name] = x
+        return x, acts
+
+    return forward_fn
+
+
+def forward(
+    spec: ModelSpec,
+    params,
+    x: jnp.ndarray,
+    *,
+    logits: bool = False,
+) -> jnp.ndarray:
+    """Classifier forward (training/inference); one interpreter with
+    spec_forward so the two paths can never drift."""
+    out, _ = spec_forward(spec, logits=logits)(params, x)
+    return out

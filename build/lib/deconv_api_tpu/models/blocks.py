@@ -1,0 +1,142 @@
+"""Shared building blocks for the DAG models (ResNet50, InceptionV3).
+
+These models don't fit the sequential ModelSpec IR, so they are written the
+idiomatic-JAX way: nested params pytrees + pure apply functions.  Their
+deconvnet projection comes for free via autodiff (engine/autodeconv.py)
+because the forward can be instantiated with "deconv rules": ReLU whose VJP
+applies ReLU to the cotangent (Zeiler–Fergus backward-ReLU) instead of the
+true gradient mask.  The reference can't express any of this — it only ever
+handles sequential Keras models (app/deepdream.py:401-423).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deconv_api_tpu import ops
+
+
+@dataclasses.dataclass(frozen=True)
+class Rules:
+    """Execution rules threaded through a model's forward pass.
+
+    - ``relu``: plain ReLU for inference/training/DeepDream (true gradients)
+      or `ops.deconv_relu` for deconvnet projection via vjp.
+    """
+
+    relu: Callable[[jnp.ndarray], jnp.ndarray]
+
+
+INFERENCE_RULES = Rules(relu=ops.relu)
+DECONV_RULES = Rules(relu=ops.deconv_relu)
+
+
+def maxpool(
+    x: jnp.ndarray,
+    window: int | tuple[int, int] = 3,
+    stride: int | tuple[int, int] = 2,
+    padding: str = "VALID",
+):
+    """Overlapping max-pool (3x3/2 in both model families).  Its native XLA
+    VJP routes cotangents to window argmaxes — the switch semantics for
+    overlapping windows (BASELINE config 4 wants no explicit switches).
+    ``window``/``stride`` accept an int or an (h, w) pair."""
+    wh, ww = (window, window) if isinstance(window, int) else window
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, wh, ww, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=padding,
+    )
+
+
+def avgpool(x: jnp.ndarray, window: int = 3, stride: int = 1, padding: str = "SAME"):
+    s = lax.reduce_window(
+        x,
+        0.0,
+        lax.add,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=padding,
+    )
+    n = lax.reduce_window(
+        jnp.ones_like(x),
+        0.0,
+        lax.add,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=padding,
+    )
+    return s / n
+
+
+def conv_bn_init(
+    key: jax.Array, cin: int, cout: int, kernel: tuple[int, int]
+) -> dict:
+    """Conv (no bias) + inference-mode BatchNorm params (Keras layout:
+    conv→BN→ReLU, BN without gamma in InceptionV3, with gamma in ResNet50 —
+    gamma initialised to 1 covers both)."""
+    kh, kw = kernel
+    fan_in = kh * kw * cin
+    return {
+        "w": jax.random.normal(key, (kh, kw, cin, cout)) * math.sqrt(2.0 / fan_in),
+        "gamma": jnp.ones((cout,)),
+        "beta": jnp.zeros((cout,)),
+        "mean": jnp.zeros((cout,)),
+        "var": jnp.ones((cout,)),
+    }
+
+
+def conv_bn(
+    p: dict,
+    x: jnp.ndarray,
+    rules: Rules,
+    *,
+    strides: tuple[int, int] = (1, 1),
+    padding: str = "SAME",
+    relu: bool = True,
+    eps: float = 1e-3,
+) -> jnp.ndarray:
+    """conv → BN(inference) → ReLU.  BN folds to a per-channel affine, which
+    XLA fuses into the conv epilogue (one MXU pass + one VPU pass)."""
+    w = p["w"].astype(x.dtype)
+    y = ops.conv2d(x, w, None, strides=strides, padding=padding)
+    scale = (p["gamma"] * lax.rsqrt(p["var"] + eps)).astype(x.dtype)
+    shift = (p["beta"] - p["mean"] * p["gamma"] * lax.rsqrt(p["var"] + eps)).astype(
+        x.dtype
+    )
+    y = y * scale + shift
+    if relu:
+        y = rules.relu(y)
+    return y
+
+
+def dense_init(key: jax.Array, din: int, dout: int) -> dict:
+    return {
+        "w": jax.random.normal(key, (din, dout)) * math.sqrt(2.0 / din),
+        "b": jnp.zeros((dout,)),
+    }
+
+
+def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
+    return x.mean(axis=(1, 2))
+
+
+class KeySeq:
+    """Deterministic PRNG key dispenser for building deep param trees."""
+
+    def __init__(self, key: jax.Array):
+        self._key = key
+
+    def __call__(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub

@@ -1,0 +1,244 @@
+"""Keras .h5 weight loading for the DAG models (ResNet50, InceptionV3).
+
+The reference's whole value proposition rests on ImageNet-pretrained
+weights loaded at startup (app/main.py:17).  The sequential loader
+(models/weights.py) handles VGG16's kernel/bias layout; the DAG models
+need BatchNorm-aware mapping into their conv_bn pytrees
+(models/blocks.py:conv_bn_init — w/gamma/beta/mean/var).
+
+Keras layout facts this loader encodes:
+
+- ResNet50 (keras.applications.resnet): conv layers DO carry biases
+  (use_bias=True) and are immediately followed by BN.  BN(conv(x)+b)
+  == BN'(conv(x)) with mean' = moving_mean - b, so the bias folds into
+  the BN mean and the conv_bn pytree needs no bias leaf.  Modern layer
+  names are `conv{s}_block{i}_{j}_conv` / `_bn` with j=0 the projection
+  shortcut and j=1..3 the bottleneck convs; the legacy keras-2.2 scheme
+  (`res2a_branch2a` / `bn2a_branch2a`, `fc1000`) is also handled.
+- InceptionV3 (keras.applications.inception_v3): conv2d_bn uses
+  use_bias=False and BN scale=False (no gamma — stays at init 1.0).
+  Layers carry INDEX names (`conv2d_42`, `batch_normalization_42`)
+  whose order is the Keras graph construction order; the order table
+  below mirrors keras.applications.inception_v3.InceptionV3 line by
+  line and is validated against the 94-conv total at import.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+# ------------------------------------------------------------------ h5 read
+
+
+def read_h5_layers(path: str) -> dict[str, dict[str, np.ndarray]]:
+    """{layer_name: {dataset_basename_without_:0: array}} for a Keras h5.
+
+    Handles both `model_weights/` roots and flat files; the layer name is
+    the top-level group, the basename the final path component.
+    """
+    import h5py
+
+    out: dict[str, dict[str, np.ndarray]] = {}
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+
+        def visit(name, obj):
+            if not isinstance(obj, h5py.Dataset):
+                return
+            layer = name.split("/")[0]
+            base = name.split("/")[-1].removesuffix(":0")
+            out.setdefault(layer, {})[base] = np.asarray(obj)
+
+        root.visititems(visit)
+    return out
+
+
+# --------------------------------------------------------------- conv_bn map
+
+
+def _conv_bn_entry(
+    conv: dict[str, np.ndarray],
+    bn: dict[str, np.ndarray] | None,
+    like: dict,
+    where: str,
+) -> dict:
+    """Build a conv_bn pytree entry from Keras conv (+ optional BN) tensors.
+
+    A Keras conv bias folds into the BN running mean (see module
+    docstring); without BN the bias folds into `beta` (scale 1, mean 0,
+    var 1 leaves the affine as y + beta).
+    """
+    w = conv.get("kernel")
+    if w is None:
+        raise ValueError(f"{where}: h5 entry has no conv kernel")
+    if tuple(w.shape) != tuple(like["w"].shape):
+        raise ValueError(
+            f"{where}: kernel shape {tuple(w.shape)} != model {tuple(like['w'].shape)}"
+        )
+    cout = w.shape[-1]
+    bias = conv.get("bias")
+    entry = {"w": w}
+    if bn is not None:
+        mean = bn.get("moving_mean", np.zeros(cout, np.float32))
+        entry.update(
+            gamma=bn.get("gamma", np.ones(cout, np.float32)),
+            beta=bn.get("beta", np.zeros(cout, np.float32)),
+            mean=mean - bias if bias is not None else mean,
+            var=bn.get("moving_variance", np.ones(cout, np.float32)),
+        )
+    else:
+        entry.update(
+            gamma=np.ones(cout, np.float32),
+            beta=bias if bias is not None else np.zeros(cout, np.float32),
+            mean=np.zeros(cout, np.float32),
+            var=np.ones(cout, np.float32),
+        )
+    return {
+        k: jnp.asarray(v, dtype=np.asarray(like[k]).dtype) for k, v in entry.items()
+    }
+
+
+def _dense_entry(tensors: dict[str, np.ndarray], like: dict, where: str) -> dict:
+    w = tensors.get("kernel")
+    if w is None:
+        raise ValueError(f"{where}: h5 entry has no dense kernel")
+    if tuple(w.shape) != tuple(like["w"].shape):
+        raise ValueError(
+            f"{where}: dense shape {tuple(w.shape)} != model {tuple(like['w'].shape)}"
+        )
+    b = tensors.get("bias", np.zeros(w.shape[-1], np.float32))
+    return {
+        "w": jnp.asarray(w, np.asarray(like["w"]).dtype),
+        "b": jnp.asarray(b, np.asarray(like["b"]).dtype),
+    }
+
+
+# ------------------------------------------------------------------ ResNet50
+
+# (stage name, n_blocks) — must match models/resnet50.py:_STAGES
+_RESNET_STAGES = (("conv2", 3), ("conv3", 4), ("conv4", 6), ("conv5", 3))
+# our block key -> modern h5 suffix j / legacy branch name
+_RESNET_BRANCHES = (("proj", "0", "1"), ("c1", "1", "2a"), ("c2", "2", "2b"), ("c3", "3", "2c"))
+
+
+def load_resnet50_h5(path: str, init_params: dict) -> dict:
+    """Map a Keras ResNet50 .h5 (modern or legacy names) into the
+    models/resnet50.py pytree.  Missing trunk layers raise; a missing
+    classifier (notop files) keeps its init values."""
+    layers = read_h5_layers(path)
+    legacy = "res2a_branch2a" in layers
+    params = {k: (dict(v) if isinstance(v, dict) else v) for k, v in init_params.items()}
+
+    def take(conv_name: str, bn_name: str, like: dict, where: str) -> dict:
+        if conv_name not in layers:
+            raise ValueError(f"resnet50 h5 {path!r} missing layer {conv_name!r}")
+        return _conv_bn_entry(layers[conv_name], layers.get(bn_name), like, where)
+
+    if legacy:
+        params["conv1"] = take("conv1", "bn_conv1", params["conv1"], "conv1")
+    else:
+        params["conv1"] = take("conv1_conv", "conv1_bn", params["conv1"], "conv1")
+    for stage, n_blocks in _RESNET_STAGES:
+        s = stage[-1]  # "2".."5"
+        for i in range(1, n_blocks + 1):
+            block_key = f"{stage}_block{i}"
+            block = dict(params[block_key])
+            for ours, modern_j, legacy_br in _RESNET_BRANCHES:
+                if ours not in block:
+                    continue  # non-first blocks have no projection
+                if legacy:
+                    blk_letter = chr(ord("a") + i - 1)
+                    conv_name = f"res{s}{blk_letter}_branch{legacy_br}"
+                    bn_name = f"bn{s}{blk_letter}_branch{legacy_br}"
+                else:
+                    conv_name = f"{block_key}_{modern_j}_conv"
+                    bn_name = f"{block_key}_{modern_j}_bn"
+                block[ours] = take(
+                    conv_name, bn_name, block[ours], f"{block_key}.{ours}"
+                )
+            params[block_key] = block
+    head = "fc1000" if legacy else "predictions"
+    if head in layers:
+        params["predictions"] = _dense_entry(
+            layers[head], params["predictions"], "predictions"
+        )
+    return params
+
+
+# --------------------------------------------------------------- InceptionV3
+
+
+def _inception_conv_order() -> tuple[tuple[str, ...], ...]:
+    """Param paths of every conv_bn, in Keras graph construction order
+    (keras.applications.inception_v3.InceptionV3)."""
+    order: list[tuple[str, ...]] = [(f"stem{i}",) for i in range(1, 6)]
+    for name in ("mixed0", "mixed1", "mixed2"):
+        order += [(name, k) for k in ("b1", "b5_1", "b5_2", "b3_1", "b3_2", "b3_3", "pool")]
+    order += [("mixed3", k) for k in ("b3", "b3d_1", "b3d_2", "b3d_3")]
+    for name in ("mixed4", "mixed5", "mixed6", "mixed7"):
+        order += [
+            (name, k)
+            for k in (
+                "b1", "b7_1", "b7_2", "b7_3",
+                "b7d_1", "b7d_2", "b7d_3", "b7d_4", "b7d_5", "pool",
+            )
+        ]
+    order += [("mixed8", k) for k in ("b3_1", "b3_2", "b7_1", "b7_2", "b7_3", "b7_4")]
+    for name in ("mixed9", "mixed10"):
+        order += [
+            (name, k)
+            for k in (
+                "b1", "b3_1", "b3_2a", "b3_2b",
+                "b3d_1", "b3d_2", "b3d_3a", "b3d_3b", "pool",
+            )
+        ]
+    return tuple(order)
+
+
+INCEPTION_V3_CONV_ORDER = _inception_conv_order()
+assert len(INCEPTION_V3_CONV_ORDER) == 94  # keras InceptionV3 has 94 conv2d layers
+
+
+def _indexed(layers: dict, prefix: str) -> dict[int, dict[str, np.ndarray]]:
+    """Collect `prefix`, `prefix_1`, ... as {0-based index: tensors},
+    normalising files whose numbering starts at 1 (keras-2.x exports)."""
+    pat = re.compile(re.escape(prefix) + r"(?:_(\d+))?$")
+    found: dict[int, dict[str, np.ndarray]] = {}
+    for name, tensors in layers.items():
+        m = pat.match(name)
+        if m:
+            found[int(m.group(1) or 0)] = tensors
+    if found and 0 not in found:
+        found = {i - min(found): t for i, t in found.items()}
+    return found
+
+
+def load_inception_v3_h5(path: str, init_params: dict) -> dict:
+    """Map a Keras InceptionV3 .h5 into the models/inception_v3.py pytree
+    by construction-order index pairing (see module docstring)."""
+    layers = read_h5_layers(path)
+    convs = _indexed(layers, "conv2d")
+    bns = _indexed(layers, "batch_normalization")
+    if len(convs) < len(INCEPTION_V3_CONV_ORDER):
+        raise ValueError(
+            f"inception_v3 h5 {path!r} has {len(convs)} conv2d layers; "
+            f"expected {len(INCEPTION_V3_CONV_ORDER)}"
+        )
+    params = {k: dict(v) for k, v in init_params.items()}
+    for idx, p_path in enumerate(INCEPTION_V3_CONV_ORDER):
+        like = params[p_path[0]] if len(p_path) == 1 else params[p_path[0]][p_path[1]]
+        entry = _conv_bn_entry(
+            convs[idx], bns.get(idx), like, ".".join(p_path) + f" (conv2d_{idx})"
+        )
+        if len(p_path) == 1:
+            params[p_path[0]] = entry
+        else:
+            params[p_path[0]][p_path[1]] = entry
+    if "predictions" in layers:
+        params["predictions"] = _dense_entry(
+            layers["predictions"], params["predictions"], "predictions"
+        )
+    return params

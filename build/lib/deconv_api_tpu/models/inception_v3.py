@@ -1,0 +1,204 @@
+"""InceptionV3 (Keras topology) as a pure function + params pytree.
+
+Exists for the DeepDream engine (BASELINE config 3: gradient ascent on
+mixed3–mixed5) — a capability extension the reference never had (its
+"deepdream.py" contains no DeepDream code, SURVEY §0.2).  Activation names
+match Keras (`mixed0`..`mixed10`) so config strings port directly.
+
+Default input 299x299x3; the conv trunk is size-agnostic (>=75 px) which the
+tests exploit to keep CPU compiles small.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.models import blocks as B
+
+
+def _cb_init(ks, cin, cout, kernel):
+    return B.conv_bn_init(ks(), cin, cout, kernel)
+
+
+def inception_v3_init(key: jax.Array | None = None, num_classes: int = 1000) -> dict:
+    ks = B.KeySeq(key if key is not None else jax.random.PRNGKey(0))
+    p: dict = {}
+    # stem
+    p["stem1"] = _cb_init(ks, 3, 32, (3, 3))
+    p["stem2"] = _cb_init(ks, 32, 32, (3, 3))
+    p["stem3"] = _cb_init(ks, 32, 64, (3, 3))
+    p["stem4"] = _cb_init(ks, 64, 80, (1, 1))
+    p["stem5"] = _cb_init(ks, 80, 192, (3, 3))
+
+    def block_a(name, cin, pool_proj):
+        p[name] = {
+            "b1": _cb_init(ks, cin, 64, (1, 1)),
+            "b5_1": _cb_init(ks, cin, 48, (1, 1)),
+            "b5_2": _cb_init(ks, 48, 64, (5, 5)),
+            "b3_1": _cb_init(ks, cin, 64, (1, 1)),
+            "b3_2": _cb_init(ks, 64, 96, (3, 3)),
+            "b3_3": _cb_init(ks, 96, 96, (3, 3)),
+            "pool": _cb_init(ks, cin, pool_proj, (1, 1)),
+        }
+        return 64 + 64 + 96 + pool_proj
+
+    c = block_a("mixed0", 192, 32)
+    c = block_a("mixed1", c, 64)
+    c = block_a("mixed2", c, 64)
+
+    # mixed3: grid reduction 35 -> 17
+    p["mixed3"] = {
+        "b3": _cb_init(ks, c, 384, (3, 3)),
+        "b3d_1": _cb_init(ks, c, 64, (1, 1)),
+        "b3d_2": _cb_init(ks, 64, 96, (3, 3)),
+        "b3d_3": _cb_init(ks, 96, 96, (3, 3)),
+    }
+    c = 384 + 96 + c  # + passthrough maxpool
+
+    def block_b(name, cin, mid):
+        p[name] = {
+            "b1": _cb_init(ks, cin, 192, (1, 1)),
+            "b7_1": _cb_init(ks, cin, mid, (1, 1)),
+            "b7_2": _cb_init(ks, mid, mid, (1, 7)),
+            "b7_3": _cb_init(ks, mid, 192, (7, 1)),
+            "b7d_1": _cb_init(ks, cin, mid, (1, 1)),
+            "b7d_2": _cb_init(ks, mid, mid, (7, 1)),
+            "b7d_3": _cb_init(ks, mid, mid, (1, 7)),
+            "b7d_4": _cb_init(ks, mid, mid, (7, 1)),
+            "b7d_5": _cb_init(ks, mid, 192, (1, 7)),
+            "pool": _cb_init(ks, cin, 192, (1, 1)),
+        }
+        return 192 * 4
+
+    c = block_b("mixed4", c, 128)
+    c = block_b("mixed5", c, 160)
+    c = block_b("mixed6", c, 160)
+    c = block_b("mixed7", c, 192)
+
+    # mixed8: grid reduction 17 -> 8
+    p["mixed8"] = {
+        "b3_1": _cb_init(ks, c, 192, (1, 1)),
+        "b3_2": _cb_init(ks, 192, 320, (3, 3)),
+        "b7_1": _cb_init(ks, c, 192, (1, 1)),
+        "b7_2": _cb_init(ks, 192, 192, (1, 7)),
+        "b7_3": _cb_init(ks, 192, 192, (7, 1)),
+        "b7_4": _cb_init(ks, 192, 192, (3, 3)),
+    }
+    c = 320 + 192 + c
+
+    def block_c(name, cin):
+        p[name] = {
+            "b1": _cb_init(ks, cin, 320, (1, 1)),
+            "b3_1": _cb_init(ks, cin, 384, (1, 1)),
+            "b3_2a": _cb_init(ks, 384, 384, (1, 3)),
+            "b3_2b": _cb_init(ks, 384, 384, (3, 1)),
+            "b3d_1": _cb_init(ks, cin, 448, (1, 1)),
+            "b3d_2": _cb_init(ks, 448, 384, (3, 3)),
+            "b3d_3a": _cb_init(ks, 384, 384, (1, 3)),
+            "b3d_3b": _cb_init(ks, 384, 384, (3, 1)),
+            "pool": _cb_init(ks, cin, 192, (1, 1)),
+        }
+        return 320 + 768 + 768 + 192
+
+    c = block_c("mixed9", c)
+    c = block_c("mixed10", c)
+    p["predictions"] = B.dense_init(ks(), c, num_classes)
+    return p
+
+
+def inception_v3_forward(
+    params: dict,
+    x: jnp.ndarray,
+    *,
+    rules: B.Rules = B.INFERENCE_RULES,
+    logits: bool = False,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    p = params
+    acts: dict[str, jnp.ndarray] = {}
+    cb = lambda name, y, **kw: B.conv_bn(p[name], y, rules, **kw)  # noqa: E731
+
+    y = cb("stem1", x, strides=(2, 2), padding="VALID")
+    y = cb("stem2", y, padding="VALID")
+    y = cb("stem3", y)
+    y = B.maxpool(y, 3, 2, "VALID")
+    y = cb("stem4", y, padding="VALID")
+    y = cb("stem5", y, padding="VALID")
+    y = B.maxpool(y, 3, 2, "VALID")
+
+    def block_a(name, y):
+        q = p[name]
+        b1 = B.conv_bn(q["b1"], y, rules)
+        b5 = B.conv_bn(q["b5_2"], B.conv_bn(q["b5_1"], y, rules), rules)
+        b3 = B.conv_bn(q["b3_1"], y, rules)
+        b3 = B.conv_bn(q["b3_3"], B.conv_bn(q["b3_2"], b3, rules), rules)
+        pool = B.conv_bn(q["pool"], B.avgpool(y), rules)
+        return jnp.concatenate([b1, b5, b3, pool], axis=-1)
+
+    for name in ("mixed0", "mixed1", "mixed2"):
+        y = block_a(name, y)
+        acts[name] = y
+
+    q = p["mixed3"]
+    b3 = B.conv_bn(q["b3"], y, rules, strides=(2, 2), padding="VALID")
+    b3d = B.conv_bn(q["b3d_2"], B.conv_bn(q["b3d_1"], y, rules), rules)
+    b3d = B.conv_bn(q["b3d_3"], b3d, rules, strides=(2, 2), padding="VALID")
+    y = jnp.concatenate([b3, b3d, B.maxpool(y, 3, 2, "VALID")], axis=-1)
+    acts["mixed3"] = y
+
+    def block_b(name, y):
+        q = p[name]
+        b1 = B.conv_bn(q["b1"], y, rules)
+        b7 = B.conv_bn(q["b7_1"], y, rules)
+        b7 = B.conv_bn(q["b7_3"], B.conv_bn(q["b7_2"], b7, rules), rules)
+        b7d = B.conv_bn(q["b7d_1"], y, rules)
+        for k in ("b7d_2", "b7d_3", "b7d_4", "b7d_5"):
+            b7d = B.conv_bn(q[k], b7d, rules)
+        pool = B.conv_bn(q["pool"], B.avgpool(y), rules)
+        return jnp.concatenate([b1, b7, b7d, pool], axis=-1)
+
+    for name in ("mixed4", "mixed5", "mixed6", "mixed7"):
+        y = block_b(name, y)
+        acts[name] = y
+
+    q = p["mixed8"]
+    b3 = B.conv_bn(q["b3_1"], y, rules)
+    b3 = B.conv_bn(q["b3_2"], b3, rules, strides=(2, 2), padding="VALID")
+    b7 = B.conv_bn(q["b7_1"], y, rules)
+    b7 = B.conv_bn(q["b7_3"], B.conv_bn(q["b7_2"], b7, rules), rules)
+    b7 = B.conv_bn(q["b7_4"], b7, rules, strides=(2, 2), padding="VALID")
+    y = jnp.concatenate([b3, b7, B.maxpool(y, 3, 2, "VALID")], axis=-1)
+    acts["mixed8"] = y
+
+    def block_c(name, y):
+        q = p[name]
+        b1 = B.conv_bn(q["b1"], y, rules)
+        b3 = B.conv_bn(q["b3_1"], y, rules)
+        b3 = jnp.concatenate(
+            [B.conv_bn(q["b3_2a"], b3, rules), B.conv_bn(q["b3_2b"], b3, rules)],
+            axis=-1,
+        )
+        b3d = B.conv_bn(q["b3d_2"], B.conv_bn(q["b3d_1"], y, rules), rules)
+        b3d = jnp.concatenate(
+            [B.conv_bn(q["b3d_3a"], b3d, rules), B.conv_bn(q["b3d_3b"], b3d, rules)],
+            axis=-1,
+        )
+        pool = B.conv_bn(q["pool"], B.avgpool(y), rules)
+        return jnp.concatenate([b1, b3, b3d, pool], axis=-1)
+
+    for name in ("mixed9", "mixed10"):
+        y = block_c(name, y)
+        acts[name] = y
+
+    y = B.global_avg_pool(y)
+    acts["avg_pool"] = y
+    w, b = p["predictions"]["w"], p["predictions"]["b"]
+    y = ops.dense(y, w.astype(y.dtype), b.astype(y.dtype))
+    if not logits:
+        y = ops.softmax(y)
+    acts["predictions"] = y
+    return y, acts
+
+
+DREAM_LAYERS = ("mixed3", "mixed4", "mixed5")

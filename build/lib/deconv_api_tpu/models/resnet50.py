@@ -1,0 +1,104 @@
+"""ResNet50 (v1, Keras topology) as a pure function + params pytree.
+
+BASELINE config 4 targets a ResNet50 deconv backbone: strided convs, no
+pool switches.  The backward projection is the autodiff path
+(engine/autodeconv.py): running this forward under DECONV_RULES makes
+`jax.vjp` produce transposed strided convs and backward-ReLU automatically —
+capabilities the reference's sequential D-layer machinery could never
+express (it sys.exit()s on any non-sequential layer,
+app/deepdream.py:418-421).
+
+Activation names mirror Keras: conv1_relu, conv2_block3_out, …,
+conv5_block3_out, avg_pool, predictions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.models import blocks as B
+
+# (blocks, bottleneck width, out channels, first-block stride) per stage
+_STAGES = (
+    ("conv2", 3, 64, 256, 1),
+    ("conv3", 4, 128, 512, 2),
+    ("conv4", 6, 256, 1024, 2),
+    ("conv5", 3, 512, 2048, 2),
+)
+
+
+def resnet50_init(key: jax.Array | None = None, num_classes: int = 1000) -> dict:
+    ks = B.KeySeq(key if key is not None else jax.random.PRNGKey(0))
+    params: dict = {"conv1": B.conv_bn_init(ks(), 3, 64, (7, 7))}
+    cin = 64
+    for name, n_blocks, width, cout, _stride in _STAGES:
+        for i in range(1, n_blocks + 1):
+            block: dict = {}
+            if i == 1:
+                block["proj"] = B.conv_bn_init(ks(), cin, cout, (1, 1))
+            block["c1"] = B.conv_bn_init(ks(), cin, width, (1, 1))
+            block["c2"] = B.conv_bn_init(ks(), width, width, (3, 3))
+            block["c3"] = B.conv_bn_init(ks(), width, cout, (1, 1))
+            params[f"{name}_block{i}"] = block
+            cin = cout
+    params["predictions"] = B.dense_init(ks(), 2048, num_classes)
+    return params
+
+
+# Keras ResNet50 BatchNormalization uses epsilon=1.001e-5 (not the 1e-3
+# Keras default that InceptionV3's conv2d_bn inherits) — load-bearing for
+# pretrained-weight parity where running variances are small.
+_BN_EPS = 1.001e-5
+
+
+def _bottleneck(p: dict, x: jnp.ndarray, rules: B.Rules, stride: int) -> jnp.ndarray:
+    """Keras-v1 bottleneck: stride sits on the first 1x1 conv and on the
+    projection shortcut."""
+    if "proj" in p:
+        shortcut = B.conv_bn(
+            p["proj"], x, rules, strides=(stride, stride), relu=False, eps=_BN_EPS
+        )
+    else:
+        shortcut = x
+    y = B.conv_bn(p["c1"], x, rules, strides=(stride, stride), eps=_BN_EPS)
+    y = B.conv_bn(p["c2"], y, rules, eps=_BN_EPS)
+    y = B.conv_bn(p["c3"], y, rules, relu=False, eps=_BN_EPS)
+    return rules.relu(y + shortcut)
+
+
+def resnet50_forward(
+    params: dict,
+    x: jnp.ndarray,
+    *,
+    rules: B.Rules = B.INFERENCE_RULES,
+    logits: bool = False,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Returns (output, activations).  `activations` carries the named
+    endpoints the deconv/DeepDream engines seed from."""
+    acts: dict[str, jnp.ndarray] = {}
+    y = B.conv_bn(params["conv1"], x, rules, strides=(2, 2), eps=_BN_EPS)
+    acts["conv1_relu"] = y
+    y = B.maxpool(y, 3, 2, padding="SAME")
+    acts["pool1_pool"] = y
+    for name, n_blocks, _width, _cout, stride in _STAGES:
+        for i in range(1, n_blocks + 1):
+            y = _bottleneck(
+                params[f"{name}_block{i}"], y, rules, stride if i == 1 else 1
+            )
+            acts[f"{name}_block{i}_out"] = y
+    y = B.global_avg_pool(y)
+    acts["avg_pool"] = y
+    w, b = params["predictions"]["w"], params["predictions"]["b"]
+    y = ops.dense(y, w.astype(y.dtype), b.astype(y.dtype))
+    if not logits:
+        y = ops.softmax(y)
+    acts["predictions"] = y
+    return y, acts
+
+
+DECONV_LAYERS = tuple(
+    [f"{name}_block{i}_out" for name, n, _w, _c, _s in _STAGES for i in range(1, n + 1)]
+    + ["conv1_relu"]
+)

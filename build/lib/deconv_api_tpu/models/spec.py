@@ -1,0 +1,164 @@
+"""Layer-spec IR for sequential CNN classifiers.
+
+A `ModelSpec` is a static, hashable description of a model — the engine
+closes over it at trace time, so layer structure never appears as traced
+control flow (everything under jit is unrolled, static-shape XLA).
+
+The reference derives the same information by walking `model.layers` of a
+live Keras object per request (app/deepdream.py:401-423); here the walk
+happens once, at spec definition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    """One model layer. ``kind`` ∈ input|conv|pool|flatten|dense."""
+
+    name: str
+    kind: str
+    activation: str = "linear"
+    filters: int = 0  # conv out-channels / dense units
+    kernel_size: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    pool_size: tuple[int, int] = (2, 2)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    input_shape: tuple[int, int, int]  # (H, W, C)
+    layers: tuple[Layer, ...]
+
+    def __post_init__(self):
+        kinds = {"input", "conv", "pool", "flatten", "dense"}
+        names = set()
+        for l in self.layers:
+            if l.kind not in kinds:
+                raise ValueError(f"layer {l.name!r}: unknown kind {l.kind!r}")
+            if l.name in names:
+                raise ValueError(f"duplicate layer name {l.name!r}")
+            names.add(l.name)
+        if not self.layers or self.layers[0].kind != "input":
+            raise ValueError("spec must start with an input layer")
+
+    def layer_names(self) -> list[str]:
+        return [l.name for l in self.layers]
+
+    def index(self, layer_name: str) -> int:
+        for i, l in enumerate(self.layers):
+            if l.name == layer_name:
+                return i
+        raise KeyError(
+            f"model {self.name!r} has no layer {layer_name!r}; "
+            f"known layers: {self.layer_names()}"
+        )
+
+    def truncated(self, layer_name: str) -> "ModelSpec":
+        """Spec cut after `layer_name` — the reference's stack-build stop
+        condition (app/deepdream.py:422-423)."""
+        i = self.index(layer_name)
+        return dataclasses.replace(self, layers=self.layers[: i + 1])
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    """One up/down step of the deconv chain.
+
+    Conv and dense layers expand to two entries — the op itself and a
+    companion activation — mirroring the reference's stack build
+    (app/deepdream.py:404-411).  ``layer`` points at the owning Layer;
+    ``is_companion_act`` marks the companion.
+    """
+
+    name: str
+    layer: Layer
+    is_companion_act: bool = False
+
+
+def entry_chain(spec: ModelSpec) -> tuple[Entry, ...]:
+    entries: list[Entry] = []
+    for l in spec.layers:
+        entries.append(Entry(l.name, l))
+        if l.kind in ("conv", "dense"):
+            entries.append(Entry(l.name + "_activation", l, True))
+    return tuple(entries)
+
+
+def layer_output_shapes(spec: ModelSpec) -> dict[str, tuple[int, ...]]:
+    """Static per-layer output shapes (without batch), by walking the spec."""
+    shapes: dict[str, tuple[int, ...]] = {}
+    shape: tuple[int, ...] = tuple(spec.input_shape)
+    for l in spec.layers:
+        if l.kind == "input":
+            pass
+        elif l.kind == "conv":
+            h, w, _ = shape
+            if l.padding == "SAME":
+                oh = math.ceil(h / l.strides[0])
+                ow = math.ceil(w / l.strides[1])
+            else:
+                oh = math.ceil((h - l.kernel_size[0] + 1) / l.strides[0])
+                ow = math.ceil((w - l.kernel_size[1] + 1) / l.strides[1])
+            shape = (oh, ow, l.filters)
+        elif l.kind == "pool":
+            h, w, c = shape
+            shape = (h // l.pool_size[0], w // l.pool_size[1], c)
+        elif l.kind == "flatten":
+            shape = (math.prod(shape),)
+        elif l.kind == "dense":
+            shape = (l.filters,)
+        shapes[l.name] = shape
+    return shapes
+
+
+def init_params(
+    spec: ModelSpec, key: jax.Array, dtype=jnp.float32
+) -> dict[str, dict[str, jnp.ndarray]]:
+    """He-normal random init for every parameterised layer.
+
+    Pretrained weights (Keras h5 → pytree) are layered on top by
+    models/weights.py when available; random init keeps the framework fully
+    functional with zero network egress.
+    """
+    params: dict[str, dict[str, jnp.ndarray]] = {}
+    shape: tuple[int, ...] = tuple(spec.input_shape)
+    shapes = layer_output_shapes(spec)
+    for l in spec.layers:
+        if l.kind == "conv":
+            cin = shape[-1]
+            kh, kw = l.kernel_size
+            key, sub = jax.random.split(key)
+            fan_in = kh * kw * cin
+            params[l.name] = {
+                "w": (
+                    jax.random.normal(sub, (kh, kw, cin, l.filters))
+                    * math.sqrt(2.0 / fan_in)
+                ).astype(dtype),
+                "b": jnp.zeros((l.filters,), dtype),
+            }
+        elif l.kind == "dense":
+            din = shape[-1] if len(shape) == 1 else math.prod(shape)
+            key, sub = jax.random.split(key)
+            params[l.name] = {
+                "w": (
+                    jax.random.normal(sub, (din, l.filters))
+                    * math.sqrt(2.0 / din)
+                ).astype(dtype),
+                "b": jnp.zeros((l.filters,), dtype),
+            }
+        shape = shapes[l.name]
+    return params
+
+
+def iter_model_layers(spec: ModelSpec) -> Iterator[Layer]:
+    yield from spec.layers

@@ -1,0 +1,36 @@
+"""A scaled-down VGG-topology spec for CI / dry-runs.
+
+Same layer kinds and naming scheme as VGG16, shrunk so CPU tests and the
+driver's virtual-device dry-run compile in seconds; channel counts stay
+divisible by small tp axis sizes."""
+
+from __future__ import annotations
+
+import jax
+
+from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+
+VGG_TINY_SPEC = ModelSpec(
+    name="vgg_tiny",
+    input_shape=(32, 32, 3),
+    layers=(
+        Layer("input_1", "input"),
+        Layer("block1_conv1", "conv", activation="relu", filters=16),
+        Layer("block1_conv2", "conv", activation="relu", filters=16),
+        Layer("block1_pool", "pool"),
+        Layer("block2_conv1", "conv", activation="relu", filters=32),
+        Layer("block2_conv2", "conv", activation="relu", filters=32),
+        Layer("block2_pool", "pool"),
+        Layer("block3_conv1", "conv", activation="relu", filters=64),
+        Layer("block3_pool", "pool"),
+        Layer("flatten", "flatten"),
+        Layer("fc1", "dense", activation="relu", filters=256),
+        Layer("predictions", "dense", activation="softmax", filters=100),
+    ),
+)
+
+
+def vgg_tiny_init(key: jax.Array | None = None):
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    return VGG_TINY_SPEC, init_params(VGG_TINY_SPEC, key)

@@ -1,0 +1,62 @@
+"""VGG16 (ImageNet classifier topology) as a ModelSpec.
+
+Layer names match Keras' `keras.applications.vgg16.VGG16(include_top=True)`
+exactly, so requests naming reference layers ("block5_conv1", …) resolve
+unchanged (the reference serves these names over HTTP, app/main.py:57,64).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+
+
+def _conv(name: str, filters: int) -> Layer:
+    return Layer(name, "conv", activation="relu", filters=filters, kernel_size=(3, 3))
+
+
+def _pool(name: str) -> Layer:
+    return Layer(name, "pool", pool_size=(2, 2))
+
+
+VGG16_SPEC = ModelSpec(
+    name="vgg16",
+    input_shape=(224, 224, 3),
+    layers=(
+        Layer("input_1", "input"),
+        _conv("block1_conv1", 64),
+        _conv("block1_conv2", 64),
+        _pool("block1_pool"),
+        _conv("block2_conv1", 128),
+        _conv("block2_conv2", 128),
+        _pool("block2_pool"),
+        _conv("block3_conv1", 256),
+        _conv("block3_conv2", 256),
+        _conv("block3_conv3", 256),
+        _pool("block3_pool"),
+        _conv("block4_conv1", 512),
+        _conv("block4_conv2", 512),
+        _conv("block4_conv3", 512),
+        _pool("block4_pool"),
+        _conv("block5_conv1", 512),
+        _conv("block5_conv2", 512),
+        _conv("block5_conv3", 512),
+        _pool("block5_pool"),
+        Layer("flatten", "flatten"),
+        Layer("fc1", "dense", activation="relu", filters=4096),
+        Layer("fc2", "dense", activation="relu", filters=4096),
+        Layer("predictions", "dense", activation="softmax", filters=1000),
+    ),
+)
+
+CONV_LAYER_NAMES = tuple(l.name for l in VGG16_SPEC.layers if l.kind == "conv")
+
+
+def vgg16_init(key: jax.Array | None = None, dtype=jnp.float32):
+    """(spec, params) with He-normal weights; see models/weights.py for
+    loading pretrained Keras h5 weights into the same pytree layout."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    return VGG16_SPEC, init_params(VGG16_SPEC, key, dtype)

@@ -1,0 +1,174 @@
+"""Pretrained-weight loading: Keras .h5 / .npz / orbax → params pytree.
+
+The reference downloads ImageNet VGG16 weights at import time via
+`vgg16.VGG16(weights='imagenet')` (app/main.py:17).  This environment has no
+network egress, so loading is gated: models initialise with deterministic
+He-normal weights (models/spec.py:init_params) and upgrade in place when a
+weights file is supplied (ServerConfig.weights_path).
+
+Keras h5 layout notes: channels-last Keras stores conv kernels as HWIO and
+dense kernels as (in, out) — exactly this framework's layout, so conversion
+is a straight copy keyed by layer name.  Both the keras-2.x
+(`layer/layer/kernel:0`) and keras-1.x (`layer/layer_W:0`) dataset naming
+schemes are handled.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from deconv_api_tpu.models.spec import ModelSpec
+
+
+def load_weights(spec: ModelSpec, path: str, init_params: dict) -> dict:
+    """Load weights from `path` into a copy of `init_params`.
+
+    Formats by extension: .h5/.hdf5 (Keras), .npz (numpy archive with
+    ``<layer>/w`` and ``<layer>/b`` keys), directory (orbax checkpoint).
+    Layers missing from the file keep their init values; shape mismatches
+    raise ValueError naming the layer.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"weights file {path!r} does not exist")
+    if os.path.isdir(path):
+        from deconv_api_tpu.utils.checkpoint import restore_params
+
+        return restore_params(path, init_params)
+    if path.endswith((".h5", ".hdf5")):
+        loaded = _read_keras_h5(path)
+    elif path.endswith(".npz"):
+        archive = np.load(path)
+        loaded = {}
+        for key in archive.files:
+            layer, _, leaf = key.rpartition("/")
+            loaded.setdefault(layer, {})[leaf] = archive[key]
+    else:
+        raise ValueError(f"unsupported weights format: {path!r}")
+
+    params = {k: dict(v) for k, v in init_params.items()}
+    for name, tensors in loaded.items():
+        if name not in params:
+            continue  # classifier-less checkpoints etc.
+        for leaf in ("w", "b"):
+            if leaf not in tensors:
+                continue
+            want = params[name][leaf].shape
+            got = tensors[leaf].shape
+            if want != got:
+                raise ValueError(
+                    f"layer {name!r} {leaf}: checkpoint shape {got} != model shape {want}"
+                )
+            params[name][leaf] = jnp.asarray(
+                tensors[leaf], dtype=params[name][leaf].dtype
+            )
+    return params
+
+
+def _read_keras_h5(path: str) -> dict[str, dict[str, np.ndarray]]:
+    import h5py
+
+    out: dict[str, dict[str, np.ndarray]] = {}
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+
+        def visit(name, obj):
+            if not isinstance(obj, h5py.Dataset):
+                return
+            layer = name.split("/")[0]
+            base = name.split("/")[-1]
+            if base.startswith(("kernel", f"{layer}_W", "W")):
+                out.setdefault(layer, {})["w"] = np.asarray(obj)
+            elif base.startswith(("bias", f"{layer}_b", "b")):
+                out.setdefault(layer, {})["b"] = np.asarray(obj)
+
+        root.visititems(visit)
+    return out
+
+
+def _flatten_tree(params: dict, prefix: str = "") -> dict[str, np.ndarray]:
+    """Nested params dict -> {"a/b/leaf": array} (any nesting depth)."""
+    flat: dict[str, np.ndarray] = {}
+    for key, val in params.items():
+        name = f"{prefix}{key}"
+        if isinstance(val, dict):
+            flat.update(_flatten_tree(val, name + "/"))
+        else:
+            flat[name] = np.asarray(val)
+    return flat
+
+
+def save_npz(params: dict, path: str) -> None:
+    """Save a params pytree as a flat npz archive (slash-joined keys).
+    Handles both the sequential 2-level layout and the DAG models' deeper
+    nesting."""
+    np.savez(path, **_flatten_tree(params))
+
+
+def load_npz_into(path: str, init_params: dict) -> dict:
+    """Merge a save_npz archive into a copy of `init_params` (any nesting).
+    Unknown keys are ignored (classifier-less checkpoints); shape
+    mismatches raise naming the key."""
+    archive = np.load(path)
+    want = _flatten_tree(init_params)
+
+    def copy_tree(t):
+        return {
+            k: (copy_tree(v) if isinstance(v, dict) else v) for k, v in t.items()
+        }
+
+    params = copy_tree(init_params)
+    for key in archive.files:
+        if key not in want:
+            continue
+        got = archive[key]
+        if tuple(got.shape) != tuple(want[key].shape):
+            raise ValueError(
+                f"{key}: checkpoint shape {tuple(got.shape)} != model "
+                f"shape {tuple(want[key].shape)}"
+            )
+        node = params
+        *parents, leaf = key.split("/")
+        for p in parents:
+            node = node[p]
+        node[leaf] = jnp.asarray(got, dtype=np.asarray(want[key]).dtype)
+    return params
+
+
+def load_model_weights(
+    model_name: str, spec: ModelSpec | None, path: str, init_params: dict
+) -> dict:
+    """Model-aware weight loading — the single entry point serving uses.
+
+    - orbax dir / .npz: any model (pytree-shaped restore).
+    - Keras .h5: sequential specs use the name-keyed kernel/bias loader
+      above; ResNet50 and InceptionV3 use the BN-aware mappings in
+      models/dag_weights.py (reference parity: app/main.py:17 loads
+      pretrained Keras weights at startup).
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"weights file {path!r} does not exist")
+    if os.path.isdir(path):
+        from deconv_api_tpu.utils.checkpoint import restore_params
+
+        return restore_params(path, init_params)
+    if path.endswith(".npz"):
+        return load_npz_into(path, init_params)
+    if path.endswith((".h5", ".hdf5")):
+        if spec is not None:
+            return load_weights(spec, path, init_params)
+        from deconv_api_tpu.models import dag_weights
+
+        loaders = {
+            "resnet50": dag_weights.load_resnet50_h5,
+            "inception_v3": dag_weights.load_inception_v3_h5,
+        }
+        if model_name not in loaders:
+            raise ValueError(
+                f"no Keras h5 mapping for model {model_name!r}; "
+                f"h5 loaders exist for sequential specs and {sorted(loaders)}"
+            )
+        return loaders[model_name](path, init_params)
+    raise ValueError(f"unsupported weights format: {path!r}")

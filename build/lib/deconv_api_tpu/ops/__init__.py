@@ -1,0 +1,51 @@
+"""Pure-functional XLA ops used by the deconv engine and the model zoo.
+
+Every op here is a pure function over jnp arrays, traceable under jit/vmap/
+shard_map, with static shapes.  They replace the reference's per-layer Keras
+model objects (reference: app/deepdream.py:53-366) with functions that XLA can
+fuse into a single program.
+"""
+
+from deconv_api_tpu.ops.activations import (
+    apply_activation,
+    deconv_relu,
+    relu,
+    softmax,
+)
+from deconv_api_tpu.ops.conv import (
+    conv2d,
+    conv2d_input_backward,
+    flip_kernel,
+)
+from deconv_api_tpu.ops.linear import (
+    dense,
+    dense_input_backward,
+    flatten,
+    unflatten,
+)
+from deconv_api_tpu.ops.pool import (
+    maxpool_with_argmax,
+    maxpool_with_switches,
+    maxpool_switched,
+    unpool_with_argmax,
+    unpool_with_switches,
+)
+
+__all__ = [
+    "apply_activation",
+    "conv2d",
+    "conv2d_input_backward",
+    "deconv_relu",
+    "dense",
+    "dense_input_backward",
+    "flatten",
+    "flip_kernel",
+    "maxpool_with_argmax",
+    "maxpool_with_switches",
+    "maxpool_switched",
+    "unpool_with_argmax",
+    "relu",
+    "softmax",
+    "unflatten",
+    "unpool_with_switches",
+]

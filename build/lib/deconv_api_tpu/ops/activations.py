@@ -1,0 +1,62 @@
+"""Activations, including the deconvnet "backward ReLU".
+
+Zeiler–Fergus deconvnets apply ReLU to the *signal being propagated down*,
+not the usual gradient gating by the forward sign; the reference does this by
+reusing the same activation function in both directions
+(reference: app/deepdream.py:227-235 and the comment at 230-231).
+
+`deconv_relu` packages that rule as a `jax.custom_vjp` so that plain
+`jax.vjp` over a whole model (engine/autodeconv.py) performs deconvnet
+backprojection instead of true backprop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def relu(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(x, 0)
+
+
+def softmax(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.softmax(x, axis=-1)
+
+
+_ACTIVATIONS = {
+    "linear": lambda x: x,
+    "relu": relu,
+    "softmax": softmax,
+}
+
+
+def apply_activation(x: jnp.ndarray, name: str) -> jnp.ndarray:
+    """Apply a named activation (the set VGG16/ResNet50/InceptionV3 use)."""
+    try:
+        return _ACTIVATIONS[name](x)
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {name!r}; expected one of {sorted(_ACTIVATIONS)}"
+        ) from None
+
+
+@jax.custom_vjp
+def deconv_relu(x: jnp.ndarray) -> jnp.ndarray:
+    """ReLU whose "gradient" is the deconvnet rule: bwd(g) = relu(g).
+
+    Forward is ordinary ReLU; the VJP applies ReLU to the cotangent itself
+    instead of masking by the forward input's sign.
+    """
+    return jnp.maximum(x, 0)
+
+
+def _deconv_relu_fwd(x):
+    return jnp.maximum(x, 0), None
+
+
+def _deconv_relu_bwd(_, g):
+    return (jnp.maximum(g, 0),)
+
+
+deconv_relu.defvjp(_deconv_relu_fwd, _deconv_relu_bwd)

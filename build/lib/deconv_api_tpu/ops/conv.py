@@ -1,0 +1,107 @@
+"""2-D convolution and its deconvnet backward projection.
+
+The reference implements the forward conv as a one-layer Keras model and the
+backward ("deconv") projection as a second one-layer model whose kernel is
+channel-transposed and spatially flipped (reference: app/deepdream.py:72-89).
+Here both directions are single `lax.conv_general_dilated` calls on NHWC/HWIO
+layouts — the layouts XLA:TPU tiles straight onto the MXU — and the backward
+projection generalises to strided convs (ResNet-style) via the exact linear
+transpose of the forward conv, which the reference could not express at all.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# NHWC activations, HWIO kernels: the canonical TPU-friendly layout.
+DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    b: jnp.ndarray | None = None,
+    *,
+    strides: Sequence[int] = (1, 1),
+    padding: str = "SAME",
+) -> jnp.ndarray:
+    """Forward convolution: NHWC input, HWIO kernel.
+
+    Mirrors the reference's `DConvolution2D.up` (app/deepdream.py:91-100)
+    minus the fused activation, which the engine applies explicitly.
+    """
+    y = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=tuple(strides),
+        padding=padding,
+        dimension_numbers=DIMENSION_NUMBERS,
+    )
+    if b is not None:
+        y = y + b
+    return y
+
+
+def flip_kernel(w: jnp.ndarray) -> jnp.ndarray:
+    """Spatially flip an HWIO kernel and swap its in/out channels.
+
+    The deconvnet backward kernel of Zeiler–Fergus: `W' = flip_hw(W^T)`
+    (reference: app/deepdream.py:80-81 does `transpose(W, (0,1,3,2))` then
+    `W[::-1, ::-1]`).
+    """
+    return jnp.transpose(w, (0, 1, 3, 2))[::-1, ::-1, :, :]
+
+
+def conv2d_input_backward(
+    y: jnp.ndarray,
+    w: jnp.ndarray,
+    *,
+    strides: Sequence[int] = (1, 1),
+    padding: str = "SAME",
+    input_hw: tuple[int, int] | None = None,
+) -> jnp.ndarray:
+    """Deconvnet backward projection of a conv layer: map an output-space
+    signal back to input space with the flipped kernel and no bias.
+
+    For stride-1 SAME odd kernels this is exactly the reference's
+    flipped-kernel convolution (app/deepdream.py:80-89 + 102-111).  For
+    strided convs (ResNet50 deconv path, BASELINE config 4) it is the
+    transposed convolution.  Both cases are computed as the exact linear
+    transpose of `conv2d`, so the padding bookkeeping always matches the
+    forward pass.
+
+    ``input_hw`` pins the forward input's spatial size when the stride does
+    not evenly divide it; defaults to ``(H_out * sh, W_out * sw)``.
+    """
+    sh, sw = tuple(strides)
+    kh, kw = w.shape[0], w.shape[1]
+    if (sh, sw) == (1, 1) and padding == "SAME" and kh % 2 == 1 and kw % 2 == 1:
+        # Fast path, bit-identical to the reference's construction.
+        return lax.conv_general_dilated(
+            y,
+            flip_kernel(w),
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=DIMENSION_NUMBERS,
+        )
+    if input_hw is None:
+        input_hw = (y.shape[1] * sh, y.shape[2] * sw)
+    x_spec = jax.ShapeDtypeStruct(
+        (y.shape[0], input_hw[0], input_hw[1], w.shape[2]), y.dtype
+    )
+
+    def fwd(x):
+        return lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(sh, sw),
+            padding=padding,
+            dimension_numbers=DIMENSION_NUMBERS,
+        )
+
+    (x_bar,) = jax.linear_transpose(fwd, x_spec)(y)
+    return x_bar

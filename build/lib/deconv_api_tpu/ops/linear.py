@@ -1,0 +1,44 @@
+"""Dense layers, their backward projection, and (un)flatten.
+
+The reference builds two one-layer Keras models per dense layer per request —
+forward with (W, b), backward with (W^T, 0) (reference: app/deepdream.py:
+264-321) — and flattens via a `K.function` graph snippet with a NumPy reshape
+back (app/deepdream.py:324-366).  Here each is one fused XLA op; the matmuls
+land on the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+
+
+def dense(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Forward dense: ``x @ W + b`` with W shaped (in, out), Keras layout."""
+    y = x @ w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def dense_input_backward(y: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Deconvnet backward projection of a dense layer: ``y @ W.T``, no bias
+    (reference: app/deepdream.py:288-298 builds Dense(W^T, 0))."""
+    return y @ w.T
+
+
+def flatten(x: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, W, C) -> (B, H*W*C), channels-last row-major — identical to
+    Keras Flatten under channels_last (reference: app/deepdream.py:338-339)."""
+    return x.reshape(x.shape[0], -1)
+
+
+def unflatten(y: jnp.ndarray, spatial_shape: Sequence[int]) -> jnp.ndarray:
+    """Inverse of `flatten` (reference: app/deepdream.py:355-366)."""
+    spatial_shape = tuple(int(d) for d in spatial_shape)
+    assert math.prod(spatial_shape) == math.prod(y.shape[1:]), (
+        f"cannot unflatten {y.shape} into {spatial_shape}"
+    )
+    return y.reshape((y.shape[0], *spatial_shape))

@@ -1,0 +1,196 @@
+"""Max-pooling with argmax "switches" and switch-guided unpooling.
+
+The reference records switches with a 4-deep interpreted-Python loop over
+(sample, channel, row, col), tie-breaking to the first max in row-major patch
+order, and unpools via `np.kron(pooled, ones) * switch`
+(reference: app/deepdream.py:152-209) — its hot loop #1 (SURVEY §3.2).
+
+Here both directions are pure XLA: a reshape exposes each non-overlapping
+window as a trailing axis, `argmax` over that axis reproduces the reference's
+first-index row-major tie-break exactly, and a one-hot scatter-by-reshape
+materialises the switch mask.  Everything fuses; nothing leaves the device.
+
+`maxpool_switched` additionally packages the pair as a `jax.custom_vjp` so
+that autodiff-driven deconv (engine/autodeconv.py) routes cotangents through
+the exact same switch semantics.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def maxpool_with_argmax(
+    x: jnp.ndarray, pool_size: Sequence[int] = (2, 2)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Non-overlapping max-pool returning (pooled, window-argmax indices).
+
+    - `pooled`: (B, H//ph, W//pw, C) window maxima.
+    - `idx`: (B, H//ph, W//pw, C) int8, the row-major in-window position of
+      the *first* maximum — the reference's tie-break
+      (app/deepdream.py:180-187; `np.argmax` over the flattened patch has
+      identical first-occurrence semantics).
+
+    The compact int8 index IS the switch data structure: a full-resolution
+    fp32 one-hot mask (what the reference materialises) costs
+    ph*pw*4 bytes per window element and dominated live memory when threaded
+    from the forward to the backward half of the program; the index costs 1.
+
+    Odd trailing rows/cols are floor-dropped from pooling, matching
+    app/deepdream.py:166-167.
+    """
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, h, w, c = x.shape
+    if h % ph == 0 and w % pw == 0:
+        from deconv_api_tpu.ops import pallas_pool
+
+        if pallas_pool.pallas_enabled("pool"):
+            return pallas_pool.maxpool_argmax(x, (ph, pw))
+    ho, wo = h // ph, w // pw
+    xt = x[:, : ho * ph, : wo * pw, :]
+    # (B, Ho, ph, Wo, pw, C) -> (B, Ho, Wo, C, ph*pw): window as last axis.
+    windows = (
+        xt.reshape(b, ho, ph, wo, pw, c)
+        .transpose(0, 1, 3, 5, 2, 4)
+        .reshape(b, ho, wo, c, ph * pw)
+    )
+    pooled = jnp.max(windows, axis=-1)
+    idx = jnp.argmax(windows, axis=-1).astype(jnp.int8)  # first occurrence
+    return pooled, idx
+
+
+def unpool_with_argmax(
+    y: jnp.ndarray,
+    idx: jnp.ndarray,
+    pool_size: Sequence[int] = (2, 2),
+    out_hw: tuple[int, int] | None = None,
+    fuse_relu: bool = False,
+) -> jnp.ndarray:
+    """Scatter each pooled value to its window's argmax position — the
+    reference's `np.kron(input, ones(tile)) * switch`
+    (app/deepdream.py:191-209) with the mask reconstructed on the fly from
+    the compact index (XLA fuses the compare into the multiply; the one-hot
+    never touches HBM).
+
+    ``out_hw`` restores the original spatial extent when the pool size did
+    not divide it (trailing rows/cols come back as zeros).  ``fuse_relu``
+    applies the deconvnet backward-ReLU as part of the scatter — the engine
+    uses it for the unpool+ReLU pair of the down chain; semantics hold on
+    every dispatch path (the pallas kernel folds it in; XLA fuses the
+    equivalent `relu(y)` below).
+    """
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, ho, wo, c = y.shape
+    if out_hw is None or out_hw == (ho * ph, wo * pw):
+        from deconv_api_tpu.ops import pallas_pool
+
+        if pallas_pool.pallas_enabled("unpool"):
+            return pallas_pool.unpool_argmax(y, idx, (ph, pw), relu=fuse_relu)
+    if fuse_relu:
+        # relu(unpool(y)) == unpool(relu(y)): the scatter only places y
+        # values, zeros elsewhere
+        y = jnp.maximum(y, 0.0).astype(y.dtype)
+    mask = _argmax_mask(idx, (ph, pw))
+    up = y[:, :, None, :, None, :] * mask.astype(y.dtype)
+    up = up.reshape(b, ho * ph, wo * pw, c)
+    if out_hw is not None and out_hw != (ho * ph, wo * pw):
+        up = jnp.pad(
+            up,
+            ((0, 0), (0, out_hw[0] - ho * ph), (0, out_hw[1] - wo * pw), (0, 0)),
+        )
+    return up
+
+
+def _argmax_mask(idx: jnp.ndarray, pool_size: tuple[int, int]) -> jnp.ndarray:
+    """(B, Ho, ph, Wo, pw, C) bool one-hot of each window's argmax position.
+
+    The single place the compact int8 index expands to a spatial mask; both
+    the compact unpool and the mask-form API go through it so the two can
+    never drift (the int8 cast on `pos` must match `idx`'s dtype exactly)."""
+    ph, pw = pool_size
+    pos = (jnp.arange(ph)[:, None] * pw + jnp.arange(pw)[None, :]).astype(idx.dtype)
+    return idx[:, :, None, :, None, :] == pos[None, None, :, None, :, None]
+
+
+def maxpool_with_switches(
+    x: jnp.ndarray, pool_size: Sequence[int] = (2, 2)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Mask-form API: (pooled, full-resolution one-hot switch mask).
+
+    Provided for parity tests and external callers that want the
+    reference-shaped (B, H, W, C) switch (app/deepdream.py:152-188); the
+    engine itself threads the compact `maxpool_with_argmax` form.
+    """
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, h, w, c = x.shape
+    ho, wo = h // ph, w // pw
+    pooled, idx = maxpool_with_argmax(x, pool_size)
+    mask = _argmax_mask(idx, (ph, pw))
+    switch = mask.astype(x.dtype).reshape(b, ho * ph, wo * pw, c)
+    if (ho * ph, wo * pw) != (h, w):
+        switch = jnp.pad(
+            switch, ((0, 0), (0, h - ho * ph), (0, w - wo * pw), (0, 0))
+        )
+    return pooled, switch
+
+
+def unpool_with_switches(
+    y: jnp.ndarray, switch: jnp.ndarray, pool_size: Sequence[int] = (2, 2)
+) -> jnp.ndarray:
+    """Mask-form unpool: Kronecker-upsample `y` and gate by the switch mask
+    (reference app/deepdream.py:191-209), as two fused XLA broadcasts."""
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, ho, wo, c = y.shape
+    h, w = switch.shape[1], switch.shape[2]
+    up = jnp.broadcast_to(
+        y[:, :, None, :, None, :], (b, ho, ph, wo, pw, c)
+    ).reshape(b, ho * ph, wo * pw, c)
+    if (ho * ph, wo * pw) != (h, w):
+        up = jnp.pad(up, ((0, 0), (0, h - ho * ph), (0, w - wo * pw), (0, 0)))
+    return up * switch
+
+
+@lru_cache(maxsize=64)
+def _maxpool_switched_op(pool_size: tuple[int, int], out_hw: tuple[int, int]):
+    """custom_vjp instance per (pool_size, input H/W).
+
+    The static output extent lives in the closure, NOT in the residual
+    pytree: residual leaves become tracers when the VJP is traced under
+    jit, and `unpool_with_argmax` needs `out_hw` concrete (tuple equality
+    + pad widths).  Shapes are always static in jax, so closing over them
+    is free; the cache keeps one op per distinct spatial extent.
+    """
+
+    @jax.custom_vjp
+    def op(x):
+        pooled, _ = maxpool_with_argmax(x, pool_size)
+        return pooled
+
+    def fwd(x):
+        pooled, idx = maxpool_with_argmax(x, pool_size)
+        return pooled, idx
+
+    def bwd(idx, g):
+        return (unpool_with_argmax(g, idx, pool_size, out_hw),)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def maxpool_switched(x: jnp.ndarray, pool_size: tuple[int, int] = (2, 2)):
+    """Max-pool whose VJP routes cotangents through deconvnet switches.
+
+    A drop-in pooling op for models that want `jax.vjp` to reproduce the
+    reference's unpool-with-switch semantics exactly — including the
+    first-index tie-break, which XLA's native reduce-window gradient does
+    not guarantee.  The DAG engine (engine/autodeconv.py) currently uses
+    the native gradient (ties are measure-zero for real-valued
+    activations); this op is the exact-tie-break alternative, exercised by
+    tests.  Safe under jit (including jit-of-grad): all static shape data
+    stays out of the residuals.
+    """
+    return _maxpool_switched_op(tuple(pool_size), x.shape[1:3])(x)

@@ -1,0 +1,32 @@
+"""Parallelism: device meshes, sharded serving batches, sharded training.
+
+The reference is strictly single-process, single-device (SURVEY §2.4 —
+no DP/TP/PP, no NCCL/MPI anywhere).  The TPU-native scale-out story is
+`jax.sharding.Mesh` + GSPMD: annotate shardings, let XLA insert the
+collectives over ICI.  Axes used here:
+
+- ``dp`` — data parallel: serving batches and training batches shard their
+  leading axis (BASELINE config 5: 256 concurrent requests over v5e-8).
+- ``tp`` — tensor parallel: conv output-channel / dense feature sharding of
+  the parameters during training.
+
+There is deliberately no NCCL-style explicit communication API to build:
+collectives are emitted by XLA from sharding constraints (SURVEY §5,
+distributed-comm row).
+"""
+
+from deconv_api_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+    replicated,
+)
+from deconv_api_tpu.parallel.batch import sharded_visualizer
+
+__all__ = [
+    "batch_sharding",
+    "make_mesh",
+    "param_shardings",
+    "replicated",
+    "sharded_visualizer",
+]

@@ -1,0 +1,50 @@
+"""Data-parallel sharded execution of the deconv visualizer.
+
+BASELINE config 5: 256 concurrent /deconv requests spread over a v5e-8.
+The batched visualizer (engine/deconv.py, batched=True) is jitted with its
+batch axis sharded over the mesh's ``dp`` axis and params replicated — XLA
+partitions the program per-core with zero cross-core traffic in the hot
+path (each image's projection is independent; the only collectives are the
+initial param broadcast)."""
+
+from __future__ import annotations
+
+import jax
+
+from deconv_api_tpu.engine import get_visualizer
+from deconv_api_tpu.models.spec import ModelSpec
+from deconv_api_tpu.parallel.mesh import batch_sharding, replicated
+
+
+def shard_batched_fn(fn, mesh):
+    """Wrap any ``fn(params, batch)`` whose outputs all carry a leading
+    batch axis: params replicated, batch (in and out) sharded over ``dp``.
+
+    This is THE serving sharding rule — both the standalone
+    `sharded_visualizer` and the HTTP path (serving/models.py
+    ModelBundle.batched_visualizer with a mesh) go through it, so the two
+    cannot drift.  Per-call batch sizes must be a multiple of the dp axis
+    size; the serving dispatcher rounds its buckets up to that multiple
+    (serving/app.py:_bucket_for)."""
+    return jax.jit(
+        fn,
+        in_shardings=(replicated(mesh), batch_sharding(mesh)),
+        out_shardings=batch_sharding(mesh),
+    )
+
+
+def sharded_visualizer(
+    spec: ModelSpec,
+    mesh,
+    layer_name: str,
+    top_k: int = 8,
+    mode: str = "all",
+    bug_compat: bool = True,
+    backward_dtype: str | None = None,
+):
+    """Jitted ``fn(params, batch)`` with batch sharded over ``dp``."""
+    fn = get_visualizer(
+        spec, layer_name, top_k, mode, bug_compat, sweep=False, batched=True,
+        backward_dtype=backward_dtype,
+    )
+    return shard_batched_fn(fn, mesh)

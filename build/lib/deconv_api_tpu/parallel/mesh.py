@@ -1,0 +1,68 @@
+"""Mesh construction and sharding rules.
+
+One honest fact drives the layout (SURVEY §2.4): every model in the zoo
+fits on a single TPU core, so serving scales by **data parallelism** over
+cores, and training additionally shards parameters over a **tensor** axis.
+Shardings are expressed as `NamedSharding` annotations; XLA/GSPMD inserts
+the ICI collectives.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deconv_api_tpu.models.spec import ModelSpec
+
+
+def make_mesh(
+    shape: tuple[int, ...] | None = None,
+    axis_names: tuple[str, ...] = ("dp", "tp"),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    Default shape: all devices on ``dp``, 1 on ``tp`` — the serving layout.
+    For training, pass e.g. ``shape=(n//2, 2)``.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {shape} != device count {len(devices)}")
+    arr = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(arr, axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) axis over the data-parallel mesh axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def param_shardings(spec: ModelSpec, params, mesh: Mesh, axis: str = "tp"):
+    """Tensor-parallel parameter shardings: conv kernels shard their output
+    channels, dense kernels their output features, biases likewise; any leaf
+    whose channel count doesn't divide the axis size stays replicated.
+
+    Returns a pytree of NamedSharding congruent with `params`.
+    """
+    tp = mesh.shape[axis]
+
+    def shard_leaf(leaf_name: str, leaf):
+        dim = leaf.shape[-1]
+        if tp > 1 and dim % tp == 0:
+            spec_dims = (None,) * (leaf.ndim - 1) + (axis,)
+            return NamedSharding(mesh, P(*spec_dims))
+        return NamedSharding(mesh, P())
+
+    return {
+        layer: {leaf: shard_leaf(leaf, v) for leaf, v in leaves.items()}
+        for layer, leaves in params.items()
+    }

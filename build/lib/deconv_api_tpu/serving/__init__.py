@@ -1,0 +1,25 @@
+"""Serving layer: wire-compatible HTTP surface + batching dispatcher.
+
+Replaces the reference's FastAPI app (app/main.py) with a dependency-free
+asyncio HTTP server (fastapi/uvicorn are deliberately not required), an
+async batching dispatcher that coalesces concurrent requests into padded
+device batches (fixing the reference's event-loop-blocking `async def`,
+SURVEY §2.2.5), and a host-side image codec reproducing the reference's
+wire format byte-for-byte.
+"""
+
+from deconv_api_tpu.serving.codec import (
+    decode_data_url,
+    deprocess_image,
+    encode_data_url,
+    preprocess_vgg,
+    stitch_grid,
+)
+
+__all__ = [
+    "decode_data_url",
+    "deprocess_image",
+    "encode_data_url",
+    "preprocess_vgg",
+    "stitch_grid",
+]

@@ -22,10 +22,7 @@ execution on the device (SURVEY §2.4's data-parallel request batching).
 from __future__ import annotations
 
 import asyncio
-import contextlib
 import json
-import os
-import threading
 import time
 
 import numpy as np
@@ -100,17 +97,6 @@ class DeconvService:
             self.bundle.mesh = self.mesh
         self.metrics = Metrics()
         self.ready = False
-        # jax.profiler surface (SURVEY §5 tracing row): with profile_dir
-        # set, the first DECONV_PROFILE_BATCHES device batches are captured
-        # as TensorBoard-loadable traces.  One trace at a time (jax
-        # constraint) — the non-blocking lock simply skips profiling when
-        # the deconv and dream dispatchers dispatch concurrently.
-        self._profile_remaining = (
-            int(os.environ.get("DECONV_PROFILE_BATCHES", "4"))
-            if self.cfg.profile_dir
-            else 0
-        )
-        self._profile_lock = threading.Lock()
         self.dispatcher = BatchingDispatcher(
             self._run_batch,
             max_batch=self.cfg.max_batch,
@@ -140,30 +126,6 @@ class DeconvService:
 
     # ---------------------------------------------------------- device side
 
-    @contextlib.contextmanager
-    def _profile_scope(self):
-        """Capture this dispatch as a jax.profiler trace while the
-        startup budget lasts (no-op without cfg.profile_dir).  Warmup
-        dispatches are excluded — they capture compiles, not steady-state."""
-        if (
-            self._profile_remaining <= 0
-            or not self.ready
-            or not self._profile_lock.acquire(blocking=False)
-        ):
-            yield
-            return
-        try:
-            if self._profile_remaining <= 0:
-                yield
-                return
-            self._profile_remaining -= 1
-            from deconv_api_tpu.utils.tracing import profile_trace
-
-            with profile_trace(self.cfg.profile_dir):
-                yield
-        finally:
-            self._profile_lock.release()
-
     def _run_batch(self, key, images: list[np.ndarray]):
         """Execute one request group as a single device dispatch.
 
@@ -172,10 +134,6 @@ class DeconvService:
         log2(max_batch)+1 batch shapes per key; dream requests run one
         multi-octave ascent per image.
         """
-        with self._profile_scope():
-            return self._run_batch_inner(key, images)
-
-    def _run_batch_inner(self, key, images: list[np.ndarray]):
         import jax.numpy as jnp
 
         if key[0] == "__dream__":

@@ -1,0 +1,130 @@
+"""Async batching dispatcher: coalesce concurrent requests into padded
+device batches.
+
+The reference's endpoint is `async def` over seconds of blocking compute, so
+its true concurrency is 1 (SURVEY §2.2.5).  Here requests enqueue a future
+and a single dispatcher task owns the device: it drains the queue up to
+`max_batch` (waiting at most `window_ms` for stragglers), groups by
+(layer, mode) — each group is one compiled executable — pads the image batch
+to a power-of-two bucket so XLA never sees a new batch shape, runs the
+executable in a worker thread (the event loop stays free), and resolves the
+futures.  One task owning the device also removes the reference's
+shared-graph thread-safety hack (`tb._SYMBOLIC_SCOPE`, app/main.py:54;
+SURVEY §5 race-detection row).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+from deconv_api_tpu import errors
+
+
+@dataclass
+class WorkItem:
+    image: Any  # (H, W, C) np/jnp array, preprocessed
+    key: Any  # groupable static config, e.g. (layer_name, mode)
+    future: asyncio.Future = field(default_factory=asyncio.Future)
+    enqueued_at: float = field(default_factory=time.perf_counter)
+
+
+def pad_bucket(n: int, max_batch: int) -> int:
+    """Smallest power-of-two >= n, capped at max_batch — bounds the set of
+    batch shapes XLA ever compiles."""
+    b = 1
+    while b < n and b < max_batch:
+        b *= 2
+    return min(b, max_batch)
+
+
+class BatchingDispatcher:
+    """Owns the device; callers `await submit(...)`.
+
+    `runner(key, images) -> list[result]` executes one compiled batch; it is
+    called in a worker thread, never on the event loop.
+    """
+
+    def __init__(
+        self,
+        runner: Callable[[Any, list[Any]], list[Any]],
+        *,
+        max_batch: int = 8,
+        window_ms: float = 3.0,
+        request_timeout_s: float = 60.0,
+        metrics=None,
+    ):
+        self._runner = runner
+        self._max_batch = max_batch
+        self._window_s = window_ms / 1e3
+        self._timeout_s = request_timeout_s
+        self._queue: asyncio.Queue[WorkItem] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        self._metrics = metrics
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run(), name="batch-dispatcher")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def submit(self, image: Any, key: Any) -> Any:
+        item = WorkItem(image=image, key=key)
+        await self._queue.put(item)
+        try:
+            return await asyncio.wait_for(item.future, self._timeout_s)
+        except asyncio.TimeoutError:
+            raise errors.RequestTimeout(
+                f"no result within {self._timeout_s:.0f}s (device saturated?)"
+            ) from None
+
+    async def _run(self) -> None:
+        while True:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = time.perf_counter() + self._window_s
+            while len(batch) < self._max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(
+                        await asyncio.wait_for(self._queue.get(), remaining)
+                    )
+                except asyncio.TimeoutError:
+                    break
+            await self._execute(batch)
+
+    async def _execute(self, batch: list[WorkItem]) -> None:
+        groups: dict[Any, list[WorkItem]] = {}
+        for item in batch:
+            groups.setdefault(item.key, []).append(item)
+        for key, items in groups.items():
+            images = [it.image for it in items]
+            t0 = time.perf_counter()
+            try:
+                results = await asyncio.to_thread(self._runner, key, images)
+            except Exception as e:  # noqa: BLE001 — propagate to callers
+                for it in items:
+                    if not it.future.done():
+                        it.future.set_exception(e)
+                continue
+            dt = time.perf_counter() - t0
+            if self._metrics is not None:
+                self._metrics.observe_batch(
+                    size=len(items),
+                    compute_s=dt,
+                    queue_s=t0 - min(it.enqueued_at for it in items),
+                )
+            for it, res in zip(items, results):
+                if not it.future.done():
+                    it.future.set_result(res)

@@ -1,0 +1,217 @@
+"""Host-side image codec: the reference's wire format, byte-compatible.
+
+Reproduces (deliberately, for pixel parity — SURVEY §2.2):
+- data-URI base64 → BGR uint8 decode via cv2 (reference app/main.py:35-39);
+- resize to 224×224 with cv2's default bilinear (app/main.py:53);
+- Keras "caffe" preprocessing applied to the BGR array: flip to the other
+  channel order and subtract the ImageNet BGR means — reproducing the
+  reference's RGB/BGR mix-up exactly (SURVEY §2.2.1);
+- 2×2 grid stitch of the top-4 projections (app/main.py:67-69);
+- deprocess: mean/std normalize to 0.1 std, +0.5 shift, clip, uint8
+  (app/deepdream.py:483-498);
+- JPEG encode + base64 + percent-quote, served under a `data:image/webp`
+  prefix — the reference's mislabel, kept for wire parity (app/main.py:73-76).
+"""
+
+from __future__ import annotations
+
+import base64
+from urllib.parse import quote
+
+import numpy as np
+
+try:  # cv2 is present in the image; PIL is the documented fallback.
+    import cv2
+
+    _HAVE_CV2 = True
+except Exception:  # pragma: no cover
+    from PIL import Image
+
+    _HAVE_CV2 = False
+
+# Keras caffe-mode ImageNet means, BGR order (what `preprocess_input`
+# subtracts after flipping channels).
+CAFFE_MEANS_BGR = np.array([103.939, 116.779, 123.68], dtype=np.float32)
+
+EPSILON = 1e-7  # K.epsilon() in the reference's deprocess (app/deepdream.py:486)
+
+
+class CodecError(ValueError):
+    """Malformed image payload (bad base64 / undecodable image)."""
+
+
+def decode_data_url(uri: str) -> np.ndarray:
+    """data-URI (or bare base64) → BGR uint8 HWC array.
+
+    The reference splits on ',' and takes index 1 (app/main.py:36), which
+    500s on bare base64; we accept both and raise CodecError (not a server
+    crash) on garbage.
+    """
+    payload = uri.split(",", 1)[1] if "," in uri else uri
+    try:
+        raw = base64.b64decode(payload, validate=False)
+    except Exception as e:
+        raise CodecError(f"invalid base64 image payload: {e}") from e
+    if _HAVE_CV2:
+        img = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+        if img is None:
+            raise CodecError("could not decode image bytes")
+        return img
+    import io  # pragma: no cover
+
+    try:
+        pil = Image.open(io.BytesIO(raw)).convert("RGB")
+    except Exception as e:
+        raise CodecError(f"could not decode image bytes: {e}") from e
+    return np.asarray(pil)[:, :, ::-1]  # to BGR
+
+
+def resize224(img: np.ndarray, size: tuple[int, int] = (224, 224)) -> np.ndarray:
+    if _HAVE_CV2:
+        return cv2.resize(img, size)
+    from PIL import Image  # pragma: no cover
+
+    return np.asarray(Image.fromarray(img).resize(size))
+
+
+def preprocess_vgg(img_bgr: np.ndarray) -> np.ndarray:
+    """Keras caffe preprocessing as the reference invokes it.
+
+    `preprocess_input` assumes RGB input, flips to BGR, subtracts BGR means.
+    The reference hands it a BGR image (SURVEY §2.2.1), so the net effect —
+    reproduced here — is a channel flip plus BGR-ordered mean subtraction.
+    """
+    x = img_bgr.astype(np.float32)[..., ::-1]
+    return x - CAFFE_MEANS_BGR
+
+
+def preprocess_tf(img_bgr: np.ndarray) -> np.ndarray:
+    """Keras 'tf'-mode preprocessing (InceptionV3): RGB scaled to [-1, 1].
+    Input arrives BGR from the decoder, so flip first."""
+    x = img_bgr.astype(np.float32)[..., ::-1]
+    return x / 127.5 - 1.0
+
+
+def unpreprocess_vgg(x: np.ndarray) -> np.ndarray:
+    """Inverse of `preprocess_vgg`: back to BGR uint8 (for DeepDream output,
+    which lives in model-input space rather than projection space)."""
+    y = x.astype(np.float32) + CAFFE_MEANS_BGR
+    return np.clip(y[..., ::-1], 0, 255).astype(np.uint8)
+
+
+def unpreprocess_tf(x: np.ndarray) -> np.ndarray:
+    """Inverse of `preprocess_tf`: back to BGR uint8."""
+    y = (x.astype(np.float32) + 1.0) * 127.5
+    return np.clip(y[..., ::-1], 0, 255).astype(np.uint8)
+
+
+def deprocess_image(x: np.ndarray) -> np.ndarray:
+    """Projection tensor → displayable uint8 (reference app/deepdream.py:483-498)."""
+    x = x.astype(np.float32)
+    x = x - x.mean()
+    x = x / (x.std() + EPSILON)
+    x = x * 0.1 + 0.5
+    x = np.clip(x, 0.0, 1.0) * 255.0
+    return np.clip(x, 0, 255).astype(np.uint8)
+
+
+def stitch_grid(images: list[np.ndarray]) -> np.ndarray:
+    """Stitch the top-4 projections into a 2×2 grid (app/main.py:67-69).
+
+    The reference IndexErrors (→ HTTP 500) when fewer than 4 filters fired
+    (SURVEY §2.2.4); we pad missing tiles with zeros instead.
+    """
+    if not images:
+        raise CodecError("no filter projections to stitch")
+    tile = np.zeros_like(images[0])
+    tiles = list(images[:4]) + [tile] * max(0, 4 - len(images))
+    top = np.concatenate((tiles[0], tiles[1]), axis=1)
+    bottom = np.concatenate((tiles[2], tiles[3]), axis=1)
+    return np.concatenate((top, bottom), axis=0)
+
+
+def encode_data_url(img_uint8: np.ndarray) -> str:
+    """uint8 image → the reference's response string: JPEG bytes, base64,
+    percent-quoted, under a data:image/webp prefix (app/main.py:73-76)."""
+    if _HAVE_CV2:
+        ok, buf = cv2.imencode(".jpg", img_uint8)
+        if not ok:
+            raise CodecError("JPEG encode failed")
+        raw = buf.tobytes()
+    else:  # pragma: no cover
+        import io
+        from PIL import Image
+
+        bio = io.BytesIO()
+        Image.fromarray(img_uint8[:, :, ::-1]).save(bio, format="JPEG")
+        raw = bio.getvalue()
+    return "data:image/webp;base64,{}".format(quote(base64.b64encode(raw).decode("ascii")))
+
+
+# --- device-side postprocessing --------------------------------------------
+# The fp32 projection stack is the largest device->host transfer of a
+# request (top_k * H * W * C * 4 bytes); deprocessing — and for the compat
+# route, stitching — ON DEVICE cuts the transfer 4-16x to one uint8 image.
+# Semantics are bit-matched to the NumPy functions above (same truncating
+# uint8 cast, same EPSILON, and the reference's stitch-THEN-deprocess
+# order, app/main.py:67-72).
+
+
+def _deprocess_jax(x):
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.float32)
+    x = x - x.mean()
+    x = x / (x.std() + EPSILON)
+    x = x * 0.1 + 0.5
+    x = jnp.clip(x, 0.0, 1.0) * 255.0
+    return jnp.clip(x, 0.0, 255.0).astype(jnp.uint8)
+
+
+import functools as _functools
+
+
+@_functools.cache
+def _deprocess_tiles_jit():
+    import jax
+
+    return jax.jit(jax.vmap(jax.vmap(_deprocess_jax)))
+
+
+def deprocess_tiles_device(images):
+    """(B, K, H, W, C) projections -> uint8, each tile normalized alone
+    (the /v1/deconv per-filter presentation).  The jitted callable is
+    memoized — pjit's trace cache keys on function identity, so a fresh
+    wrapper per call would retrace on the hot serving path."""
+    return _deprocess_tiles_jit()(images)
+
+
+@_functools.cache
+def _stitch_grid_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(images, valid):
+        b, k = images.shape[:2]
+        if k < 4:
+            pad = jnp.zeros((b, 4 - k, *images.shape[2:]), images.dtype)
+            images = jnp.concatenate([images, pad], axis=1)
+            valid = jnp.concatenate(
+                [valid, jnp.zeros((b, 4 - k), valid.dtype)], axis=1
+            )
+        tiles = images[:, :4] * valid[:, :4, None, None, None].astype(images.dtype)
+        top = jnp.concatenate([tiles[:, 0], tiles[:, 1]], axis=2)
+        bottom = jnp.concatenate([tiles[:, 2], tiles[:, 3]], axis=2)
+        grid = jnp.concatenate([top, bottom], axis=1)
+        return jax.vmap(_deprocess_jax)(grid)
+
+    return run
+
+
+def stitch_grid_device(images, valid):
+    """(B, K, H, W, C) + (B, K) validity -> (B, 2H, 2W, C) uint8: zero the
+    tiles that didn't fire, stitch 2x2, deprocess over the WHOLE grid —
+    the reference's order (stitch at app/main.py:67-69, deprocess of the
+    stitched grid at :72), which normalizes all four tiles jointly."""
+    return _stitch_grid_jit()(images, valid)

@@ -1,0 +1,245 @@
+"""A minimal asyncio HTTP/1.1 server.
+
+The reference serves over FastAPI+uvicorn (app/main.py:19-32, Dockerfile:15);
+neither is in this image, so the framework carries its own dependency-free
+HTTP layer: enough of HTTP/1.1 for the reference's wire surface (urlencoded
+and multipart form POSTs, JSON responses, CORS with allow-all origins and no
+credentials — matching app/main.py:22-32) plus keep-alive.
+
+Handlers are `async def handler(Request) -> Response`; blocking device work
+never runs on the event loop (the dispatcher hands it to a worker thread),
+fixing the reference's frozen-loop concurrency of 1 (SURVEY §2.2.5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from dataclasses import dataclass, field
+from urllib.parse import parse_qsl, unquote, urlsplit
+
+MAX_BODY = 64 * 1024 * 1024  # 64 MiB: base64 images are bulky
+MAX_HEADER = 64 * 1024
+
+CORS_HEADERS = {
+    # Reference CORS: allow-all origins, no credentials (app/main.py:22-32).
+    "access-control-allow-origin": "*",
+    "access-control-allow-methods": "*",
+    "access-control-allow-headers": "*",
+}
+
+_STATUS_TEXT = {
+    200: "OK", 204: "No Content", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 408: "Request Timeout", 411: "Length Required",
+    413: "Payload Too Large", 422: "Unprocessable Entity",
+    500: "Internal Server Error", 501: "Not Implemented",
+    503: "Service Unavailable", 504: "Gateway Timeout",
+}
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, str]
+    headers: dict[str, str]
+    body: bytes
+
+    def form(self) -> dict[str, str]:
+        """Parse the body as a form: urlencoded or multipart/form-data."""
+        ctype = self.headers.get("content-type", "")
+        if ctype.startswith("application/x-www-form-urlencoded"):
+            return {
+                k: v
+                for k, v in parse_qsl(
+                    self.body.decode("utf-8", "replace"), keep_blank_values=True
+                )
+            }
+        if ctype.startswith("multipart/form-data"):
+            m = re.search(r'boundary="?([^";,]+)"?', ctype)
+            if not m:
+                raise ValueError("multipart body without boundary")
+            return _parse_multipart(self.body, m.group(1).encode())
+        if ctype.startswith("application/json"):
+            data = json.loads(self.body.decode("utf-8"))
+            if not isinstance(data, dict):
+                raise ValueError("JSON form body must be an object")
+            return {k: str(v) for k, v in data.items()}
+        raise ValueError(f"unsupported content-type {ctype!r}")
+
+
+def _parse_multipart(body: bytes, boundary: bytes) -> dict[str, str]:
+    fields: dict[str, str] = {}
+    delim = b"--" + boundary
+    for part in body.split(delim):
+        part = part.strip(b"\r\n")
+        if not part or part == b"--":
+            continue
+        if b"\r\n\r\n" not in part:
+            continue
+        head, _, value = part.partition(b"\r\n\r\n")
+        m = re.search(rb'name="([^"]*)"', head)
+        if m:
+            fields[m.group(1).decode()] = value.decode("utf-8", "replace")
+    return fields
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: bytes = b""
+    headers: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def json(cls, obj, status: int = 200) -> "Response":
+        return cls(
+            status=status,
+            body=json.dumps(obj).encode(),
+            headers={"content-type": "application/json"},
+        )
+
+    @classmethod
+    def text(cls, s: str, status: int = 200, content_type: str = "text/plain") -> "Response":
+        return cls(status=status, body=s.encode(), headers={"content-type": content_type})
+
+    def encode(self, keep_alive: bool) -> bytes:
+        headers = {
+            **CORS_HEADERS,
+            "content-length": str(len(self.body)),
+            "connection": "keep-alive" if keep_alive else "close",
+            **self.headers,
+        }
+        head = f"HTTP/1.1 {self.status} {_STATUS_TEXT.get(self.status, 'Unknown')}\r\n"
+        head += "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+        return head.encode() + b"\r\n" + self.body
+
+
+class HttpServer:
+    """Route table + asyncio stream server."""
+
+    def __init__(self):
+        self._routes: dict[tuple[str, str], callable] = {}
+        self._server: asyncio.AbstractServer | None = None
+
+    def route(self, method: str, path: str):
+        def register(fn):
+            self._routes[(method.upper(), path)] = fn
+            return fn
+
+        return register
+
+    async def start(self, host: str, port: int) -> int:
+        self._server = await asyncio.start_server(self._handle_conn, host, port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                keep_alive = req.headers.get("connection", "keep-alive") != "close"
+                resp = await self._dispatch(req)
+                writer.write(resp.encode(keep_alive))
+                await writer.drain()
+                if not keep_alive:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except _BadRequest as e:
+            try:
+                writer.write(Response.json({"error": str(e)}, e.status).encode(False))
+                await writer.drain()
+            except ConnectionResetError:
+                pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except ConnectionResetError:
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader) -> Request | None:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.IncompleteReadError as e:
+            if not e.partial:
+                return None  # clean close between keep-alive requests
+            raise
+        except asyncio.LimitOverrunError:
+            raise _BadRequest(431, "headers too large") from None
+        if len(head) > MAX_HEADER:
+            raise _BadRequest(431, "headers too large")
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = lines[0].split(" ", 2)
+        except ValueError:
+            raise _BadRequest(400, f"malformed request line {lines[0]!r}") from None
+        headers: dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        if "content-length" in headers:
+            try:
+                n = int(headers["content-length"])
+            except ValueError:
+                raise _BadRequest(400, "bad content-length") from None
+            if n > MAX_BODY:
+                raise _BadRequest(413, "body too large")
+            body = await reader.readexactly(n)
+        elif headers.get("transfer-encoding", "").lower() == "chunked":
+            body = await self._read_chunked(reader)
+        parts = urlsplit(target)
+        query = {k: v for k, v in parse_qsl(parts.query, keep_blank_values=True)}
+        return Request(method.upper(), unquote(parts.path), query, headers, body)
+
+    async def _read_chunked(self, reader: asyncio.StreamReader) -> bytes:
+        chunks = []
+        total = 0
+        while True:
+            size_line = (await reader.readline()).strip()
+            try:
+                n = int(size_line.split(b";")[0], 16)
+            except ValueError:
+                raise _BadRequest(400, "bad chunk size") from None
+            if n == 0:
+                await reader.readline()
+                return b"".join(chunks)
+            total += n
+            if total > MAX_BODY:
+                raise _BadRequest(413, "body too large")
+            chunks.append(await reader.readexactly(n))
+            await reader.readexactly(2)  # trailing CRLF
+
+    async def _dispatch(self, req: Request) -> Response:
+        if req.method == "OPTIONS":  # CORS preflight
+            return Response(204)
+        handler = self._routes.get((req.method, req.path))
+        if handler is None:
+            if any(p == req.path for (_, p) in self._routes):
+                return Response.json({"error": "method not allowed"}, 405)
+            return Response.json({"error": f"no route for {req.path}"}, 404)
+        try:
+            return await handler(req)
+        except Exception as e:  # noqa: BLE001 — last-resort 500, never a dropped conn
+            import traceback
+
+            traceback.print_exc()
+            return Response.json(
+                {"error": "internal_error", "detail": f"{type(e).__name__}: {e}"}, 500
+            )
+
+
+class _BadRequest(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status

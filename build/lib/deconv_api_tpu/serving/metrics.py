@@ -1,0 +1,121 @@
+"""Request/batch metrics with a Prometheus-style text exposition.
+
+The reference pins prometheus-client but never uses it and has no metrics
+at all (SURVEY §5 metrics row: health endpoint + stdout prints only).  This
+registry feeds the `/metrics` endpoint and the bench harness: request
+latency quantiles (p50/p99), batch sizes, images/sec.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+
+class _Reservoir:
+    """Bounded sorted sample for quantiles (simple, lock-protected)."""
+
+    def __init__(self, cap: int = 4096):
+        self._cap = cap
+        self._sorted: list[float] = []
+        self._ring: list[float] = []
+
+    def add(self, v: float) -> None:
+        if len(self._ring) >= self._cap:
+            old = self._ring.pop(0)
+            i = bisect.bisect_left(self._sorted, old)
+            self._sorted.pop(i)
+        self._ring.append(v)
+        bisect.insort(self._sorted, v)
+
+    def quantile(self, q: float) -> float:
+        if not self._sorted:
+            return 0.0
+        i = min(len(self._sorted) - 1, int(q * len(self._sorted)))
+        return self._sorted[i]
+
+    def __len__(self) -> int:
+        return len(self._sorted)
+
+
+class Metrics:
+    def __init__(self, prefix: str = "deconv"):
+        self._prefix = prefix
+        self._lock = threading.Lock()
+        self._started = time.time()
+        self.requests_total = 0
+        self.errors_total: dict[str, int] = {}
+        self.images_total = 0
+        self.batches_total = 0
+        self._latency = _Reservoir()
+        self._batch_size = _Reservoir()
+        self._compute = _Reservoir()
+        self._queue_wait = _Reservoir()
+        self._stage: dict[str, _Reservoir] = {}
+
+    def observe_request(self, latency_s: float, error_code: str | None = None) -> None:
+        with self._lock:
+            self.requests_total += 1
+            self._latency.add(latency_s)
+            if error_code:
+                self.errors_total[error_code] = self.errors_total.get(error_code, 0) + 1
+
+    def observe_batch(self, size: int, compute_s: float, queue_s: float) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.images_total += size
+            self._batch_size.add(float(size))
+            self._compute.add(compute_s)
+            self._queue_wait.add(queue_s)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        """Per-stage request timing (decode/preprocess/compute/encode) —
+        the structured-tracing counterpart of SURVEY §5's tracing row."""
+        with self._lock:
+            self._stage.setdefault(stage, _Reservoir()).add(seconds)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            up = time.time() - self._started
+            return {
+                "uptime_s": up,
+                "requests_total": self.requests_total,
+                "errors_total": dict(self.errors_total),
+                "images_total": self.images_total,
+                "batches_total": self.batches_total,
+                "images_per_sec": self.images_total / up if up > 0 else 0.0,
+                "latency_p50_s": self._latency.quantile(0.50),
+                "latency_p99_s": self._latency.quantile(0.99),
+                "batch_size_p50": self._batch_size.quantile(0.50),
+                "compute_p50_s": self._compute.quantile(0.50),
+                "queue_wait_p50_s": self._queue_wait.quantile(0.50),
+                "stages": {
+                    k: {"p50_s": r.quantile(0.5), "p99_s": r.quantile(0.99)}
+                    for k, r in self._stage.items()
+                },
+            }
+
+    def prometheus(self) -> str:
+        p = self._prefix
+        s = self.snapshot()
+        lines = [
+            f"# TYPE {p}_requests_total counter",
+            f"{p}_requests_total {s['requests_total']}",
+            f"# TYPE {p}_images_total counter",
+            f"{p}_images_total {s['images_total']}",
+            f"# TYPE {p}_batches_total counter",
+            f"{p}_batches_total {s['batches_total']}",
+            f"# TYPE {p}_request_latency_seconds summary",
+            f'{p}_request_latency_seconds{{quantile="0.5"}} {s["latency_p50_s"]:.6f}',
+            f'{p}_request_latency_seconds{{quantile="0.99"}} {s["latency_p99_s"]:.6f}',
+            f"# TYPE {p}_images_per_sec gauge",
+            f"{p}_images_per_sec {s['images_per_sec']:.3f}",
+        ]
+        for code, n in s["errors_total"].items():
+            lines.append(f'{p}_errors_total{{code="{code}"}} {n}')
+        for stage, q in s["stages"].items():
+            lines.append(
+                f'{p}_stage_seconds{{stage="{stage}",quantile="0.5"}} {q["p50_s"]:.6f}'
+            )
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,217 @@
+"""Model registry for serving: every model family behind one interface.
+
+A ModelBundle packages what the HTTP layer needs: preprocessing, the set of
+nameable layers, and a builder for batched jitted visualizers.  Sequential
+specs (VGG16) use the bug-compat parity engine (engine/deconv.py); DAG
+models (ResNet50, InceptionV3) use the autodiff engine
+(engine/autodeconv.py).  The reference hardcodes exactly one model at import
+time (app/main.py:17); here `DECONV_MODEL=resnet50` is a config change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import numpy as np
+
+from deconv_api_tpu.engine import autodeconv_visualizer, get_visualizer
+from deconv_api_tpu.serving import codec
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    name: str
+    params: dict
+    image_size: int
+    preprocess: Callable[[np.ndarray], np.ndarray]
+    layer_names: tuple[str, ...]  # projectable layers
+    dream_layers: tuple[str, ...]  # default DeepDream targets
+    forward_fn: Callable | None  # DAG-model calling convention
+    unpreprocess: Callable[[np.ndarray], np.ndarray] = codec.unpreprocess_vgg
+    min_dream_size: int = 16  # smallest octave edge the trunk accepts
+    spec: object = None  # ModelSpec, set for sequential models
+    mesh: object = None  # jax.sharding.Mesh — set by DeconvService when
+    # cfg.mesh_shape is configured; visualizers then run dp-sharded
+    _vis_cache: dict = dataclasses.field(default_factory=dict)
+    _dream_cache: dict = dataclasses.field(default_factory=dict)
+
+    def dream_forward(self, layers: tuple[str, ...]):
+        """A resolution-robust forward for octave dreaming: DAG models
+        as-is; sequential specs truncated below their flatten/dense head.
+        Cached per layer set so repeated dream requests reuse the same
+        closure (and therefore the same jit cache)."""
+        if self.forward_fn is not None:
+            return self.forward_fn
+        if layers not in self._dream_cache:
+            from deconv_api_tpu.models.apply import spec_forward
+
+            by_name = {l.name: l for l in self.spec.layers}
+            for l in layers:
+                if l not in by_name:
+                    raise KeyError(f"model has no activation {l!r}")
+                if by_name[l].kind not in ("conv", "pool"):
+                    raise KeyError(
+                        f"layer {l!r} ({by_name[l].kind}) is not dreamable: octave "
+                        "resizing requires conv/pool layers (dense heads are "
+                        "resolution-bound)"
+                    )
+            names = self.spec.layer_names()
+            deepest = max(layers, key=names.index)
+            self._dream_cache[layers] = spec_forward(self.spec.truncated(deepest))
+        return self._dream_cache[layers]
+
+    def batched_visualizer(
+        self,
+        layer: str,
+        mode: str,
+        top_k: int,
+        bug_compat: bool = True,
+        backward_dtype: str | None = None,
+    ):
+        """fn(params, batch) -> {layer: {images, indices, sums, valid}} —
+        jitted once per static configuration and cached.  ``bug_compat``
+        only affects sequential models (the DAG autodiff path has no
+        double-ReLU quirk to reproduce).  ``backward_dtype`` defaults to
+        exact (None); the serving layer passes its configured policy.  The
+        DAG autodiff path ignores it (its backward is a vjp over the saved
+        fp32 forward residuals, so there is no separate projection chain to
+        downcast) — normalised out of the cache key there."""
+        if self.spec is None:
+            backward_dtype = None
+        key = (layer, mode, top_k, bug_compat, backward_dtype)
+        if key not in self._vis_cache:
+            if self.spec is not None:
+                fn = get_visualizer(
+                    self.spec, layer, top_k, mode, bug_compat,
+                    sweep=False, batched=True,
+                    backward_dtype=backward_dtype or None,
+                )
+                if self.mesh is not None:
+                    from deconv_api_tpu.parallel.batch import shard_batched_fn
+
+                    fn = shard_batched_fn(fn, self.mesh)
+            else:
+                vmapped = jax.vmap(
+                    autodeconv_visualizer(self.forward_fn, layer, top_k, mode),
+                    in_axes=(None, 0),
+                )
+                if self.mesh is not None:
+                    from deconv_api_tpu.parallel.batch import shard_batched_fn
+
+                    vmapped = shard_batched_fn(vmapped, self.mesh)
+                else:
+                    vmapped = jax.jit(vmapped)
+                fn = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
+            self._vis_cache[key] = fn
+        return self._vis_cache[key]
+
+
+def spec_bundle(
+    spec,
+    params,
+    *,
+    dream_layers: tuple[str, ...] = (),
+    preprocess: Callable[[np.ndarray], np.ndarray] = codec.preprocess_vgg,
+) -> ModelBundle:
+    """The one place a sequential ModelSpec becomes a ModelBundle (used by
+    both the registry and injected-spec servers, so the projectable-layer
+    rule cannot drift between them)."""
+    return ModelBundle(
+        name=spec.name,
+        params=params,
+        image_size=spec.input_shape[0],
+        preprocess=preprocess,
+        layer_names=tuple(l.name for l in spec.layers if l.kind != "input"),
+        dream_layers=dream_layers,
+        forward_fn=None,
+        spec=spec,
+    )
+
+
+def _vgg16_bundle() -> ModelBundle:
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    spec, params = vgg16_init()
+    return spec_bundle(
+        spec, params, dream_layers=("block4_conv3", "block5_conv1")
+    )
+
+
+def _resnet50_bundle() -> ModelBundle:
+    from deconv_api_tpu.models.resnet50 import (
+        DECONV_LAYERS,
+        resnet50_forward,
+        resnet50_init,
+    )
+
+    params = resnet50_init(jax.random.PRNGKey(0))
+    return ModelBundle(
+        name="resnet50",
+        params=params,
+        image_size=224,
+        preprocess=codec.preprocess_vgg,  # Keras resnet50 uses caffe mode too
+        layer_names=DECONV_LAYERS,
+        dream_layers=("conv4_block3_out", "conv4_block6_out"),
+        forward_fn=resnet50_forward,
+    )
+
+
+def _inception_v3_bundle() -> ModelBundle:
+    from deconv_api_tpu.models.inception_v3 import (
+        DREAM_LAYERS,
+        inception_v3_forward,
+        inception_v3_init,
+    )
+
+    params = inception_v3_init(jax.random.PRNGKey(0))
+    return ModelBundle(
+        name="inception_v3",
+        params=params,
+        image_size=299,
+        preprocess=codec.preprocess_tf,  # Keras inception uses 'tf' mode
+        layer_names=tuple(f"mixed{i}" for i in range(11)),
+        dream_layers=DREAM_LAYERS,
+        forward_fn=inception_v3_forward,
+        unpreprocess=codec.unpreprocess_tf,
+        min_dream_size=75,  # the VALID-padded stem needs >= 75 px
+    )
+
+
+REGISTRY: dict[str, Callable[[], ModelBundle]] = {
+    "vgg16": _vgg16_bundle,
+    "resnet50": _resnet50_bundle,
+    "inception_v3": _inception_v3_bundle,
+}
+
+
+def registry_info() -> list[dict]:
+    """Static metadata for each registered model — no weight init, no
+    device touch (the CLI's `models` listing must work instantly)."""
+    from deconv_api_tpu.models.inception_v3 import DREAM_LAYERS
+    from deconv_api_tpu.models.resnet50 import DECONV_LAYERS
+    from deconv_api_tpu.models.vgg16 import VGG16_SPEC as spec
+    return [
+        {
+            "model": "vgg16",
+            "image_size": 224,
+            "engine": "switch-deconv (sequential spec)",
+            "layers": [l.name for l in spec.layers if l.kind != "input"],
+            "dream_layers": ["block4_conv3", "block5_conv1"],
+        },
+        {
+            "model": "resnet50",
+            "image_size": 224,
+            "engine": "autodiff-deconv (DAG)",
+            "layers": list(DECONV_LAYERS),
+            "dream_layers": ["conv4_block3_out", "conv4_block6_out"],
+        },
+        {
+            "model": "inception_v3",
+            "image_size": 299,
+            "engine": "autodiff-deconv (DAG)",
+            "layers": [f"mixed{i}" for i in range(11)],
+            "dream_layers": list(DREAM_LAYERS),
+        },
+    ]

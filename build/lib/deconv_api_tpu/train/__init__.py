@@ -1,0 +1,12 @@
+"""Training: sharded fine-tuning of the model zoo.
+
+The reference has no training at all (SURVEY §1: inference-only service);
+this subsystem exists because a framework serving deconv visualizations of
+*fine-tuned* models needs a way to produce them.  The step is one jitted
+program sharded over a (dp, tp) mesh — batch over ``dp``, parameters over
+``tp`` — with XLA inserting the gradient psums over ICI.
+"""
+
+from deconv_api_tpu.train.step import TrainState, make_train_step, train_state_shardings
+
+__all__ = ["TrainState", "make_train_step", "train_state_shardings"]

@@ -1,0 +1,30 @@
+"""Checkpointing via orbax + the XLA persistent compilation cache.
+
+The reference has no save path at all — its only persistence is the
+pretrained-weight download (app/main.py:17; SURVEY §5 checkpoint row).
+Here params pytrees round-trip through orbax (so fine-tuned weights from
+train/ can be served), and compiled executables persist across process
+restarts via JAX's compilation cache (config.enable_compilation_cache),
+which matters on TPU where a cold compile of the deconv program is tens of
+seconds.
+"""
+
+from __future__ import annotations
+
+import os
+
+import orbax.checkpoint as ocp
+
+
+def save_params(path: str, params) -> None:
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_params(path: str, like):
+    """Restore a params pytree shaped like `like` from an orbax dir."""
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, like)

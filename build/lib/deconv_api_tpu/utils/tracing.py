@@ -1,0 +1,41 @@
+"""Tracing & profiling hooks.
+
+The reference's only observability is debug prints of layer lists/shapes on
+every request (app/deepdream.py:438,445-447; SURVEY §5 tracing row).  Here:
+- `stage(...)`: lightweight per-stage wall-time spans feeding
+  serving.metrics (decode / compute / encode timings behind /metrics);
+- `profile_trace(...)`: a jax.profiler trace scope writing TensorBoard-
+  loadable traces (XLA op-level timeline on TPU) when a profile dir is
+  configured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def stage(metrics, name: str):
+    """Time a pipeline stage into the metrics registry (no-op without one)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if metrics is not None:
+            metrics.observe_stage(name, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profile_trace(profile_dir: str):
+    """jax.profiler trace scope; inert when profile_dir is empty."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -150,3 +150,54 @@ def test_mixed_precision_backward_parity():
     mse = np.mean((i32.astype(np.float64) - imx.astype(np.float64)) ** 2)
     psnr = 10 * np.log10(255.0**2 / max(mse, 1e-12))
     assert psnr > 40.0, f"mixed-precision PSNR {psnr:.1f} dB under target"
+
+
+MID = ModelSpec(
+    name="mid_vgg",
+    input_shape=(64, 64, 3),
+    layers=(
+        Layer("input_1", "input"),
+        Layer("b1c1", "conv", activation="relu", filters=16),
+        Layer("b1c2", "conv", activation="relu", filters=16),
+        Layer("b1p", "pool"),
+        Layer("b2c1", "conv", activation="relu", filters=32),
+        Layer("b2c2", "conv", activation="relu", filters=32),
+        Layer("b2p", "pool"),
+        Layer("b3c1", "conv", activation="relu", filters=48),
+        Layer("b3c2", "conv", activation="relu", filters=48),
+        Layer("b3c3", "conv", activation="relu", filters=48),
+        Layer("b3p", "pool"),
+    ),
+)
+
+
+@pytest.mark.slow
+def test_mid_size_depth_parity():
+    """VERDICT r1 #4: oracle parity beyond the 16x16 toy — 64x64, 3 blocks,
+    deepest conv, full top-8.  Run with -m slow (excluded by default); the
+    FULL-depth 224x224 artifact lives in tools/full_depth_parity.py with
+    results recorded in BASELINE.md."""
+    spec = MID
+    np_spec = []
+    for l in spec.layers:
+        d = {"name": l.name, "kind": l.kind}
+        if l.kind in ("conv", "dense"):
+            d["activation"] = l.activation
+        if l.kind == "pool":
+            d["pool_size"] = tuple(l.pool_size)
+        np_spec.append(d)
+    params = init_params(spec, jax.random.PRNGKey(11))
+    np_params = jax.tree.map(lambda a: np.asarray(a, np.float64), params)
+    img = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(5), (64, 64, 3)), np.float64
+    ) * 20.0
+
+    got = visualize(spec, params, jnp.asarray(img, jnp.float32), "b3c3")
+    want = ref.visualize_all_layers(np_spec, np_params, img[None], "b3c3")["b3c3"]
+    valid = int(np.asarray(got["valid"]).sum())
+    assert valid == len(want)
+    for k in range(valid):
+        np.testing.assert_allclose(
+            np.asarray(got["images"][k]), want[k], rtol=1e-3, atol=1e-3,
+            err_msg=f"b3c3 filter rank {k}",
+        )

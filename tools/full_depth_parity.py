@@ -1,0 +1,132 @@
+"""One-off artifact: fp64 NumPy-oracle vs jitted engine parity at FULL
+VGG16 depth and resolution (224x224, block5_conv1, top-8) — VERDICT r1 #4.
+
+The round-1 parity evidence ran on a 16x16 toy spec; this script runs the
+independent fp64 oracle (tests/reference_numpy.py — the reference
+algorithm, SURVEY §2.2 quirks included) once at full depth and reports
+PSNR of the engine output against it, in raw projection space and after
+deprocess-uint8 (the serving path), for both the exact fp32 engine and
+the bf16-backward serving configuration.  Slow (minutes of fp64 NumPy) —
+run manually; results are recorded in BASELINE.md.
+
+Usage: python tools/full_depth_parity.py [--layer block5_conv1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def np_spec_of(spec):
+    out = []
+    for l in spec.layers:
+        d = {"name": l.name, "kind": l.kind}
+        if l.kind in ("conv", "dense"):
+            d["activation"] = l.activation
+        if l.kind == "pool":
+            d["pool_size"] = tuple(l.pool_size)
+        out.append(d)
+    return out
+
+
+def psnr_db(a: np.ndarray, b: np.ndarray, peak: float) -> float:
+    mse = float(np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2))
+    return 10 * np.log10(peak**2 / max(mse, 1e-20))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layer", default="block5_conv1")
+    ap.add_argument("--top-k", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # oracle comparison is a CPU job
+    import jax.numpy as jnp
+
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+    from deconv_api_tpu.serving.codec import deprocess_image
+    from tests import reference_numpy as ref
+
+    spec, params = vgg16_init(jax.random.PRNGKey(0))
+    # caffe-preprocessed scale: zero-centred, O(100) dynamic range
+    img = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(7), (224, 224, 3)), np.float64
+    ) * 40.0
+
+    # ---- oracle: forward once, project only the requested layer ----
+    t0 = time.perf_counter()
+    np_params = jax.tree.map(lambda a: np.asarray(a, np.float64), params)
+    nspec = np_spec_of(spec)
+    names = [l["name"] for l in nspec]
+    entries = ref.build_entries(nspec[: names.index(args.layer) + 1], np_params)
+    x = img[None]
+    for e in entries:
+        x = e.up(x)
+        e.up_data = x
+    fwd_s = time.perf_counter() - t0
+    print(f"oracle forward: {fwd_s:.1f}s", flush=True)
+
+    target_i = next(i for i, e in enumerate(entries) if e.name == args.layer)
+    output = entries[target_i].up_data
+    top = ref.find_top_filters(output, args.top_k)
+    oracle_imgs = []
+    t0 = time.perf_counter()
+    for rank, (fidx, _) in enumerate(top):
+        seed = np.zeros_like(output)
+        seed[..., fidx] = output[..., fidx]
+        sig = entries[target_i].down(seed)
+        for j in range(target_i - 1, -1, -1):
+            sig = entries[j].down(sig)
+        oracle_imgs.append(np.squeeze(sig))
+        print(f"  oracle projection {rank + 1}/{len(top)} "
+              f"({time.perf_counter() - t0:.1f}s cum)", flush=True)
+    bwd_s = time.perf_counter() - t0
+    oracle_imgs = np.stack(oracle_imgs)
+
+    # ---- engine (exact fp32 and the bf16-backward serving path) ----
+    results = {"layer": args.layer, "top_k": len(top),
+               "oracle_forward_s": round(fwd_s, 1),
+               "oracle_backward_s": round(bwd_s, 1)}
+    for label, bwd_dtype in (("fp32", None), ("bf16_backward", "bfloat16")):
+        t0 = time.perf_counter()
+        fn = get_visualizer(
+            spec, args.layer, args.top_k, "all", True, backward_dtype=bwd_dtype
+        )
+        out = fn(params, jnp.asarray(img, jnp.float32))[args.layer]
+        dt = time.perf_counter() - t0
+        n = int(np.asarray(out["valid"]).sum())
+        idx = np.asarray(out["indices"])[:n]
+        imgs = np.asarray(out["images"], np.float64)[:n]
+        assert n == len(top), f"{label}: engine found {n} filters, oracle {len(top)}"
+        idx_match = bool((idx == [i for i, _ in top]).all())
+
+        raw_peak = float(np.abs(oracle_imgs).max())
+        raw = psnr_db(imgs, oracle_imgs, raw_peak)
+        a = np.stack([deprocess_image(v) for v in imgs])
+        b = np.stack([deprocess_image(v) for v in oracle_imgs])
+        dep = psnr_db(a, b, 255.0)
+        results[label] = {
+            "engine_s": round(dt, 1),
+            "indices_match": idx_match,
+            "raw_psnr_db": round(raw, 1),
+            "deprocessed_psnr_db": round(dep, 1),
+        }
+        print(f"{label}: idx_match={idx_match} raw={raw:.1f}dB "
+              f"deprocessed={dep:.1f}dB ({dt:.1f}s)", flush=True)
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
