@@ -108,6 +108,14 @@ class BatchingDispatcher:
         groups: dict[Any, list[WorkItem]] = {}
         for item in batch:
             groups.setdefault(item.key, []).append(item)
+        # Distinct keys in one drain window run SERIALLY — a deliberate
+        # decision (round-1 review asked): one dispatcher task owns the
+        # device, and device execution is serial regardless; overlapping
+        # group B's dispatch with group A's host postprocess would pipeline
+        # at most a few ms of encode time per window at the cost of losing
+        # the single-owner invariant that replaces the reference's
+        # _SYMBOLIC_SCOPE thread hack.  Mixed-key bursts complete without
+        # starvation (tests/test_serving.py::test_mixed_layer_burst).
         for key, items in groups.items():
             images = [it.image for it in items]
             t0 = time.perf_counter()

@@ -206,7 +206,13 @@ class HttpServer:
         chunks = []
         total = 0
         while True:
-            size_line = (await reader.readline()).strip()
+            try:
+                # readline raises (LimitOverrun wrapped in ValueError) when
+                # a "chunk-size line" exceeds the StreamReader limit — a
+                # malformed or hostile request, not a server error
+                size_line = (await reader.readline()).strip()
+            except (asyncio.LimitOverrunError, ValueError):
+                raise _BadRequest(400, "bad chunk framing") from None
             try:
                 n = int(size_line.split(b";")[0], 16)
             except ValueError:

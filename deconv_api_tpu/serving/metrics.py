@@ -9,21 +9,27 @@ latency quantiles (p50/p99), batch sizes, images/sec.
 from __future__ import annotations
 
 import bisect
+import collections
 import threading
 import time
 
 
 class _Reservoir:
-    """Bounded sorted sample for quantiles (simple, lock-protected)."""
+    """Bounded sliding-window sample for quantiles (lock-protected).
+
+    Cost decision (round-1 review): the deque eviction is O(1); the sorted
+    list's insort/pop are O(n) *memmoves* — at cap 4096 that is a ~32 KB
+    C-level move, ~1 µs per sample, against requests measured in
+    milliseconds.  A skip-list/t-digest would save nothing observable."""
 
     def __init__(self, cap: int = 4096):
         self._cap = cap
         self._sorted: list[float] = []
-        self._ring: list[float] = []
+        self._ring: collections.deque[float] = collections.deque()
 
     def add(self, v: float) -> None:
         if len(self._ring) >= self._cap:
-            old = self._ring.pop(0)
+            old = self._ring.popleft()
             i = bisect.bisect_left(self._sorted, old)
             self._sorted.pop(i)
         self._ring.append(v)
