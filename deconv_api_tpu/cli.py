@@ -16,6 +16,9 @@ import sys
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--model", default=None, help="vgg16 | resnet50 | inception_v3")
     p.add_argument("--platform", default=None, help="force jax backend (e.g. cpu)")
+    p.add_argument(
+        "--weights", default=None, help="Keras .h5 / .npz / orbax checkpoint dir"
+    )
 
 
 def cmd_serve(args: argparse.Namespace) -> int:
@@ -39,6 +42,8 @@ def _load_service(args: argparse.Namespace):
         overrides["model"] = args.model
     if args.platform:
         overrides["platform"] = args.platform
+    if getattr(args, "weights", None):
+        overrides["weights_path"] = args.weights
     return DeconvService(ServerConfig.from_env(**overrides))
 
 
@@ -140,7 +145,6 @@ def main(argv: list[str] | None = None) -> int:
     s = sub.add_parser("serve", help="run the HTTP service")
     s.add_argument("--host", default=None)
     s.add_argument("--port", type=int, default=None)
-    s.add_argument("--weights", default=None)
     _add_common(s)
     s.set_defaults(fn=cmd_serve)
 

@@ -33,6 +33,11 @@ class ServerConfig:
     warmup_all_buckets: bool = True
     request_timeout_s: float = 60.0
     dream_timeout_s: float = 300.0  # dreams run minutes; own queue + timeout
+    # Concurrent dreams with identical (layers, steps, octaves, lr) batch
+    # into one octave pyramid (engine/deepdream.py:deepdream_batch); the
+    # window is wide because dreams run for seconds anyway.
+    dream_max_batch: int = 4
+    dream_window_ms: float = 50.0
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

@@ -13,11 +13,16 @@ from deconv_api_tpu.engine.deconv import (
     visualize,
     visualize_all_layers,
 )
-from deconv_api_tpu.engine.deepdream import deepdream, make_octave_runner
+from deconv_api_tpu.engine.deepdream import (
+    deepdream,
+    deepdream_batch,
+    make_octave_runner,
+)
 
 __all__ = [
     "autodeconv_visualizer",
     "deepdream",
+    "deepdream_batch",
     "get_visualizer",
     "make_octave_runner",
     "visualize",

@@ -20,23 +20,34 @@ import jax.numpy as jnp
 from deconv_api_tpu.models.blocks import INFERENCE_RULES
 
 
-def activation_loss(forward_fn, params, x, layers: tuple[str, ...]) -> jnp.ndarray:
-    """Mean squared activation of the chosen layers (the classic DeepDream
-    objective — maximised by ascent).  Uses TRUE gradients (inference rules),
-    not deconv rules: DeepDream is gradient ascent, not projection."""
+def activation_loss(
+    forward_fn, params, x, layers: tuple[str, ...]
+) -> jnp.ndarray:
+    """Per-image mean squared activation of the chosen layers — (B,) for a
+    (B, H, W, C) batch (the classic DeepDream objective, maximised by
+    ascent).  Uses TRUE gradients (inference rules), not deconv rules:
+    DeepDream is gradient ascent, not projection."""
     _, acts = forward_fn(params, x, rules=INFERENCE_RULES)
     losses = []
     for name in layers:
         if name not in acts:
             raise KeyError(f"model has no activation {name!r}; known: {sorted(acts)}")
         a = acts[name]
-        losses.append(jnp.mean(jnp.square(a)))
-    return jnp.stack(losses).mean()
+        losses.append(jnp.mean(jnp.square(a), axis=tuple(range(1, a.ndim))))
+    return jnp.stack(losses).mean(axis=0)  # (B,)
 
 
 @lru_cache(maxsize=64)
 def _octave_jit(forward_fn, layers: tuple[str, ...]):
-    """One jitted program running a full octave of ascent steps.
+    """One jitted program running a full octave of ascent steps, for a
+    whole BATCH of independent dreams at once.
+
+    Per-image decoupling: the differentiated scalar is the SUM of per-image
+    losses (grads decompose per image) and the gradient-magnitude
+    normalisation is per-image — so a batch of B dreams evolves exactly as
+    B separate runs would (bar conv reduction order), while the device sees
+    one batched conv chain per step.  At B=1 this is numerically identical
+    to the original single-dream form.
 
     Cached on (forward_fn, layers) only; ``steps`` and ``lr`` are traced
     arguments so client-chosen values never trigger recompilation (a sweep
@@ -45,19 +56,24 @@ def _octave_jit(forward_fn, layers: tuple[str, ...]):
     dream_forward closures for exactly this reason."""
 
     def run(params, x, steps, lr):
-        loss_grad = jax.value_and_grad(
-            lambda xx: activation_loss(forward_fn, params, xx, layers)
-        )
+        def total_loss(xx):
+            per_image = activation_loss(forward_fn, params, xx, layers)
+            return per_image.sum(), per_image
+
+        loss_grad = jax.value_and_grad(total_loss, has_aux=True)
 
         def body(_, carry):
-            x, _loss = carry
-            loss, g = loss_grad(x)
-            # gradient-magnitude normalisation keeps lr scale-free across
-            # octaves/layers (standard DeepDream practice)
-            g = g / (jnp.mean(jnp.abs(g)) + 1e-8)
-            return x + lr.astype(x.dtype) * g, loss
+            x, _losses = carry
+            (_total, per_image), g = loss_grad(x)
+            # per-image gradient-magnitude normalisation keeps lr scale-free
+            # across octaves/layers (standard DeepDream practice) AND keeps
+            # batched dreams independent of their batch-mates
+            norm = jnp.mean(jnp.abs(g), axis=tuple(range(1, g.ndim)), keepdims=True)
+            g = g / (norm + 1e-8)
+            return x + lr.astype(x.dtype) * g, per_image
 
-        return jax.lax.fori_loop(0, steps, body, (x, jnp.asarray(0.0, x.dtype)))
+        zeros = jnp.zeros((x.shape[0],), x.dtype)
+        return jax.lax.fori_loop(0, steps, body, (x, zeros))
 
     return jax.jit(run)
 
@@ -76,10 +92,10 @@ def _resize(x: jnp.ndarray, hw: tuple[int, int]) -> jnp.ndarray:
     )
 
 
-def deepdream(
+def deepdream_batch(
     forward_fn,
     params,
-    image: jnp.ndarray,
+    images: jnp.ndarray,
     *,
     layers: tuple[str, ...],
     steps_per_octave: int = 10,
@@ -88,8 +104,12 @@ def deepdream(
     octave_scale: float = 1.4,
     min_size: int = 75,
 ):
-    """Run multi-octave DeepDream on (H, W, C) `image`; returns (dreamed
-    image (H, W, C), final-octave loss).
+    """Run multi-octave DeepDream on a (B, H, W, C) batch of independent
+    images; returns (dreamed batch (B, H, W, C), final-octave losses (B,)).
+
+    The whole batch rides one octave pyramid — B concurrent dream requests
+    cost one set of device dispatches (the serving dream dispatcher relies
+    on this).  Per-image gradient normalisation keeps the dreams decoupled.
 
     Octave pyramid: ascend from the smallest scale, re-injecting the detail
     lost to downsampling at each scale jump (the canonical octave recipe).
@@ -101,7 +121,7 @@ def deepdream(
     sequential specs must be truncated below their flatten/dense head
     (`spec.truncated(deepest_layer)`) before wrapping with `spec_forward`.
     """
-    base = image[None].astype(jnp.float32)
+    base = images.astype(jnp.float32)
     h, w = base.shape[1:3]
     shapes: list[tuple[int, int]] = []
     for i in range(num_octaves):
@@ -116,10 +136,38 @@ def deepdream(
     runner = make_octave_runner(forward_fn, tuple(layers), steps_per_octave, lr)
 
     x = _resize(base, shapes[0])
-    loss = jnp.asarray(0.0)
+    losses = jnp.zeros((base.shape[0],))
     for i, hw in enumerate(shapes):
         if i > 0:
             lost_detail = _resize(base, hw) - _resize(_resize(base, shapes[i - 1]), hw)
             x = _resize(x, hw) + lost_detail
-        x, loss = runner(params, x)
-    return x[0], loss
+        x, losses = runner(params, x)
+    return x, losses
+
+
+def deepdream(
+    forward_fn,
+    params,
+    image: jnp.ndarray,
+    *,
+    layers: tuple[str, ...],
+    steps_per_octave: int = 10,
+    lr: float = 0.01,
+    num_octaves: int = 10,
+    octave_scale: float = 1.4,
+    min_size: int = 75,
+):
+    """Single-image form of `deepdream_batch`: (H, W, C) in, (dreamed
+    (H, W, C), scalar final-octave loss) out."""
+    out, losses = deepdream_batch(
+        forward_fn,
+        params,
+        image[None],
+        layers=layers,
+        steps_per_octave=steps_per_octave,
+        lr=lr,
+        num_octaves=num_octaves,
+        octave_scale=octave_scale,
+        min_size=min_size,
+    )
+    return out[0], losses[0]

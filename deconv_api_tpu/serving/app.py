@@ -125,8 +125,8 @@ class DeconvService:
         self.dream_metrics = Metrics(prefix="dream")
         self.dream_dispatcher = BatchingDispatcher(
             self._run_batch,
-            max_batch=1,
-            window_ms=0.0,
+            max_batch=self.cfg.dream_max_batch,
+            window_ms=self.cfg.dream_window_ms,
             request_timeout_s=self.cfg.dream_timeout_s,
             metrics=self.dream_metrics,
         )
@@ -169,8 +169,9 @@ class DeconvService:
 
         Runs in a worker thread (never on the event loop).  Deconv batches
         are padded to a power-of-two bucket so XLA compiles at most
-        log2(max_batch)+1 batch shapes per key; dream requests run one
-        multi-octave ascent per image.
+        log2(max_batch)+1 batch shapes per key; dream groups run as ONE
+        batched multi-octave ascent (see _run_dream), bucket-padded the
+        same way.
         """
         with self._profile_scope():
             return self._run_batch_inner(key, images)
@@ -205,24 +206,35 @@ class DeconvService:
         ]
 
     def _run_dream(self, key, images: list[np.ndarray]):
-        from deconv_api_tpu.engine import deepdream
+        from deconv_api_tpu.engine import deepdream_batch
 
         _, layers, steps, octaves, lr = key
         fwd = self.bundle.dream_forward(layers)
-        results = []
-        for img in images:
-            out, loss = deepdream(
-                fwd,
-                self.bundle.params,
-                np.asarray(img),
-                layers=layers,
-                steps_per_octave=steps,
-                num_octaves=octaves,
-                lr=lr,
-                min_size=self.bundle.min_dream_size,
-            )
-            results.append({"image": np.asarray(out), "loss": float(loss)})
-        return results
+        # Concurrent dreams with the same config ride ONE octave pyramid:
+        # per-image gradient normalisation keeps them independent while the
+        # device sees a single batched conv chain per ascent step.  Pad to
+        # a power-of-two bucket like the deconv path, else every distinct
+        # concurrency level compiles a fresh executable per octave shape.
+        bucket = pad_bucket(len(images), self.cfg.dream_max_batch)
+        batch = np.stack(
+            [np.asarray(img) for img in images]
+            + [np.asarray(images[-1])] * (bucket - len(images))
+        )
+        out, losses = deepdream_batch(
+            fwd,
+            self.bundle.params,
+            batch,
+            layers=layers,
+            steps_per_octave=steps,
+            num_octaves=octaves,
+            lr=lr,
+            min_size=self.bundle.min_dream_size,
+        )
+        out = np.asarray(out)
+        losses = np.asarray(losses)
+        return [
+            {"image": out[i], "loss": float(losses[i])} for i in range(len(images))
+        ]
 
     def _bucket_for(self, n: int) -> int:
         """Padded batch size for n requests: power-of-two bucket, rounded up

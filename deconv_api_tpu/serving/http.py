@@ -217,6 +217,10 @@ class HttpServer:
                 n = int(size_line.split(b";")[0], 16)
             except ValueError:
                 raise _BadRequest(400, "bad chunk size") from None
+            if n < 0:
+                # int(b"-1", 16) parses; readexactly(-1) would raise an
+                # uncaught ValueError and kill the connection task
+                raise _BadRequest(400, "bad chunk size")
             if n == 0:
                 await reader.readline()
                 return b"".join(chunks)

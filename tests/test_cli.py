@@ -1,0 +1,86 @@
+"""CLI surface tests (SURVEY §5 config row): models / visualize / dream,
+including pretrained-weight plumbing.  Shallow layers keep compiles cheap."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from deconv_api_tpu.cli import main
+
+
+@pytest.fixture()
+def png(tmp_path, rng):
+    from PIL import Image
+
+    p = tmp_path / "in.png"
+    Image.fromarray(
+        (rng.random((64, 64, 3)) * 255).astype(np.uint8), "RGB"
+    ).save(p)
+    return str(p)
+
+
+def test_models_lists_registry(capsys):
+    assert main(["models"]) == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert {l["model"] for l in lines} == {"vgg16", "resnet50", "inception_v3"}
+    assert all("layers" in l and "engine" in l for l in lines)
+
+
+def test_visualize_writes_grid(tmp_path, png, capsys):
+    out = str(tmp_path / "grid.png")
+    rc = main(
+        [
+            "visualize", "--image", png, "--layer", "block1_conv1",
+            "--output", out, "--top-k", "4",
+        ]
+    )
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert info["output"] == out and info["layer"] == "block1_conv1"
+    from PIL import Image
+
+    assert Image.open(out).size == (448, 448)  # 2x2 grid of 224px tiles
+
+
+def test_dream_runs_one_octave(tmp_path, png, capsys):
+    out = str(tmp_path / "dream.png")
+    rc = main(
+        [
+            "dream", "--image", png, "--layers", "block1_conv1",
+            "--output", out, "--steps", "1", "--octaves", "1",
+        ]
+    )
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert np.isfinite(info["loss"])
+    from PIL import Image
+
+    assert Image.open(out).size == (224, 224)
+
+
+def test_visualize_honours_weights_flag(tmp_path, png, capsys):
+    """--weights must actually change the served parameters."""
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+    from deconv_api_tpu.models.weights import save_npz
+
+    _, params = vgg16_init(jax.random.PRNGKey(9))
+    # zero block1_conv1 -> its projection grid becomes flat gray
+    params["block1_conv1"] = {
+        "w": params["block1_conv1"]["w"] * 0,
+        "b": params["block1_conv1"]["b"] * 0,
+    }
+    wpath = str(tmp_path / "w.npz")
+    save_npz(params, wpath)
+    out = str(tmp_path / "none.png")
+    rc = main(
+        [
+            "visualize", "--image", png, "--layer", "block1_conv1",
+            "--output", out, "--weights", wpath,
+        ]
+    )
+    capsys.readouterr()
+    # zero weights -> zero activations -> no positive filter sums -> rc 1
+    assert rc == 1

@@ -24,9 +24,10 @@ def setup():
 def test_octave_runner_increases_loss(setup):
     params, fwd, img = setup
     runner = make_octave_runner(fwd, ("b2c1",), steps=8, lr=0.05)
-    before = float(activation_loss(fwd, params, img[None], ("b2c1",)))
+    # activation_loss is per-image: (B,)
+    before = float(activation_loss(fwd, params, img[None], ("b2c1",))[0])
     x, _ = runner(params, img[None])
-    after = float(activation_loss(fwd, params, x, ("b2c1",)))
+    after = float(activation_loss(fwd, params, x, ("b2c1",))[0])
     assert after > before, f"ascent failed: {before} -> {after}"
     assert bool(jnp.isfinite(x).all())
 
@@ -84,3 +85,33 @@ def test_octave_runner_no_recompile_across_lr_steps(setup):
         runner(params, img[None])
     compiles = jitted._cache_size() - before
     assert compiles <= 1, f"lr/steps sweep compiled {compiles} executables"
+
+
+def test_batched_dreams_match_singles():
+    """deepdream_batch must evolve each image exactly as a solo run would
+    (per-image loss + per-image gradient normalisation decouple the
+    batch; tolerance covers batched-conv reduction order)."""
+    import jax
+    import numpy as np
+
+    from deconv_api_tpu.engine import deepdream, deepdream_batch
+    from deconv_api_tpu.models.apply import spec_forward
+    from deconv_api_tpu.models.spec import init_params
+    from tests.test_engine_parity import TINY
+
+    spec = TINY.truncated("b2c1")
+    fwd = spec_forward(spec)
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    imgs = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 16, 3))
+
+    kw = dict(layers=("b2c1",), steps_per_octave=3, num_octaves=2, min_size=8)
+    batch_out, batch_losses = deepdream_batch(fwd, params, imgs, **kw)
+    for i in range(3):
+        solo_out, solo_loss = deepdream(fwd, params, imgs[i], **kw)
+        np.testing.assert_allclose(
+            np.asarray(batch_out[i]), np.asarray(solo_out), rtol=2e-4, atol=2e-5,
+            err_msg=f"dream {i} diverged from its solo run",
+        )
+        np.testing.assert_allclose(
+            float(batch_losses[i]), float(solo_loss), rtol=2e-4
+        )

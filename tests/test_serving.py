@@ -521,3 +521,56 @@ def test_reservoir_eviction_keeps_quantiles():
     assert len(r) == 100
     assert r.quantile(0.0) == 900.0
     assert r.quantile(0.5) == 950.0
+
+
+def test_chunked_negative_size_400(server):
+    """int(b'-1', 16) parses — a negative chunk size must 400 cleanly, not
+    kill the connection task via readexactly(-1) (code-review finding)."""
+
+    async def go():
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+        writer.write(
+            b"POST / HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n-1\r\n"
+        )
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), 10)
+        writer.close()
+        return raw
+
+    raw = asyncio.run(go())
+    assert b" 400 " in raw.split(b"\r\n", 1)[0], raw[:80]
+    assert httpx.get(server.base_url + "/health-check").status_code == 200
+
+
+def test_dream_group_results_align_after_padding(server):
+    """3 concurrent dreams (padded to bucket 4) must each get their own
+    result back."""
+    seeds = [1, 2, 3]
+    results = {}
+
+    def one(i):
+        r = httpx.post(
+            server.base_url + "/v1/dream",
+            data={
+                "file": _data_url(seeds[i]),
+                "layers": "b2c1",
+                "steps": "2",
+                "octaves": "1",
+            },
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        results[i] = r.json()
+
+    threads = [
+        threading.Thread(target=lambda i=i: one(i)) for i in range(len(seeds))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert len(results) == 3
+    # distinct inputs -> distinct dreamed images
+    imgs = {results[i]["image"] for i in range(3)}
+    assert len(imgs) == 3
