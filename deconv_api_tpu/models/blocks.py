@@ -41,14 +41,18 @@ def maxpool(
     x: jnp.ndarray,
     window: int | tuple[int, int] = 3,
     stride: int | tuple[int, int] = 2,
-    padding: str = "VALID",
+    padding: str | tuple[tuple[int, int], tuple[int, int]] = "VALID",
 ):
     """Overlapping max-pool (3x3/2 in both model families).  Its native XLA
     VJP routes cotangents to window argmaxes — the switch semantics for
     overlapping windows (BASELINE config 4 wants no explicit switches).
-    ``window``/``stride`` accept an int or an (h, w) pair."""
+    ``window``/``stride`` accept an int or an (h, w) pair; ``padding`` a
+    string or explicit spatial (lo, hi) pairs (Keras ZeroPadding2D parity —
+    equivalent to zero-pads for post-ReLU inputs, which are >= 0)."""
     wh, ww = (window, window) if isinstance(window, int) else window
     sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    if not isinstance(padding, str):
+        padding = ((0, 0), *padding, (0, 0))
     return lax.reduce_window(
         x,
         -jnp.inf,
@@ -102,7 +106,7 @@ def conv_bn(
     rules: Rules,
     *,
     strides: tuple[int, int] = (1, 1),
-    padding: str = "SAME",
+    padding: str | tuple[tuple[int, int], tuple[int, int]] = "SAME",
     relu: bool = True,
     eps: float = 1e-3,
 ) -> jnp.ndarray:

@@ -78,9 +78,16 @@ def resnet50_forward(
     """Returns (output, activations).  `activations` carries the named
     endpoints the deconv/DeepDream engines seed from."""
     acts: dict[str, jnp.ndarray] = {}
-    y = B.conv_bn(params["conv1"], x, rules, strides=(2, 2), eps=_BN_EPS)
+    # Keras pads conv1/pool1 explicitly (ZeroPadding2D(3)/(1) + VALID,
+    # keras.applications.resnet) — NOT XLA SAME, which pads (2,3)/(0,1) at
+    # 224 and shifts the grid one pixel.  Load-bearing for pretrained-weight
+    # activation parity (tests/test_weights_golden.py).
+    y = B.conv_bn(
+        params["conv1"], x, rules, strides=(2, 2), padding=((3, 3), (3, 3)),
+        eps=_BN_EPS,
+    )
     acts["conv1_relu"] = y
-    y = B.maxpool(y, 3, 2, padding="SAME")
+    y = B.maxpool(y, 3, 2, padding=((1, 1), (1, 1)))
     acts["pool1_pool"] = y
     for name, n_blocks, _width, _cout, stride in _STAGES:
         for i in range(1, n_blocks + 1):

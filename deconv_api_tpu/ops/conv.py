@@ -27,9 +27,11 @@ def conv2d(
     b: jnp.ndarray | None = None,
     *,
     strides: Sequence[int] = (1, 1),
-    padding: str = "SAME",
+    padding: str | Sequence[tuple[int, int]] = "SAME",
 ) -> jnp.ndarray:
-    """Forward convolution: NHWC input, HWIO kernel.
+    """Forward convolution: NHWC input, HWIO kernel.  ``padding`` is an XLA
+    padding string or explicit per-spatial-dim (lo, hi) pairs (Keras
+    ZeroPadding2D parity for ResNet50's conv1).
 
     Mirrors the reference's `DConvolution2D.up` (app/deepdream.py:91-100)
     minus the fused activation, which the engine applies explicitly.

@@ -1,0 +1,262 @@
+"""Golden-data validation of the pretrained-weight loaders.
+
+VERDICT r2 flagged that every weight-loader test consumed synthetic h5
+fixtures built by the tests themselves — loader and fixture shared the same
+layout assumptions, so a wrong assumption about real Keras file layout
+would pass silently.  This module breaks that loop two ways:
+
+1. A COMMITTED fixture (tests/fixtures/golden/) written by real Keras —
+   authentic legacy-h5 group nesting and naming (`model_weights/<layer>/
+   <layer>/kernel`), generated once by tools/make_golden_fixture.py and
+   hash-pinned here.  Works without Keras installed.
+2. LIVE golden tests (skipped when Keras is absent): build each
+   keras.applications model with random seeded weights, save a genuine h5,
+   load it through our loaders, and compare our forward activations
+   against Keras's own `predict` on an identical input — end-to-end
+   load → forward → activation parity at every major endpoint, including
+   all 11 InceptionV3 mixed blocks (validating the 94-conv construction-
+   order table in models/dag_weights.py against real Keras naming).
+
+A deliberate same-shape-swap test proves the check is SENSITIVE: swapping
+two identically-shaped InceptionV3 conv kernels must break activation
+parity (the failure mode VERDICT called un-catchable by shape checks).
+
+Reference parity target: the reference's startup weight load
+(/root/reference/app/main.py:17).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "golden")
+
+# sha256 pins from tools/make_golden_fixture.py — a mismatch means the
+# committed artifacts were regenerated or corrupted; update deliberately.
+H5_SHA256 = "b0969ec43c0949b7c3ec522f752b02eca6db29780831da73b89971656e4fd397"
+NPZ_SHA256 = "17de247280de4340a866b2a5952a1e3421d9e229ba45cb41d538209226d839f5"
+
+
+def _rel_err(ref: np.ndarray, got: np.ndarray) -> float:
+    ref = np.asarray(ref, np.float64)
+    got = np.asarray(got, np.float64)
+    return float(np.abs(ref - got).max()) / max(float(np.abs(ref).max()), 1e-6)
+
+
+def _sha256(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+# ------------------------------------------------------- committed fixture
+
+
+class TestCommittedFixture:
+    """Real-Keras-written h5 + expected activations, no Keras required."""
+
+    def test_fixture_integrity(self):
+        assert _sha256(os.path.join(FIXTURES, "vgg16_block1.h5")) == H5_SHA256
+        assert (
+            _sha256(os.path.join(FIXTURES, "vgg16_block1_expected.npz"))
+            == NPZ_SHA256
+        )
+
+    def test_load_and_forward_matches_keras_activations(self):
+        import dataclasses
+
+        import jax
+
+        from deconv_api_tpu.models.apply import spec_forward
+        from deconv_api_tpu.models.spec import init_params
+        from deconv_api_tpu.models.vgg16 import VGG16_SPEC
+        from deconv_api_tpu.models.weights import load_weights
+
+        spec = dataclasses.replace(
+            VGG16_SPEC.truncated("block1_pool"), input_shape=(64, 64, 3)
+        )
+        params = init_params(spec, jax.random.PRNGKey(0))
+        params = load_weights(
+            spec, os.path.join(FIXTURES, "vgg16_block1.h5"), params
+        )
+        exp = np.load(os.path.join(FIXTURES, "vgg16_block1_expected.npz"))
+        _, acts = spec_forward(spec)(params, exp["x"])
+        assert _rel_err(exp["block1_conv1"], acts["block1_conv1"]) < 1e-4
+        assert _rel_err(exp["block1_pool"], acts["block1_pool"]) < 1e-4
+
+    def test_random_init_does_not_match(self):
+        """Sensitivity: without the real weights, the same forward must NOT
+        reproduce the expected activations — the comparison is not vacuous."""
+        import dataclasses
+
+        import jax
+
+        from deconv_api_tpu.models.apply import spec_forward
+        from deconv_api_tpu.models.spec import init_params
+        from deconv_api_tpu.models.vgg16 import VGG16_SPEC
+
+        spec = dataclasses.replace(
+            VGG16_SPEC.truncated("block1_pool"), input_shape=(64, 64, 3)
+        )
+        params = init_params(spec, jax.random.PRNGKey(0))
+        exp = np.load(os.path.join(FIXTURES, "vgg16_block1_expected.npz"))
+        _, acts = spec_forward(spec)(params, exp["x"])
+        assert _rel_err(exp["block1_conv1"], acts["block1_conv1"]) > 1e-2
+
+
+# ------------------------------------------------------------ live keras
+
+keras = pytest.importorskip("keras", reason="live golden tests need Keras")
+
+
+@pytest.fixture(scope="module")
+def keras_h5(tmp_path_factory):
+    """Build each keras.applications model once (random seeded weights),
+    save a genuine legacy h5, and capture Keras's own activations."""
+    tmp = tmp_path_factory.mktemp("keras_golden")
+
+    def build(factory, input_shape, probe_layers, rng_seed):
+        keras.utils.set_random_seed(0)
+        model = factory(
+            weights=None, include_top=False, input_shape=input_shape
+        )
+        path = str(tmp / f"{factory.__name__.lower()}.h5")
+        model.save(path)
+        x = (
+            np.random.default_rng(rng_seed)
+            .normal(0, 1, (1,) + input_shape)
+            .astype(np.float32)
+        )
+        probe = keras.Model(
+            model.input, [model.get_layer(n).output for n in probe_layers]
+        )
+        outs = probe.predict(x, verbose=0)
+        if not isinstance(outs, list):
+            outs = [outs]
+        return path, x, dict(zip(probe_layers, outs))
+
+    return build
+
+
+def _check_acts(expected: dict, ours: dict, tol: float = 2e-4):
+    for name, ref in expected.items():
+        got = np.asarray(ours[name])
+        if got.ndim == ref.ndim - 1:
+            got = got[None]
+        err = _rel_err(ref, got)
+        assert err < tol, f"{name}: rel_err {err:.2e} >= {tol}"
+
+
+def test_vgg16_golden(keras_h5):
+    import dataclasses
+
+    import jax
+
+    from deconv_api_tpu.models.apply import spec_forward
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.models.vgg16 import VGG16_SPEC
+    from deconv_api_tpu.models.weights import load_weights
+
+    names = ["block1_conv1", "block2_conv2", "block3_conv3", "block5_conv1", "block5_pool"]
+    path, x, expected = keras_h5(
+        keras.applications.VGG16, (64, 64, 3), names, rng_seed=0
+    )
+    spec = dataclasses.replace(
+        VGG16_SPEC.truncated("block5_pool"), input_shape=(64, 64, 3)
+    )
+    params = load_weights(spec, path, init_params(spec, jax.random.PRNGKey(0)))
+    _, acts = spec_forward(spec)(params, x)
+    _check_acts(expected, acts)
+
+
+def test_resnet50_golden(keras_h5):
+    from deconv_api_tpu.models.dag_weights import load_resnet50_h5
+    from deconv_api_tpu.models.resnet50 import resnet50_forward, resnet50_init
+
+    names = [
+        "conv1_relu", "pool1_pool", "conv2_block1_out", "conv3_block4_out",
+        "conv4_block6_out", "conv5_block3_out",
+    ]
+    path, x, expected = keras_h5(
+        keras.applications.ResNet50, (96, 96, 3), names, rng_seed=1
+    )
+    params = load_resnet50_h5(path, resnet50_init())
+    _, acts = resnet50_forward(params, x)
+    _check_acts(expected, acts)
+
+
+@pytest.fixture(scope="module")
+def inception_golden(keras_h5):
+    names = [f"mixed{i}" for i in range(11)]
+    return keras_h5(
+        keras.applications.InceptionV3, (128, 128, 3), names, rng_seed=2
+    )
+
+
+def test_inception_v3_golden(inception_golden):
+    """End-to-end validation of the 94-conv construction-order table in
+    models/dag_weights.py against real Keras auto-indexed layer names."""
+    from deconv_api_tpu.models.dag_weights import load_inception_v3_h5
+    from deconv_api_tpu.models.inception_v3 import (
+        inception_v3_forward,
+        inception_v3_init,
+    )
+
+    path, x, expected = inception_golden
+    params = load_inception_v3_h5(path, inception_v3_init())
+    _, acts = inception_v3_forward(params, x)
+    _check_acts(expected, acts)
+
+
+def test_inception_v3_same_shape_swap_is_caught(inception_golden, tmp_path):
+    """Swap two identically-shaped conv kernels in the REAL Keras h5 and
+    assert activation parity breaks — the construction-order failure mode
+    VERDICT r2 called un-catchable by shape checks alone is catchable by
+    the golden activation comparison."""
+    import shutil
+
+    import h5py
+
+    from deconv_api_tpu.models.dag_weights import (
+        INCEPTION_V3_CONV_ORDER,
+        load_inception_v3_h5,
+    )
+    from deconv_api_tpu.models.inception_v3 import (
+        inception_v3_forward,
+        inception_v3_init,
+    )
+
+    path, x, expected = inception_golden
+    swapped = str(tmp_path / "swapped.h5")
+    shutil.copy(path, swapped)
+    # mixed4's b7d_2 and b7d_4 are both (7, 1, 128, 128) — find their
+    # conv2d indices from the order table and swap the kernel datasets.
+    i1 = INCEPTION_V3_CONV_ORDER.index(("mixed4", "b7d_2"))
+    i2 = INCEPTION_V3_CONV_ORDER.index(("mixed4", "b7d_4"))
+    with h5py.File(swapped, "r+") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+
+        def kernel_ds(idx):
+            name = "conv2d" if idx == 0 else f"conv2d_{idx}"
+            grp = root[name]
+            ds = []
+            grp.visititems(
+                lambda n, o: ds.append(o)
+                if isinstance(o, h5py.Dataset) and "kernel" in n
+                else None
+            )
+            assert len(ds) == 1
+            return ds[0]
+
+        d1, d2 = kernel_ds(i1), kernel_ds(i2)
+        assert d1.shape == d2.shape  # same-shape: a shape check cannot catch this
+        a, b = np.asarray(d1), np.asarray(d2)
+        d1[...], d2[...] = b, a
+
+    params = load_inception_v3_h5(swapped, inception_v3_init())  # loads fine
+    _, acts = inception_v3_forward(params, x)
+    err = _rel_err(expected["mixed4"], np.asarray(acts["mixed4"]))
+    assert err > 1e-2, "same-shape swap went undetected by activation parity"

@@ -1,0 +1,148 @@
+"""Golden weight-loading probe: our loaders + forwards vs real Keras.
+
+Builds each keras.applications model with seeded random weights, saves a
+genuine legacy-format .h5 (authentic layer naming / group nesting /
+construction order — nothing shared with our loaders' assumptions), loads
+it through deconv_api_tpu's loaders, and compares intermediate activations
+between keras's own forward pass and ours on an identical input.
+
+This is the independent cross-check VERDICT r2 asked for: a wrong
+assumption about real Keras file layout (or a same-shape swap in the
+InceptionV3 construction-order table) shows up here as an activation
+mismatch, not a silent pass.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "")
+
+import jax
+
+# Env JAX_PLATFORMS does not stop the axon TPU plugin from initialising in
+# this image (see bench.py); the config-level override is the reliable form.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def rel_err(a: np.ndarray, b: np.ndarray) -> float:
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    denom = max(float(np.abs(a).max()), 1e-6)
+    return float(np.abs(a - b).max()) / denom
+
+
+def keras_acts(model, names: list[str], x: np.ndarray) -> dict[str, np.ndarray]:
+    import keras
+
+    probe = keras.Model(model.input, [model.get_layer(n).output for n in names])
+    outs = probe.predict(x, verbose=0)
+    if not isinstance(outs, list):
+        outs = [outs]
+    return dict(zip(names, outs))
+
+
+def check(tag: str, ours: dict, theirs: dict, tol: float = 2e-3) -> bool:
+    ok = True
+    for name, ref in theirs.items():
+        got = np.asarray(ours[name])
+        if got.ndim == ref.ndim - 1:
+            got = got[None]
+        e = rel_err(ref, got)
+        status = "OK " if e < tol else "FAIL"
+        if e >= tol:
+            ok = False
+        print(f"  [{status}] {tag}.{name}: rel_err={e:.2e} shape={got.shape}")
+    return ok
+
+
+def probe_vgg16(tmp: str) -> bool:
+    import keras
+
+    from deconv_api_tpu.models.apply import spec_forward
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+    from deconv_api_tpu.models.weights import load_weights
+
+    # 224 input: the spec forward runs the (random-init) fc head too, and
+    # flatten->fc1 only lines up at the native size.
+    keras.utils.set_random_seed(0)
+    km = keras.applications.VGG16(weights=None, include_top=False, input_shape=(224, 224, 3))
+    path = os.path.join(tmp, "vgg16_golden.h5")
+    km.save(path)
+
+    spec, params = vgg16_init()
+    params = load_weights(spec, path, params)
+    x = np.random.default_rng(0).normal(0, 30, (1, 224, 224, 3)).astype(np.float32)
+    _, acts = spec_forward(spec)(params, x)
+    names = ["block1_conv1", "block1_pool", "block3_conv3", "block5_conv1", "block5_pool"]
+    return check("vgg16", acts, keras_acts(km, names, x))
+
+
+def probe_resnet50(tmp: str) -> bool:
+    import keras
+
+    from deconv_api_tpu.models.dag_weights import load_resnet50_h5
+    from deconv_api_tpu.models.resnet50 import resnet50_forward, resnet50_init
+
+    keras.utils.set_random_seed(0)
+    km = keras.applications.ResNet50(weights=None, include_top=False, input_shape=(96, 96, 3))
+    path = os.path.join(tmp, "resnet50_golden.h5")
+    km.save(path)
+
+    params = load_resnet50_h5(path, resnet50_init())
+    x = np.random.default_rng(1).normal(0, 1, (1, 96, 96, 3)).astype(np.float32)
+    _, acts = resnet50_forward(params, x)
+    names = [
+        "conv1_relu", "pool1_pool", "conv2_block1_out", "conv3_block4_out",
+        "conv4_block6_out", "conv5_block3_out",
+    ]
+    return check("resnet50", acts, keras_acts(km, names, x))
+
+
+def probe_inception_v3(tmp: str) -> bool:
+    import keras
+
+    from deconv_api_tpu.models.dag_weights import load_inception_v3_h5
+    from deconv_api_tpu.models.inception_v3 import (
+        inception_v3_forward,
+        inception_v3_init,
+    )
+
+    keras.utils.set_random_seed(0)
+    km = keras.applications.InceptionV3(
+        weights=None, include_top=False, input_shape=(128, 128, 3)
+    )
+    path = os.path.join(tmp, "inception_v3_golden.h5")
+    km.save(path)
+
+    params = load_inception_v3_h5(path, inception_v3_init())
+    x = np.random.default_rng(2).normal(0, 1, (1, 128, 128, 3)).astype(np.float32)
+    _, acts = inception_v3_forward(params, x)
+    names = [f"mixed{i}" for i in range(11)]
+    return check("inception_v3", acts, keras_acts(km, names, x))
+
+
+def main() -> int:
+    import tempfile
+
+    ok = True
+    with tempfile.TemporaryDirectory() as tmp:
+        for fn in (probe_vgg16, probe_resnet50, probe_inception_v3):
+            try:
+                ok &= fn(tmp)
+            except Exception as e:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+                print(f"  [FAIL] {fn.__name__}: {type(e).__name__}: {e}")
+                ok = False
+    print("GOLDEN PROBE:", "ALL OK" if ok else "FAILURES")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""Generate the committed golden weight fixture (tests/fixtures/golden/).
+
+Run offline, once, in an environment with Keras installed.  Produces:
+
+- ``vgg16_block1.h5`` — a REAL Keras legacy-format h5 of VGG16's first conv
+  block (a submodel of ``keras.applications.VGG16``), written by Keras
+  itself — authentic group nesting and dataset naming, sharing nothing with
+  deconv_api_tpu's loader assumptions.
+- ``vgg16_block1_expected.npz`` — the fixed input plus Keras's own forward
+  activations at block1_conv1 / block1_pool.
+
+tests/test_weights_golden.py consumes these without needing Keras (and
+hash-pins both files); the same test module runs the full three-model
+golden comparison live when Keras IS importable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "")
+
+import numpy as np
+
+OUT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "golden",
+)
+
+
+def main() -> int:
+    import keras
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    keras.utils.set_random_seed(7)
+    full = keras.applications.VGG16(
+        weights=None, include_top=False, input_shape=(64, 64, 3)
+    )
+    sub = keras.Model(full.input, full.get_layer("block1_pool").output)
+    h5_path = os.path.join(OUT_DIR, "vgg16_block1.h5")
+    sub.save(h5_path)
+
+    x = np.random.default_rng(0).normal(0, 30, (1, 64, 64, 3)).astype(np.float32)
+    probe = keras.Model(
+        full.input,
+        [full.get_layer("block1_conv1").output, full.get_layer("block1_pool").output],
+    )
+    conv1, pool1 = probe.predict(x, verbose=0)
+    npz_path = os.path.join(OUT_DIR, "vgg16_block1_expected.npz")
+    np.savez(npz_path, x=x, block1_conv1=conv1, block1_pool=pool1)
+
+    for path in (h5_path, npz_path):
+        digest = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        print(f"{os.path.basename(path)}: sha256={digest} "
+              f"size={os.path.getsize(path)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
