@@ -33,6 +33,16 @@ class ServerConfig:
     warmup_all_buckets: bool = True
     request_timeout_s: float = 60.0
     dream_timeout_s: float = 300.0  # dreams run minutes; own queue + timeout
+    # Connection-level abuse hardening (VERDICT r2): a slowloris client may
+    # hold a socket (and body buffer) only this long; idle keep-alive
+    # connections are reaped on the same clock.  0 disables (tests).
+    conn_idle_timeout_s: float = 30.0
+    body_read_timeout_s: float = 20.0
+    max_connections: int = 256  # concurrent sockets; excess get 503 + close
+    # Load shedding: reject immediately (503) when the estimated queue drain
+    # time exceeds this multiple of request_timeout_s (callers would only
+    # wait out the timeout and 504 anyway).  0 disables shedding.
+    shed_factor: float = 1.0
     # Concurrent dreams with identical (layers, steps, octaves, lr) batch
     # into one octave pyramid (engine/deepdream.py:deepdream_batch); the
     # window is wide because dreams run for seconds anyway.

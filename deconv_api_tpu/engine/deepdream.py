@@ -38,7 +38,7 @@ def activation_loss(
 
 
 @lru_cache(maxsize=64)
-def _octave_jit(forward_fn, layers: tuple[str, ...]):
+def _octave_jit(forward_fn, layers: tuple[str, ...], mesh=None):
     """One jitted program running a full octave of ascent steps, for a
     whole BATCH of independent dreams at once.
 
@@ -75,12 +75,29 @@ def _octave_jit(forward_fn, layers: tuple[str, ...]):
         zeros = jnp.zeros((x.shape[0],), x.dtype)
         return jax.lax.fori_loop(0, steps, body, (x, zeros))
 
-    return jax.jit(run)
+    if mesh is None:
+        return jax.jit(run)
+    # Mesh-sharded octave program: the dream batch (in and out, losses
+    # included — every output carries a leading batch axis) shards over the
+    # mesh's dp axis; params and the (steps, lr) scalars replicate.  Same
+    # sharding rule as the deconv serving path (parallel/batch.py).
+    from deconv_api_tpu.parallel.mesh import batch_sharding, replicated
+
+    return jax.jit(
+        run,
+        in_shardings=(
+            replicated(mesh), batch_sharding(mesh),
+            replicated(mesh), replicated(mesh),
+        ),
+        out_shardings=(batch_sharding(mesh), batch_sharding(mesh)),
+    )
 
 
-def make_octave_runner(forward_fn, layers: tuple[str, ...], steps: int, lr: float):
+def make_octave_runner(
+    forward_fn, layers: tuple[str, ...], steps: int, lr: float, mesh=None
+):
     """Bind (steps, lr) over the per-(model, layers) jitted octave program."""
-    fn = _octave_jit(forward_fn, tuple(layers))
+    fn = _octave_jit(forward_fn, tuple(layers), mesh)
     steps = jnp.asarray(steps, jnp.int32)
     lr = jnp.asarray(lr, jnp.float32)
     return lambda params, x: fn(params, x, steps, lr)
@@ -103,9 +120,14 @@ def deepdream_batch(
     num_octaves: int = 10,
     octave_scale: float = 1.4,
     min_size: int = 75,
+    mesh=None,
 ):
     """Run multi-octave DeepDream on a (B, H, W, C) batch of independent
     images; returns (dreamed batch (B, H, W, C), final-octave losses (B,)).
+
+    With ``mesh``, each octave program runs dp-sharded over the mesh (B
+    must be a multiple of the dp axis; the serving dispatcher rounds its
+    dream buckets up accordingly).
 
     The whole batch rides one octave pyramid — B concurrent dream requests
     cost one set of device dispatches (the serving dream dispatcher relies
@@ -133,7 +155,9 @@ def deepdream_batch(
     if not shapes:
         shapes = [(h, w)]
 
-    runner = make_octave_runner(forward_fn, tuple(layers), steps_per_octave, lr)
+    runner = make_octave_runner(
+        forward_fn, tuple(layers), steps_per_octave, lr, mesh
+    )
 
     x = _resize(base, shapes[0])
     losses = jnp.zeros((base.shape[0],))

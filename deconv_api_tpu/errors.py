@@ -53,8 +53,21 @@ class NoActiveFilters(DeconvError):
 
 
 class ModelNotReady(DeconvError):
+    """Compute routes 503 until warmup has compiled the serving
+    executables — callers poll /ready instead of silently paying compile
+    latency inside a request."""
+
     status = 503
     code = "model_not_ready"
+
+
+class Overloaded(DeconvError):
+    """Queue drain estimate exceeds the request timeout: shedding now with
+    an immediate 503 beats making every excess caller wait out the full
+    timeout for a guaranteed 504 (serving/batcher.py:submit)."""
+
+    status = 503
+    code = "overloaded"
 
 
 class RequestTimeout(DeconvError):

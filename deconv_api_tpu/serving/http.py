@@ -116,11 +116,27 @@ class Response:
 
 
 class HttpServer:
-    """Route table + asyncio stream server."""
+    """Route table + asyncio stream server.
 
-    def __init__(self):
+    Abuse hardening (VERDICT r2): per-connection header/idle and body read
+    timeouts bound how long a slowloris client can hold a socket (and up to
+    MAX_BODY of buffer); ``max_connections`` caps concurrent sockets —
+    excess connections get an immediate 503 and close.  Timeouts of 0
+    disable the respective guard."""
+
+    def __init__(
+        self,
+        *,
+        idle_timeout_s: float = 30.0,
+        body_timeout_s: float = 20.0,
+        max_connections: int = 256,
+    ):
         self._routes: dict[tuple[str, str], callable] = {}
         self._server: asyncio.AbstractServer | None = None
+        self._idle_timeout_s = idle_timeout_s
+        self._body_timeout_s = body_timeout_s
+        self._max_connections = max_connections
+        self._nconn = 0
 
     def route(self, method: str, path: str):
         def register(fn):
@@ -139,6 +155,32 @@ class HttpServer:
             await self._server.wait_closed()
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        if self._max_connections > 0 and self._nconn >= self._max_connections:
+            try:
+                writer.write(
+                    Response.json({"error": "too many connections"}, 503).encode(False)
+                )
+                await writer.drain()
+                # Drain briefly before close: closing with unread request
+                # bytes in the socket buffer sends RST, which can destroy
+                # the in-flight 503 before the client reads it — the
+                # back-off signal would look like a server crash.
+                try:
+                    await asyncio.wait_for(reader.read(65536), 0.25)
+                except asyncio.TimeoutError:
+                    pass
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            finally:
+                writer.close()
+            return
+        self._nconn += 1
+        try:
+            await self._serve_conn(reader, writer)
+        finally:
+            self._nconn -= 1
+
+    async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             while True:
                 req = await self._read_request(reader)
@@ -150,7 +192,7 @@ class HttpServer:
                 await writer.drain()
                 if not keep_alive:
                     break
-        except (asyncio.IncompleteReadError, ConnectionResetError):
+        except (asyncio.IncompleteReadError, ConnectionResetError, _ConnExpired):
             pass
         except _BadRequest as e:
             try:
@@ -167,7 +209,14 @@ class HttpServer:
 
     async def _read_request(self, reader: asyncio.StreamReader) -> Request | None:
         try:
-            head = await reader.readuntil(b"\r\n\r\n")
+            # One clock bounds both idle keep-alive waits and slow-header
+            # (slowloris) sends: a client gets idle_timeout_s to deliver a
+            # complete header block, then the connection is reaped.
+            head = await self._timed(
+                reader.readuntil(b"\r\n\r\n"), self._idle_timeout_s
+            )
+        except asyncio.TimeoutError:
+            raise _ConnExpired from None
         except asyncio.IncompleteReadError as e:
             if not e.partial:
                 return None  # clean close between keep-alive requests
@@ -195,9 +244,17 @@ class HttpServer:
                 raise _BadRequest(400, "bad content-length") from None
             if n > MAX_BODY:
                 raise _BadRequest(413, "body too large")
-            body = await reader.readexactly(n)
+            try:
+                body = await self._timed(reader.readexactly(n), self._body_timeout_s)
+            except asyncio.TimeoutError:
+                raise _BadRequest(408, "body read timed out") from None
         elif headers.get("transfer-encoding", "").lower() == "chunked":
-            body = await self._read_chunked(reader)
+            try:
+                body = await self._timed(
+                    self._read_chunked(reader), self._body_timeout_s
+                )
+            except asyncio.TimeoutError:
+                raise _BadRequest(408, "body read timed out") from None
         parts = urlsplit(target)
         query = {k: v for k, v in parse_qsl(parts.query, keep_blank_values=True)}
         return Request(method.upper(), unquote(parts.path), query, headers, body)
@@ -249,7 +306,20 @@ class HttpServer:
             )
 
 
+    @staticmethod
+    async def _timed(coro, timeout_s: float):
+        """await with a timeout; 0 disables (tests, trusted meshes)."""
+        if timeout_s <= 0:
+            return await coro
+        return await asyncio.wait_for(coro, timeout_s)
+
+
 class _BadRequest(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(message)
         self.status = status
+
+
+class _ConnExpired(Exception):
+    """Idle/slow-header connection reaped; closed without a response (a
+    slowloris peer never reads it, an idle keep-alive peer expects none)."""

@@ -75,6 +75,22 @@ class Metrics:
             self._compute.add(compute_s)
             self._queue_wait.add(queue_s)
 
+    def compute_p50(self) -> float:
+        """Median per-batch compute seconds — the load-shedding estimator's
+        input (serving/batcher.py).  Cheap: one lock + one indexed read, no
+        snapshot dict."""
+        with self._lock:
+            return self._compute.quantile(0.50)
+
+    def batch_size_p50(self) -> float:
+        """Median EXECUTED batch size.  The shed estimator divides queue
+        depth by this rather than max_batch: under heterogeneous keys a
+        drain window splits into per-key serial executions, and the
+        observed size reflects that splitting where max_batch would
+        underestimate drain time by up to max_batch x."""
+        with self._lock:
+            return self._batch_size.quantile(0.50)
+
     def observe_stage(self, stage: str, seconds: float) -> None:
         """Per-stage request timing (decode/preprocess/compute/encode) —
         the structured-tracing counterpart of SURVEY §5's tracing row."""

@@ -115,3 +115,32 @@ def test_batched_dreams_match_singles():
         np.testing.assert_allclose(
             float(batch_losses[i]), float(solo_loss), rtol=2e-4
         )
+
+
+def test_deepdream_batch_mesh_matches_single():
+    """VERDICT r2 item 5: dreams must ride the mesh.  An 8-dream batch on
+    an 8-device dp mesh must produce the same pixels as the unsharded run,
+    with dp-sharded outputs."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deconv_api_tpu.engine import deepdream_batch
+    from deconv_api_tpu.parallel import make_mesh
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    fwd = spec_forward(TINY.truncated("b2c1"))
+    batch = jax.random.uniform(jax.random.PRNGKey(2), (8, 16, 16, 3)) * 0.2
+    kw = dict(
+        layers=("b2c1",), steps_per_octave=3, lr=0.05, num_octaves=2,
+        octave_scale=1.3, min_size=8,
+    )
+    out_single, loss_single = deepdream_batch(fwd, params, batch, **kw)
+    mesh = make_mesh((8,), axis_names=("dp",))
+    out_mesh, loss_mesh = deepdream_batch(fwd, params, batch, mesh=mesh, **kw)
+    sh = out_mesh.sharding
+    assert isinstance(sh, NamedSharding) and sh.spec == P("dp")
+    np.testing.assert_allclose(
+        np.asarray(out_mesh), np.asarray(out_single), rtol=0, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(loss_mesh), np.asarray(loss_single), rtol=1e-6
+    )

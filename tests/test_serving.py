@@ -372,14 +372,31 @@ def test_mesh_sharded_serving_end_to_end():
                 assert r.status_code == 200, r.text
                 grids[i] = _decode_grid(r.json())
 
+            def one_dream(i):
+                # dreams must ride the mesh too (VERDICT r2 item 5)
+                r = httpx.post(
+                    s.base_url + "/v1/dream",
+                    data={
+                        "file": _data_url(i),
+                        "layers": "b2c1",
+                        "steps": "2",
+                        "octaves": "2",
+                    },
+                    timeout=120,
+                )
+                assert r.status_code == 200, r.text
+                grids[("dream", i)] = _decode_grid(r.json()["image"])
+
             threads = [
                 threading.Thread(target=lambda i=i: one(i)) for i in range(32)
+            ] + [
+                threading.Thread(target=lambda i=i: one_dream(i)) for i in range(4)
             ]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join(120)
-            assert len(grids) == 32
+            assert len(grids) == 36
 
             if cfg.mesh_shape:
                 # the visualizer the HTTP path uses really is dp-sharded
@@ -396,8 +413,8 @@ def test_mesh_sharded_serving_end_to_end():
 
     mesh_grids = drive(cfg_mesh)
     single_grids = drive(cfg_single)
-    for i in range(32):
-        np.testing.assert_array_equal(mesh_grids[i], single_grids[i])
+    for key in mesh_grids:
+        np.testing.assert_array_equal(mesh_grids[key], single_grids[key])
 
 
 def test_profile_dir_captures_trace(tmp_path):

@@ -1,0 +1,321 @@
+"""Serving abuse-hardening tests (VERDICT r2 item 4): slowloris reaping,
+body-read timeouts, connection caps, queue load shedding, pre-warmup
+readiness gating, discovery endpoint, and forward-dtype wiring.
+
+The reference has none of these failure modes handled — its server blocks
+its single event loop for seconds per request (SURVEY §2.2.5) and crashes
+on bad input (§2.2.8); this module pins the replacements' behaviour.
+"""
+
+import asyncio
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.models.spec import init_params
+from deconv_api_tpu.serving.app import DeconvService
+from deconv_api_tpu.serving.batcher import BatchingDispatcher
+from deconv_api_tpu.serving.http import HttpServer, Response
+from deconv_api_tpu.serving.metrics import Metrics
+from tests.test_engine_parity import TINY
+
+
+# ------------------------------------------------------------ HTTP edge
+
+
+def _run_http(test_coro_factory, **server_kw):
+    """Boot a bare HttpServer with one trivial route, run the test coro
+    against it, tear down."""
+
+    async def main():
+        srv = HttpServer(**server_kw)
+
+        async def ping(_req):
+            return Response.json({"pong": True})
+
+        srv.route("GET", "/ping")(ping)
+        srv.route("POST", "/echo")(ping)
+        port = await srv.start("127.0.0.1", 0)
+        try:
+            return await test_coro_factory(port)
+        finally:
+            await srv.stop()
+
+    return asyncio.run(main())
+
+
+def test_slowloris_header_connection_reaped():
+    """A client that never finishes its header block is disconnected after
+    idle_timeout_s — it cannot hold a socket open indefinitely."""
+
+    async def scenario(port):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /ping HTTP/1.1\r\nHost: x")  # no terminator, ever
+        await writer.drain()
+        t0 = time.perf_counter()
+        data = await asyncio.wait_for(reader.read(), 5.0)
+        elapsed = time.perf_counter() - t0
+        writer.close()
+        return data, elapsed
+
+    data, elapsed = _run_http(scenario, idle_timeout_s=0.3, body_timeout_s=0.3)
+    assert data == b""  # closed without a response (slowloris peers don't read)
+    assert elapsed < 3.0
+
+
+def test_idle_keepalive_connection_reaped():
+    """A completed request does not grant an immortal keep-alive socket."""
+
+    async def scenario(port):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        head = await reader.readuntil(b"\r\n\r\n")
+        assert b" 200 " in head.split(b"\r\n", 1)[0]
+        body_len = int(
+            [l for l in head.split(b"\r\n") if l.lower().startswith(b"content-length")][0]
+            .split(b":")[1]
+        )
+        await reader.readexactly(body_len)
+        # now idle: server must close within the idle timeout
+        data = await asyncio.wait_for(reader.read(), 5.0)
+        writer.close()
+        return data
+
+    assert _run_http(scenario, idle_timeout_s=0.3) == b""
+
+
+def test_slow_body_times_out_408():
+    """Headers complete but the body trickles: 408, not an indefinite hold
+    of the connection (and its MAX_BODY buffer)."""
+
+    async def scenario(port):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            b"POST /echo HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+            b"Content-Length: 1000\r\n\r\n{\"a\":"
+        )
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(), 5.0)
+        writer.close()
+        return data
+
+    data = _run_http(scenario, idle_timeout_s=5.0, body_timeout_s=0.3)
+    assert b" 408 " in data.split(b"\r\n", 1)[0]
+
+
+def test_connection_cap_503():
+    """Connections beyond max_connections get an immediate 503 + close;
+    existing connections keep working."""
+
+    async def scenario(port):
+        held = []
+        for _ in range(2):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            held.append((r, w))
+        # cap is 2: the third connection is refused with 503
+        r3, w3 = await asyncio.open_connection("127.0.0.1", port)
+        refused = await asyncio.wait_for(r3.read(), 5.0)
+        w3.close()
+        # a held connection still serves
+        r, w = held[0]
+        w.write(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+        await w.drain()
+        served = await asyncio.wait_for(r.readuntil(b"\r\n\r\n"), 5.0)
+        for _, w in held:
+            w.close()
+        return refused, served
+
+    refused, served = _run_http(scenario, max_connections=2, idle_timeout_s=5.0)
+    assert b" 503 " in refused.split(b"\r\n", 1)[0]
+    assert b" 200 " in served.split(b"\r\n", 1)[0]
+
+
+# ------------------------------------------------------- load shedding
+
+
+def test_dispatcher_sheds_when_queue_exceeds_timeout():
+    """With an observed batch p50 that makes the queued work exceed the
+    request timeout, excess submissions 503 immediately instead of waiting
+    out the timeout for a guaranteed 504.  Arrivals at an empty queue are
+    never shed."""
+
+    async def main():
+        metrics = Metrics()
+        for _ in range(8):
+            metrics.observe_batch(size=1, compute_s=0.5, queue_s=0.0)
+
+        def slow_runner(_key, images):
+            time.sleep(0.25)
+            return [0] * len(images)
+
+        d = BatchingDispatcher(
+            slow_runner,
+            max_batch=1,
+            window_ms=0.0,
+            request_timeout_s=0.4,
+            metrics=metrics,
+        )
+        await d.start()
+        tasks = [asyncio.create_task(d.submit(i, "k")) for i in range(8)]
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        await d.stop()
+        return results
+
+    results = asyncio.run(main())
+    shed = [r for r in results if isinstance(r, errors.Overloaded)]
+    assert shed, "deep queue produced no immediate 503s"
+    assert not isinstance(results[0], errors.Overloaded), (
+        "the first arrival saw an empty queue and must not shed"
+    )
+    assert all(
+        isinstance(r, (int, errors.Overloaded, errors.RequestTimeout))
+        for r in results
+    )
+
+
+def test_dispatcher_does_not_shed_cold():
+    """Before any batch has been measured (p50 unknown), nothing sheds."""
+
+    async def main():
+        d = BatchingDispatcher(
+            lambda _k, imgs: [1] * len(imgs),
+            max_batch=2,
+            window_ms=1.0,
+            request_timeout_s=5.0,
+            metrics=Metrics(),
+        )
+        await d.start()
+        out = await asyncio.gather(*(d.submit(i, "k") for i in range(8)))
+        await d.stop()
+        return out
+
+    assert asyncio.run(main()) == [1] * 8
+
+
+# ------------------------------------------- readiness / discovery / dtype
+
+
+class _Booted:
+    """Minimal service-in-a-thread harness (does NOT force ready=True,
+    unlike test_serving.ServiceFixture)."""
+
+    def __init__(self, cfg):
+        params = init_params(TINY, jax.random.PRNGKey(3))
+        self.service = DeconvService(cfg, spec=TINY, params=params)
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self.port = None
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self.port = await self.service.start("127.0.0.1", 0)
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        self._loop.run_forever()
+
+    def __enter__(self):
+        self._thread.start()
+        assert self._started.wait(10)
+        return self
+
+    def __exit__(self, *exc):
+        fut = asyncio.run_coroutine_threadsafe(self.service.stop(), self._loop)
+        fut.result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(10)
+
+    @property
+    def base_url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+
+def _tiny_cfg(**kw):
+    return ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        compilation_cache_dir="",
+        warmup_all_buckets=False,
+        **kw,
+    )
+
+
+def test_compute_routes_503_before_warmup():
+    """VERDICT r2: ModelNotReady was defined but raised nowhere — pre-warmup
+    requests silently paid compile latency.  Now: 503 until ready, 200
+    after; health/metrics/discovery stay available throughout."""
+    import httpx
+
+    from tests.test_serving import _data_url
+
+    with _Booted(_tiny_cfg()) as s:
+        assert not s.service.ready
+        r = httpx.post(
+            s.base_url + "/",
+            data={"file": _data_url(0), "layer": "b2c1"},
+            timeout=30,
+        )
+        assert r.status_code == 503
+        assert r.json()["error"] == "model_not_ready"
+        r = httpx.post(s.base_url + "/v1/dream", data={"file": _data_url(0)}, timeout=30)
+        assert r.status_code == 503
+        # liveness/observability unaffected
+        assert httpx.get(s.base_url + "/health-check", timeout=30).status_code == 200
+        assert httpx.get(s.base_url + "/metrics", timeout=30).status_code == 200
+        assert httpx.get(s.base_url + "/ready", timeout=30).status_code == 503
+
+        s.service.warmup()
+        assert httpx.get(s.base_url + "/ready", timeout=30).status_code == 200
+        r = httpx.post(
+            s.base_url + "/",
+            data={"file": _data_url(0), "layer": "b2c1"},
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+
+
+def test_models_discovery_endpoint():
+    """GET /v1/models returns the registry plus the live bundle, so clients
+    stop hardcoding layer names (VERDICT r2 item 6)."""
+    import httpx
+
+    with _Booted(_tiny_cfg()) as s:
+        r = httpx.get(s.base_url + "/v1/models", timeout=30)
+        assert r.status_code == 200
+        models = r.json()["models"]
+        names = {m["model"] for m in models}
+        assert {"vgg16", "resnet50", "inception_v3"} <= names
+        active = [m for m in models if m.get("active")]
+        assert len(active) == 1
+        assert active[0]["model"] == TINY.name
+        assert "b2c1" in active[0]["layers"]
+
+
+def test_cfg_dtype_changes_serving_path():
+    """DECONV_DTYPE=bfloat16 must provably change the served computation
+    (VERDICT r2: cfg.dtype was consumed only by bench.py)."""
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    img = np.random.default_rng(0).normal(0, 30, (16, 16, 3)).astype(np.float32)
+
+    def grid(cfg):
+        svc = DeconvService(cfg, spec=TINY, params=params)
+        return svc._run_batch(("b2c1", "all", 4, "grid"), [img])[0]["grid"]
+
+    g32 = grid(_tiny_cfg())
+    g32b = grid(_tiny_cfg())
+    g16 = grid(_tiny_cfg(dtype="bfloat16"))
+    np.testing.assert_array_equal(g32, g32b)  # fp32 path is deterministic
+    assert g16.shape == g32.shape and g16.dtype == g32.dtype
+    assert (g16 != g32).any(), "bfloat16 forward produced bit-identical output"
