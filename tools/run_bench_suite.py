@@ -1,0 +1,130 @@
+"""Run BASELINE bench configs sequentially on the attached chip.
+
+Each config runs in its own child subprocess under a hard timeout, so a
+tunnel hang in one config cannot strand the rest (same rationale as
+bench.py's parent/child split).  Results append as JSON lines to the
+output file; failures record an {"config": n, "error": ...} line instead
+of aborting the suite.
+
+Usage: python tools/run_bench_suite.py [--configs 2,3,4,5] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Generous per-config budgets: first compiles over the tunnel are tens of
+# seconds each, and config 3 compiles one executable per octave shape.
+TIMEOUTS = {1: 1800, 2: 2400, 3: 5400, 4: 3600, 5: 2400}
+
+
+def run_one(n: int, timeout_s: float) -> dict:
+    code = (
+        "import json, sys\n"
+        "from deconv_api_tpu.config import ServerConfig, enable_compilation_cache\n"
+        "enable_compilation_cache(ServerConfig.from_env())\n"
+        "from deconv_api_tpu.bench.suite import run_config\n"
+        f"print(json.dumps(run_config({n})), flush=True)\n"
+    )
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            timeout=timeout_s,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"config": n, "error": f"timeout after {timeout_s:.0f}s"}
+    wall = time.monotonic() - t0
+    sys.stderr.write(proc.stderr.decode(errors="replace")[-4000:])
+    if proc.returncode != 0:
+        return {
+            "config": n,
+            "error": f"rc={proc.returncode}",
+            "stderr_tail": proc.stderr.decode(errors="replace")[-800:],
+        }
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out = json.loads(line)
+                out["wall_s_total"] = round(wall, 1)
+                return out
+            except json.JSONDecodeError:
+                continue
+    return {"config": n, "error": "no JSON output"}
+
+
+def preflight(timeout_s: float = 120.0) -> bool:
+    """One tiny device matmul in a subprocess.  The axon tunnel's failure
+    mode is an indefinite HANG at backend init (bench.py docstring), so
+    liveness must be probed under a hard timeout before burning a config's
+    multi-minute compile budget on a dead tunnel."""
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "x = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).sum()\n"
+        "print('preflight-ok', float(x))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            timeout=timeout_s,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and b"preflight-ok" in proc.stdout
+
+
+def wait_for_device(max_wait_s: float) -> bool:
+    deadline = time.monotonic() + max_wait_s
+    delay = 60.0
+    while True:
+        if preflight():
+            return True
+        remaining = deadline - time.monotonic()
+        print(
+            f"tunnel down; retrying in {delay:.0f}s "
+            f"({remaining / 60:.0f} min left)",
+            file=sys.stderr, flush=True,
+        )
+        if remaining <= delay:
+            return False
+        time.sleep(delay)
+        delay = min(delay * 1.5, 300.0)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="2,3,4,5")
+    ap.add_argument("--out", default=os.path.join(REPO, "bench_suite_results.jsonl"))
+    ap.add_argument("--max-wait-hours", type=float, default=8.0)
+    args = ap.parse_args()
+    date = datetime.date.today().isoformat()
+    for n in [int(x) for x in args.configs.split(",") if x]:
+        print(f"=== config {n} ===", file=sys.stderr, flush=True)
+        if not wait_for_device(args.max_wait_hours * 3600):
+            result = {"config": n, "error": "device tunnel unavailable", "date": date}
+        else:
+            result = run_one(n, TIMEOUTS.get(n, 3600))
+            result["date"] = date
+        with open(args.out, "a") as f:
+            f.write(json.dumps(result) + "\n")
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
