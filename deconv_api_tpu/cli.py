@@ -127,6 +127,32 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_train(args: argparse.Namespace) -> int:
+    """Synthetic-data fine-tune on a (dp, tp) mesh -> orbax checkpoint that
+    `serve --weights <dir>` loads back (the full train->checkpoint->serve
+    loop; SURVEY §5 checkpoint row)."""
+    from deconv_api_tpu.train.loop import train_synthetic
+
+    svc = _load_service(args)
+    mesh_shape = tuple(int(x) for x in args.mesh.split(",") if x)
+    result = train_synthetic(
+        svc.bundle.spec,
+        svc.bundle.params,
+        steps=args.steps,
+        batch=args.batch,
+        lr=args.lr,
+        mesh_shape=mesh_shape,
+        save_dir=args.save,
+        seed=args.seed,
+        progress=lambda i, loss: print(
+            f"step {i}: loss {loss:.4f}", file=sys.stderr, flush=True
+        ),
+    )
+    result.pop("params")  # not printable
+    print(json.dumps(result))
+    return 0
+
+
 def cmd_models(_args: argparse.Namespace) -> int:
     from deconv_api_tpu.serving.models import registry_info
 
@@ -166,6 +192,20 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--lr", type=float, default=0.01)
     _add_common(s)
     s.set_defaults(fn=cmd_dream)
+
+    s = sub.add_parser(
+        "train", help="synthetic fine-tune on a mesh, save an orbax checkpoint"
+    )
+    s.add_argument("--steps", type=int, default=10)
+    s.add_argument("--batch", type=int, default=8)
+    s.add_argument("--lr", type=float, default=1e-4)
+    s.add_argument(
+        "--mesh", default="", help="dp[,tp] mesh shape (default: all devices on dp)"
+    )
+    s.add_argument("--save", default="", help="orbax checkpoint output dir")
+    s.add_argument("--seed", type=int, default=0)
+    _add_common(s)
+    s.set_defaults(fn=cmd_train)
 
     s = sub.add_parser("bench", help="run BASELINE benchmark configs")
     s.add_argument("--config", default="all", help="1-5 or 'all'")

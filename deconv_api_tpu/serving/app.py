@@ -53,21 +53,25 @@ class DeconvService:
         if spec is not None:
             # injected sequential model (tests, embedding)
             self.bundle = spec_bundle(spec, params)
+            model_name = spec.name
         else:
             if self.cfg.model not in REGISTRY:
                 raise errors.UnknownModel(
                     f"unknown model {self.cfg.model!r}; available: {sorted(REGISTRY)}"
                 )
             self.bundle = REGISTRY[self.cfg.model]()
-            if self.cfg.weights_path:
-                from deconv_api_tpu.models.weights import load_model_weights
+            model_name = self.cfg.model
+        if self.cfg.weights_path:
+            # one load path for registry and injected-spec bundles, so a
+            # fine-tuned checkpoint serves under either
+            from deconv_api_tpu.models.weights import load_model_weights
 
-                self.bundle.params = load_model_weights(
-                    self.cfg.model,
-                    self.bundle.spec,
-                    self.cfg.weights_path,
-                    self.bundle.params,
-                )
+            self.bundle.params = load_model_weights(
+                model_name,
+                self.bundle.spec,
+                self.cfg.weights_path,
+                self.bundle.params,
+            )
         if self.cfg.image_size <= 0:
             # resolve on a copy: the caller's config object stays untouched
             self.cfg = dataclasses.replace(

@@ -42,15 +42,24 @@ def psnr_db(a: np.ndarray, b: np.ndarray, peak: float) -> float:
     return 10 * np.log10(peak**2 / max(mse, 1e-20))
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--layer", default="block5_conv1")
-    ap.add_argument("--top-k", type=int, default=8)
-    args = ap.parse_args()
-
+def run(layer: str = "block5_conv1", top_k: int = 8) -> dict:
+    """Full-depth parity measurement: fixed seeds, returns the results
+    dict.  Callable from the `-m slow` test (tests/test_full_depth_parity)
+    so future engine changes cannot silently regress bug-compat parity."""
     import jax
 
-    jax.config.update("jax_platforms", "cpu")  # oracle comparison is a CPU job
+    # Force CPU only while backends are uninitialised: jax.default_backend()
+    # would itself initialise the (possibly wedged) axon TPU backend, and a
+    # config.update after init is a silent no-op.  Under pytest the conftest
+    # has already pinned CPU; standalone this line does it.
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:  # noqa: BLE001 — private API; fall back to forcing
+        initialized = False
+    if not initialized:
+        jax.config.update("jax_platforms", "cpu")  # oracle comparison is a CPU job
     import jax.numpy as jnp
 
     from deconv_api_tpu.engine import get_visualizer
@@ -69,7 +78,7 @@ def main() -> None:
     np_params = jax.tree.map(lambda a: np.asarray(a, np.float64), params)
     nspec = np_spec_of(spec)
     names = [l["name"] for l in nspec]
-    entries = ref.build_entries(nspec[: names.index(args.layer) + 1], np_params)
+    entries = ref.build_entries(nspec[: names.index(layer) + 1], np_params)
     x = img[None]
     for e in entries:
         x = e.up(x)
@@ -77,9 +86,9 @@ def main() -> None:
     fwd_s = time.perf_counter() - t0
     print(f"oracle forward: {fwd_s:.1f}s", flush=True)
 
-    target_i = next(i for i, e in enumerate(entries) if e.name == args.layer)
+    target_i = next(i for i, e in enumerate(entries) if e.name == layer)
     output = entries[target_i].up_data
-    top = ref.find_top_filters(output, args.top_k)
+    top = ref.find_top_filters(output, top_k)
     oracle_imgs = []
     t0 = time.perf_counter()
     for rank, (fidx, _) in enumerate(top):
@@ -95,15 +104,15 @@ def main() -> None:
     oracle_imgs = np.stack(oracle_imgs)
 
     # ---- engine (exact fp32 and the bf16-backward serving path) ----
-    results = {"layer": args.layer, "top_k": len(top),
+    results = {"layer": layer, "top_k": len(top),
                "oracle_forward_s": round(fwd_s, 1),
                "oracle_backward_s": round(bwd_s, 1)}
     for label, bwd_dtype in (("fp32", None), ("bf16_backward", "bfloat16")):
         t0 = time.perf_counter()
         fn = get_visualizer(
-            spec, args.layer, args.top_k, "all", True, backward_dtype=bwd_dtype
+            spec, layer, top_k, "all", True, backward_dtype=bwd_dtype
         )
-        out = fn(params, jnp.asarray(img, jnp.float32))[args.layer]
+        out = fn(params, jnp.asarray(img, jnp.float32))[layer]
         dt = time.perf_counter() - t0
         n = int(np.asarray(out["valid"]).sum())
         idx = np.asarray(out["indices"])[:n]
@@ -125,7 +134,15 @@ def main() -> None:
         print(f"{label}: idx_match={idx_match} raw={raw:.1f}dB "
               f"deprocessed={dep:.1f}dB ({dt:.1f}s)", flush=True)
 
-    print(json.dumps(results))
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layer", default="block5_conv1")
+    ap.add_argument("--top-k", type=int, default=8)
+    args = ap.parse_args()
+    print(json.dumps(run(args.layer, args.top_k)))
 
 
 if __name__ == "__main__":
