@@ -193,9 +193,13 @@ class DeconvService:
         if key[0] == "__dream__":
             return self._run_dream(key, images)
         layer_name, mode, top_k, post = key
+        # The device postprocess (stitch/deprocess to uint8) is FUSED into
+        # the visualizer program: one device dispatch per batch instead of
+        # two, the fp32 projections never round-trip HBM between programs,
+        # and only uint8 crosses to the host.
         fn = self.bundle.batched_visualizer(
             layer_name, mode, top_k, self.cfg.bug_compat,
-            self.cfg.backward_dtype or None,
+            self.cfg.backward_dtype or None, post,
         )
         bucket = self._bucket_for(len(images))
         batch = np.stack(images + [images[-1]] * (bucket - len(images)))
@@ -210,15 +214,13 @@ class DeconvService:
         out = fn(self.bundle.params, jnp.asarray(batch, dtype=fwd_dtype))[layer_name]
         valid = np.asarray(out["valid"])  # (B, K)
         indices = np.asarray(out["indices"])
-        # Postprocess ON DEVICE so only uint8 crosses to the host — the
-        # fp32 projections are otherwise the request's dominant transfer.
         if post == "grid":
-            grids = np.asarray(codec.stitch_grid_device(out["images"], out["valid"]))
+            grids = np.asarray(out["grid"])
             return [
                 {"grid": grids[i], "valid": valid[i], "indices": indices[i]}
                 for i in range(len(images))
             ]
-        tiles = np.asarray(codec.deprocess_tiles_device(out["images"]))
+        tiles = np.asarray(out["tiles"])
         return [
             {"images": tiles[i], "valid": valid[i], "indices": indices[i]}
             for i in range(len(images))

@@ -186,27 +186,32 @@ def deprocess_tiles_device(images):
     return _deprocess_tiles_jit()(images)
 
 
-@_functools.cache
-def _stitch_grid_jit():
+def _stitch_grid_traced(images, valid):
+    """Traceable stitch+deprocess body — also composed INTO the fused
+    serving program (serving/models.py:_fuse_post), where it runs as an
+    epilogue of the visualizer dispatch."""
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
-    def run(images, valid):
-        b, k = images.shape[:2]
-        if k < 4:
-            pad = jnp.zeros((b, 4 - k, *images.shape[2:]), images.dtype)
-            images = jnp.concatenate([images, pad], axis=1)
-            valid = jnp.concatenate(
-                [valid, jnp.zeros((b, 4 - k), valid.dtype)], axis=1
-            )
-        tiles = images[:, :4] * valid[:, :4, None, None, None].astype(images.dtype)
-        top = jnp.concatenate([tiles[:, 0], tiles[:, 1]], axis=2)
-        bottom = jnp.concatenate([tiles[:, 2], tiles[:, 3]], axis=2)
-        grid = jnp.concatenate([top, bottom], axis=1)
-        return jax.vmap(_deprocess_jax)(grid)
+    b, k = images.shape[:2]
+    if k < 4:
+        pad = jnp.zeros((b, 4 - k, *images.shape[2:]), images.dtype)
+        images = jnp.concatenate([images, pad], axis=1)
+        valid = jnp.concatenate(
+            [valid, jnp.zeros((b, 4 - k), valid.dtype)], axis=1
+        )
+    tiles = images[:, :4] * valid[:, :4, None, None, None].astype(images.dtype)
+    top = jnp.concatenate([tiles[:, 0], tiles[:, 1]], axis=2)
+    bottom = jnp.concatenate([tiles[:, 2], tiles[:, 3]], axis=2)
+    grid = jnp.concatenate([top, bottom], axis=1)
+    return jax.vmap(_deprocess_jax)(grid)
 
-    return run
+
+@_functools.cache
+def _stitch_grid_jit():
+    import jax
+
+    return jax.jit(_stitch_grid_traced)
 
 
 def stitch_grid_device(images, valid):

@@ -69,43 +69,70 @@ class ModelBundle:
         top_k: int,
         bug_compat: bool = True,
         backward_dtype: str | None = None,
+        post: str | None = None,
     ):
-        """fn(params, batch) -> {layer: {images, indices, sums, valid}} —
+        """fn(params, batch) -> {layer: {..., indices, sums, valid}} —
         jitted once per static configuration and cached.  ``bug_compat``
         only affects sequential models (the DAG autodiff path has no
         double-ReLU quirk to reproduce).  ``backward_dtype`` defaults to
         exact (None); the serving layer passes its configured policy.  The
         DAG autodiff path ignores it (its backward is a vjp over the saved
         fp32 forward residuals, so there is no separate projection chain to
-        downcast) — normalised out of the cache key there."""
+        downcast) — normalised out of the cache key there.
+
+        ``post`` fuses the device-side postprocess INTO the same program:
+        ``"grid"`` adds a uint8 ``grid`` (2x2 stitch + deprocess, the
+        POST / presentation), ``"tiles"`` a uint8 ``tiles`` (per-filter
+        deprocess, the /v1/deconv presentation) — and drops the raw fp32
+        ``images`` from the outputs.  One device dispatch per batch instead
+        of two, and the full-resolution fp32 projections never round-trip
+        HBM between programs (they fuse into the epilogue); only uint8
+        crosses to the host.  ``post=None`` keeps the raw projections (the
+        library/bench surface)."""
         if self.spec is None:
             backward_dtype = None
-        key = (layer, mode, top_k, bug_compat, backward_dtype)
+        key = (layer, mode, top_k, bug_compat, backward_dtype, post)
         if key not in self._vis_cache:
             if self.spec is not None:
-                fn = get_visualizer(
+                raw = get_visualizer(
                     self.spec, layer, top_k, mode, bug_compat,
                     sweep=False, batched=True,
                     backward_dtype=backward_dtype or None,
                 )
-                if self.mesh is not None:
-                    from deconv_api_tpu.parallel.batch import shard_batched_fn
-
-                    fn = shard_batched_fn(fn, self.mesh)
             else:
                 vmapped = jax.vmap(
                     autodeconv_visualizer(self.forward_fn, layer, top_k, mode),
                     in_axes=(None, 0),
                 )
-                if self.mesh is not None:
-                    from deconv_api_tpu.parallel.batch import shard_batched_fn
+                raw = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
 
-                    vmapped = shard_batched_fn(vmapped, self.mesh)
-                else:
-                    vmapped = jax.jit(vmapped)
-                fn = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
+            fn = raw if post is None else _fuse_post(raw, layer, post)
+            if self.mesh is not None:
+                from deconv_api_tpu.parallel.batch import shard_batched_fn
+
+                fn = shard_batched_fn(fn, self.mesh)
+            else:
+                fn = jax.jit(fn)
             self._vis_cache[key] = fn
         return self._vis_cache[key]
+
+
+def _fuse_post(raw, layer: str, post: str):
+    """Compose the raw visualizer with the device postprocess under one
+    trace (nested jit inlines), replacing fp32 `images` with the uint8
+    presentation the route actually serves."""
+    from deconv_api_tpu.serving.codec import _deprocess_jax, _stitch_grid_traced
+
+    def fused(params, batch):
+        out = dict(raw(params, batch)[layer])
+        images = out.pop("images")
+        if post == "grid":
+            out["grid"] = _stitch_grid_traced(images, out["valid"])
+        else:
+            out["tiles"] = jax.vmap(jax.vmap(_deprocess_jax))(images)
+        return {layer: out}
+
+    return fused
 
 
 def spec_bundle(

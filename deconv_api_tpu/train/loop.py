@@ -59,11 +59,22 @@ def train_synthetic(
         )
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
+    devices = jax.devices()
     if not mesh_shape:
-        mesh_shape = (len(jax.devices()), 1)
+        mesh_shape = (len(devices), 1)
     elif len(mesh_shape) == 1:
         mesh_shape = (mesh_shape[0], 1)
-    mesh = make_mesh(tuple(mesh_shape), axis_names=("dp", "tp"))
+    mesh_shape = tuple(mesh_shape)
+    ndev = math.prod(mesh_shape)
+    if len(devices) < ndev:
+        raise ValueError(
+            f"mesh {mesh_shape} needs {ndev} devices, have {len(devices)}"
+        )
+    # same subsetting rule as serving (app.py): use the first prod(shape)
+    # devices rather than demanding an exact count match
+    mesh = make_mesh(
+        mesh_shape, axis_names=("dp", "tp"), devices=devices[:ndev]
+    )
 
     dp = mesh.shape["dp"]
     batch = max(dp, -(-batch // dp) * dp)
