@@ -145,6 +145,7 @@ class DeconvService:
         self.server.route("GET", "/ready")(self._ready)
         self.server.route("GET", "/metrics")(self._metrics)
         self.server.route("GET", "/v1/models")(self._models)
+        self.server.route("POST", "/v1/profile")(self._profile)
         self.server.route("POST", "/")(self._deconv_compat)
         self.server.route("POST", "/v1/deconv")(self._deconv_v1)
         self.server.route("POST", "/v1/dream")(self._dream_v1)
@@ -389,6 +390,39 @@ class DeconvService:
                 }
             )
         return Response.json({"models": info})
+
+    async def _profile(self, req: Request) -> Response:
+        """POST /v1/profile {batches: N} — re-arm the jax.profiler capture
+        budget so the NEXT N device batches are traced to cfg.profile_dir
+        (SURVEY §5 tracing row: on-demand capture without a restart)."""
+        if not self.cfg.profile_dir:
+            return Response.json(
+                {
+                    "error": "bad_request",
+                    "detail": "profiling disabled: set DECONV_PROFILE_DIR",
+                },
+                400,
+            )
+        try:
+            form = _parse_form(req) if req.body else {}
+            batches = int(form.get("batches", 4))
+        except errors.DeconvError as e:
+            return Response.json({"error": e.code, "detail": e.message}, e.status)
+        except ValueError:
+            return Response.json(
+                {"error": "bad_request", "detail": "batches must be an int"}, 400
+            )
+        if not 1 <= batches <= 64:
+            return Response.json(
+                {"error": "bad_request", "detail": "batches must be in [1, 64]"}, 400
+            )
+        # under the lock: a worker thread's read-modify-write decrement in
+        # _profile_scope must not stomp a concurrent re-arm
+        with self._profile_lock:
+            self._profile_remaining = batches
+        return Response.json(
+            {"armed": batches, "profile_dir": self.cfg.profile_dir}
+        )
 
     async def _deconv_compat(self, req: Request) -> Response:
         """POST / — the reference's endpoint, wire-compatible."""

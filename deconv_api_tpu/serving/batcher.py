@@ -65,6 +65,7 @@ class BatchingDispatcher:
         self._task: asyncio.Task | None = None
         self._metrics = metrics
         self._shed_factor = shed_factor
+        self._inflight = 0  # executing drain's remaining serial groups
 
     async def start(self) -> None:
         if self._task is None:
@@ -80,24 +81,29 @@ class BatchingDispatcher:
             self._task = None
 
     def _estimated_drain_s(self) -> float:
-        """Time for the queue ahead of a new arrival to clear, from the
+        """Time for the work ahead of a new arrival to clear, from the
         observed per-batch compute median.  0.0 while unmeasured (cold
-        start: never shed before the first batches complete)."""
+        start) AND whenever the queue is empty: an empty-queue arrival
+        rides the very next batch, and always accepting it guarantees
+        liveness — if everything shed, no batch would ever run and the p50
+        estimate could never correct itself."""
         if self._metrics is None:
+            return 0.0
+        depth = self._queue.qsize()
+        if depth == 0:
             return 0.0
         p50 = self._metrics.compute_p50()
         if p50 <= 0.0:
             return 0.0
-        # Queue AHEAD of this arrival only: an arrival at an empty queue
-        # rides the very next batch and must never shed, whatever p50 is.
         # Divide by the OBSERVED executed-batch size, not max_batch: mixed
         # keys split a drain window into per-key serial executions, so the
-        # effective batch size can be far below max_batch (review finding).
+        # effective batch size can be far below max_batch.  _inflight
+        # counts the executing drain's remaining groups (serial device
+        # batches the queue no longer shows).
         eff_batch = min(
             float(self._max_batch), max(1.0, self._metrics.batch_size_p50())
         )
-        batches_ahead = self._queue.qsize() / eff_batch
-        return batches_ahead * p50
+        return (depth / eff_batch + self._inflight) * p50
 
     async def submit(self, image: Any, key: Any) -> Any:
         # Load shedding (VERDICT r2): when the queue already needs longer
@@ -151,23 +157,29 @@ class BatchingDispatcher:
         # the single-owner invariant that replaces the reference's
         # _SYMBOLIC_SCOPE thread hack.  Mixed-key bursts complete without
         # starvation (tests/test_serving.py::test_mixed_layer_burst).
-        for key, items in groups.items():
-            images = [it.image for it in items]
-            t0 = time.perf_counter()
-            try:
-                results = await asyncio.to_thread(self._runner, key, images)
-            except Exception as e:  # noqa: BLE001 — propagate to callers
-                for it in items:
+        self._inflight = len(groups)
+        try:
+            for key, items in groups.items():
+                images = [it.image for it in items]
+                t0 = time.perf_counter()
+                try:
+                    results = await asyncio.to_thread(self._runner, key, images)
+                except Exception as e:  # noqa: BLE001 — propagate to callers
+                    for it in items:
+                        if not it.future.done():
+                            it.future.set_exception(e)
+                    continue
+                finally:
+                    self._inflight -= 1
+                dt = time.perf_counter() - t0
+                if self._metrics is not None:
+                    self._metrics.observe_batch(
+                        size=len(items),
+                        compute_s=dt,
+                        queue_s=t0 - min(it.enqueued_at for it in items),
+                    )
+                for it, res in zip(items, results):
                     if not it.future.done():
-                        it.future.set_exception(e)
-                continue
-            dt = time.perf_counter() - t0
-            if self._metrics is not None:
-                self._metrics.observe_batch(
-                    size=len(items),
-                    compute_s=dt,
-                    queue_s=t0 - min(it.enqueued_at for it in items),
-                )
-            for it, res in zip(items, results):
-                if not it.future.done():
-                    it.future.set_result(res)
+                        it.future.set_result(res)
+        finally:
+            self._inflight = 0  # cancellation mid-drain must not leak count

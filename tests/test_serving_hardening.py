@@ -319,3 +319,28 @@ def test_cfg_dtype_changes_serving_path():
     np.testing.assert_array_equal(g32, g32b)  # fp32 path is deterministic
     assert g16.shape == g32.shape and g16.dtype == g32.dtype
     assert (g16 != g32).any(), "bfloat16 forward produced bit-identical output"
+
+
+def test_profile_rearm_endpoint(tmp_path):
+    """POST /v1/profile re-arms the capture budget; the next batch writes a
+    trace (on-demand jax.profiler capture, SURVEY §5 tracing row)."""
+    import httpx
+
+    cfg = _tiny_cfg(profile_dir=str(tmp_path / "traces"))
+    with _Booted(cfg) as s:
+        s.service.warmup()
+        s.service._profile_remaining = 0  # startup budget spent
+        r = httpx.post(s.base_url + "/v1/profile", data={"batches": "2"}, timeout=30)
+        assert r.status_code == 200 and r.json()["armed"] == 2
+        img = np.zeros((16, 16, 3), np.float32)
+        s.service._run_batch(("b2c1", "all", 4, "grid"), [img])
+        assert s.service._profile_remaining == 1
+        assert any(f.is_file() for f in (tmp_path / "traces").rglob("*"))
+
+
+def test_profile_rearm_disabled_400():
+    import httpx
+
+    with _Booted(_tiny_cfg()) as s:
+        r = httpx.post(s.base_url + "/v1/profile", data={"batches": "2"}, timeout=30)
+        assert r.status_code == 400
