@@ -111,7 +111,7 @@ def main() -> int:
     print(f"{'segment':38s} {'GFLOP':>9s} {'MB':>8s} {'us':>8s}  bound")
     for name, f, b, t, bound in rows:
         print(f"{name:38s} {f / 1e9:9.1f} {b / 1e6:8.1f} {t * 1e6:8.0f}  {bound}")
-    mxu_time = sum(f for _, f, _ in segs) / PEAK_BF16
+    mxu_time = tot_f / PEAK_BF16
     print(f"\ntotals: {tot_f / 1e12:.2f} TFLOP, {tot_b / 1e9:.2f} GB HBM, "
           f"intensity {tot_f / tot_b:.0f} FLOP/byte")
     print(f"pure-MXU time      : {mxu_time * 1e3:7.2f} ms/batch (100% MFU)")
