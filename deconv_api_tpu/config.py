@@ -33,6 +33,11 @@ class ServerConfig:
     warmup_all_buckets: bool = True
     request_timeout_s: float = 60.0
     dream_timeout_s: float = 300.0  # dreams run minutes; own queue + timeout
+    # Layer sweeps project ~13x a single-layer request and compile a large
+    # program on first use; they ride their own dispatcher + metrics stream
+    # (like dreams) so interactive traffic is never head-of-line blocked
+    # and the shed estimator's p50 stays clean.
+    sweep_timeout_s: float = 300.0
     # Connection-level abuse hardening (VERDICT r2): a slowloris client may
     # hold a socket (and body buffer) only this long; idle keep-alive
     # connections are reaped on the same clock.  0 disables (tests).

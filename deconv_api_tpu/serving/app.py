@@ -136,6 +136,19 @@ class DeconvService:
             metrics=self.dream_metrics,
             shed_factor=self.cfg.shed_factor,
         )
+        # Sweeps (~13x a single-layer request, large first-use compile) get
+        # the dream treatment: own dispatcher so they never head-of-line
+        # block interactive traffic, own metrics so their batch p50 cannot
+        # poison the interactive shed estimator.
+        self.sweep_metrics = Metrics(prefix="sweep")
+        self.sweep_dispatcher = BatchingDispatcher(
+            self._run_batch,
+            max_batch=self.cfg.max_batch,
+            window_ms=self.cfg.batch_window_ms,
+            request_timeout_s=self.cfg.sweep_timeout_s,
+            metrics=self.sweep_metrics,
+            shed_factor=self.cfg.shed_factor,
+        )
         self.server = HttpServer(
             idle_timeout_s=self.cfg.conn_idle_timeout_s,
             body_timeout_s=self.cfg.body_read_timeout_s,
@@ -193,14 +206,16 @@ class DeconvService:
 
         if key[0] == "__dream__":
             return self._run_dream(key, images)
-        layer_name, mode, top_k, post = key
+        # 4-tuple: single-layer (the default); 5-tuple adds sweep=True
+        layer_name, mode, top_k, post, *rest = key
+        sweep = bool(rest[0]) if rest else False
         # The device postprocess (stitch/deprocess to uint8) is FUSED into
         # the visualizer program: one device dispatch per batch instead of
         # two, the fp32 projections never round-trip HBM between programs,
         # and only uint8 crosses to the host.
         fn = self.bundle.batched_visualizer(
             layer_name, mode, top_k, self.cfg.bug_compat,
-            self.cfg.backward_dtype or None, post,
+            self.cfg.backward_dtype or None, post, sweep,
         )
         bucket = self._bucket_for(len(images))
         batch = np.stack(images + [images[-1]] * (bucket - len(images)))
@@ -212,7 +227,26 @@ class DeconvService:
         fwd_dtype = (
             jnp.bfloat16 if self.cfg.dtype == "bfloat16" else jnp.float32
         )
-        out = fn(self.bundle.params, jnp.asarray(batch, dtype=fwd_dtype))[layer_name]
+        out_all = fn(self.bundle.params, jnp.asarray(batch, dtype=fwd_dtype))
+        if sweep:
+            # one entry per projected layer (reference §2.2.3 semantics);
+            # materialise each layer's tensors once, slice per image
+            host = {
+                name: {k: np.asarray(v) for k, v in entry.items()}
+                for name, entry in out_all.items()
+            }
+            return [
+                {
+                    name: {
+                        "images": e["tiles"][i],
+                        "valid": e["valid"][i],
+                        "indices": e["indices"][i],
+                    }
+                    for name, e in host.items()
+                }
+                for i in range(len(images))
+            ]
+        out = out_all[layer_name]
         valid = np.asarray(out["valid"])  # (B, K)
         indices = np.asarray(out["indices"])
         if post == "grid":
@@ -313,7 +347,12 @@ class DeconvService:
     # ----------------------------------------------------------- pipeline
 
     async def _project(
-        self, form: dict[str, str], mode: str, top_k: int, post: str
+        self,
+        form: dict[str, str],
+        mode: str,
+        top_k: int,
+        post: str,
+        sweep: bool = False,
     ):
         if not self.ready:
             # Pre-warmup requests would silently pay a full XLA compile
@@ -345,9 +384,13 @@ class DeconvService:
             # work per request and would serialize all concurrent requests
             x = await asyncio.to_thread(decode)
 
+        if sweep:
+            with stage(self.sweep_metrics, "compute"):
+                return await self.sweep_dispatcher.submit(
+                    x, (layer, mode, top_k, post, True)
+                )
         with stage(self.metrics, "compute"):
-            result = await self.dispatcher.submit(x, (layer, mode, top_k, post))
-        return result
+            return await self.dispatcher.submit(x, (layer, mode, top_k, post))
 
     # ------------------------------------------------------------- routes
 
@@ -361,7 +404,9 @@ class DeconvService:
 
     async def _metrics(self, _req: Request) -> Response:
         return Response.text(
-            self.metrics.prometheus() + self.dream_metrics.prometheus(),
+            self.metrics.prometheus()
+            + self.dream_metrics.prometheus()
+            + self.sweep_metrics.prometheus(),
             content_type="text/plain; version=0.0.4",
         )
 
@@ -472,14 +517,30 @@ class DeconvService:
             top_k = int(form.get("top_k", self.cfg.top_k))
             if not 1 <= top_k <= 64:
                 raise errors.BadRequest("top_k must be in [1, 64]")
+            sweep = form.get("sweep", "").lower() in ("1", "true", "yes", "on")
+            if sweep and self.bundle.spec is None:
+                # fail fast at the route, before decode/queue/dispatch —
+                # the autodiff engine has no layer sweep
+                raise errors.IllegalMode(
+                    f"model {self.bundle.name!r} (autodiff engine) has no "
+                    "layer sweep; sweep is a sequential-spec feature"
+                )
+            if sweep:
+                # every layer from the requested one down — the reference's
+                # always-on behaviour (SURVEY §2.2.3) as an explicit opt-in
+                result = await self._project(form, mode, top_k, "tiles", sweep=True)
+                layers = await asyncio.to_thread(
+                    lambda: {
+                        name: _encode_tiles(entry) for name, entry in result.items()
+                    }
+                )
+                self.metrics.observe_request(time.perf_counter() - t0)
+                return Response.json(
+                    {"layer": form["layer"], "mode": mode, "sweep": True,
+                     "layers": layers}
+                )
             result = await self._project(form, mode, top_k, "tiles")
-            n_valid = int(result["valid"].sum())
-            images = await asyncio.to_thread(
-                lambda: [
-                    codec.encode_data_url(result["images"][k])
-                    for k in range(n_valid)
-                ]
-            )
+            payload = await asyncio.to_thread(_encode_tiles, result)
         except errors.DeconvError as e:
             self.metrics.observe_request(time.perf_counter() - t0, e.code)
             return Response.json({"error": e.code, "detail": e.message}, e.status)
@@ -488,12 +549,7 @@ class DeconvService:
             return Response.json({"error": "bad_request", "detail": str(e)}, 400)
         self.metrics.observe_request(time.perf_counter() - t0)
         return Response.json(
-            {
-                "layer": form["layer"],
-                "mode": mode,
-                "filters": [int(i) for i in result["indices"][:n_valid]],
-                "images": images,
-            }
+            {"layer": form["layer"], "mode": mode, **payload}
         )
 
     async def _dream_v1(self, req: Request) -> Response:
@@ -571,6 +627,7 @@ class DeconvService:
     async def start(self, host: str | None = None, port: int | None = None) -> int:
         await self.dispatcher.start()
         await self.dream_dispatcher.start()
+        await self.sweep_dispatcher.start()
         return await self.server.start(
             host if host is not None else self.cfg.host,
             self.cfg.port if port is None else port,
@@ -580,6 +637,20 @@ class DeconvService:
         await self.server.stop()
         await self.dispatcher.stop()
         await self.dream_dispatcher.stop()
+        await self.sweep_dispatcher.stop()
+
+
+def _encode_tiles(entry: dict) -> dict:
+    """{filters, images} JSON payload for one projected layer's valid-prefix
+    tiles — shared by the single-layer and sweep branches of /v1/deconv so
+    the two presentations cannot drift."""
+    n_valid = int(entry["valid"].sum())
+    return {
+        "filters": [int(i) for i in entry["indices"][:n_valid]],
+        "images": [
+            codec.encode_data_url(entry["images"][k]) for k in range(n_valid)
+        ],
+    }
 
 
 def _parse_form(req: Request) -> dict[str, str]:

@@ -70,6 +70,7 @@ class ModelBundle:
         bug_compat: bool = True,
         backward_dtype: str | None = None,
         post: str | None = None,
+        sweep: bool = False,
     ):
         """fn(params, batch) -> {layer: {..., indices, sums, valid}} —
         jitted once per static configuration and cached.  ``bug_compat``
@@ -88,15 +89,25 @@ class ModelBundle:
         of two, and the full-resolution fp32 projections never round-trip
         HBM between programs (they fuse into the epilogue); only uint8
         crosses to the host.  ``post=None`` keeps the raw projections (the
-        library/bench surface)."""
+        library/bench surface).
+
+        ``sweep=True`` (sequential specs only) projects EVERY model layer
+        from ``layer`` down — the reference's always-on behaviour
+        (SURVEY §2.2.3) as an explicit opt-in; the result dict then carries
+        one entry per projected layer."""
         if self.spec is None:
+            if sweep:
+                raise ValueError(
+                    f"model {self.name!r} (autodiff engine) has no layer "
+                    "sweep; sweep is a sequential-spec feature"
+                )
             backward_dtype = None
-        key = (layer, mode, top_k, bug_compat, backward_dtype, post)
+        key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep)
         if key not in self._vis_cache:
             if self.spec is not None:
                 raw = get_visualizer(
                     self.spec, layer, top_k, mode, bug_compat,
-                    sweep=False, batched=True,
+                    sweep=sweep, batched=True,
                     backward_dtype=backward_dtype or None,
                 )
             else:
@@ -106,7 +117,7 @@ class ModelBundle:
                 )
                 raw = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
 
-            fn = raw if post is None else _fuse_post(raw, layer, post)
+            fn = raw if post is None else _fuse_post(raw, post)
             if self.mesh is not None:
                 from deconv_api_tpu.parallel.batch import shard_batched_fn
 
@@ -117,20 +128,24 @@ class ModelBundle:
         return self._vis_cache[key]
 
 
-def _fuse_post(raw, layer: str, post: str):
+def _fuse_post(raw, post: str):
     """Compose the raw visualizer with the device postprocess under one
     trace (nested jit inlines), replacing fp32 `images` with the uint8
-    presentation the route actually serves."""
+    presentation the route actually serves.  Applies per projected layer
+    (one for the default single-layer program, many under sweep)."""
     from deconv_api_tpu.serving.codec import _deprocess_jax, _stitch_grid_traced
 
     def fused(params, batch):
-        out = dict(raw(params, batch)[layer])
-        images = out.pop("images")
-        if post == "grid":
-            out["grid"] = _stitch_grid_traced(images, out["valid"])
-        else:
-            out["tiles"] = jax.vmap(jax.vmap(_deprocess_jax))(images)
-        return {layer: out}
+        result = {}
+        for name, entry in raw(params, batch).items():
+            out = dict(entry)
+            images = out.pop("images")
+            if post == "grid":
+                out["grid"] = _stitch_grid_traced(images, out["valid"])
+            else:
+                out["tiles"] = jax.vmap(jax.vmap(_deprocess_jax))(images)
+            result[name] = out
+        return result
 
     return fused
 

@@ -344,3 +344,65 @@ def test_profile_rearm_disabled_400():
     with _Booted(_tiny_cfg()) as s:
         r = httpx.post(s.base_url + "/v1/profile", data={"batches": "2"}, timeout=30)
         assert r.status_code == 400
+
+
+def test_v1_deconv_sweep_over_http():
+    """sweep=1 on /v1/deconv projects every layer from the requested one
+    down — the reference's always-on behaviour (SURVEY §2.2.3) as an
+    explicit opt-in over the wire."""
+    import httpx
+
+    from tests.test_serving import _data_url
+
+    with _Booted(_tiny_cfg()) as s:
+        s.service.ready = True
+        r = httpx.post(
+            s.base_url + "/v1/deconv",
+            data={"file": _data_url(0), "layer": "b2c1", "sweep": "1"},
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["sweep"] is True
+        # TINY from b2c1 down: b2c1, b1p, b1c2, b1c1 (input excluded)
+        assert set(body["layers"]) == {"b2c1", "b1p", "b1c2", "b1c1"}
+        for name, entry in body["layers"].items():
+            assert len(entry["filters"]) == len(entry["images"])
+            assert all(u.startswith("data:image/") for u in entry["images"])
+
+        # single-layer requests on the same server still work (cache keys
+        # must not collide between sweep and non-sweep programs)
+        r = httpx.post(
+            s.base_url + "/v1/deconv",
+            data={"file": _data_url(0), "layer": "b2c1"},
+            timeout=120,
+        )
+        assert r.status_code == 200 and "images" in r.json()
+
+
+def test_v1_deconv_sweep_rejected_for_dag_models():
+    """DAG (autodiff-engine) models have no layer sweep: the bundle refuses,
+    and the ROUTE fails fast with 422 before decode/queue/dispatch."""
+    import json as _json
+
+    from deconv_api_tpu.serving.http import Request
+    from deconv_api_tpu.serving.models import REGISTRY
+
+    bundle = REGISTRY["resnet50"]()
+    with pytest.raises(ValueError, match="sweep"):
+        bundle.batched_visualizer("conv4_block6_out", "all", 4, sweep=True)
+
+    svc = DeconvService(
+        ServerConfig(
+            model="resnet50", compilation_cache_dir="", warmup_all_buckets=False
+        )
+    )
+    svc.ready = True
+    req = Request(
+        "POST", "/v1/deconv", {},
+        {"content-type": "application/x-www-form-urlencoded"},
+        b"file=x&layer=conv4_block6_out&sweep=1",
+    )
+    resp = asyncio.run(svc._deconv_v1(req))
+    assert resp.status == 422
+    assert _json.loads(resp.body)["error"] == "illegal_visualize_mode"

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-3 headline measurements, chained AFTER the bench suite completes
+# (single TPU: two processes on the tunnel at once wedge it).
+# Usage: tools/run_r3_headline.sh <suite_pid> <out_file>
+set -u
+SUITE_PID=${1:?}
+OUT=${2:-headline_r3.log}
+
+while kill -0 "$SUITE_PID" 2>/dev/null; do sleep 60; done
+
+cd "$(dirname "$0")/.."
+{
+  echo "=== headline (batch 64) $(date -u +%FT%TZ) ==="
+  DECONV_BENCH_TRIES=2 timeout 1800 python bench.py --breakdown
+  echo "=== headline batch 128 $(date -u +%FT%TZ) ==="
+  DECONV_BENCH_BATCH=128 DECONV_BENCH_TRIES=2 timeout 1800 python bench.py
+  echo "=== headline batch 32 $(date -u +%FT%TZ) ==="
+  DECONV_BENCH_BATCH=32 DECONV_BENCH_TRIES=2 timeout 1800 python bench.py
+  echo "=== done $(date -u +%FT%TZ) ==="
+} >> "$OUT" 2>&1
