@@ -57,11 +57,38 @@ def _read_image(path: str, size: int):
 
 
 def cmd_visualize(args: argparse.Namespace) -> int:
-    import numpy as np
+    import os
+
     from PIL import Image
 
     svc = _load_service(args)
+    try:
+        svc.bundle.check_layer(args.layer)
+        if args.sweep:
+            svc.bundle.check_sweep()
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 2
     x = svc.bundle.preprocess(_read_image(args.image, svc.cfg.image_size))
+    if args.sweep:
+        # one grid per layer from the requested one down (the reference's
+        # visualize_all_layers, app/deepdream.py:383-476)
+        result = svc._run_batch(
+            (args.layer, args.mode, args.top_k, "grid", True), [x]
+        )[0]
+        stem, ext = os.path.splitext(args.output)
+        outputs = {}
+        for name, entry in result.items():
+            if int(entry["valid"].sum()) == 0:
+                continue
+            path = f"{stem}_{name}{ext or '.png'}"
+            Image.fromarray(entry["grid"][:, :, ::-1]).save(path)
+            outputs[name] = path
+        if not outputs:
+            print("no filters fired for any layer", file=sys.stderr)
+            return 1
+        print(json.dumps({"outputs": outputs, "layer": args.layer}))
+        return 0
     result = svc._run_batch((args.layer, args.mode, args.top_k, "grid"), [x])[0]
     n_valid = int(result["valid"].sum())
     if n_valid == 0:
@@ -180,6 +207,10 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--output", default="deconv.png")
     s.add_argument("--mode", default="all", choices=("all", "max"))
     s.add_argument("--top-k", type=int, default=8, dest="top_k")
+    s.add_argument(
+        "--sweep", action="store_true",
+        help="project every layer from --layer down (one output per layer)",
+    )
     _add_common(s)
     s.set_defaults(fn=cmd_visualize)
 

@@ -235,10 +235,11 @@ class DeconvService:
                 name: {k: np.asarray(v) for k, v in entry.items()}
                 for name, entry in out_all.items()
             }
+            src, dst = ("grid", "grid") if post == "grid" else ("tiles", "images")
             return [
                 {
                     name: {
-                        "images": e["tiles"][i],
+                        dst: e[src][i],
                         "valid": e["valid"][i],
                         "indices": e["indices"][i],
                     }
@@ -366,11 +367,10 @@ class DeconvService:
         layer = form.get("layer")
         if not file_uri or not layer:
             raise errors.BadRequest("form fields 'file' and 'layer' are required")
-        if layer not in self.bundle.layer_names:
-            raise errors.UnknownLayer(
-                f"model {self.bundle.name!r} has no projectable layer {layer!r}; "
-                f"known: {list(self.bundle.layer_names)}"
-            )
+        try:
+            self.bundle.check_layer(layer)
+        except ValueError as e:
+            raise errors.UnknownLayer(str(e)) from None
         def decode():
             try:
                 img = codec.decode_data_url(file_uri)
@@ -518,14 +518,12 @@ class DeconvService:
             if not 1 <= top_k <= 64:
                 raise errors.BadRequest("top_k must be in [1, 64]")
             sweep = form.get("sweep", "").lower() in ("1", "true", "yes", "on")
-            if sweep and self.bundle.spec is None:
-                # fail fast at the route, before decode/queue/dispatch —
-                # the autodiff engine has no layer sweep
-                raise errors.IllegalMode(
-                    f"model {self.bundle.name!r} (autodiff engine) has no "
-                    "layer sweep; sweep is a sequential-spec feature"
-                )
             if sweep:
+                try:
+                    # fail fast at the route, before decode/queue/dispatch
+                    self.bundle.check_sweep()
+                except ValueError as e:
+                    raise errors.IllegalMode(str(e)) from None
                 # every layer from the requested one down — the reference's
                 # always-on behaviour (SURVEY §2.2.3) as an explicit opt-in
                 result = await self._project(form, mode, top_k, "tiles", sweep=True)

@@ -37,6 +37,26 @@ class ModelBundle:
     _vis_cache: dict = dataclasses.field(default_factory=dict)
     _dream_cache: dict = dataclasses.field(default_factory=dict)
 
+    def check_sweep(self) -> None:
+        """Single source of truth for the sweep precondition — raised here,
+        surfaced as IllegalMode (422) by the route and as a clean stderr
+        message by the CLI."""
+        if self.spec is None:
+            raise ValueError(
+                f"model {self.name!r} (autodiff engine) has no layer "
+                "sweep; sweep is a sequential-spec feature"
+            )
+
+    def check_layer(self, layer: str) -> None:
+        """Single source of truth for layer-name validation — surfaced as
+        UnknownLayer (422) by the route and as a clean stderr message by
+        the CLI."""
+        if layer not in self.layer_names:
+            raise ValueError(
+                f"model {self.name!r} has no projectable layer {layer!r}; "
+                f"known: {list(self.layer_names)}"
+            )
+
     def dream_forward(self, layers: tuple[str, ...]):
         """A resolution-robust forward for octave dreaming: DAG models
         as-is; sequential specs truncated below their flatten/dense head.
@@ -95,12 +115,9 @@ class ModelBundle:
         from ``layer`` down — the reference's always-on behaviour
         (SURVEY §2.2.3) as an explicit opt-in; the result dict then carries
         one entry per projected layer."""
+        if sweep:
+            self.check_sweep()
         if self.spec is None:
-            if sweep:
-                raise ValueError(
-                    f"model {self.name!r} (autodiff engine) has no layer "
-                    "sweep; sweep is a sequential-spec feature"
-                )
             backward_dtype = None
         key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep)
         if key not in self._vis_cache:

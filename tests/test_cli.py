@@ -84,3 +84,108 @@ def test_visualize_honours_weights_flag(tmp_path, png, capsys):
     capsys.readouterr()
     # zero weights -> zero activations -> no positive filter sums -> rc 1
     assert rc == 1
+
+
+def test_visualize_sweep_writes_one_grid_per_layer(tmp_path, monkeypatch, capsys):
+    """--sweep projects every layer from --layer down, one PNG per layer
+    (the reference's visualize_all_layers, app/deepdream.py:383-476)."""
+    import json
+
+    import jax
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.cli import main as cli_main
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving import models as m
+    from deconv_api_tpu.serving.models import spec_bundle
+    from tests.test_engine_parity import TINY
+
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    monkeypatch.setitem(m.REGISTRY, "tiny_vgg", lambda: spec_bundle(TINY, params))
+
+    src = tmp_path / "in.png"
+    rng = np.random.default_rng(0)
+    Image.fromarray(rng.integers(0, 255, (16, 16, 3), np.uint8), "RGB").save(src)
+    out = tmp_path / "sweep.png"
+    rc = cli_main(
+        [
+            "visualize", "--model", "tiny_vgg", "--image", str(src),
+            "--layer", "b2c1", "--sweep", "--output", str(out),
+        ]
+    )
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(result["outputs"]) <= {"b2c1", "b1p", "b1c2", "b1c1"}
+    assert result["outputs"], "no layers produced output"
+    for path in result["outputs"].values():
+        img = np.asarray(Image.open(path))
+        assert img.shape == (32, 32, 3)  # 2x2 grid of 16x16 tiles
+
+
+def test_visualize_sweep_rejects_autodiff_models(tmp_path, monkeypatch, capsys):
+    """--sweep on a DAG/autodiff bundle must exit cleanly (rc 2, message on
+    stderr), mirroring the route-level IllegalMode guard (app.py)."""
+    import jax
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.cli import main as cli_main
+    from deconv_api_tpu.models.apply import spec_forward
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving import models as m
+    from tests.test_engine_parity import TINY
+
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    fwd = spec_forward(TINY)
+    bundle = m.ModelBundle(
+        name="tiny_dag",
+        params=params,
+        image_size=16,
+        preprocess=lambda x: x,
+        layer_names=tuple(l.name for l in TINY.layers if l.kind != "input"),
+        dream_layers=(),
+        forward_fn=lambda p, x: fwd(p, x),
+    )
+    monkeypatch.setitem(m.REGISTRY, "tiny_dag", lambda: bundle)
+
+    src = tmp_path / "in.png"
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8), "RGB").save(src)
+    rc = cli_main(
+        [
+            "visualize", "--model", "tiny_dag", "--image", str(src),
+            "--layer", "b2c1", "--sweep", "--output", str(tmp_path / "o.png"),
+        ]
+    )
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "no layer sweep" in err
+
+
+def test_visualize_unknown_layer_clean_error(tmp_path, monkeypatch, capsys):
+    """An unknown --layer exits 2 with a message naming the valid layers,
+    not a traceback (parity with the route's UnknownLayer 422)."""
+    import jax
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.cli import main as cli_main
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving import models as m
+    from deconv_api_tpu.serving.models import spec_bundle
+    from tests.test_engine_parity import TINY
+
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    monkeypatch.setitem(m.REGISTRY, "tiny_vgg", lambda: spec_bundle(TINY, params))
+
+    src = tmp_path / "in.png"
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8), "RGB").save(src)
+    rc = cli_main(
+        [
+            "visualize", "--model", "tiny_vgg", "--image", str(src),
+            "--layer", "nope", "--output", str(tmp_path / "o.png"),
+        ]
+    )
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "no projectable layer" in err and "b2c1" in err

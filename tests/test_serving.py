@@ -24,10 +24,11 @@ import jax
 class ServiceFixture:
     """Runs the asyncio service in a background thread; exposes base_url."""
 
-    def __init__(self, cfg):
-        spec = TINY
-        params = init_params(spec, jax.random.PRNGKey(3))
-        self.service = DeconvService(cfg, spec=spec, params=params)
+    def __init__(self, cfg, service=None):
+        if service is None:
+            params = init_params(TINY, jax.random.PRNGKey(3))
+            service = DeconvService(cfg, spec=TINY, params=params)
+        self.service = service
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._started = threading.Event()
@@ -162,6 +163,38 @@ def test_v1_illegal_mode_422(server):
     )
     assert r.status_code == 422
     assert r.json()["error"] == "illegal_visualize_mode"
+
+
+def test_v1_sweep_on_autodiff_model_422(monkeypatch):
+    """sweep=true against a DAG/autodiff bundle must 422 at the route
+    (check_sweep -> IllegalMode), before decode/queue/dispatch."""
+    from deconv_api_tpu.models.apply import spec_forward
+    from deconv_api_tpu.serving import models as m
+
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    fwd = spec_forward(TINY)
+    bundle = m.ModelBundle(
+        name="tiny_dag",
+        params=params,
+        image_size=16,
+        preprocess=lambda x: x,
+        layer_names=tuple(l.name for l in TINY.layers if l.kind != "input"),
+        dream_layers=(),
+        forward_fn=lambda p, x: fwd(p, x),
+    )
+    monkeypatch.setitem(m.REGISTRY, "tiny_dag", lambda: bundle)
+    cfg = ServerConfig(
+        model="tiny_dag", image_size=16, max_batch=2,
+        batch_window_ms=1.0, compilation_cache_dir="",
+    )
+    with ServiceFixture(cfg, service=DeconvService(cfg)) as s:
+        r = httpx.post(
+            s.base_url + "/v1/deconv",
+            data={"file": _data_url(), "layer": "b2c1", "sweep": "true"},
+        )
+        assert r.status_code == 422
+        assert r.json()["error"] == "illegal_visualize_mode"
+        assert "no layer sweep" in r.json()["detail"]
 
 
 def test_ready_and_metrics_endpoints(server):
