@@ -1,0 +1,161 @@
+"""Empirical decomposition of the headline program's batch time.
+
+bench.py --breakdown estimates the forward/backward split by subtracting
+T(k=1) from T(k=8), which attributes ALL fixed per-iteration overhead
+(dispatch, tunnel round trips, checksum fetch) to the "forward" bucket.
+This probe separates the confounds by timing four programs directly:
+
+  A. conv-forward + selection, pools WITHOUT switch recording
+  B. conv-forward + selection, pools WITH switch recording (the real
+     forward half of the headline program; switches consumed via tiny
+     checksums so XLA cannot dead-code them)
+  C. the full headline program (k=8, bf16 backward)
+  D. program C again at 4x the iteration count
+
+Interpretation:
+  D/C       -> fixed per-iteration overhead (if ms/batch drops at 4x iters,
+               the difference is dispatch/tunnel cost, not device compute)
+  B - A     -> cost of switch recording in the forward pool layers
+  C - B     -> true cost of the 8-way vmapped backward projection chain
+  A         -> the irreducible conv-chain forward + top-k selection
+
+Timing methodology matches bench.py: per-iteration inputs differ (defeats
+relay caching); synchronization is a 4-byte scalar checksum fetch.
+
+Usage: python tools/bench_probe.py [--batch 64] [--iters 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _checksum(out):
+    return sum(
+        jnp.sum(leaf.astype(jnp.float32)) for leaf in jax.tree_util.tree_leaves(out)
+    )
+
+
+def build_programs(layer: str, backward_dtype: str):
+    from deconv_api_tpu import ops
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.engine.deconv import _up_step, _visualize_entry
+    from deconv_api_tpu.models.spec import entry_chain
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    spec, params = vgg16_init()
+    truncated = spec.truncated(layer)
+    entries = entry_chain(truncated)
+    model_names = set(spec.layer_names())
+    vis_indices = [i for i, e in enumerate(entries) if e.name in model_names]
+    vis_indices.reverse()
+    vis_indices.pop()
+    top_i = vis_indices[0]
+
+    def fwd_noswitch(params, image):
+        """A: forward + selection, pools as plain max (no argmax recording)."""
+        x = image[None]
+        for e in entries:
+            l = e.layer
+            if not e.is_companion_act and l.kind == "pool":
+                ph, pw = l.pool_size
+                b, h, w, c = x.shape
+                x = jnp.max(
+                    x[:, : h // ph * ph, : w // pw * pw, :].reshape(
+                        b, h // ph, ph, w // pw, pw, c
+                    ),
+                    axis=(2, 4),
+                )
+            else:
+                x = _up_step(e, params, x, {})
+        sums = jnp.sum(x, axis=tuple(range(x.ndim - 1)))
+        masked = jnp.where(sums > 0, sums, -jnp.inf)
+        top_sums, top_idx = jax.lax.top_k(masked, 8)
+        return top_sums, top_idx
+
+    def fwd_switch(params, image):
+        """B: the headline program's real forward half, switches kept live."""
+        x = image[None]
+        switches: dict = {}
+        for e in entries:
+            x = _up_step(e, params, x, switches)
+        sums = jnp.sum(x, axis=tuple(range(x.ndim - 1)))
+        masked = jnp.where(sums > 0, sums, -jnp.inf)
+        top_sums, top_idx = jax.lax.top_k(masked, 8)
+        # int8 argmax planes summed to one scalar each: keeps the switch
+        # computation live at negligible output cost
+        sw_sums = [jnp.sum(idx.astype(jnp.int32)) for idx, _ in switches.values()]
+        return top_sums, top_idx, sw_sums
+
+    full = get_visualizer(
+        spec, layer, 8, "all", True, sweep=False, batched=True,
+        backward_dtype=backward_dtype,
+    )
+    A = jax.jit(jax.vmap(fwd_noswitch, in_axes=(None, 0)))
+    B = jax.jit(jax.vmap(fwd_switch, in_axes=(None, 0)))
+    return spec, params, A, B, full
+
+
+def time_program(fn, params, batches) -> float:
+    """ms per batch, checksum-synchronized, warm (first call compiled away)."""
+    checksum = jax.jit(_checksum)
+    float(checksum(fn(params, batches[0])))  # compile
+    t0 = time.perf_counter()
+    sums = [checksum(fn(params, b)) for b in batches]
+    vals = [float(s) for s in sums]
+    dt = time.perf_counter() - t0
+    assert all(v == v for v in vals)
+    return dt / len(batches) * 1e3
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--layer", default="block5_conv1")
+    args = ap.parse_args()
+
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+
+    cfg = ServerConfig.from_env()
+    enable_compilation_cache(cfg)
+    dev = jax.devices()[0]
+    print(f"device: {dev}", flush=True)
+
+    spec, params, A, B, full = build_programs(args.layer, cfg.backward_dtype)
+
+    def make_batches(n, seed0=0):
+        return [
+            jax.random.normal(
+                jax.random.PRNGKey(seed0 + i), (args.batch, 224, 224, 3)
+            ).astype(jnp.float32)
+            for i in range(n)
+        ]
+
+    batches = make_batches(args.iters)
+    out = {"batch": args.batch, "iters": args.iters}
+    out["A_fwd_noswitch_ms"] = round(time_program(A, params, batches), 2)
+    out["B_fwd_switch_ms"] = round(time_program(B, params, batches), 2)
+    out["C_full_k8_ms"] = round(time_program(full, params, batches), 2)
+    big = make_batches(4 * args.iters, seed0=100)
+    out["D_full_k8_4x_iters_ms"] = round(time_program(full, params, big), 2)
+
+    out["switch_record_ms"] = round(out["B_fwd_switch_ms"] - out["A_fwd_noswitch_ms"], 2)
+    out["backward_ms"] = round(out["C_full_k8_ms"] - out["B_fwd_switch_ms"], 2)
+    out["fixed_overhead_ms_est"] = round(
+        (out["C_full_k8_ms"] - out["D_full_k8_4x_iters_ms"]) * 4 / 3, 2
+    )
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

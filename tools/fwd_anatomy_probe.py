@@ -1,0 +1,128 @@
+"""Isolate WHY the VGG16 forward half runs at ~27 TF/s on v5e.
+
+Variants timed at batch 64, 224x224, fp32 inputs:
+
+  conv_only      : the 11 truncated VGG16 convs back-to-back (stride-1 SAME,
+                   ReLU), spatial sizes follow the real model (pool layers
+                   replaced by plain 2x2 max) — NO vmap, batch dim native
+  conv_vmap      : same, but written per-sample and jax.vmap'ed with an
+                   inner singleton batch dim — the engine's actual structure
+  conv_bf16      : conv_only with bf16 activations end-to-end
+  first_two      : only block1 (2 convs at 224^2x64) + pool — the suspected
+                   low-intensity hot spot
+  rest           : everything after block1
+
+Prints ms/batch and achieved TF/s per variant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, args, iters=10, tag=""):
+    cs = jax.jit(lambda *a: jnp.sum(fn(*a).astype(jnp.float32)))
+    float(cs(*args(0)))
+    t0 = time.perf_counter()
+    vals = [cs(*args(i)) for i in range(iters)]
+    _ = [float(v) for v in vals]
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main() -> None:
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    enable_compilation_cache(ServerConfig.from_env())
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    spec, params = vgg16_init()
+    # (name, out_channels) for the truncated chain; pools as markers
+    chain = [
+        ("block1_conv1", "c"), ("block1_conv2", "c"), ("pool", "p"),
+        ("block2_conv1", "c"), ("block2_conv2", "c"), ("pool", "p"),
+        ("block3_conv1", "c"), ("block3_conv2", "c"), ("block3_conv3", "c"),
+        ("pool", "p"),
+        ("block4_conv1", "c"), ("block4_conv2", "c"), ("block4_conv3", "c"),
+        ("pool", "p"),
+        ("block5_conv1", "c"),
+    ]
+
+    def maxpool(x):
+        b, h, w, c = x.shape
+        return jnp.max(x.reshape(b, h // 2, 2, w // 2, 2, c), axis=(2, 4))
+
+    def run_chain(x, sub, dtype=None):
+        for name, kind in sub:
+            if kind == "p":
+                x = maxpool(x)
+            else:
+                w = params[name]["w"]
+                b = params[name]["b"]
+                if dtype is not None:
+                    w, b = w.astype(dtype), b.astype(dtype)
+                y = jax.lax.conv_general_dilated(
+                    x, w, (1, 1), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+                x = jax.nn.relu(y + b)
+        return x
+
+    batch = 64
+    def mk(dtype=jnp.float32, shape=(224, 224, 3)):
+        def args(i):
+            return (
+                jax.random.normal(jax.random.PRNGKey(i), (batch,) + shape).astype(
+                    dtype
+                ),
+            )
+        return args
+
+    # FLOP counts
+    def conv_flops(h, w, cin, cout):
+        return 2 * batch * h * w * 9 * cin * cout
+
+    flops_all = (
+        conv_flops(224, 224, 3, 64) + conv_flops(224, 224, 64, 64)
+        + conv_flops(112, 112, 64, 128) + conv_flops(112, 112, 128, 128)
+        + conv_flops(56, 56, 128, 256) + 2 * conv_flops(56, 56, 256, 256)
+        + conv_flops(28, 28, 256, 512) + 2 * conv_flops(28, 28, 512, 512)
+        + conv_flops(14, 14, 512, 512)
+    )
+    flops_b1 = conv_flops(224, 224, 3, 64) + conv_flops(224, 224, 64, 64)
+
+    out = {}
+
+    ms = timed(lambda x: run_chain(x, chain), mk())
+    out["conv_only_ms"] = round(ms, 2)
+    out["conv_only_tfs"] = round(flops_all / ms * 1e-9, 1)
+
+    single = jax.vmap(lambda x: run_chain(x[None], chain))
+    ms = timed(single, mk())
+    out["conv_vmap_ms"] = round(ms, 2)
+
+    ms = timed(lambda x: run_chain(x, chain, dtype=jnp.bfloat16), mk(jnp.bfloat16))
+    out["conv_bf16_ms"] = round(ms, 2)
+    out["conv_bf16_tfs"] = round(flops_all / ms * 1e-9, 1)
+
+    ms = timed(lambda x: run_chain(x, chain[:3]), mk())
+    out["block1_ms"] = round(ms, 2)
+    out["block1_tfs"] = round(flops_b1 / ms * 1e-9, 1)
+
+    ms = timed(lambda x: run_chain(x, chain[3:], ), mk(shape=(112, 112, 64)))
+    out["rest_ms"] = round(ms, 2)
+    out["rest_tfs"] = round((flops_all - flops_b1) / ms * 1e-9, 1)
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Measure the forward conv chain under different MXU precision settings.
+
+bench_probe.py showed the fp32 forward runs at ~27 TF/s — the multi-pass
+fp32 MXU rate — falsifying bench.py's assumption that fp32-typed convs
+execute as single-pass bf16 under default precision.  This probe times the
+forward half under:
+
+  f32_default   : fp32 inputs, no precision override (the current path)
+  f32_fastest   : fp32 inputs, jax.default_matmul_precision('bfloat16')
+  bf16_mul_f32acc: inputs/weights cast to bf16 per-conv with
+                   preferred_element_type=float32 — one MXU pass, fp32
+                   accumulator, fp32 activations throughout
+
+and reports max|Δ| of the block5_conv1 activations and whether the top-8
+selection matches f32_default, so the parity cost of each option is known
+before wiring it into the engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.engine.deconv import _up_step
+    from deconv_api_tpu.models.spec import entry_chain
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    enable_compilation_cache(ServerConfig.from_env())
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    spec, params = vgg16_init()
+    entries = entry_chain(spec.truncated("block5_conv1"))
+
+    def fwd(params, image):
+        x = image[None]
+        switches: dict = {}
+        for e in entries:
+            x = _up_step(e, params, x, switches)
+        sums = jnp.sum(x, axis=tuple(range(x.ndim - 1)))
+        masked = jnp.where(sums > 0, sums, -jnp.inf)
+        _, top_idx = jax.lax.top_k(masked, 8)
+        return x, top_idx
+
+    batch = 64
+    iters = 10
+    batches = [
+        jax.random.normal(jax.random.PRNGKey(i), (batch, 224, 224, 3))
+        for i in range(iters)
+    ]
+
+    F = jax.vmap(fwd, in_axes=(None, 0))
+
+    def timed(fn, tag):
+        cs = jax.jit(lambda p, b: jnp.sum(fn(p, b)[0].astype(jnp.float32)))
+        float(cs(params, batches[0]))
+        t0 = time.perf_counter()
+        vals = [cs(params, b) for b in batches]
+        _ = [float(v) for v in vals]
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        out, idx = jax.jit(fn)(params, batches[0])
+        return ms, jax.device_get(out), jax.device_get(idx)
+
+    results = {}
+
+    ms, ref_out, ref_idx = timed(F, "f32_default")
+    results["f32_default_ms"] = round(ms, 2)
+
+    with jax.default_matmul_precision("bfloat16"):
+        ms, out, idx = timed(F, "f32_fastest")
+    results["f32_fastest_ms"] = round(ms, 2)
+    results["f32_fastest_maxdiff"] = float(abs(out - ref_out).max())
+    results["f32_fastest_topk_match"] = bool((idx == ref_idx).all())
+
+    # bf16-multiply / fp32-accumulate: cast per-conv, activations stay fp32
+    import deconv_api_tpu.ops.conv as convmod
+
+    orig = convmod.conv2d
+
+    def conv2d_bf16acc(x, w, b, *, strides, padding):
+        y = jax.lax.conv_general_dilated(
+            x.astype(jnp.bfloat16),
+            w.astype(jnp.bfloat16),
+            window_strides=strides,
+            padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        return y + b.astype(jnp.float32)
+
+    convmod_conv_users = []
+    try:
+        convmod.conv2d = conv2d_bf16acc
+        # engine imported ops.conv2d via the ops namespace — patch there too
+        from deconv_api_tpu import ops as opsmod
+
+        opsmod.conv2d = conv2d_bf16acc
+        ms, out, idx = timed(F, "bf16_mul_f32acc")
+    finally:
+        convmod.conv2d = orig
+        from deconv_api_tpu import ops as opsmod
+
+        opsmod.conv2d = orig
+    results["bf16acc_ms"] = round(ms, 2)
+    results["bf16acc_maxdiff"] = float(abs(out - ref_out).max())
+    results["bf16acc_topk_match"] = bool((idx == ref_idx).all())
+    results["ref_out_absmax"] = float(abs(ref_out).max())
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Pin down the axon tunnel's per-dispatch/per-fetch overhead structure.
+
+fwd_anatomy_probe.py produced non-additive timings (block1 79ms + rest
+68ms vs full chain 88ms), implying a large fixed cost per timed iteration
+rather than device compute.  Candidates: the scalar-checksum fetch round
+trip (serialized per float()) and per-dispatch program-send cost.
+
+Measurements (batch-64 VGG16 forward chain + a trivial add program):
+
+  trivial_fetch_each : x+1 checksum, fetched every iter   -> RTT floor
+  fwd_fetch_each     : forward chain, fetched every iter  -> current method
+  fwd_fetch_last     : forward chain, dispatch N, fetch ONLY the last
+                       checksum -> amortized device time + 1 RTT
+  fwd_fetch_last_4x  : same at 4x iters (amortization check)
+
+If fetch_last << fetch_each, every probe so far has been over-reporting
+per-batch time by the tunnel RTT, and bench.py's methodology needs a
+pipelined variant (with the fetch-each number kept for honesty about
+per-request latency).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    enable_compilation_cache(ServerConfig.from_env())
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    spec, params = vgg16_init()
+    chain = [
+        "block1_conv1", "block1_conv2", "P",
+        "block2_conv1", "block2_conv2", "P",
+        "block3_conv1", "block3_conv2", "block3_conv3", "P",
+        "block4_conv1", "block4_conv2", "block4_conv3", "P",
+        "block5_conv1",
+    ]
+
+    def fwd(x):
+        for name in chain:
+            if name == "P":
+                b, h, w, c = x.shape
+                x = jnp.max(x.reshape(b, h // 2, 2, w // 2, 2, c), axis=(2, 4))
+            else:
+                y = jax.lax.conv_general_dilated(
+                    x, params[name]["w"], (1, 1), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+                x = jax.nn.relu(y + params[name]["b"])
+        return jnp.sum(x)
+
+    fwd_j = jax.jit(fwd)
+    triv_j = jax.jit(lambda x: jnp.sum(x[0, :4, :4, 0]) + 1.0)
+
+    batch = 64
+    def inputs(n, seed0=0):
+        return [
+            jax.random.normal(jax.random.PRNGKey(seed0 + i), (batch, 224, 224, 3))
+            for i in range(n)
+        ]
+
+    out = {}
+    xs = inputs(10)
+
+    float(triv_j(xs[0]))
+    t0 = time.perf_counter()
+    vals = [triv_j(x) for x in xs]
+    _ = [float(v) for v in vals]
+    out["trivial_fetch_each_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
+
+    float(fwd_j(xs[0]))
+    t0 = time.perf_counter()
+    vals = [fwd_j(x) for x in xs]
+    _ = [float(v) for v in vals]
+    out["fwd_fetch_each_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
+
+    t0 = time.perf_counter()
+    vals = [fwd_j(x) for x in xs]
+    _ = float(vals[-1])
+    out["fwd_fetch_last_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
+
+    xs4 = inputs(40, seed0=100)
+    t0 = time.perf_counter()
+    vals = [fwd_j(x) for x in xs4]
+    _ = float(vals[-1])
+    out["fwd_fetch_last_4x_ms"] = round((time.perf_counter() - t0) / 40 * 1e3, 2)
+
+    # dispatch-only cost: enqueue 10 programs, no fetch at all inside timer
+    t0 = time.perf_counter()
+    vals = [fwd_j(x) for x in xs]
+    out["dispatch_only_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
+    _ = float(vals[-1])
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
