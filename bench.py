@@ -17,10 +17,18 @@ line with an "error" field; the driver never sees an unparseable artifact.
 
 Timing methodology: `jax.block_until_ready` does not reliably await remote
 execution over the axon tunnel (observed returning in ~0.1 ms for work that
-measurably takes ~70 ms), so each iteration is synchronized by fetching a
-4-byte scalar checksum reduced from the full output pytree — the result
-cannot be produced without executing the whole program, and the transfer
-cost is negligible.  Inputs differ per iteration to defeat any
+measurably takes ~70 ms), so the run is synchronized by fetching a 4-byte
+scalar checksum reduced from the full output pytree — the result cannot be
+produced without executing the whole program.  Round-3 refinement
+(tools/tunnel_probe.py): the tunnel costs ~71 ms per host-side fetch, and
+fetching EVERY iteration serializes those round trips into the measurement
+(a trivial x+1 program "measures" 71 ms/iter that way).  The timed loop
+therefore dispatches all iterations (device executes them in dispatch
+order) and fetches ONE trailing checksum inside the timer — the total
+still covers every execution plus a single RTT, which a local-PCIe
+deployment would not pay.  The remaining checksums are fetched after the
+timer stops and validated for finiteness, so every iteration's output is
+still checked.  Inputs differ per iteration to defeat any
 content-addressed result caching in the relay.
 
 The measured path is mixed precision — fp32 forward/selection/switches,
@@ -223,8 +231,9 @@ def main_child(force_cpu: bool) -> None:
     with trace_cm:
         t0 = time.perf_counter()
         sums = [checksum(fn(params, b)) for b in batches]
-        vals = [float(s) for s in sums]
+        last = float(sums[-1])  # one in-timer fetch: covers all executions
         dt = time.perf_counter() - t0
+    vals = [float(s) for s in sums[:-1]] + [last]  # post-timer validation
     assert all(math.isfinite(v) for v in vals), "non-finite checksum"
     images_per_sec = batch * iters / dt
     ms_per_batch = dt / iters * 1e3
@@ -239,11 +248,14 @@ def main_child(force_cpu: bool) -> None:
     # the K projection chains bf16.  Two facts make the accounting honest:
     # (a) under JAX's default TPU matmul precision (no `precision=` set
     # anywhere in ops/ or engine/), fp32-typed convs execute as single-pass
-    # bf16-multiply/fp32-accumulate MXU ops, so 197 TF/s is the right MXU
-    # peak for BOTH halves — the bf16 backward's ~1.4x speedup comes from
-    # halved HBM traffic, not MXU rate; (b) if fp32 convs were true
-    # multi-pass fp32 (precision=HIGHEST), the fwd half's peak would be
-    # ~half — reported as mfu_pct_conservative to bracket the truth.
+    # bf16-multiply/fp32-accumulate MXU ops — VERIFIED empirically by
+    # tools/precision_probe.py (forcing default_matmul_precision('bfloat16')
+    # produces bit-identical activations and no speedup), so 197 TF/s is
+    # the right MXU peak for BOTH halves — the bf16 backward's ~1.4x
+    # speedup comes from halved HBM traffic, not MXU rate; (b) if fp32
+    # convs were ever lowered as true multi-pass fp32 (e.g. a future
+    # toolchain changing the default), the fwd half's peak would be ~half —
+    # still reported as mfu_pct_conservative to bracket that case.
     program_flops = _compiled_flops(fn, params, batches[0])
     if program_flops is None:
         try:
@@ -298,29 +310,29 @@ def main_child(force_cpu: bool) -> None:
                     "weighted peak"
                 )
 
-    # --- optional per-stage breakdown (VERDICT r2 item 2: where does the
-    # other ~half of peak go?).  Times the same program at top_k=1: the
-    # difference against top_k=8 isolates the per-projection chain cost,
-    # and T(k=1) minus one projection approximates forward+selection+
-    # dispatch overhead.  No profiler tooling needed over the tunnel.
+    # --- optional per-stage breakdown.  Round-3 method: time the forward
+    # half DIRECTLY (forward chain + selection, switch argmaxes kept live
+    # via tiny reductions so XLA cannot dead-code them) with the same
+    # pipelined loop; backward = full - forward.  The earlier k=1-vs-k=8
+    # subtraction attributed the tunnel RTT to "forward" (BASELINE.md
+    # tunnel-anatomy note) and is gone.
     if "--breakdown" in sys.argv and on_tpu:
-        fn1 = get_visualizer(
-            spec, layer, 1, "all", True, sweep=False, batched=True,
-            backward_dtype=cfg.backward_dtype or None,
-        )
-        float(checksum(fn1(params, batches[0])))  # compile
+        from deconv_api_tpu.engine.deconv import get_forward_only
+
+        fwd_b = get_forward_only(spec, layer, top_k=8, batched=True)
+        float(checksum(fwd_b(params, batches[0])))  # compile
         t0 = time.perf_counter()
-        for b in batches:
-            float(checksum(fn1(params, b)))
-        dt1 = (time.perf_counter() - t0) / iters
+        fsums = [checksum(fwd_b(params, b)) for b in batches]
+        float(fsums[-1])
+        dt_f = (time.perf_counter() - t0) / iters
         dt8 = dt / iters
-        per_proj_ms = (dt8 - dt1) / 7 * 1e3
-        fwd_ms = dt1 * 1e3 - per_proj_ms
+        fwd_ms = dt_f * 1e3
+        bwd_ms = (dt8 - dt_f) * 1e3
         log(
-            f"breakdown (batch {batch}): T(k=8)={dt8 * 1e3:.1f}ms "
-            f"T(k=1)={dt1 * 1e3:.1f}ms -> per-projection {per_proj_ms:.1f}ms, "
-            f"fwd+selection+overhead {fwd_ms:.1f}ms "
-            f"({100 * fwd_ms / (dt8 * 1e3):.0f}% of batch time)"
+            f"breakdown (batch {batch}): total={dt8 * 1e3:.1f}ms "
+            f"fwd+selection={fwd_ms:.1f}ms ({100 * fwd_ms / (dt8 * 1e3):.0f}%), "
+            f"backward k=8 projections={bwd_ms:.1f}ms "
+            f"({bwd_ms / 8:.1f}ms each if linear)"
         )
 
     suffix = "" if on_tpu else f" [{platform} fallback]"

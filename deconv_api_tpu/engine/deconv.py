@@ -64,7 +64,12 @@ def _up_step(e: Entry, params, x, switches):
     raise AssertionError(l.kind)
 
 
-def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool):
+def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool,
+               groups: int = 1):
+    """One downward (deconv) step.  With ``groups > 1`` the signal carries
+    `groups` independent projections packed into its channel dim
+    (_pack_boundary guarantees only relu/linear activations, stride-1 SAME
+    odd-kernel convs, pools and the input entry appear in that regime)."""
     l = e.layer
     if e.is_companion_act:
         # Deconvnet backward-ReLU: same activation on the way down
@@ -73,10 +78,19 @@ def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool):
     if l.kind == "input":
         return x
     if l.kind == "conv":
-        w = params[l.name]["w"].astype(x.dtype)
-        y = ops.conv2d_input_backward(
-            x, w, strides=l.strides, padding=l.padding, input_hw=prev_shape[1:3]
-        )
+        if groups > 1:
+            fk = ops.flip_kernel(params[l.name]["w"]).astype(x.dtype)
+            y = lax.conv_general_dilated(
+                x, jnp.concatenate([fk] * groups, axis=3), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=groups,
+            )
+        else:
+            w = params[l.name]["w"].astype(x.dtype)
+            y = ops.conv2d_input_backward(
+                x, w, strides=l.strides, padding=l.padding,
+                input_hw=prev_shape[1:3],
+            )
         if bug_compat:
             # The reference's config-clone keeps the fused activation in the
             # backward conv model too (SURVEY §2.2.2).
@@ -84,6 +98,8 @@ def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool):
         return y
     if l.kind == "pool":
         idx, out_hw = switches[e.name]
+        if groups > 1:
+            idx = jnp.tile(idx, (1, 1, 1, groups))
         return ops.unpool_with_argmax(x, idx, l.pool_size, out_hw)
     if l.kind == "flatten":
         return ops.unflatten(x, prev_shape[1:])
@@ -93,10 +109,100 @@ def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool):
     raise AssertionError(l.kind)
 
 
+def _down_chain(entries, params, ups, switches, x, start, stop_after,
+                bug_compat, groups: int = 1):
+    """Walk the backward chain from entry `start` down to `stop_after`
+    (exclusive) — the ONE walker shared by the per-projection (vmapped)
+    path and the K-packed tail, so the peephole and per-kind dispatch can
+    never drift between them."""
+    j = start
+    while j > stop_after:
+        e = entries[j]
+        # Peephole: a pool followed (downward) by the deconvnet
+        # backward-ReLU collapses into one fused unpool+ReLU op call.
+        # Equivalent on every dispatch path; matters for the pallas
+        # backend, whose opaque custom call would otherwise cost a
+        # full-res HBM pass for the separate elementwise ReLU.
+        if (
+            not e.is_companion_act
+            and e.layer.kind == "pool"
+            and j - 1 > stop_after
+            and entries[j - 1].is_companion_act
+            and entries[j - 1].layer.activation == "relu"
+        ):
+            sw_idx, out_hw = switches[e.name]
+            if groups > 1:
+                sw_idx = jnp.tile(sw_idx, (1, 1, 1, groups))
+            x = ops.unpool_with_argmax(
+                x, sw_idx, e.layer.pool_size, out_hw, fuse_relu=True
+            )
+            j -= 2
+            continue
+        prev_shape = ups[j - 1].shape if j > 0 else ups[0].shape
+        x = _down_step(
+            entries[j], params, x, switches, prev_shape, bug_compat,
+            groups=groups,
+        )
+        j -= 1
+    return x
+
+
+def _pack_boundary(entries, ups, i, max_chan: int) -> int:
+    """Largest entry index jb < i such that every entry in [0, jb] is safe
+    to run with the K projections packed into the channel dim AND the
+    signal entering jb has at most `max_chan` channels (below that, the
+    channel-minor dim under-fills the 128-wide lanes and XLA's layout
+    padding doubles both HBM bytes and MXU time — see BASELINE.md's
+    tunnel-anatomy section).  Returns -1 when no packed tail applies."""
+    safe = []
+    for e in entries:
+        l = e.layer
+        # Channel-separable activations only: softmax (axis=-1) would mix
+        # the K packed projections.  Covers both companion-act entries and
+        # the bug_compat activation applied after a packed backward conv.
+        act_ok = l.activation in ("relu", "linear")
+        if e.is_companion_act:
+            safe.append(act_ok)
+        elif l.kind in ("input", "pool"):
+            safe.append(True)
+        elif l.kind == "conv":
+            kh, kw = l.kernel_size
+            safe.append(
+                act_ok
+                and tuple(l.strides) == (1, 1)
+                and l.padding == "SAME"
+                and kh % 2 == 1
+                and kw % 2 == 1
+            )
+        else:  # dense / flatten: leave to the general vmapped path
+            safe.append(False)
+    jb = -1
+    for j in range(i - 1, -1, -1):
+        if all(safe[: j + 1]) and ups[j].shape[-1] <= max_chan:
+            jb = j
+            break
+    return jb
+
+
 def _visualize_entry(
-    entries, params, ups, switches, i, top_k, mode, bug_compat, backward_dtype
+    entries, params, ups, switches, i, top_k, mode, bug_compat, backward_dtype,
+    kpack_chan=0,
 ):
-    """Top-K selection + vmapped backward projection from entry index `i`."""
+    """Top-K selection + vmapped backward projection from entry index `i`.
+
+    With ``kpack_chan > 0`` the low-channel tail of the chain (entries
+    whose signal has <= kpack_chan channels, for VGG16 the whole block1
+    path at C=64) runs ONCE with the K projections packed into the
+    channel dimension — K x C fills the 128 vector lanes that the
+    per-projection layout leaves half-empty — using grouped convolutions
+    (`feature_group_count=K`, the flipped kernel tiled per group) and a
+    channel-tiled switch unpool.  Bit-exact in fp32 (CPU test); measured
+    END-TO-END SLOWER on a v5e-1 (280 vs 368 img/s at batch 32, and
+    +6.6 GB of XLA temps — OOM at batch 64) even though the isolated
+    block1 tail is 2.5x faster (tools/kpack_probe.py): the boundary
+    transposes and the grouped-conv lowering cost more than the lane
+    packing saves.  Default OFF; kept as the measurement harness for
+    revisiting on future toolchains (same policy as ops/pallas_pool.py)."""
     output = ups[i]
     n_chan = output.shape[-1]
     k = min(top_k, n_chan)
@@ -106,7 +212,13 @@ def _visualize_entry(
     top_sums, top_idx = lax.top_k(masked, k)
     valid = top_sums > 0
 
-    def backproject(idx):
+    jb = _pack_boundary(entries, ups, i, kpack_chan) if kpack_chan > 0 else -1
+
+    def backproject(idx, stop_after: int):
+        """One projection chain from entry i down to (but NOT including)
+        entry `stop_after`, matching _down_chain's exclusive bound; -1
+        walks the full chain to pixels.  With stop_after=jb the packed
+        tail owns entry jb itself."""
         chan = jax.nn.one_hot(idx, n_chan, dtype=output.dtype)
         fmap = jnp.sum(output * chan, axis=-1)  # == output[..., idx]
         if mode == "max":
@@ -118,33 +230,30 @@ def _visualize_entry(
             # Mixed precision: selection ran on the exact forward; the
             # projection chain (8/9 of the FLOPs) runs in e.g. bfloat16.
             x = x.astype(backward_dtype)
-        j = i
-        while j >= 0:
-            e = entries[j]
-            # Peephole: a pool followed (downward) by the deconvnet
-            # backward-ReLU collapses into one fused unpool+ReLU op call.
-            # Equivalent on every dispatch path; matters for the pallas
-            # backend, whose opaque custom call would otherwise cost a
-            # full-res HBM pass for the separate elementwise ReLU.
-            if (
-                not e.is_companion_act
-                and e.layer.kind == "pool"
-                and j > 0
-                and entries[j - 1].is_companion_act
-                and entries[j - 1].layer.activation == "relu"
-            ):
-                sw_idx, out_hw = switches[e.name]
-                x = ops.unpool_with_argmax(
-                    x, sw_idx, e.layer.pool_size, out_hw, fuse_relu=True
-                )
-                j -= 2
-                continue
-            prev_shape = ups[j - 1].shape if j > 0 else ups[0].shape
-            x = _down_step(entries[j], params, x, switches, prev_shape, bug_compat)
-            j -= 1
-        return x.astype(output.dtype)
+        return _down_chain(
+            entries, params, ups, switches, x, i, stop_after, bug_compat
+        )
 
-    images = jax.vmap(backproject)(top_idx)  # (K, 1, H, W, C)
+    def packed_tail(xk):
+        """Run entries[jb..0] once with K packed into channels.
+
+        xk: (K, 1, h, w, c) -> (K, 1, H0, W0, C0)."""
+        kk, one, h, w, c = xk.shape
+        x = jnp.transpose(xk, (1, 2, 3, 0, 4)).reshape(one, h, w, kk * c)
+        x = _down_chain(
+            entries, params, ups, switches, x, jb, -1, bug_compat, groups=kk
+        )
+        c0 = x.shape[-1] // kk
+        return jnp.transpose(
+            x.reshape(one, x.shape[1], x.shape[2], kk, c0), (3, 0, 1, 2, 4)
+        )
+
+    if jb < 0:
+        images = jax.vmap(lambda t: backproject(t, -1))(top_idx)  # (K, 1, H, W, C)
+    else:
+        upper = jax.vmap(lambda t: backproject(t, jb))(top_idx)  # (K, 1, h, w, c)
+        images = packed_tail(upper)
+    images = images.astype(output.dtype)
     return {
         "images": images[:, 0],  # (K, H, W, C) — reference squeezes batch
         "indices": top_idx,
@@ -153,7 +262,6 @@ def _visualize_entry(
     }
 
 
-@lru_cache(maxsize=128)
 def get_visualizer(
     spec: ModelSpec,
     layer_name: str,
@@ -163,6 +271,7 @@ def get_visualizer(
     sweep: bool = False,
     batched: bool = False,
     backward_dtype: str | None = None,
+    kpack_chan: int | None = None,
 ):
     """Build (and cache) the jitted visualizer for a static configuration.
 
@@ -173,7 +282,35 @@ def get_visualizer(
     ``backward_dtype`` (e.g. ``"bfloat16"``) runs only the backward
     projection chain in that dtype: filter selection and switches stay
     exact, trading a little projection precision for MXU throughput.
+    ``kpack_chan`` sets the channel threshold below which the backward
+    tail runs K-packed into the channel dim (see ``_visualize_entry`` —
+    measured slower end-to-end, so the default is OFF); ``None`` reads
+    ``DECONV_KPACK_CHAN`` (default 0 = disabled).  The env var is resolved
+    HERE, outside the cache, so changing it between calls always takes
+    effect (the cache never keys on a stale environment read).
     """
+    if kpack_chan is None:
+        import os
+
+        kpack_chan = int(os.environ.get("DECONV_KPACK_CHAN", "0"))
+    return _get_visualizer_cached(
+        spec, layer_name, top_k, mode, bug_compat, sweep, batched,
+        backward_dtype, kpack_chan,
+    )
+
+
+@lru_cache(maxsize=128)
+def _get_visualizer_cached(
+    spec: ModelSpec,
+    layer_name: str,
+    top_k: int,
+    mode: str,
+    bug_compat: bool,
+    sweep: bool,
+    batched: bool,
+    backward_dtype: str | None,
+    kpack_chan: int,
+):
     if mode not in ("all", "max"):
         # The reference sys.exit()s the server here (app/deepdream.py:458-460);
         # we raise instead (error taxonomy, SURVEY §5).
@@ -205,13 +342,38 @@ def get_visualizer(
         return {
             entries[i].name: _visualize_entry(
                 entries, params, ups, switches, i, top_k, mode, bug_compat,
-                bwd_dtype,
+                bwd_dtype, kpack_chan=kpack_chan,
             )
             for i in vis_indices
         }
 
     fn = jax.vmap(single, in_axes=(None, 0)) if batched else single
     return jax.jit(fn)
+
+
+def get_forward_only(spec: ModelSpec, layer_name: str, top_k: int = 8,
+                     batched: bool = False):
+    """Jitted forward chain + top-K selection ONLY — the engine's forward
+    half with the pool switch argmaxes kept live via tiny int32 reductions
+    (so XLA cannot dead-code the switch recording that the full program
+    pays for).  This is the single forward-prober shared by bench.py
+    --breakdown and tools/*_probe.py: it is built from the same
+    entry_chain/_up_step the real visualizer traces, so the probed forward
+    can never drift from the measured program."""
+    entries = entry_chain(spec.truncated(layer_name))
+
+    def fwd(params, image):
+        x = image[None]
+        switches: dict[str, jnp.ndarray] = {}
+        for e in entries:
+            x = _up_step(e, params, x, switches)
+        sums = jnp.sum(x, axis=tuple(range(x.ndim - 1)))
+        masked = jnp.where(sums > 0, sums, -jnp.inf)
+        top_sums, top_idx = lax.top_k(masked, min(top_k, x.shape[-1]))
+        sw = [jnp.sum(i.astype(jnp.int32)) for i, _ in switches.values()]
+        return top_sums, top_idx, sw
+
+    return jax.jit(jax.vmap(fwd, in_axes=(None, 0)) if batched else fwd)
 
 
 def visualize(

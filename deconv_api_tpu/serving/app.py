@@ -235,7 +235,13 @@ class DeconvService:
                 name: {k: np.asarray(v) for k, v in entry.items()}
                 for name, entry in out_all.items()
             }
-            src, dst = ("grid", "grid") if post == "grid" else ("tiles", "images")
+            # post=None (raw library/bench surface) keeps the engine's
+            # "images" key; grid/tiles are the fused device-postprocess forms
+            src, dst = {
+                "grid": ("grid", "grid"),
+                "tiles": ("tiles", "images"),
+                None: ("images", "images"),
+            }[post]
             return [
                 {
                     name: {

@@ -201,3 +201,59 @@ def test_mid_size_depth_parity():
             np.asarray(got["images"][k]), want[k], rtol=1e-3, atol=1e-3,
             err_msg=f"b3c3 filter rank {k}",
         )
+
+
+class TestKPack:
+    """The opt-in K-packed backward tail (engine/deconv.py kpack_chan)
+    must be exactly equivalent to the vmapped chain — grouped convs with
+    a per-group-identical tiled kernel reduce in the same order."""
+
+    def test_kpack_matches_default_fp32(self, setup):
+        from deconv_api_tpu.engine import get_visualizer
+
+        params, _, img = setup
+        batch = jnp.asarray(np.stack([img, img[::-1]]))
+        # TINY's low-channel tail: thresholds cover b1 (8ch) and b2 (12ch)
+        for layer_name, kc in [("b2c1", 8), ("b2c1", 16), ("b1c2", 16)]:
+            base = get_visualizer(TINY, layer_name, 4, "all", True, batched=True,
+                                  kpack_chan=0)(params, batch)[layer_name]
+            pack = get_visualizer(TINY, layer_name, 4, "all", True, batched=True,
+                                  kpack_chan=kc)(params, batch)[layer_name]
+            np.testing.assert_array_equal(
+                np.asarray(base["indices"]), np.asarray(pack["indices"])
+            )
+            np.testing.assert_allclose(
+                np.asarray(base["images"]), np.asarray(pack["images"]),
+                rtol=0, atol=1e-6,
+            )
+
+    def test_kpack_bf16_backward_close(self, setup):
+        from deconv_api_tpu.engine import get_visualizer
+
+        params, _, img = setup
+        batch = jnp.asarray(img)[None]
+        base = get_visualizer(TINY, "b2c1", 4, "all", True, batched=True,
+                              backward_dtype="bfloat16", kpack_chan=0)(
+            params, batch)["b2c1"]
+        pack = get_visualizer(TINY, "b2c1", 4, "all", True, batched=True,
+                              backward_dtype="bfloat16", kpack_chan=16)(
+            params, batch)["b2c1"]
+        a = np.asarray(base["images"], np.float32)
+        b = np.asarray(pack["images"], np.float32)
+        scale = max(np.abs(a).max(), 1e-6)
+        assert np.abs(a - b).max() / scale < 1e-2
+
+    def test_kpack_sweep_and_max_mode(self, setup):
+        from deconv_api_tpu.engine import get_visualizer
+
+        params, _, img = setup
+        batch = jnp.asarray(img)[None]
+        base = get_visualizer(TINY, "b2c1", 4, "max", True, sweep=True,
+                              batched=True, kpack_chan=0)(params, batch)
+        pack = get_visualizer(TINY, "b2c1", 4, "max", True, sweep=True,
+                              batched=True, kpack_chan=16)(params, batch)
+        for name in base:
+            np.testing.assert_allclose(
+                np.asarray(base[name]["images"]),
+                np.asarray(pack[name]["images"]), rtol=0, atol=1e-6,
+            )

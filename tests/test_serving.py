@@ -624,3 +624,22 @@ def test_dream_group_results_align_after_padding(server):
     # distinct inputs -> distinct dreamed images
     imgs = {results[i]["image"] for i in range(3)}
     assert len(imgs) == 3
+
+
+def test_run_batch_sweep_raw_post_none(tmp_path):
+    """sweep=True with post=None (the raw library/bench surface documented
+    by batched_visualizer) must return the engine's raw 'images' key — it
+    used to KeyError on 'tiles' (r3 review finding)."""
+    import jax
+
+    cfg = ServerConfig(
+        image_size=16, warmup_all_buckets=False, compilation_cache_dir=""
+    )
+    params = init_params(TINY, jax.random.PRNGKey(5))
+    svc = DeconvService(cfg, spec=TINY, params=params)
+    img = np.zeros((16, 16, 3), np.float32)
+    (res,) = svc._run_batch(("b2c1", "all", 2, None, True), [img])
+    assert isinstance(res, dict) and "b2c1" in res
+    for name, entry in res.items():
+        assert entry["images"].ndim == 4  # (K, H, W, C) raw projections
+        assert entry["indices"].shape == (2,)

@@ -46,23 +46,18 @@ def _checksum(out):
 
 
 def build_programs(layer: str, backward_dtype: str):
-    from deconv_api_tpu import ops
     from deconv_api_tpu.engine import get_visualizer
-    from deconv_api_tpu.engine.deconv import _up_step, _visualize_entry
+    from deconv_api_tpu.engine.deconv import _up_step, get_forward_only
     from deconv_api_tpu.models.spec import entry_chain
     from deconv_api_tpu.models.vgg16 import vgg16_init
 
     spec, params = vgg16_init()
-    truncated = spec.truncated(layer)
-    entries = entry_chain(truncated)
-    model_names = set(spec.layer_names())
-    vis_indices = [i for i, e in enumerate(entries) if e.name in model_names]
-    vis_indices.reverse()
-    vis_indices.pop()
-    top_i = vis_indices[0]
+    entries = entry_chain(spec.truncated(layer))
 
     def fwd_noswitch(params, image):
-        """A: forward + selection, pools as plain max (no argmax recording)."""
+        """A: forward + selection, pools as plain max (no argmax recording).
+        Intentionally NOT the shared get_forward_only prober — this variant
+        exists to isolate the cost of switch recording by removing it."""
         x = image[None]
         for e in entries:
             l = e.layer
@@ -82,26 +77,13 @@ def build_programs(layer: str, backward_dtype: str):
         top_sums, top_idx = jax.lax.top_k(masked, 8)
         return top_sums, top_idx
 
-    def fwd_switch(params, image):
-        """B: the headline program's real forward half, switches kept live."""
-        x = image[None]
-        switches: dict = {}
-        for e in entries:
-            x = _up_step(e, params, x, switches)
-        sums = jnp.sum(x, axis=tuple(range(x.ndim - 1)))
-        masked = jnp.where(sums > 0, sums, -jnp.inf)
-        top_sums, top_idx = jax.lax.top_k(masked, 8)
-        # int8 argmax planes summed to one scalar each: keeps the switch
-        # computation live at negligible output cost
-        sw_sums = [jnp.sum(idx.astype(jnp.int32)) for idx, _ in switches.values()]
-        return top_sums, top_idx, sw_sums
-
     full = get_visualizer(
         spec, layer, 8, "all", True, sweep=False, batched=True,
         backward_dtype=backward_dtype,
     )
     A = jax.jit(jax.vmap(fwd_noswitch, in_axes=(None, 0)))
-    B = jax.jit(jax.vmap(fwd_switch, in_axes=(None, 0)))
+    # B: the headline program's real forward half — the engine's own prober
+    B = get_forward_only(spec, layer, top_k=8, batched=True)
     return spec, params, A, B, full
 
 

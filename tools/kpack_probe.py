@@ -1,0 +1,132 @@
+"""Prototype: pack the K=8 projections into the channel dim for the
+high-resolution backward tail (block1), where C=64 wastes half the
+128-wide vector lanes (XLA pads the channel-minor dim to 128, doubling
+both HBM bytes and MXU time).
+
+Current engine layout (vmap over K): block1 backward tensors are
+(B*K, 224, 224, 64) — lanes half-empty.
+Packed layout: (B, 224, 224, 64*K=512) — lanes full; the per-K convs
+become ONE grouped conv (feature_group_count=K) with the flipped kernel
+tiled K times; the unpool switch index broadcasts across K groups.
+
+This probe times the block1 backward chain both ways at headline shapes
+and checks bit-equality, to decide whether to wire the layout switch
+into the engine at the block2->block1 boundary.
+
+Chain (from the unpool1 input down, bf16):
+  unpool 112->224 (C=64, switches) -> relu -> conv1_2-bwd (64->64 @224^2)
+  -> relu -> conv1_1-bwd (64->3) -> fp32 out
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+B, K = 32, 8
+H = W = 112  # pre-unpool spatial
+
+
+def main() -> None:
+    from deconv_api_tpu import ops
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+
+    enable_compilation_cache(ServerConfig.from_env())
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    spec, params = vgg16_init()
+    w12 = params["block1_conv2"]["w"]  # (3,3,64,64) HWIO
+    w11 = params["block1_conv1"]["w"]  # (3,3,3,64)
+
+    key = jax.random.PRNGKey(0)
+    y = jax.random.normal(key, (B, K, H, W, 64)).astype(jnp.bfloat16)
+    # compact int8 switches for the 2x2 pool over a 224x224x64 input
+    idx = jax.random.randint(jax.random.PRNGKey(1), (B, 1, H, W, 64), 0, 4).astype(
+        jnp.int8
+    )
+
+    from deconv_api_tpu.ops.conv import flip_kernel
+
+    f12 = flip_kernel(w12).astype(jnp.bfloat16)  # (3,3,64,64)
+    f11 = flip_kernel(w11).astype(jnp.bfloat16)  # (3,3,64,3)
+
+    def chain_vmapk(y, idx):
+        """Current form: K in the batch dim via vmap (over a singleton)."""
+
+        def one(yk):  # (B_like=1? no — per-k slice) (B,H,W,64)
+            x = ops.unpool_with_argmax(yk, idx[:, 0], (2, 2), (224, 224), fuse_relu=True)
+            x = jax.lax.conv_general_dilated(
+                x, f12, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+            )
+            x = jax.nn.relu(x)
+            x = jax.lax.conv_general_dilated(
+                x, f11, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+            )
+            return x.astype(jnp.float32)
+
+        return jax.vmap(one, in_axes=1, out_axes=1)(y)
+
+    def chain_packed(y, idx):
+        """K packed into channels: (B,H,W,64K), grouped convs."""
+        yp = jnp.transpose(y, (0, 2, 3, 1, 4)).reshape(B, H, W, K * 64)
+        idxp = jnp.tile(idx[:, 0], (1, 1, 1, K))
+        x = ops.unpool_with_argmax(yp, idxp, (2, 2), (224, 224), fuse_relu=True)
+        # grouped conv: each K-group convolves with the same flipped kernel
+        f12g = jnp.concatenate([f12] * K, axis=3)  # (3,3,64,64K), groups=K
+        x = jax.lax.conv_general_dilated(
+            x, f12g, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=K,
+        )
+        x = jax.nn.relu(x)
+        f11g = jnp.concatenate([f11] * K, axis=3)  # (3,3,64,3K)
+        x = jax.lax.conv_general_dilated(
+            x, f11g, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=K,
+        )  # (B,224,224,3K)
+        x = x.reshape(B, 224, 224, K, 3).transpose(0, 3, 1, 2, 4)
+        return x.astype(jnp.float32)
+
+    # distinct inputs per iteration: defeats any content-addressed result
+    # caching in the relay (same rule as bench.py's timed loop)
+    ys = [
+        jax.random.normal(jax.random.PRNGKey(10 + i), (B, K, H, W, 64)).astype(
+            jnp.bfloat16
+        )
+        for i in range(10)
+    ]
+
+    def timed(fn, iters=10):
+        cs = jax.jit(lambda y, i: jnp.sum(fn(y, i).astype(jnp.float32)))
+        float(cs(ys[0], idx))
+        t0 = time.perf_counter()
+        vals = [cs(ys[i], idx) for i in range(iters)]
+        _ = float(vals[-1])
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        assert all(float(v) == float(v) for v in vals[:-1])
+        return ms
+
+    a = jax.jit(chain_vmapk)(y, idx)
+    b = jax.jit(chain_packed)(y, idx)
+    # a is (B,K,224,224,3)? vmap out_axes=1 with per-k (B,224,224,3) -> (B,K,...)
+    diff = float(jnp.abs(a - b).max())
+
+    out = {
+        "vmapk_ms": round(timed(chain_vmapk), 2),
+        "packed_ms": round(timed(chain_packed), 2),
+        "max_abs_diff": diff,
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

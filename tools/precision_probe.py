@@ -42,6 +42,8 @@ def main() -> None:
     entries = entry_chain(spec.truncated("block5_conv1"))
 
     def fwd(params, image):
+        # Not the shared get_forward_only prober: this probe needs the RAW
+        # block5_conv1 activations back to diff them across precision modes.
         x = image[None]
         switches: dict = {}
         for e in entries:
@@ -97,7 +99,6 @@ def main() -> None:
         )
         return y + b.astype(jnp.float32)
 
-    convmod_conv_users = []
     try:
         convmod.conv2d = conv2d_bf16acc
         # engine imported ops.conv2d via the ops namespace — patch there too

@@ -71,6 +71,11 @@ def main() -> None:
             for i in range(n)
         ]
 
+    # Every loop gets a FRESH input set (disjoint seeds): a relay that
+    # content-caches results can never serve a hit, so fetch_last's speed
+    # is real execution, not cache returns.  Cross-check on the numbers:
+    # fetch_last measured ~23 ms/iter = 10 executions + 1 RTT (~71 ms) —
+    # if results were cache hits the total would collapse to ~1 RTT.
     out = {}
     xs = inputs(10)
 
@@ -80,16 +85,19 @@ def main() -> None:
     _ = [float(v) for v in vals]
     out["trivial_fetch_each_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
 
+    xs = inputs(10, seed0=20)
     float(fwd_j(xs[0]))
     t0 = time.perf_counter()
     vals = [fwd_j(x) for x in xs]
     _ = [float(v) for v in vals]
     out["fwd_fetch_each_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
 
+    xs = inputs(10, seed0=40)
     t0 = time.perf_counter()
     vals = [fwd_j(x) for x in xs]
     _ = float(vals[-1])
     out["fwd_fetch_last_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
+    assert all(float(v) == float(v) for v in vals[:-1])
 
     xs4 = inputs(40, seed0=100)
     t0 = time.perf_counter()
@@ -98,6 +106,7 @@ def main() -> None:
     out["fwd_fetch_last_4x_ms"] = round((time.perf_counter() - t0) / 40 * 1e3, 2)
 
     # dispatch-only cost: enqueue 10 programs, no fetch at all inside timer
+    xs = inputs(10, seed0=200)
     t0 = time.perf_counter()
     vals = [fwd_j(x) for x in xs]
     out["dispatch_only_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
