@@ -48,6 +48,11 @@ class ServerConfig:
     # time exceeds this multiple of request_timeout_s (callers would only
     # wait out the timeout and 504 anyway).  0 disables shedding.
     shed_factor: float = 1.0
+    # Batch pipelining: how many dispatched-but-unfetched device batches a
+    # dispatcher may hold (serving/batcher.py).  2 overlaps batch N's host
+    # result-fetch (+~71 ms tunnel RTT — BASELINE.md) with batch N+1's
+    # device execution; 1 restores the serial dispatch->fetch loop.
+    pipeline_depth: int = 2
     # Concurrent dreams with identical (layers, steps, octaves, lr) batch
     # into one octave pyramid (engine/deepdream.py:deepdream_batch); the
     # window is wide because dreams run for seconds anyway.

@@ -73,3 +73,12 @@ class Overloaded(DeconvError):
 class RequestTimeout(DeconvError):
     status = 504
     code = "request_timeout"
+
+
+class Unavailable(DeconvError):
+    """The dispatcher is shutting down: in-flight requests whose batch can
+    no longer deliver results fail immediately instead of hanging to a
+    full request-timeout 504 (serving/batcher.py:_execute_pipelined)."""
+
+    status = 503
+    code = "unavailable"

@@ -122,6 +122,8 @@ class DeconvService:
             request_timeout_s=self.cfg.request_timeout_s,
             metrics=self.metrics,
             shed_factor=self.cfg.shed_factor,
+            dispatch_runner=self._dispatch_batch,
+            pipeline_depth=self.cfg.pipeline_depth,
         )
         # Dreams run for seconds-to-minutes; a separate dispatcher keeps them
         # from head-of-line blocking the deconv queue (the device interleaves
@@ -135,6 +137,8 @@ class DeconvService:
             request_timeout_s=self.cfg.dream_timeout_s,
             metrics=self.dream_metrics,
             shed_factor=self.cfg.shed_factor,
+            dispatch_runner=self._dispatch_batch,
+            pipeline_depth=self.cfg.pipeline_depth,
         )
         # Sweeps (~13x a single-layer request, large first-use compile) get
         # the dream treatment: own dispatcher so they never head-of-line
@@ -148,6 +152,8 @@ class DeconvService:
             request_timeout_s=self.cfg.sweep_timeout_s,
             metrics=self.sweep_metrics,
             shed_factor=self.cfg.shed_factor,
+            dispatch_runner=self._dispatch_batch,
+            pipeline_depth=self.cfg.pipeline_depth,
         )
         self.server = HttpServer(
             idle_timeout_s=self.cfg.conn_idle_timeout_s,
@@ -190,22 +196,40 @@ class DeconvService:
             self._profile_lock.release()
 
     def _run_batch(self, key, images: list[np.ndarray]):
-        """Execute one request group as a single device dispatch.
+        """Execute one request group as a single device dispatch and block
+        for its results.
 
         Runs in a worker thread (never on the event loop).  Deconv batches
         are padded to a power-of-two bucket so XLA compiles at most
         log2(max_batch)+1 batch shapes per key; dream groups run as ONE
-        batched multi-octave ascent (see _run_dream), bucket-padded the
-        same way.
+        batched multi-octave ascent (see _dispatch_dream), bucket-padded
+        the same way.
         """
         with self._profile_scope():
-            return self._run_batch_inner(key, images)
+            return self._dispatch_inner(key, images)()
 
-    def _run_batch_inner(self, key, images: list[np.ndarray]):
+    def _dispatch_batch(self, key, images: list[np.ndarray]):
+        """Pipelined form: dispatch the device program WITHOUT blocking and
+        return a thunk that materialises the per-request results (one
+        device_get).  The dispatcher calls the thunk in a separate fetch
+        task so the device can start the next batch while this one's
+        results stream back — over the axon tunnel each fetch costs ~71 ms
+        of round trip (BASELINE.md tunnel anatomy), and even on local PCIe
+        the host-side decode/encode no longer stalls the device.
+
+        While a jax.profiler capture budget is armed the batch falls back
+        to the blocking path INSIDE the trace scope, so captures keep
+        covering device execution, not just the dispatch."""
+        if self._profile_remaining > 0:
+            res = self._run_batch(key, images)
+            return lambda: res
+        return self._dispatch_inner(key, images)
+
+    def _dispatch_inner(self, key, images: list[np.ndarray]):
         import jax.numpy as jnp
 
         if key[0] == "__dream__":
-            return self._run_dream(key, images)
+            return self._dispatch_dream(key, images)
         # 4-tuple: single-layer (the default); 5-tuple adds sweep=True
         layer_name, mode, top_k, post, *rest = key
         sweep = bool(rest[0]) if rest else False
@@ -228,47 +252,54 @@ class DeconvService:
             jnp.bfloat16 if self.cfg.dtype == "bfloat16" else jnp.float32
         )
         out_all = fn(self.bundle.params, jnp.asarray(batch, dtype=fwd_dtype))
-        if sweep:
-            # one entry per projected layer (reference §2.2.3 semantics);
-            # materialise each layer's tensors once, slice per image
-            host = {
-                name: {k: np.asarray(v) for k, v in entry.items()}
-                for name, entry in out_all.items()
-            }
-            # post=None (raw library/bench surface) keeps the engine's
-            # "images" key; grid/tiles are the fused device-postprocess forms
-            src, dst = {
-                "grid": ("grid", "grid"),
-                "tiles": ("tiles", "images"),
-                None: ("images", "images"),
-            }[post]
-            return [
-                {
-                    name: {
-                        dst: e[src][i],
-                        "valid": e["valid"][i],
-                        "indices": e["indices"][i],
-                    }
-                    for name, e in host.items()
-                }
-                for i in range(len(images))
-            ]
-        out = out_all[layer_name]
-        valid = np.asarray(out["valid"])  # (B, K)
-        indices = np.asarray(out["indices"])
-        if post == "grid":
-            grids = np.asarray(out["grid"])
-            return [
-                {"grid": grids[i], "valid": valid[i], "indices": indices[i]}
-                for i in range(len(images))
-            ]
-        tiles = np.asarray(out["tiles"])
-        return [
-            {"images": tiles[i], "valid": valid[i], "indices": indices[i]}
-            for i in range(len(images))
-        ]
+        n = len(images)
 
-    def _run_dream(self, key, images: list[np.ndarray]):
+        def materialise():
+            # ONE device_get per batch for ALL result leaves: per-leaf
+            # np.asarray would serialize one ~71 ms tunnel round trip EACH
+            # (3 per single-layer batch, 3 x n_layers per sweep —
+            # BASELINE.md tunnel anatomy)
+            import jax
+
+            if sweep:
+                host = jax.device_get(out_all)
+                # post=None (raw library/bench surface) keeps the engine's
+                # "images" key; grid/tiles are the fused device-postprocess
+                # forms
+                src, dst = {
+                    "grid": ("grid", "grid"),
+                    "tiles": ("tiles", "images"),
+                    None: ("images", "images"),
+                }[post]
+                return [
+                    {
+                        name: {
+                            dst: e[src][i],
+                            "valid": e["valid"][i],
+                            "indices": e["indices"][i],
+                        }
+                        for name, e in host.items()
+                    }
+                    for i in range(n)
+                ]
+            out = jax.device_get(out_all[layer_name])
+            valid = out["valid"]  # (B, K)
+            indices = out["indices"]
+            if post == "grid":
+                grids = out["grid"]
+                return [
+                    {"grid": grids[i], "valid": valid[i], "indices": indices[i]}
+                    for i in range(n)
+                ]
+            tiles = out["tiles"]
+            return [
+                {"images": tiles[i], "valid": valid[i], "indices": indices[i]}
+                for i in range(n)
+            ]
+
+        return materialise
+
+    def _dispatch_dream(self, key, images: list[np.ndarray]):
         from deconv_api_tpu.engine import deepdream_batch
 
         _, layers, steps, octaves, lr = key
@@ -297,11 +328,15 @@ class DeconvService:
             min_size=self.bundle.min_dream_size,
             mesh=self.mesh,
         )
-        out = np.asarray(out)
-        losses = np.asarray(losses)
-        return [
-            {"image": out[i], "loss": float(losses[i])} for i in range(len(images))
-        ]
+        n = len(images)
+
+        def materialise():
+            import jax
+
+            o, ls = jax.device_get((out, losses))  # one host transfer
+            return [{"image": o[i], "loss": float(ls[i])} for i in range(n)]
+
+        return materialise
 
     def _round_to_dp(self, bucket: int) -> int:
         """Round a bucket up to a multiple of the mesh's dp axis so every

@@ -3,14 +3,22 @@ device batches.
 
 The reference's endpoint is `async def` over seconds of blocking compute, so
 its true concurrency is 1 (SURVEY §2.2.5).  Here requests enqueue a future
-and a single dispatcher task owns the device: it drains the queue up to
-`max_batch` (waiting at most `window_ms` for stragglers), groups by
-(layer, mode) — each group is one compiled executable — pads the image batch
-to a power-of-two bucket so XLA never sees a new batch shape, runs the
-executable in a worker thread (the event loop stays free), and resolves the
-futures.  One task owning the device also removes the reference's
+and a single dispatcher task drains the queue up to `max_batch` (waiting at
+most `window_ms` for stragglers), groups by (layer, mode) — each group is
+one compiled executable — and pads the image batch to a power-of-two bucket
+so XLA never sees a new batch shape.  All device DISPATCH happens from that
+one task (in dispatch order), which also removes the reference's
 shared-graph thread-safety hack (`tb._SYMBOLIC_SCOPE`, app/main.py:54;
 SURVEY §5 race-detection row).
+
+Execution is PIPELINED (round 3): the dispatcher enqueues a batch's device
+program without blocking and farms the result fetch (device_get + host
+postprocess, ~71 ms of tunnel round trip remote — BASELINE.md tunnel
+anatomy) out to a bounded set of fetch tasks, so batch N+1 executes on the
+device while batch N's results stream back.  `pipeline_depth` caps
+dispatched-but-unfetched batches; depth 1 restores the serial
+dispatch->fetch->resolve loop.  Worker threads keep the event loop free in
+both modes.
 """
 
 from __future__ import annotations
@@ -56,6 +64,9 @@ class BatchingDispatcher:
         request_timeout_s: float = 60.0,
         metrics=None,
         shed_factor: float = 1.0,
+        dispatch_runner: Callable[[Any, list[Any]], Callable[[], list[Any]]]
+        | None = None,
+        pipeline_depth: int = 2,
     ):
         self._runner = runner
         self._max_batch = max_batch
@@ -65,7 +76,20 @@ class BatchingDispatcher:
         self._task: asyncio.Task | None = None
         self._metrics = metrics
         self._shed_factor = shed_factor
-        self._inflight = 0  # executing drain's remaining serial groups
+        self._inflight = 0  # dispatched-or-pending groups not yet resolved
+        # Pipelined mode (round 3): `dispatch_runner(key, images)` enqueues
+        # the device program WITHOUT blocking and returns a thunk that
+        # materialises results; the thunk runs in a separate fetch task so
+        # the dispatcher can collect and dispatch the NEXT batch while this
+        # one's results stream back to the host (the device executes
+        # in-order regardless).  `pipeline_depth` bounds dispatched-but-
+        # unfetched batches — the device-side working set — via a
+        # semaphore; depth<=1 or dispatch_runner=None restores the fully
+        # serial dispatch->fetch->resolve loop.
+        self._dispatch_runner = dispatch_runner if pipeline_depth > 1 else None
+        self._fetch_sem = asyncio.Semaphore(max(1, pipeline_depth))
+        self._fetch_tasks: set[asyncio.Task] = set()
+        self._last_done: float | None = None  # cadence observation anchor
 
     async def start(self) -> None:
         if self._task is None:
@@ -79,27 +103,35 @@ class BatchingDispatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self._fetch_tasks:
+            await asyncio.gather(*tuple(self._fetch_tasks), return_exceptions=True)
 
     def _estimated_drain_s(self) -> float:
-        """Time for the work ahead of a new arrival to clear, from the
-        observed per-batch compute median.  0.0 while unmeasured (cold
-        start) AND whenever the queue is empty: an empty-queue arrival
-        rides the very next batch, and always accepting it guarantees
-        liveness — if everything shed, no batch would ever run and the p50
-        estimate could never correct itself."""
+        """Time for the work ahead of a new arrival to clear.  0.0 while
+        unmeasured (cold start) AND whenever the queue is empty: an
+        empty-queue arrival rides the very next batch, and always accepting
+        it guarantees liveness — if everything shed, no batch would ever
+        run and the p50 estimate could never correct itself.
+
+        Rate source: the batch-completion CADENCE median when observed
+        (interval between consecutive completions while more work was in
+        flight — the true sustained rate, which under pipelining is
+        shorter than any single batch's dispatch->fetch wall), falling
+        back to compute_p50 before any sustained load has been seen."""
         if self._metrics is None:
             return 0.0
         depth = self._queue.qsize()
         if depth == 0:
             return 0.0
-        p50 = self._metrics.compute_p50()
+        p50 = self._metrics.cadence_p50()
+        if p50 <= 0.0:
+            p50 = self._metrics.compute_p50()
         if p50 <= 0.0:
             return 0.0
         # Divide by the OBSERVED executed-batch size, not max_batch: mixed
-        # keys split a drain window into per-key serial executions, so the
+        # keys split a drain window into per-key executions, so the
         # effective batch size can be far below max_batch.  _inflight
-        # counts the executing drain's remaining groups (serial device
-        # batches the queue no longer shows).
+        # counts dispatched-or-executing groups the queue no longer shows.
         eff_batch = min(
             float(self._max_batch), max(1.0, self._metrics.batch_size_p50())
         )
@@ -149,14 +181,15 @@ class BatchingDispatcher:
         groups: dict[Any, list[WorkItem]] = {}
         for item in batch:
             groups.setdefault(item.key, []).append(item)
-        # Distinct keys in one drain window run SERIALLY — a deliberate
-        # decision (round-1 review asked): one dispatcher task owns the
-        # device, and device execution is serial regardless; overlapping
-        # group B's dispatch with group A's host postprocess would pipeline
-        # at most a few ms of encode time per window at the cost of losing
-        # the single-owner invariant that replaces the reference's
-        # _SYMBOLIC_SCOPE thread hack.  Mixed-key bursts complete without
-        # starvation (tests/test_serving.py::test_mixed_layer_burst).
+        if self._dispatch_runner is not None:
+            await self._execute_pipelined(groups)
+            return
+        # Serial fallback: dispatch -> block for results -> resolve, one
+        # group at a time.  Device execution is serial regardless; what the
+        # pipelined mode adds is overlapping the HOST side (result
+        # transfer + postprocess) of group A with the device side of
+        # group B.  Mixed-key bursts complete without starvation
+        # (tests/test_serving.py::test_mixed_layer_burst).
         self._inflight = len(groups)
         try:
             for key, items in groups.items():
@@ -171,15 +204,92 @@ class BatchingDispatcher:
                     continue
                 finally:
                     self._inflight -= 1
-                dt = time.perf_counter() - t0
-                if self._metrics is not None:
-                    self._metrics.observe_batch(
-                        size=len(items),
-                        compute_s=dt,
-                        queue_s=t0 - min(it.enqueued_at for it in items),
-                    )
-                for it, res in zip(items, results):
-                    if not it.future.done():
-                        it.future.set_result(res)
+                self._resolve(items, results, t0)
         finally:
             self._inflight = 0  # cancellation mid-drain must not leak count
+
+    async def _execute_pipelined(self, groups: dict[Any, list[WorkItem]]) -> None:
+        """Dispatch every group, farming each group's result-fetch out to
+        its own task; returns as soon as all groups are DISPATCHED so the
+        _run loop can collect the next window while results stream back.
+        The fetch semaphore bounds dispatched-but-unfetched groups.
+
+        On cancellation (server shutdown) every group that has not handed
+        its thunk to a fetch task FAILS its futures immediately — including
+        the group whose dispatch the cancellation interrupted, whose device
+        results are unreachable (asyncio.to_thread discards the worker
+        thread's return value on cancel).  Letting them hang to a full
+        request-timeout 504 would stall graceful shutdown."""
+        self._inflight += len(groups)
+        handed_off = 0
+        group_list = list(groups.items())
+        try:
+            for key, items in group_list:
+                images = [it.image for it in items]
+                await self._fetch_sem.acquire()
+                t0 = time.perf_counter()
+                try:
+                    thunk = await asyncio.to_thread(
+                        self._dispatch_runner, key, images
+                    )
+                except asyncio.CancelledError:
+                    self._fetch_sem.release()  # held permit must not leak
+                    raise
+                except Exception as e:  # noqa: BLE001 — propagate to callers
+                    self._fetch_sem.release()
+                    self._inflight -= 1
+                    handed_off += 1
+                    for it in items:
+                        if not it.future.done():
+                            it.future.set_exception(e)
+                    continue
+                handed_off += 1
+                task = asyncio.create_task(
+                    self._finish(items, thunk, t0), name="batch-fetch"
+                )
+                self._fetch_tasks.add(task)
+                task.add_done_callback(self._fetch_tasks.discard)
+        except asyncio.CancelledError:
+            for _, items in group_list[handed_off:]:
+                for it in items:
+                    if not it.future.done():
+                        it.future.set_exception(
+                            errors.Unavailable("server shutting down")
+                        )
+            raise
+        finally:
+            # groups never handed to a fetch task (failed, cancelled, or
+            # unreached) must not leak the inflight count
+            self._inflight -= len(group_list) - handed_off
+
+    async def _finish(self, items: list[WorkItem], thunk, t0: float) -> None:
+        try:
+            results = await asyncio.to_thread(thunk)
+        except Exception as e:  # noqa: BLE001 — propagate to callers
+            for it in items:
+                if not it.future.done():
+                    it.future.set_exception(e)
+            return
+        finally:
+            self._inflight -= 1
+            self._fetch_sem.release()
+        self._resolve(items, results, t0)
+
+    def _resolve(self, items: list[WorkItem], results: list[Any], t0: float) -> None:
+        """Shared epilogue for both execution modes: metrics + futures.
+        Cadence (interval between completions while more work is in
+        flight) feeds the load-shed estimator's sustained-rate input."""
+        now = time.perf_counter()
+        if self._metrics is not None:
+            self._metrics.observe_batch(
+                size=len(items),
+                compute_s=now - t0,
+                queue_s=t0 - min(it.enqueued_at for it in items),
+            )
+            busy = self._inflight > 0 or self._queue.qsize() > 0
+            if busy and self._last_done is not None:
+                self._metrics.observe_cadence(now - self._last_done)
+            self._last_done = now
+        for it, res in zip(items, results):
+            if not it.future.done():
+                it.future.set_result(res)

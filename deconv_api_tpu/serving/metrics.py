@@ -57,6 +57,7 @@ class Metrics:
         self._latency = _Reservoir()
         self._batch_size = _Reservoir()
         self._compute = _Reservoir()
+        self._cadence = _Reservoir()
         self._queue_wait = _Reservoir()
         self._stage: dict[str, _Reservoir] = {}
 
@@ -74,6 +75,19 @@ class Metrics:
             self._batch_size.add(float(size))
             self._compute.add(compute_s)
             self._queue_wait.add(queue_s)
+
+    def observe_cadence(self, cadence_s: float) -> None:
+        """Interval between consecutive batch COMPLETIONS while more work
+        was in flight — the dispatcher's true sustained per-batch rate.
+        Under pipelining this is shorter than compute_p50 (whose window
+        spans overlapping dispatch->fetch walls), so the load-shed
+        estimator prefers it (serving/batcher.py)."""
+        with self._lock:
+            self._cadence.add(cadence_s)
+
+    def cadence_p50(self) -> float:
+        with self._lock:
+            return self._cadence.quantile(0.50)
 
     def compute_p50(self) -> float:
         """Median per-batch compute seconds — the load-shedding estimator's
@@ -111,6 +125,7 @@ class Metrics:
                 "latency_p99_s": self._latency.quantile(0.99),
                 "batch_size_p50": self._batch_size.quantile(0.50),
                 "compute_p50_s": self._compute.quantile(0.50),
+                "batch_cadence_p50_s": self._cadence.quantile(0.50),
                 "queue_wait_p50_s": self._queue_wait.quantile(0.50),
                 "stages": {
                     k: {"p50_s": r.quantile(0.5), "p99_s": r.quantile(0.99)}

@@ -1,0 +1,279 @@
+"""Pipelined BatchingDispatcher (serving/batcher.py round 3): dispatch and
+result-fetch are decoupled so the device-side of batch N+1 overlaps the
+host-side fetch of batch N.  These tests drive the dispatcher with
+synthetic runners (no JAX) and assert overlap, ordering, error
+propagation, inflight accounting and shutdown draining."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deconv_api_tpu.serving.batcher import BatchingDispatcher
+
+
+def _img():
+    return np.zeros((2, 2, 3), np.float32)
+
+
+def test_fetch_overlaps_next_dispatch():
+    """With pipeline_depth=2 the dispatcher must dispatch batch 2 while
+    batch 1's fetch thunk is still blocking."""
+    events = []
+    fetch_gate = threading.Event()
+
+    def dispatch(key, images):
+        events.append(("dispatch", key))
+
+        def thunk():
+            if key == "a":
+                fetch_gate.wait(5)  # block batch a's fetch
+            events.append(("fetched", key))
+            return [f"{key}-res"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0,
+        )
+        await d.start()
+        fa = asyncio.create_task(d.submit(_img(), "a"))
+        await asyncio.sleep(0.1)  # a dispatched, its fetch now blocked
+        fb = asyncio.create_task(d.submit(_img(), "b"))
+        rb = await asyncio.wait_for(fb, 5)  # b completes while a's fetch hangs
+        assert rb == "b-res"
+        assert ("dispatch", "b") in events
+        assert ("fetched", "a") not in events  # a still blocked => overlap
+        fetch_gate.set()
+        ra = await asyncio.wait_for(fa, 5)
+        assert ra == "a-res"
+        await d.stop()
+
+    asyncio.run(go())
+
+
+def test_pipeline_depth_bounds_inflight():
+    """A third batch must NOT dispatch while depth=2 permits are held."""
+    dispatched = []
+    gate = threading.Event()
+
+    def dispatch(key, images):
+        dispatched.append(key)
+
+        def thunk():
+            gate.wait(5)
+            return ["ok"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0,
+        )
+        await d.start()
+        futs = [asyncio.create_task(d.submit(_img(), k)) for k in "abc"]
+        await asyncio.sleep(0.3)
+        assert sorted(dispatched) == ["a", "b"]  # c waits for a permit
+        gate.set()
+        assert await asyncio.gather(*futs) == ["ok", "ok", "ok"]
+        await d.stop()
+
+    asyncio.run(go())
+
+
+def test_fetch_error_propagates_and_pipeline_recovers():
+    def dispatch(key, images):
+        def thunk():
+            if key == "bad":
+                raise RuntimeError("fetch exploded")
+            return ["ok"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0,
+        )
+        await d.start()
+        with pytest.raises(RuntimeError, match="fetch exploded"):
+            await d.submit(_img(), "bad")
+        assert await d.submit(_img(), "good") == "ok"  # permit not leaked
+        assert d._inflight == 0
+        await d.stop()
+
+    asyncio.run(go())
+
+
+def test_dispatch_error_propagates_and_pipeline_recovers():
+    def dispatch(key, images):
+        if key == "bad":
+            raise RuntimeError("dispatch exploded")
+
+        def thunk():
+            return ["ok"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0,
+        )
+        await d.start()
+        with pytest.raises(RuntimeError, match="dispatch exploded"):
+            await d.submit(_img(), "bad")
+        assert await d.submit(_img(), "good") == "ok"
+        assert d._inflight == 0
+        await d.stop()
+
+    asyncio.run(go())
+
+
+def test_stop_drains_inflight_fetches():
+    """stop() must wait for outstanding fetch tasks so no future is left
+    dangling after shutdown."""
+    release = threading.Event()
+
+    def dispatch(key, images):
+        def thunk():
+            release.wait(5)
+            return ["done"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0,
+        )
+        await d.start()
+        fut = asyncio.create_task(d.submit(_img(), "x"))
+        await asyncio.sleep(0.1)
+        release.set()
+        await d.stop()
+        assert await asyncio.wait_for(fut, 1) == "done"
+
+    asyncio.run(go())
+
+
+def test_depth_one_falls_back_to_serial():
+    """pipeline_depth=1 must use the serial runner path (dispatch_runner
+    ignored), preserving the pre-round-3 execution model."""
+    used = []
+
+    def runner(key, images):
+        used.append("serial")
+        return ["s"] * len(images)
+
+    def dispatch(key, images):  # pragma: no cover - must not be called
+        used.append("pipelined")
+        return lambda: ["p"] * len(images)
+
+    async def go():
+        d = BatchingDispatcher(
+            runner, dispatch_runner=dispatch, pipeline_depth=1,
+            max_batch=4, window_ms=1.0,
+        )
+        await d.start()
+        assert await d.submit(_img(), "k") == "s"
+        await d.stop()
+
+    asyncio.run(go())
+    assert used == ["serial"]
+
+
+def test_mixed_keys_same_window_pipeline():
+    """Distinct keys arriving together resolve correctly through separate
+    fetch tasks, results mapped per request."""
+
+    def dispatch(key, images):
+        def thunk():
+            time.sleep(0.02)
+            return [f"{key}:{i}" for i in range(len(images))]
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=8, window_ms=30.0,
+        )
+        await d.start()
+        futs = [
+            asyncio.create_task(d.submit(_img(), k))
+            for k in ("a", "b", "a", "b", "a")
+        ]
+        res = await asyncio.gather(*futs)
+        assert res == ["a:0", "b:0", "a:1", "b:1", "a:2"]
+        await d.stop()
+
+    asyncio.run(go())
+
+
+def test_stop_mid_dispatch_fails_futures_fast():
+    """Cancelling the dispatcher while a group's dispatch is in the worker
+    thread must FAIL that group's futures immediately (503 unavailable),
+    not leave them hanging to a full request-timeout 504."""
+    from deconv_api_tpu import errors
+
+    started = threading.Event()
+    release = threading.Event()
+
+    def dispatch(key, images):
+        started.set()
+        release.wait(5)  # hold the dispatch in the worker thread
+        return lambda: ["late"] * len(images)
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0,
+            request_timeout_s=30.0,
+        )
+        await d.start()
+        fut = asyncio.create_task(d.submit(_img(), "x"))
+        await asyncio.to_thread(started.wait, 5)
+        stop = asyncio.create_task(d.stop())
+        await asyncio.sleep(0.1)
+        release.set()  # let the worker thread finish so stop() completes
+        await stop
+        t0 = time.monotonic()
+        with pytest.raises(errors.Unavailable):
+            await fut
+        assert time.monotonic() - t0 < 5  # failed fast, not a 30 s timeout
+
+    asyncio.run(go())
+
+
+def test_cadence_observed_under_sustained_load():
+    """Back-to-back batches must record completion cadence so the shed
+    estimator sees the sustained (pipelined) rate, not per-batch walls."""
+    from deconv_api_tpu.serving.metrics import Metrics
+
+    m = Metrics()
+
+    def dispatch(key, images):
+        def thunk():
+            time.sleep(0.01)
+            return ["ok"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0, metrics=m,
+        )
+        await d.start()
+        futs = [asyncio.create_task(d.submit(_img(), f"k{i}")) for i in range(6)]
+        await asyncio.gather(*futs)
+        await d.stop()
+
+    asyncio.run(go())
+    assert m.cadence_p50() > 0.0

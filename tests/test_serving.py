@@ -273,12 +273,17 @@ def test_input_layer_rejected_422(server):
 def test_handler_crash_returns_500_not_dropped_conn(server):
     """Unexpected handler exceptions become a 500 JSON response and the
     connection (and server) survive."""
-    orig = server.service.dispatcher._runner
+    d = server.service.dispatcher
+    orig = d._runner, d._dispatch_runner
     try:
         def boom(key, images):
             raise RuntimeError("synthetic device failure")
 
-        server.service.dispatcher._runner = boom
+        # patch both execution paths: _dispatch_runner drives the pipelined
+        # mode (default), _runner the serial fallback
+        d._runner = boom
+        if d._dispatch_runner is not None:
+            d._dispatch_runner = boom
         r = httpx.post(
             server.base_url + "/",
             data={"file": _data_url(), "layer": "b2c1"},
@@ -287,7 +292,7 @@ def test_handler_crash_returns_500_not_dropped_conn(server):
         assert r.status_code == 500
         assert r.json()["error"] == "internal_error"
     finally:
-        server.service.dispatcher._runner = orig
+        d._runner, d._dispatch_runner = orig
     assert httpx.get(server.base_url + "/health-check").status_code == 200
 
 
