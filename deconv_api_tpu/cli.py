@@ -180,6 +180,22 @@ def cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_doctor(args: argparse.Namespace) -> int:
+    """Environment diagnostics: backend liveness (under a hard timeout —
+    a wedged remote backend HANGS rather than raising), per-fetch RTT,
+    compile-cache writability, tiny engine self-test."""
+    from deconv_api_tpu.utils.doctor import CHECKS, run_doctor
+
+    names = [c for c in args.checks.split(",") if c] if args.checks else None
+    if names:
+        unknown = set(names) - set(CHECKS)
+        if unknown:
+            print(f"unknown checks: {sorted(unknown)}; have {sorted(CHECKS)}",
+                  file=sys.stderr)
+            return 2
+    return run_doctor(names, platform=args.platform or None)
+
+
 def cmd_models(_args: argparse.Namespace) -> int:
     from deconv_api_tpu.serving.models import registry_info
 
@@ -244,6 +260,21 @@ def main(argv: list[str] | None = None) -> int:
 
     s = sub.add_parser("models", help="list registered models")
     s.set_defaults(fn=cmd_models)
+
+    s = sub.add_parser(
+        "doctor", help="environment diagnostics (backend, RTT, cache, selftest)"
+    )
+    s.add_argument(
+        "--checks", default="",
+        help="comma list (default all): backend,rtt,compile_cache,selftest",
+    )
+    s.add_argument(
+        "--platform", default="",
+        help="force a backend inside the probes (e.g. cpu) — uses the "
+        "config-update form, which unlike JAX_PLATFORMS works even when "
+        "the default plugin is wedged",
+    )
+    s.set_defaults(fn=cmd_doctor)
 
     args = p.parse_args(argv)
     return args.fn(args)

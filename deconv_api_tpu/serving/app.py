@@ -702,8 +702,18 @@ def _parse_form(req: Request) -> dict[str, str]:
 async def serve_forever(cfg: ServerConfig) -> None:
     service = DeconvService(cfg)
     port = await service.start()
+    from deconv_api_tpu.utils import slog
+
+    slog.configure()  # server entrypoint owns logging setup (embedders don't)
+    slog.event(
+        slog.get_logger("deconv.app"), "server_start",
+        host=service.cfg.host, port=port, model=service.cfg.model or "injected",
+        pipeline_depth=service.cfg.pipeline_depth,
+        mesh=list(service.cfg.mesh_shape) or None,
+    )
     print(f"deconv_api_tpu serving on {service.cfg.host}:{port}", flush=True)
     await asyncio.to_thread(service.warmup)
+    slog.event(slog.get_logger("deconv.app"), "warmup_done")
     print("model warmed up; /ready now 200", flush=True)
     await asyncio.Event().wait()
 

@@ -29,6 +29,9 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
 
 from deconv_api_tpu import errors
+from deconv_api_tpu.utils import slog
+
+_log = slog.get_logger("deconv.batcher")
 
 
 @dataclass
@@ -105,6 +108,15 @@ class BatchingDispatcher:
             self._task = None
         if self._fetch_tasks:
             await asyncio.gather(*tuple(self._fetch_tasks), return_exceptions=True)
+        # Items still queued (never picked up by a drain window) fail fast
+        # with the same shutdown signal as the interrupted window — without
+        # this they would hang to a full request-timeout 504.
+        while not self._queue.empty():
+            item = self._queue.get_nowait()
+            if not item.future.done():
+                item.future.set_exception(
+                    errors.Unavailable("server shutting down")
+                )
 
     def _estimated_drain_s(self) -> float:
         """Time for the work ahead of a new arrival to clear.  0.0 while
@@ -280,16 +292,30 @@ class BatchingDispatcher:
         Cadence (interval between completions while more work is in
         flight) feeds the load-shed estimator's sustained-rate input."""
         now = time.perf_counter()
+        slog.event(
+            _log, "batch_done", level=10,  # DEBUG: per-request http_request
+            # lines already cover the serving story at INFO
+            key=str(items[0].key), size=len(items),
+            ms=round((now - t0) * 1e3, 1), inflight=self._inflight,
+        )
         if self._metrics is not None:
             self._metrics.observe_batch(
                 size=len(items),
                 compute_s=now - t0,
                 queue_s=t0 - min(it.enqueued_at for it in items),
             )
+            # Cadence is only meaningful between completions under
+            # SUSTAINED load; going idle clears the anchor, else the next
+            # burst's first completion would record the whole idle gap as
+            # an interval and inflate the shed estimator into spurious
+            # 503s (r3 review finding).
             busy = self._inflight > 0 or self._queue.qsize() > 0
-            if busy and self._last_done is not None:
-                self._metrics.observe_cadence(now - self._last_done)
-            self._last_done = now
+            if busy:
+                if self._last_done is not None:
+                    self._metrics.observe_cadence(now - self._last_done)
+                self._last_done = now
+            else:
+                self._last_done = None
         for it, res in zip(items, results):
             if not it.future.done():
                 it.future.set_result(res)

@@ -15,9 +15,15 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import re
+import time
 from dataclasses import dataclass, field
 from urllib.parse import parse_qsl, unquote, urlsplit
+
+from deconv_api_tpu.utils import slog
+
+_log = slog.get_logger("deconv.http")
 
 MAX_BODY = 64 * 1024 * 1024  # 64 MiB: base64 images are bulky
 MAX_HEADER = 64 * 1024
@@ -156,6 +162,10 @@ class HttpServer:
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         if self._max_connections > 0 and self._nconn >= self._max_connections:
+            slog.event(
+                _log, "http_reject", level=logging.WARNING,
+                status=503, reason="too_many_connections", nconn=self._nconn,
+            )
             try:
                 writer.write(
                     Response.json({"error": "too many connections"}, 503).encode(False)
@@ -187,14 +197,38 @@ class HttpServer:
                 if req is None:
                     break
                 keep_alive = req.headers.get("connection", "keep-alive") != "close"
+                t0 = time.perf_counter()
                 resp = await self._dispatch(req)
+                # 500 = handler crash -> ERROR.  503/504 are DESIGNED
+                # backpressure (shedding, timeouts) — WARNING, or they
+                # would flood error alerting exactly at peak load.
+                lvl = (
+                    logging.ERROR if resp.status == 500
+                    else logging.WARNING if resp.status >= 500
+                    else logging.INFO
+                )
+                slog.event(
+                    _log, "http_request", level=lvl,
+                    method=req.method, path=req.path, status=resp.status,
+                    ms=round((time.perf_counter() - t0) * 1e3, 1),
+                )
                 writer.write(resp.encode(keep_alive))
                 await writer.drain()
                 if not keep_alive:
                     break
-        except (asyncio.IncompleteReadError, ConnectionResetError, _ConnExpired):
+        except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
+        except _ConnExpired:
+            # routine idle/slowloris reaping — DEBUG, not an error signal
+            slog.event(_log, "conn_expired", level=logging.DEBUG)
         except _BadRequest as e:
+            # protocol-level rejections (400/408/413/431) never reach
+            # _dispatch, so they get their own structured line — these are
+            # exactly the abuse signals operators grep for (r3 review)
+            slog.event(
+                _log, "http_reject", level=logging.WARNING,
+                status=e.status, reason=str(e),
+            )
             try:
                 writer.write(Response.json({"error": str(e)}, e.status).encode(False))
                 await writer.drain()
@@ -301,6 +335,10 @@ class HttpServer:
             import traceback
 
             traceback.print_exc()
+            slog.event(
+                _log, "handler_crash", level=logging.ERROR,
+                path=req.path, error=f"{type(e).__name__}: {e}",
+            )
             return Response.json(
                 {"error": "internal_error", "detail": f"{type(e).__name__}: {e}"}, 500
             )

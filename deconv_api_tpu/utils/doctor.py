@@ -1,0 +1,175 @@
+"""Environment diagnostics: `deconv_api_tpu doctor`.
+
+Operational packaging of the failure modes catalogued in BASELINE.md's
+tunnel-anatomy section (SURVEY §5 failure-detection row).  The critical
+design constraint: a wedged remote backend HANGS at init rather than
+raising (bench.py docstring), so every device probe here runs in a CHILD
+subprocess under a hard timeout — the doctor itself can never wedge.
+
+Checks:
+  backend     device discovery + one tiny matmul (liveness, platform)
+  rtt         per-fetch host<->device round trip (median of 5 scalar
+              fetches of pre-computed results; ~71 ms over the axon
+              tunnel, microseconds on local PCIe — tells you whether the
+              pipelined fetch path matters for your deployment)
+  compile_cache  persistent XLA cache dir configured + writable
+  selftest    jitted 8x8 deconv roundtrip through ops (engine sanity)
+
+Output: one JSON object per check, then an overall verdict; exit 0 only
+if every non-informational check passes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_CHILD_TIMEOUT_S = 120.0
+
+
+def _platform_prelude(platform: str | None) -> str:
+    """Force a backend INSIDE the child, after jax import.  The env-var
+    form (JAX_PLATFORMS=cpu) is NOT used: with an unhealthy axon plugin
+    it still hangs at backend init (verify-skill/conftest finding); only
+    the config update reliably bypasses the plugin."""
+    if not platform:
+        return "import jax\n"
+    return (
+        "import jax\n"
+        f"jax.config.update('jax_platforms', {platform!r})\n"
+    )
+
+
+def _run_child(code: str, timeout_s: float = _CHILD_TIMEOUT_S) -> dict:
+    """Run probe code in a subprocess; last JSON line of stdout wins."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "ok": False,
+            "error": f"probe hung past {timeout_s:.0f}s (wedged backend?)",
+        }
+    wall = time.monotonic() - t0
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out = json.loads(line)
+                out.setdefault("wall_s", round(wall, 1))
+                return out
+            except json.JSONDecodeError:
+                continue
+    return {
+        "ok": False,
+        "error": f"probe rc={proc.returncode}",
+        "stderr_tail": proc.stderr.decode(errors="replace")[-400:],
+    }
+
+
+def check_backend(platform: str | None = None) -> dict:
+    return _run_child(
+        _platform_prelude(platform)
+        + "import json, jax.numpy as jnp\n"
+        "d = jax.devices()[0]\n"
+        "x = float((jnp.ones((128, 128)) @ jnp.ones((128, 128))).sum())\n"
+        "print(json.dumps({'ok': x == 128.0 * 128 * 128,\n"
+        "                  'device': str(d), 'platform': d.platform,\n"
+        "                  'n_devices': jax.device_count()}))\n"
+    )
+
+
+def check_rtt(platform: str | None = None) -> dict:
+    """Median per-fetch round trip for an ALREADY-COMPUTED scalar: pure
+    host<->device latency, the quantity that decides whether per-leaf
+    fetches and per-iteration syncs are harmless or ~71 ms each."""
+    return _run_child(
+        _platform_prelude(platform)
+        + "import json, time, statistics, jax.numpy as jnp\n"
+        "f = jax.jit(lambda i: jnp.float32(i) + 1.0)\n"
+        "vals = [f(i) for i in range(6)]\n"
+        "float(vals[0])  # settle dispatch + compile\n"
+        "ts = []\n"
+        "for v in vals[1:]:\n"
+        "    t0 = time.perf_counter()\n"
+        "    float(v)\n"
+        "    ts.append((time.perf_counter() - t0) * 1e3)\n"
+        "print(json.dumps({'ok': True,\n"
+        "                  'fetch_rtt_ms_p50': round(statistics.median(ts), 2),\n"
+        "                  'hint': 'pipelined serving/bench amortize this'}))\n"
+    )
+
+
+def check_compile_cache(platform: str | None = None) -> dict:
+    from deconv_api_tpu.config import ServerConfig
+
+    cfg = ServerConfig.from_env()
+    path = cfg.compilation_cache_dir
+    if not path:
+        return {"ok": True, "configured": False,
+                "hint": "set DECONV_COMPILATION_CACHE_DIR to skip recompiles"}
+    ok = os.path.isdir(path) and os.access(path, os.W_OK)
+    if not ok:
+        try:
+            os.makedirs(path, exist_ok=True)
+            ok = os.access(path, os.W_OK)
+        except OSError:
+            ok = False
+    return {
+        "ok": ok,
+        "configured": True,
+        "dir": path,
+        "entries": len(os.listdir(path)) if ok else None,
+    }
+
+
+def check_selftest(platform: str | None = None) -> dict:
+    """Tiny end-to-end engine roundtrip (jitted, one shape)."""
+    return _run_child(
+        _platform_prelude(platform)
+        + "import json, jax.numpy as jnp\n"
+        "from deconv_api_tpu.engine import get_visualizer\n"
+        "from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params\n"
+        "spec = ModelSpec(name='doc', input_shape=(8, 8, 3), layers=(\n"
+        "    Layer('input_1', 'input'),\n"
+        "    Layer('c1', 'conv', activation='relu', filters=4),\n"
+        "    Layer('p1', 'pool'),\n"
+        "    Layer('c2', 'conv', activation='relu', filters=4),\n"
+        "))\n"
+        "params = init_params(spec, jax.random.PRNGKey(0))\n"
+        "fn = get_visualizer(spec, 'c2', 2, 'all', True)\n"
+        "out = fn(params, jnp.ones((8, 8, 3)))['c2']\n"
+        "img = out['images']\n"
+        "ok = img.shape == (2, 8, 8, 3) and bool(jnp.isfinite(img).all())\n"
+        "print(json.dumps({'ok': ok, 'out_shape': list(img.shape)}))\n",
+        timeout_s=300.0,
+    )
+
+
+CHECKS = {
+    "backend": check_backend,
+    "rtt": check_rtt,
+    "compile_cache": check_compile_cache,
+    "selftest": check_selftest,
+}
+
+
+def run_doctor(checks: list[str] | None = None,
+               platform: str | None = None) -> int:
+    names = checks or list(CHECKS)
+    all_ok = True
+    for name in names:
+        result = CHECKS[name](platform)
+        result = {"check": name, **result}
+        all_ok = all_ok and bool(result.get("ok"))
+        print(json.dumps(result), flush=True)
+    print(json.dumps({"check": "overall", "ok": all_ok}), flush=True)
+    return 0 if all_ok else 1

@@ -277,3 +277,76 @@ def test_cadence_observed_under_sustained_load():
 
     asyncio.run(go())
     assert m.cadence_p50() > 0.0
+
+
+def test_cadence_not_contaminated_by_idle_gaps():
+    """A burst after an idle period must NOT record the idle gap as a
+    cadence sample (it would inflate the shed estimator into spurious
+    503s — r3 review finding)."""
+    from deconv_api_tpu.serving.metrics import Metrics
+
+    m = Metrics()
+
+    def dispatch(key, images):
+        def thunk():
+            time.sleep(0.01)
+            return ["ok"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0, metrics=m,
+        )
+        await d.start()
+        # burst 1: four back-to-back batches -> in-burst cadence samples
+        # (the first completion only sets the anchor; the last completes
+        # with nothing in flight and clears it)
+        await asyncio.gather(*(d.submit(_img(), f"a{i}") for i in range(4)))
+        await asyncio.sleep(0.5)  # idle gap
+        # burst 2
+        await asyncio.gather(*(d.submit(_img(), f"b{i}") for i in range(4)))
+        await d.stop()
+
+    asyncio.run(go())
+    # every recorded sample must be a genuine in-burst interval, far below
+    # the 0.5 s idle gap
+    assert 0.0 < m.cadence_p50() < 0.25
+
+
+def test_stop_fails_queued_items_fast():
+    """Requests still in the queue at stop() fail with Unavailable
+    immediately instead of hanging to the request timeout."""
+    from deconv_api_tpu import errors
+
+    release = threading.Event()
+
+    def dispatch(key, images):
+        def thunk():
+            release.wait(5)
+            return ["ok"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=1 + 1, max_batch=1, window_ms=1.0,
+            request_timeout_s=30.0,
+        )
+        await d.start()
+        # depth permits (2) + several queued behind them
+        futs = [asyncio.create_task(d.submit(_img(), f"k{i}")) for i in range(6)]
+        await asyncio.sleep(0.2)
+        release.set()
+        stop = asyncio.create_task(d.stop())
+        t0 = time.monotonic()
+        results = await asyncio.gather(*futs, return_exceptions=True)
+        await stop
+        assert time.monotonic() - t0 < 10  # nobody waited out a 30 s timeout
+        ok = [r for r in results if r == "ok"]
+        failed = [r for r in results if isinstance(r, errors.Unavailable)]
+        assert len(ok) + len(failed) == 6 and failed  # queued tail failed fast
+
+    asyncio.run(go())

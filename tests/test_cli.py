@@ -189,3 +189,26 @@ def test_visualize_unknown_layer_clean_error(tmp_path, monkeypatch, capsys):
     assert rc == 2
     err = capsys.readouterr().err
     assert "no projectable layer" in err and "b2c1" in err
+
+
+def test_doctor_cpu(capsys):
+    """`doctor --platform cpu` runs its probes green without touching the
+    default backend (the config-update form works even when the default
+    plugin is wedged — utils/doctor.py)."""
+    import json as _json
+
+    from deconv_api_tpu.cli import main
+
+    rc = main(["doctor", "--checks", "backend,compile_cache", "--platform", "cpu"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    parsed = [_json.loads(l) for l in out]
+    byname = {p["check"]: p for p in parsed}
+    assert byname["backend"]["ok"] and byname["backend"]["platform"] == "cpu"
+    assert byname["overall"]["ok"] is True
+
+
+def test_doctor_unknown_check():
+    from deconv_api_tpu.cli import main
+
+    assert main(["doctor", "--checks", "nope"]) == 2

@@ -648,3 +648,35 @@ def test_run_batch_sweep_raw_post_none(tmp_path):
     for name, entry in res.items():
         assert entry["images"].ndim == 4  # (K, H, W, C) raw projections
         assert entry["indices"].shape == (2,)
+
+
+def test_dispatch_batch_profiling_falls_back_to_blocking(tmp_path):
+    """While the jax.profiler budget is armed, _dispatch_batch must run the
+    batch monolithically INSIDE the trace scope (the capture has to cover
+    device execution, not just the async dispatch) and return its results
+    as a pre-resolved thunk."""
+    import jax
+
+    cfg = ServerConfig(
+        image_size=16,
+        warmup_all_buckets=False,
+        compilation_cache_dir="",
+        profile_dir=str(tmp_path / "traces"),
+    )
+    params = init_params(TINY, jax.random.PRNGKey(7))
+    svc = DeconvService(cfg, spec=TINY, params=params)
+    svc.warmup()
+    assert svc._profile_remaining > 0
+    img = np.zeros((16, 16, 3), np.float32)
+    thunk = svc._dispatch_batch(("b2c1", "all", 2, "grid"), [img])
+    # budget consumed at dispatch time => the batch ran under the scope
+    assert svc._profile_remaining < int(
+        __import__("os").environ.get("DECONV_PROFILE_BATCHES", "4")
+    )
+    (res,) = thunk()
+    assert res["grid"].ndim == 3
+    # once the budget is exhausted the pipelined (lazy) path returns
+    svc._profile_remaining = 0
+    thunk2 = svc._dispatch_batch(("b2c1", "all", 2, "grid"), [img])
+    (res2,) = thunk2()
+    np.testing.assert_array_equal(res["grid"], res2["grid"])

@@ -1,0 +1,114 @@
+"""Structured JSON-lines logging (utils/slog.py) + its serving wiring."""
+
+import json
+import logging
+
+from deconv_api_tpu.utils import slog
+
+
+def _capture(logger):
+    records = []
+
+    class H(logging.Handler):
+        def emit(self, record):
+            records.append(slog._JsonFormatter().format(record))
+
+    h = H()
+    logger.addHandler(h)
+    return records, h
+
+
+def test_event_formats_one_json_line():
+    slog.configure()  # entrypoint responsibility; tests stand in for it
+    log = slog.get_logger("deconv.test")
+    records, h = _capture(log)
+    try:
+        slog.event(log, "batch_done", key="block5_conv1", size=8, ms=42.1)
+    finally:
+        log.removeHandler(h)
+    assert len(records) == 1
+    obj = json.loads(records[0])
+    assert obj["event"] == "batch_done"
+    assert obj["level"] == "info"
+    assert obj["key"] == "block5_conv1" and obj["size"] == 8 and obj["ms"] == 42.1
+    assert isinstance(obj["ts"], float)
+
+
+def test_level_threshold_respected():
+    slog.configure()
+    log = slog.get_logger("deconv.test2")
+    records, h = _capture(log)
+    try:
+        slog.event(log, "noise", level=logging.DEBUG, x=1)  # below INFO root
+        slog.event(log, "signal", level=logging.ERROR, x=2)
+    finally:
+        log.removeHandler(h)
+    events = [json.loads(r)["event"] for r in records]
+    assert "signal" in events and "noise" not in events
+
+
+def test_http_request_access_line(server=None):
+    """Driving the real server produces an http_request event with method,
+    path, status and a duration."""
+    import httpx
+
+    from tests.test_serving import ServiceFixture
+    from deconv_api_tpu.config import ServerConfig
+
+    slog.configure()
+    log = slog.get_logger("deconv.http")
+    records, h = _capture(log)
+    cfg = ServerConfig(
+        image_size=16, max_batch=2, batch_window_ms=1.0, compilation_cache_dir=""
+    )
+    try:
+        with ServiceFixture(cfg) as s:
+            assert httpx.get(s.base_url + "/health-check").status_code == 200
+    finally:
+        log.removeHandler(h)
+    lines = [json.loads(r) for r in records]
+    hits = [l for l in lines if l["event"] == "http_request"]
+    assert hits and hits[0]["method"] == "GET"
+    assert hits[0]["path"] == "/health-check" and hits[0]["status"] == 200
+    assert hits[0]["ms"] >= 0
+
+
+def test_configure_is_explicit_not_import_side_effect():
+    """Importing serving modules must NOT configure the logger tree —
+    embedding applications keep their own logging config until the server
+    entrypoint calls slog.configure() (r3 review finding)."""
+    import importlib
+    import subprocess
+    import sys
+
+    code = (
+        "import logging\n"
+        "import deconv_api_tpu.serving.batcher\n"
+        "import deconv_api_tpu.serving.http\n"
+        "lg = logging.getLogger('deconv')\n"
+        "assert not lg.handlers, lg.handlers\n"
+        "assert lg.propagate is True\n"
+        "print('clean')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, timeout=120
+    )
+    assert out.returncode == 0, out.stderr.decode()[-500:]
+    assert b"clean" in out.stdout
+
+
+def test_bad_log_level_falls_back_to_info(monkeypatch):
+    import importlib
+
+    monkeypatch.setenv("DECONV_LOG_LEVEL", "verbose")
+    monkeypatch.setattr(slog, "_CONFIGURED", False)
+    import logging as _l
+
+    root = _l.getLogger("deconv")
+    before = list(root.handlers)
+    try:
+        slog.configure()  # must not raise on the bogus level
+        assert root.level == _l.INFO
+    finally:
+        for h in root.handlers[len(before):]:
+            root.removeHandler(h)

@@ -1,0 +1,90 @@
+"""Why does sustained pipelined dispatch slow down?
+
+tunnel_probe.py measured the VGG16 forward at 23.4 ms/batch over 10
+pipelined iterations but 31.2 ms/batch over 40; bench_probe.py saw the
+full headline program go 162 -> 204 ms/batch at 4x iterations.  Two
+hypotheses:
+
+  (a) dispatch/queue-depth throttling: the axon relay or device queue
+      degrades as more programs are enqueued at once -> per-iter time
+      should grow with N in an all-enqueued run regardless of inputs;
+  (b) input-buffer HBM pressure: N live (64,224,224,3) fp32 inputs
+      (38.5 MB each; 1.5 GB at N=40) squeeze the ~10 GB-temp program ->
+      capping live inputs (reuse) or freeing them (donation) should
+      restore the 10-iter rate.
+
+Measurements (forward chain, all-enqueued + one trailing fetch):
+  n10/n20/n30/n40      : per-iter ms vs N, distinct inputs  (curve -> a)
+  n40_reuse20          : 40 iters cycling 20 distinct inputs (tests b)
+  n40_donated          : 40 iters, input donated to the program (tests b;
+                         if this restores n10, bench.py should donate)
+
+Caveat on reuse: repeated inputs could in principle hit a relay result
+cache, which would bias FAST — so a slow reuse run still falsifies (b),
+and a fast one is cross-checked by the donation variant (distinct
+inputs, no cache possible).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+BATCH = 64
+
+
+def main() -> None:
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.engine.deconv import get_forward_only
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    enable_compilation_cache(ServerConfig.from_env())
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    spec, params = vgg16_init()
+    fwd = get_forward_only(spec, "block5_conv1", top_k=8, batched=True)
+
+    def checksum(p, b):
+        return sum(
+            jnp.sum(l.astype(jnp.float32))
+            for l in jax.tree_util.tree_leaves(fwd(p, b))
+        )
+
+    cs = jax.jit(checksum)
+    cs_don = jax.jit(checksum, donate_argnums=(1,))
+
+    def mk(i):
+        return jax.random.normal(jax.random.PRNGKey(1000 + i), (BATCH, 224, 224, 3))
+
+    def run(fn, xs):
+        t0 = time.perf_counter()
+        vals = [fn(params, x) for x in xs]
+        _ = float(vals[-1])
+        ms = (time.perf_counter() - t0) / len(xs) * 1e3
+        assert all(float(v) == float(v) for v in vals[:-1])
+        return round(ms, 2)
+
+    out = {}
+    float(cs(params, mk(0)))  # compile
+    for n in (10, 20, 30, 40):
+        out[f"n{n}_ms"] = run(cs, [mk(i) for i in range(n)])
+
+    pool = [mk(500 + i) for i in range(20)]
+    out["n40_reuse20_ms"] = run(cs, [pool[i % 20] for i in range(40)])
+    del pool
+
+    float(cs_don(params, mk(0)))  # compile donated form
+    out["n40_donated_ms"] = run(cs_don, [mk(600 + i) for i in range(40)])
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
