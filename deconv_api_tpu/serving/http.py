@@ -276,6 +276,11 @@ class HttpServer:
                 n = int(headers["content-length"])
             except ValueError:
                 raise _BadRequest(400, "bad content-length") from None
+            if n < 0:
+                # readexactly(-5) would raise an uncaught ValueError and
+                # kill the connection task (same hazard as the chunked
+                # path's negative chunk size below; r3 fuzz-review finding)
+                raise _BadRequest(400, "bad content-length")
             if n > MAX_BODY:
                 raise _BadRequest(413, "body too large")
             try:
