@@ -109,3 +109,57 @@ def test_device_postprocess_matches_host_reference():
             np.testing.assert_array_equal(
                 deprocess_image(images[b, k]), got_tiles[b, k]
             )
+
+
+class TestPilFallback:
+    """The documented cv2-less fallback paths (serving/codec.py): forced by
+    monkeypatching _HAVE_CV2, which must be safe now that every fallback
+    imports PIL locally."""
+
+    def _png_bgr(self):
+        import cv2
+
+        rng = np.random.default_rng(7)
+        img = (rng.random((20, 24, 3)) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".png", img)
+        assert ok
+        uri = "data:image/png;base64," + base64.b64encode(buf.tobytes()).decode()
+        return img, uri
+
+    def test_decode_matches_cv2_exactly(self, monkeypatch):
+        img, uri = self._png_bgr()
+        got_cv2 = codec.decode_data_url(uri)
+        monkeypatch.setattr(codec, "_HAVE_CV2", False)
+        got_pil = codec.decode_data_url(uri)
+        np.testing.assert_array_equal(got_cv2, got_pil)  # PNG is lossless
+        np.testing.assert_array_equal(got_cv2, img)
+
+    def test_decode_garbage_raises_codec_error(self, monkeypatch):
+        monkeypatch.setattr(codec, "_HAVE_CV2", False)
+        with pytest.raises(codec.CodecError):
+            codec.decode_data_url("data:image/png;base64,aGVsbG8=")
+
+    def test_encode_roundtrips_decodably(self, monkeypatch):
+        # smooth gradient, not noise: JPEG error on noise is huge by design
+        yy, xx = np.mgrid[0:20, 0:24]
+        img = np.stack(
+            [(yy * 12) % 256, (xx * 10) % 256, ((yy + xx) * 6) % 256], axis=-1
+        ).astype(np.uint8)
+        monkeypatch.setattr(codec, "_HAVE_CV2", False)
+        s = codec.encode_data_url(img)
+        assert s.startswith("data:image/webp;base64,")
+        from urllib.parse import unquote
+
+        monkeypatch.setattr(codec, "_HAVE_CV2", True)
+        # the payload is percent-quoted for wire parity (app/main.py:73-76);
+        # consumers (the browser) percent-decode before base64-decoding
+        back = codec.decode_data_url(unquote(s.split(",", 1)[1]))
+        # JPEG is lossy; assert same shape and close content
+        assert back.shape == img.shape
+        assert np.abs(back.astype(int) - img.astype(int)).mean() < 16
+
+    def test_resize_shape(self, monkeypatch):
+        img, _ = self._png_bgr()
+        monkeypatch.setattr(codec, "_HAVE_CV2", False)
+        out = codec.resize224(img, (32, 32))
+        assert out.shape == (32, 32, 3) and out.dtype == np.uint8

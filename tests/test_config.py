@@ -1,0 +1,62 @@
+"""ServerConfig environment parsing — the whole user-facing knob surface
+(SURVEY §5 config row; the reference hardcodes every one of these)."""
+
+import dataclasses
+
+import pytest
+
+from deconv_api_tpu.config import ServerConfig, _coerce
+
+
+def test_defaults_are_consistent():
+    cfg = ServerConfig()
+    assert cfg.pipeline_depth == 2
+    assert cfg.backward_dtype == "bfloat16"
+    assert cfg.dtype == "float32"
+    assert cfg.mesh_shape == ()
+
+
+def test_env_overrides_every_field_kind(monkeypatch):
+    monkeypatch.setenv("DECONV_PORT", "8123")  # int
+    monkeypatch.setenv("DECONV_BATCH_WINDOW_MS", "7.5")  # float
+    monkeypatch.setenv("DECONV_MODEL", "resnet50")  # str
+    monkeypatch.setenv("DECONV_MESH_SHAPE", "4,2")  # tuple
+    monkeypatch.setenv("DECONV_BUG_COMPAT", "0")  # bool
+    monkeypatch.setenv("DECONV_PIPELINE_DEPTH", "3")
+    cfg = ServerConfig.from_env()
+    assert cfg.port == 8123
+    assert cfg.batch_window_ms == 7.5
+    assert cfg.model == "resnet50"
+    assert cfg.mesh_shape == (4, 2)
+    assert cfg.bug_compat is False
+    assert cfg.pipeline_depth == 3
+
+
+@pytest.mark.parametrize(
+    "raw,want", [("1", True), ("true", True), ("YES", True), ("on", True),
+                 ("0", False), ("false", False), ("banana", False)]
+)
+def test_bool_coercion(raw, want):
+    assert _coerce(raw, bool, True) is want
+
+
+def test_tuple_coercion_tolerates_blanks():
+    assert _coerce("8,", tuple, ()) == (8,)
+    assert _coerce("", tuple, ()) == ()
+    assert _coerce("2,2,2", tuple, ()) == (2, 2, 2)
+
+
+def test_overrides_beat_env(monkeypatch):
+    monkeypatch.setenv("DECONV_TOP_K", "4")
+    cfg = ServerConfig.from_env(top_k=16)
+    assert cfg.top_k == 16
+
+
+def test_unknown_override_raises():
+    with pytest.raises(ValueError, match="unknown config field"):
+        ServerConfig.from_env(no_such_field=1)
+
+
+def test_every_field_has_an_env_name_without_collisions():
+    names = [f"DECONV_{f.name.upper()}" for f in dataclasses.fields(ServerConfig)]
+    assert len(names) == len(set(names))
