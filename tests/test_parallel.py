@@ -75,3 +75,35 @@ def test_train_step_single_axis_mesh():
     labels = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
     state, loss = step_fn(state, images, labels)
     assert np.isfinite(float(loss))
+
+
+def test_mesh_sweep_visualizer_matches_single_device():
+    """The all-layers sweep (BASELINE config 2) dp-sharded over the mesh:
+    shard_batched_fn must apply batch sharding across the sweep's nested
+    per-layer output tree and reproduce the single-device results exactly."""
+    from deconv_api_tpu.parallel.batch import shard_batched_fn
+
+    params = init_params(TINY, jax.random.PRNGKey(11))
+    batch = jax.random.normal(jax.random.PRNGKey(12), (8, 16, 16, 3))
+
+    raw = get_visualizer(TINY, "b2c1", 4, "all", True, sweep=True, batched=True)
+    single = jax.jit(raw)(params, batch)
+
+    mesh = make_mesh((8,), axis_names=("dp",), devices=jax.devices()[:8])
+    sharded = shard_batched_fn(raw, mesh)
+    out = sharded(params, jnp.asarray(batch))
+
+    assert set(out) == set(single)
+    for name in single:
+        # same tolerance as the single-layer sibling test: separately
+        # compiled sharded programs may differ in float fusion by an ulp
+        np.testing.assert_allclose(
+            np.asarray(single[name]["images"]), np.asarray(out[name]["images"]),
+            rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(single[name]["indices"]), np.asarray(out[name]["indices"])
+        )
+        # outputs really are dp-sharded over the mesh
+        shard_devs = {s.device for s in out[name]["images"].addressable_shards}
+        assert len(shard_devs) == 8
