@@ -148,6 +148,17 @@ class Metrics:
             f'{p}_request_latency_seconds{{quantile="0.99"}} {s["latency_p99_s"]:.6f}',
             f"# TYPE {p}_images_per_sec gauge",
             f"{p}_images_per_sec {s['images_per_sec']:.3f}",
+            f"# TYPE {p}_batch_size summary",
+            f'{p}_batch_size{{quantile="0.5"}} {s["batch_size_p50"]:.1f}',
+            f"# TYPE {p}_batch_compute_seconds summary",
+            f'{p}_batch_compute_seconds{{quantile="0.5"}} {s["compute_p50_s"]:.6f}',
+            # inter-completion interval under sustained load — the
+            # pipelined dispatcher's true per-batch rate (batcher.py)
+            f"# TYPE {p}_batch_cadence_seconds summary",
+            f'{p}_batch_cadence_seconds{{quantile="0.5"}} '
+            f'{s["batch_cadence_p50_s"]:.6f}',
+            f"# TYPE {p}_queue_wait_seconds summary",
+            f'{p}_queue_wait_seconds{{quantile="0.5"}} {s["queue_wait_p50_s"]:.6f}',
         ]
         for code, n in s["errors_total"].items():
             lines.append(f'{p}_errors_total{{code="{code}"}} {n}')

@@ -680,3 +680,21 @@ def test_dispatch_batch_profiling_falls_back_to_blocking(tmp_path):
     thunk2 = svc._dispatch_batch(("b2c1", "all", 2, "grid"), [img])
     (res2,) = thunk2()
     np.testing.assert_array_equal(res["grid"], res2["grid"])
+
+
+def test_prometheus_exposition_includes_batch_gauges():
+    """The /metrics text must surface the batch-level summaries the shed
+    estimator and pipelined dispatcher produce, not just request totals."""
+    from deconv_api_tpu.serving.metrics import Metrics
+
+    m = Metrics()
+    m.observe_batch(size=4, compute_s=0.05, queue_s=0.01)
+    m.observe_cadence(0.03)
+    text = m.prometheus()
+    for needle in (
+        "deconv_batch_size{quantile=\"0.5\"} 4.0",
+        "deconv_batch_compute_seconds{quantile=\"0.5\"} 0.050000",
+        "deconv_batch_cadence_seconds{quantile=\"0.5\"} 0.030000",
+        "deconv_queue_wait_seconds{quantile=\"0.5\"} 0.010000",
+    ):
+        assert needle in text, text

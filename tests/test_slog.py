@@ -112,3 +112,4 @@ def test_bad_log_level_falls_back_to_info(monkeypatch):
     finally:
         for h in root.handlers[len(before):]:
             root.removeHandler(h)
+
