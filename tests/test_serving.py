@@ -698,3 +698,28 @@ def test_prometheus_exposition_includes_batch_gauges():
         "deconv_queue_wait_seconds{quantile=\"0.5\"} 0.010000",
     ):
         assert needle in text, text
+
+
+@pytest.mark.parametrize(
+    "field,value",
+    [("steps", "0"), ("steps", "101"), ("octaves", "0"), ("octaves", "17"),
+     ("steps", "banana"), ("lr", "0"), ("lr", "nan"), ("lr", "1.5")],
+)
+def test_v1_dream_bad_knobs_400(server, field, value):
+    """Every dream knob outside its validated range (or non-numeric) is a
+    clean 400 — never a crash or a device dispatch."""
+    data = {"file": _data_url(), "layers": "b2c1", field: value}
+    r = httpx.post(server.base_url + "/v1/dream", data=data, timeout=30)
+    assert r.status_code == 400, r.text
+    assert r.json()["error"] in ("bad_request",)
+
+
+def test_v1_dream_total_steps_cap_400(server):
+    r = httpx.post(
+        server.base_url + "/v1/dream",
+        data={"file": _data_url(), "layers": "b2c1", "steps": "100",
+              "octaves": "6"},
+        timeout=30,
+    )
+    assert r.status_code == 400
+    assert "steps x octaves" in r.json()["detail"]
