@@ -19,13 +19,16 @@ backend is TPU and DECONV_PALLAS opts in.
 
 Measured on a v5e-1 (VGG16 block1 pool, batch 32 fp32): the standalone
 pool+unpool roundtrip is 1.34x faster than the XLA lowering (1.48 ms vs
-1.98 ms, ~365 GB/s).  END-TO-END the engine is ~3-20% FASTER WITHOUT these
-kernels (318 img/s XLA vs 308 pallas-pool / 298 pallas-unpool+fused-relu):
-the pallas_call boundary is opaque to XLA, which costs the surrounding
-elementwise fusion more than the kernel saves — even with the backward-ReLU
-folded into the scatter.  Hence the default is OFF (DECONV_PALLAS=1 opts
-in); the kernels remain maintained, tested, and benchmarked as the
-measurement harness for revisiting that trade-off on future toolchains.
+1.98 ms, ~365 GB/s).  END-TO-END the engine is FASTER WITHOUT these
+kernels — round 2: 318 img/s XLA vs 308 pallas-pool / 298
+pallas-unpool+fused-relu; re-confirmed round 3 with the RTT confound
+removed (pipelined fetch-last timing, batch 64): 161 ms/batch XLA vs
+188 ms pallas-unpool / 193 ms pallas-all.  The pallas_call boundary is
+opaque to XLA, which costs the surrounding elementwise fusion more than
+the kernel saves — even with the backward-ReLU folded into the scatter.
+Hence the default is OFF (DECONV_PALLAS=1 opts in); the kernels remain
+maintained, tested, and benchmarked as the measurement harness for
+revisiting that trade-off on future toolchains.
 """
 
 from __future__ import annotations
