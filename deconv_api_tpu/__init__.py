@@ -25,3 +25,29 @@ rebuild designed for TPU:
 """
 
 __version__ = "0.1.0"
+
+# Lazy top-level API: the convenience surface without paying the jax/engine
+# import cost for users who only need, say, the config or codec helpers.
+_EXPORTS = {
+    "visualize": "deconv_api_tpu.engine",
+    "visualize_all_layers": "deconv_api_tpu.engine",
+    "get_visualizer": "deconv_api_tpu.engine",
+    "autodeconv_visualizer": "deconv_api_tpu.engine",
+    "deepdream": "deconv_api_tpu.engine",
+    "deepdream_batch": "deconv_api_tpu.engine",
+    "ServerConfig": "deconv_api_tpu.config",
+    "DeconvService": "deconv_api_tpu.serving.app",
+}
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_EXPORTS))

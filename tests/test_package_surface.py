@@ -1,0 +1,15 @@
+"""Top-level package API surface (lazy PEP 562 exports)."""
+
+import pytest
+
+
+def test_top_level_lazy_exports():
+    """The package's convenience surface resolves lazily and __dir__ lists
+    it; unknown attributes raise AttributeError normally."""
+    import deconv_api_tpu as d
+
+    assert "visualize" in dir(d) and "DeconvService" in dir(d)
+    assert d.ServerConfig().model == "vgg16"
+    assert callable(d.get_visualizer)
+    with pytest.raises(AttributeError):
+        d.definitely_not_an_export
