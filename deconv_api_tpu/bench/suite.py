@@ -71,6 +71,14 @@ def config1_single(iters: int = 10) -> dict:
     ]
     latency_s = _timed(lambda im: fn(params, im), images, checksum)
 
+    # Per-fetch RTT baseline measured the same way in the same session: a
+    # trivial program's "latency" is pure host<->device round trip (~71 ms
+    # over the axon tunnel, ~0 on local PCIe — BASELINE.md tunnel anatomy),
+    # so the row can report how much of the single-request latency is
+    # transport rather than device work.
+    triv = jax.jit(lambda im: im[0, 0, 0] + 1.0)
+    rtt_s = _timed(lambda im: triv(im), images, checksum)
+
     # PSNR parity on a small stack vs tests/reference_numpy.py (fp64).  The
     # oracle needs minutes for full VGG16 at 224; parity at depth is covered
     # by tests/test_engine_parity.py on reduced specs, so here we measure
@@ -86,6 +94,8 @@ def config1_single(iters: int = 10) -> dict:
     return {
         "config": 1,
         "latency_ms": round(latency_s * 1e3, 2),
+        "fetch_rtt_floor_ms": round(rtt_s * 1e3, 2),
+        "device_latency_ms_est": round(max(0.0, latency_s - rtt_s) * 1e3, 2),
         "images_per_sec": round(1.0 / latency_s, 2),
         "psnr_mixed_vs_fp32_db": round(psnr, 1),
     }

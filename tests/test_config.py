@@ -60,4 +60,3 @@ def test_unknown_override_raises():
 def test_every_field_has_an_env_name_without_collisions():
     names = [f"DECONV_{f.name.upper()}" for f in dataclasses.fields(ServerConfig)]
     assert len(names) == len(set(names))
-

@@ -13,3 +13,24 @@ def test_top_level_lazy_exports():
     assert callable(d.get_visualizer)
     with pytest.raises(AttributeError):
         d.definitely_not_an_export
+
+
+def test_exports_are_actually_lazy():
+    """Importing the package must NOT import the engine/jax stack — the
+    property the PEP 562 indirection exists to provide."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import deconv_api_tpu\n"
+        "assert 'deconv_api_tpu.engine' not in sys.modules\n"
+        "assert 'deconv_api_tpu.serving.app' not in sys.modules\n"
+        "deconv_api_tpu.ServerConfig()  # light export works\n"
+        "assert 'deconv_api_tpu.engine' not in sys.modules\n"
+        "print('lazy')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr.decode()[-400:]
+    assert b"lazy" in out.stdout
