@@ -164,6 +164,7 @@ class DeconvService:
         self.server.route("GET", "/ready")(self._ready)
         self.server.route("GET", "/metrics")(self._metrics)
         self.server.route("GET", "/v1/models")(self._models)
+        self.server.route("GET", "/v1/config")(self._config)
         self.server.route("POST", "/v1/profile")(self._profile)
         self.server.route("POST", "/")(self._deconv_compat)
         self.server.route("POST", "/v1/deconv")(self._deconv_v1)
@@ -451,6 +452,24 @@ class DeconvService:
             content_type="text/plain; version=0.0.4",
         )
 
+    async def _config(self, _req: Request) -> Response:
+        """GET /v1/config — the EFFECTIVE server configuration (after env,
+        CLI and model-derived defaults), so operators can confirm what a
+        live server is actually running with instead of reconstructing it
+        from env vars.  Paths are reported as booleans (configured or not)
+        rather than leaked verbatim."""
+        import dataclasses
+
+        cfg = dataclasses.asdict(self.cfg)
+        for key in ("weights_path", "compilation_cache_dir", "profile_dir"):
+            cfg[key] = bool(cfg[key])
+        cfg["mesh_active"] = self.mesh is not None
+        cfg["model_active"] = self.bundle.name
+        # live bind address (start() overrides can differ from cfg.host/port)
+        bound = getattr(self, "bound", None)
+        cfg["bound_host"], cfg["bound_port"] = bound or (None, None)
+        return Response.json(cfg)
+
     async def _models(self, _req: Request) -> Response:
         """GET /v1/models — registry discovery so clients stop hardcoding
         layer names (the reference's client must know VGG16's layer list
@@ -667,10 +686,14 @@ class DeconvService:
         await self.dispatcher.start()
         await self.dream_dispatcher.start()
         await self.sweep_dispatcher.start()
-        return await self.server.start(
-            host if host is not None else self.cfg.host,
-            self.cfg.port if port is None else port,
+        bind_host = host if host is not None else self.cfg.host
+        bound_port = await self.server.start(
+            bind_host, self.cfg.port if port is None else port
         )
+        # the LIVE bind address — /v1/config reports this, not cfg.host/
+        # cfg.port, which start() overrides can differ from
+        self.bound = (bind_host, bound_port)
+        return bound_port
 
     async def stop(self) -> None:
         await self.server.stop()

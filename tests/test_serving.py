@@ -723,3 +723,37 @@ def test_v1_dream_total_steps_cap_400(server):
     )
     assert r.status_code == 400
     assert "steps x octaves" in r.json()["detail"]
+
+
+def test_v1_config_reports_effective_settings(server):
+    """GET /v1/config returns the live effective config: resolved image
+    size, pipeline depth, active model — with filesystem paths sanitized
+    to booleans."""
+    r = httpx.get(server.base_url + "/v1/config")
+    assert r.status_code == 200
+    c = r.json()
+    assert c["image_size"] == 16
+    assert c["pipeline_depth"] == 2
+    assert c["model_active"] == "tiny_vgg"
+    assert c["mesh_active"] is False
+    # the LIVE bind address, not cfg.host/cfg.port (which the fixture's
+    # start('127.0.0.1', 0) overrides)
+    assert c["bound_host"] == "127.0.0.1"
+    assert c["bound_port"] == server.port
+    for key in ("weights_path", "compilation_cache_dir", "profile_dir"):
+        assert isinstance(c[key], bool)
+
+
+def test_v1_config_resolves_image_size_sentinel():
+    """image_size=0 means 'the model's native size'; /v1/config must show
+    the RESOLVED value the server actually runs with."""
+    import asyncio as _asyncio
+    import json as _json
+
+    cfg = ServerConfig(image_size=0, compilation_cache_dir="")
+    params = init_params(TINY, jax.random.PRNGKey(9))
+    svc = DeconvService(cfg, spec=TINY, params=params)
+    resp = _asyncio.run(svc._config(None))
+    c = _json.loads(resp.body.decode())
+    assert c["image_size"] == 16  # TINY's native input, not the 0 sentinel
+    assert c["bound_port"] is None  # never started
