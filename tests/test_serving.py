@@ -757,3 +757,29 @@ def test_v1_config_resolves_image_size_sentinel():
     c = _json.loads(resp.body.decode())
     assert c["image_size"] == 16  # TINY's native input, not the 0 sentinel
     assert c["bound_port"] is None  # never started
+
+
+def test_no_active_filters_400_on_dead_input():
+    """When nothing fires (zero activations at the requested layer), the
+    compat route returns 422 no_active_filters — not a silent all-gray
+    200 (the reference IndexErrors into a 500 here, SURVEY §2.2.4)."""
+    cfg = ServerConfig(
+        image_size=16, max_batch=2, batch_window_ms=1.0,
+        compilation_cache_dir="", warmup_all_buckets=False,
+    )
+    params = init_params(TINY, jax.random.PRNGKey(21))
+    service = DeconvService(cfg, spec=TINY, params=params)
+    # zero preprocessed input + zero conv biases => all activations zero =>
+    # positive-sum selection keeps nothing (valid all False)
+    service.bundle.preprocess = lambda img: np.zeros_like(img, np.float32)
+    with ServiceFixture(cfg, service=service) as s:
+        r = httpx.post(
+            s.base_url + "/",
+            data={"file": _data_url(), "layer": "b2c1"},
+            timeout=60,
+        )
+        assert r.status_code == 422, r.text  # unprocessable: valid image,
+        # but the requested projection has no content (errors.py taxonomy)
+        assert r.json()["error"] == "no_active_filters"
+        # server stays healthy
+        assert httpx.get(s.base_url + "/health-check").status_code == 200
