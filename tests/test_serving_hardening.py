@@ -489,3 +489,33 @@ def test_negative_content_length_400_not_crash():
     raw = _run_http(scenario, idle_timeout_s=1.0, body_timeout_s=1.0)
     assert b" 400 " in raw.split(b"\r\n", 1)[0]
     assert b"bad content-length" in raw
+
+
+def test_profile_rearm_validation(server=None):
+    """/v1/profile input validation: disabled without profile_dir; bad or
+    out-of-range batch counts are clean 400s."""
+    import httpx
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import init_params
+    from tests.test_serving import ServiceFixture
+
+    cfg = ServerConfig(
+        image_size=16, max_batch=2, batch_window_ms=1.0,
+        compilation_cache_dir="",  # no profile_dir
+    )
+    with ServiceFixture(cfg) as s:
+        r = httpx.post(s.base_url + "/v1/profile", data={"batches": "2"})
+        assert r.status_code == 400
+        assert "profiling disabled" in r.json()["detail"]
+
+    import dataclasses, tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        cfg2 = dataclasses.replace(cfg, profile_dir=td)
+        with ServiceFixture(cfg2) as s:
+            for bad in ("0", "65", "pear"):
+                r = httpx.post(s.base_url + "/v1/profile", data={"batches": bad})
+                assert r.status_code == 400, (bad, r.text)
+            r = httpx.post(s.base_url + "/v1/profile", data={"batches": "8"})
+            assert r.status_code == 200 and r.json()["armed"] == 8
