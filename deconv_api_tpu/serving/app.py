@@ -738,7 +738,31 @@ async def serve_forever(cfg: ServerConfig) -> None:
     await asyncio.to_thread(service.warmup)
     slog.event(slog.get_logger("deconv.app"), "warmup_done")
     print("model warmed up; /ready now 200", flush=True)
-    await asyncio.Event().wait()
+    # Graceful shutdown on SIGTERM/SIGINT (the Dockerfile runs this as
+    # PID 1): stop the listener, then drain the dispatchers — in-flight
+    # fetches complete, queued requests fail fast with 503 unavailable
+    # (batcher.stop) instead of dying as connection resets.
+    import signal
+
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    def _on_signal() -> None:
+        if stop_ev.is_set():
+            # second signal during a wedged drain: escalate — the default
+            # die-on-signal behaviour was swallowed by this handler
+            os._exit(130)
+        stop_ev.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, _on_signal)
+        except NotImplementedError:  # pragma: no cover — non-unix hosts
+            pass
+    await stop_ev.wait()
+    slog.event(slog.get_logger("deconv.app"), "shutdown_begin")
+    await service.stop()
+    slog.event(slog.get_logger("deconv.app"), "shutdown_complete")
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -155,10 +155,20 @@ class HttpServer:
         self._server = await asyncio.start_server(self._handle_conn, host, port)
         return self._server.sockets[0].getsockname()[1]
 
-    async def stop(self) -> None:
+    async def stop(self, grace_s: float = 5.0) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            try:
+                # Python >= 3.12.1: wait_closed() waits for ALL open client
+                # connections — an idle keep-alive peer would hold shutdown
+                # for up to idle_timeout_s (or forever, if active).  Bound
+                # it: after the grace period the remaining connection tasks
+                # are abandoned (they die with the loop) so the dispatcher
+                # drain behind us still runs within a container's term
+                # grace window.
+                await asyncio.wait_for(self._server.wait_closed(), grace_s)
+            except asyncio.TimeoutError:
+                pass
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         if self._max_connections > 0 and self._nconn >= self._max_connections:

@@ -783,3 +783,59 @@ def test_no_active_filters_400_on_dead_input():
         assert r.json()["error"] == "no_active_filters"
         # server stays healthy
         assert httpx.get(s.base_url + "/health-check").status_code == 200
+
+
+def test_sigterm_graceful_shutdown():
+    """SIGTERM to the server process (the container's PID-1 path) triggers
+    the graceful stop: shutdown events logged, clean exit code 0."""
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+    import urllib.request
+
+    env = dict(__import__("os").environ)
+    env.update(
+        DECONV_WARMUP_ALL_BUCKETS="0", DECONV_MAX_BATCH="2",
+        DECONV_COMPILATION_CACHE_DIR="",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deconv_api_tpu.serving.app",
+         "--platform", "cpu", "--port", "0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # read stdout on a thread so a wedged warmup cannot hang the
+        # suite, and an early child crash (EOF) fails fast, not busy-spins
+        import queue as _queue
+
+        lines: "_queue.Queue[str]" = _queue.Queue()
+        threading.Thread(
+            target=lambda: [lines.put(l) for l in proc.stdout] + [lines.put("")],
+            daemon=True,
+        ).start()
+        port = None
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            try:
+                line = lines.get(timeout=5)
+            except _queue.Empty:
+                assert proc.poll() is None, "server died during startup"
+                continue
+            if line == "":
+                break  # EOF
+            if "serving on" in line:
+                port = int(line.rsplit(":", 1)[1])
+            if "warmed up" in line:
+                break
+        assert port, "server never reported its port"
+        r = urllib.request.urlopen(f"http://127.0.0.1:{port}/ready", timeout=5)
+        assert r.status == 200
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0, proc.stderr.read()[-500:]
+        err = proc.stderr.read()
+        assert "shutdown_begin" in err and "shutdown_complete" in err
+    finally:
+        if proc.poll() is None:
+            proc.kill()

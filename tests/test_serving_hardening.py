@@ -491,13 +491,12 @@ def test_negative_content_length_400_not_crash():
     assert b"bad content-length" in raw
 
 
-def test_profile_rearm_validation(server=None):
+def test_profile_rearm_validation():
     """/v1/profile input validation: disabled without profile_dir; bad or
     out-of-range batch counts are clean 400s."""
     import httpx
 
     from deconv_api_tpu.config import ServerConfig
-    from deconv_api_tpu.models.spec import init_params
     from tests.test_serving import ServiceFixture
 
     cfg = ServerConfig(
