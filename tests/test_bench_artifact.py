@@ -1,0 +1,110 @@
+"""Driver-artifact contract for bench.py (VERDICT r3 item 1).
+
+Round 3's BENCH artifact died rc=124 with nothing on stdout because the
+driver's outer ``timeout`` killed the bench parent before its guaranteed
+JSON line.  These tests pin the two defenses: the budget-derived child
+schedule and the parent signal net.  They spawn ``python bench.py`` as the
+driver does and assert that stdout carries exactly one machine-parseable
+JSON line under each failure mode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+BENCH = Path(__file__).resolve().parent.parent / "bench.py"
+REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
+
+
+def _json_lines(stdout: bytes) -> list[dict]:
+    out = []
+    for line in stdout.decode(errors="replace").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            out.append(json.loads(line))
+    return out
+
+
+def test_sigterm_mid_run_still_emits_one_parseable_line():
+    """External ``timeout`` sends SIGTERM first; the artifact must survive.
+
+    The measurement child takes tens of seconds even on CPU, so a SIGTERM
+    at ~2s always lands mid-measurement — the round-3 failure window."""
+    mark = f"bench-test-{os.getpid()}-{time.monotonic_ns()}"
+    env = dict(os.environ, DECONV_BENCH_TEST_MARK=mark)
+    proc = subprocess.Popen(
+        [sys.executable, str(BENCH)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        cwd=BENCH.parent,
+        env=env,
+    )
+    time.sleep(2.0)
+    proc.send_signal(signal.SIGTERM)
+    try:
+        stdout, _ = proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        pytest.fail("bench parent did not exit after SIGTERM")
+    lines = _json_lines(stdout)
+    assert len(lines) == 1, f"expected exactly one JSON line, got {lines!r}"
+    payload = lines[0]
+    assert REQUIRED_KEYS <= set(payload), payload
+    assert payload["value"] is None
+    assert "signal 15" in payload["error"]
+    # no orphaned measurement child from THIS run (identified by the env
+    # marker, so concurrent legitimate bench runs don't trip the check)
+    time.sleep(0.5)
+    live = []
+    for p in Path("/proc").iterdir():
+        if not p.name.isdigit():
+            continue
+        try:
+            environ = (p / "environ").read_bytes()
+        except OSError:
+            continue
+        if mark.encode() in environ and int(p.name) != proc.pid:
+            live.append(p.name)
+    assert not live, f"orphaned bench children: {live}"
+
+
+@pytest.mark.slow
+def test_budget_exhaustion_falls_back_to_cpu_line():
+    """Tunnel-down shape: TPU attempts bounded by the budget, then a CPU
+    fallback measurement line — all before any plausible outer timeout."""
+    env = dict(os.environ)
+    env.update(
+        DECONV_BENCH_BUDGET="240",
+        DECONV_BENCH_TIMEOUT="5",
+        DECONV_BENCH_TRIES="2",
+        DECONV_BENCH_BATCH="1",
+        DECONV_BENCH_ITERS="1",
+    )
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(BENCH)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        timeout=300,
+        cwd=BENCH.parent,
+        env=env,
+    )
+    wall = time.monotonic() - t0
+    lines = _json_lines(proc.stdout)
+    assert len(lines) == 1, f"expected exactly one JSON line, got {lines!r}"
+    payload = lines[0]
+    assert REQUIRED_KEYS <= set(payload), payload
+    # either the 5s "TPU" child finished (CPU test env) or the fallback ran;
+    # in both cases the line is a real measurement, not an error
+    assert payload.get("error") is None, payload
+    assert payload["value"] is not None and payload["value"] > 0
+    assert wall < 240, f"exceeded its own budget: {wall:.0f}s"

@@ -11,10 +11,10 @@ while kill -0 "$SUITE_PID" 2>/dev/null; do sleep 60; done
 cd "$(dirname "$0")/.."
 {
   echo "=== headline (batch 64) $(date -u +%FT%TZ) ==="
-  DECONV_BENCH_TRIES=2 timeout 1800 python bench.py --breakdown
+  DECONV_BENCH_BUDGET=1700 DECONV_BENCH_TIMEOUT=800 DECONV_BENCH_TRIES=2 timeout 1800 python bench.py --breakdown
   echo "=== headline batch 128 $(date -u +%FT%TZ) ==="
-  DECONV_BENCH_BATCH=128 DECONV_BENCH_TRIES=2 timeout 1800 python bench.py
+  DECONV_BENCH_BATCH=128 DECONV_BENCH_BUDGET=1700 DECONV_BENCH_TIMEOUT=800 DECONV_BENCH_TRIES=2 timeout 1800 python bench.py
   echo "=== headline batch 32 $(date -u +%FT%TZ) ==="
-  DECONV_BENCH_BATCH=32 DECONV_BENCH_TRIES=2 timeout 1800 python bench.py
+  DECONV_BENCH_BATCH=32 DECONV_BENCH_BUDGET=1700 DECONV_BENCH_TIMEOUT=800 DECONV_BENCH_TRIES=2 timeout 1800 python bench.py
   echo "=== done $(date -u +%FT%TZ) ==="
 } >> "$OUT" 2>&1
