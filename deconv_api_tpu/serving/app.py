@@ -695,11 +695,15 @@ class DeconvService:
         self.bound = (bind_host, bound_port)
         return bound_port
 
-    async def stop(self) -> None:
+    async def stop(self, grace_s: float = 10.0) -> None:
         await self.server.stop()
-        await self.dispatcher.stop()
-        await self.dream_dispatcher.stop()
-        await self.sweep_dispatcher.stop()
+        # One SHARED grace deadline across the three dispatchers: they sit
+        # on the same device, so a wedge is correlated — sequential
+        # independent graces would triple the drain (and blow through e.g.
+        # a k8s 30s terminationGracePeriod) for the same wedge.
+        deadline = time.perf_counter() + grace_s
+        for d in (self.dispatcher, self.dream_dispatcher, self.sweep_dispatcher):
+            await d.stop(grace_s=max(0.0, deadline - time.perf_counter()))
 
 
 def _encode_tiles(entry: dict) -> dict:

@@ -24,6 +24,7 @@ both modes.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -32,6 +33,34 @@ from deconv_api_tpu import errors
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.batcher")
+
+
+def _to_daemon_thread(fn: Callable[[], Any]) -> asyncio.Future:
+    """Run ``fn`` on a fresh DAEMON thread, resolving an asyncio future.
+
+    asyncio.to_thread uses the default executor, whose threads are
+    non-daemon and joined at interpreter exit — a device_get wedged in one
+    (the documented hang-not-raise backend failure mode) blocks process
+    exit forever even after the awaiting task is cancelled.  A daemon
+    thread lets the interpreter exit once the event loop is done with it.
+    Thread-per-call is fine at batch granularity (~100ms+ each)."""
+    loop = asyncio.get_running_loop()
+    fut: asyncio.Future = loop.create_future()
+
+    def _resolve(setter, value):
+        if not fut.cancelled():
+            setter(value)
+
+    def work():
+        try:
+            result = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the future
+            loop.call_soon_threadsafe(_resolve, fut.set_exception, e)
+        else:
+            loop.call_soon_threadsafe(_resolve, fut.set_result, result)
+
+    threading.Thread(target=work, daemon=True, name="batch-worker").start()
+    return fut
 
 
 @dataclass
@@ -93,12 +122,18 @@ class BatchingDispatcher:
         self._fetch_sem = asyncio.Semaphore(max(1, pipeline_depth))
         self._fetch_tasks: set[asyncio.Task] = set()
         self._last_done: float | None = None  # cadence observation anchor
+        self._stopping = False
 
     async def start(self) -> None:
         if self._task is None:
+            self._stopping = False  # allow a stop() -> start() restart cycle
             self._task = asyncio.create_task(self._run(), name="batch-dispatcher")
 
-    async def stop(self) -> None:
+    async def stop(self, grace_s: float = 10.0) -> None:
+        # Reject new submits immediately: a request racing stop() could
+        # otherwise enqueue after the drain loop below and sit in a
+        # dispatcherless queue until its full request-timeout 504.
+        self._stopping = True
         if self._task is not None:
             self._task.cancel()
             try:
@@ -107,7 +142,22 @@ class BatchingDispatcher:
                 pass
             self._task = None
         if self._fetch_tasks:
-            await asyncio.gather(*tuple(self._fetch_tasks), return_exceptions=True)
+            # Bounded drain: a wedged remote device_get HANGS rather than
+            # raises (documented backend failure mode), and an unbounded
+            # gather here would stall graceful shutdown indefinitely —
+            # leaving only the second-signal os._exit escape.  On timeout,
+            # cancel the stragglers; _finish fails their futures.
+            done, pending = await asyncio.wait(
+                tuple(self._fetch_tasks), timeout=grace_s
+            )
+            if pending:
+                _log.warning(
+                    "%d in-flight fetch task(s) exceeded the %.0fs shutdown "
+                    "grace; cancelling", len(pending), grace_s,
+                )
+                for t in pending:
+                    t.cancel()
+                await asyncio.gather(*pending, return_exceptions=True)
         # Items still queued (never picked up by a drain window) fail fast
         # with the same shutdown signal as the interrupted window — without
         # this they would hang to a full request-timeout 504.
@@ -150,6 +200,8 @@ class BatchingDispatcher:
         return (depth / eff_batch + self._inflight) * p50
 
     async def submit(self, image: Any, key: Any) -> Any:
+        if self._stopping:
+            raise errors.Unavailable("server shutting down")
         # Load shedding (VERDICT r2): when the queue already needs longer
         # than the request timeout to drain, every excess request is a
         # guaranteed 504 after a full timeout's wait — reject it NOW with a
@@ -203,12 +255,26 @@ class BatchingDispatcher:
         # group B.  Mixed-key bursts complete without starvation
         # (tests/test_serving.py::test_mixed_layer_burst).
         self._inflight = len(groups)
+        pending_groups = list(groups.values())
         try:
             for key, items in groups.items():
                 images = [it.image for it in items]
                 t0 = time.perf_counter()
                 try:
-                    results = await asyncio.to_thread(self._runner, key, images)
+                    results = await _to_daemon_thread(
+                        lambda key=key, images=images: self._runner(key, images)
+                    )
+                except asyncio.CancelledError:
+                    # stop() cancelled the dispatcher mid-batch: these items
+                    # are already out of the queue, so the stop() drain loop
+                    # cannot fail them — do it here or they 504 (r4 review)
+                    for grp in pending_groups:
+                        for it in grp:
+                            if not it.future.done():
+                                it.future.set_exception(
+                                    errors.Unavailable("server shutting down")
+                                )
+                    raise
                 except Exception as e:  # noqa: BLE001 — propagate to callers
                     for it in items:
                         if not it.future.done():
@@ -216,6 +282,7 @@ class BatchingDispatcher:
                     continue
                 finally:
                     self._inflight -= 1
+                    pending_groups = pending_groups[1:]
                 self._resolve(items, results, t0)
         finally:
             self._inflight = 0  # cancellation mid-drain must not leak count
@@ -229,8 +296,8 @@ class BatchingDispatcher:
         On cancellation (server shutdown) every group that has not handed
         its thunk to a fetch task FAILS its futures immediately — including
         the group whose dispatch the cancellation interrupted, whose device
-        results are unreachable (asyncio.to_thread discards the worker
-        thread's return value on cancel).  Letting them hang to a full
+        results are unreachable (the cancelled await discards the worker
+        thread's eventual result).  Letting them hang to a full
         request-timeout 504 would stall graceful shutdown."""
         self._inflight += len(groups)
         handed_off = 0
@@ -241,8 +308,10 @@ class BatchingDispatcher:
                 await self._fetch_sem.acquire()
                 t0 = time.perf_counter()
                 try:
-                    thunk = await asyncio.to_thread(
-                        self._dispatch_runner, key, images
+                    thunk = await _to_daemon_thread(
+                        lambda key=key, images=images: self._dispatch_runner(
+                            key, images
+                        )
                     )
                 except asyncio.CancelledError:
                     self._fetch_sem.release()  # held permit must not leak
@@ -276,7 +345,17 @@ class BatchingDispatcher:
 
     async def _finish(self, items: list[WorkItem], thunk, t0: float) -> None:
         try:
-            results = await asyncio.to_thread(thunk)
+            results = await _to_daemon_thread(thunk)
+        except asyncio.CancelledError:
+            # stop()'s bounded grace cancels wedged fetches; their results
+            # are unreachable (to_thread discards the worker's return on
+            # cancel) so the futures must fail NOW, not 504 later
+            for it in items:
+                if not it.future.done():
+                    it.future.set_exception(
+                        errors.Unavailable("server shutting down")
+                    )
+            raise
         except Exception as e:  # noqa: BLE001 — propagate to callers
             for it in items:
                 if not it.future.done():

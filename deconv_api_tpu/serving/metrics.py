@@ -150,6 +150,12 @@ class Metrics:
             f"{p}_images_per_sec {s['images_per_sec']:.3f}",
             f"# TYPE {p}_batch_size summary",
             f'{p}_batch_size{{quantile="0.5"}} {s["batch_size_p50"]:.1f}',
+            # HELP: dispatch->fetch-completion wall per batch.  Under the
+            # pipelined dispatcher this window OVERLAPS other batches, so
+            # it overstates per-batch device time; use batch_cadence_seconds
+            # for the sustained per-batch rate (ADVICE r3)
+            f"# HELP {p}_batch_compute_seconds dispatch-to-fetch wall; "
+            "overlaps other batches when pipelined — see batch_cadence_seconds",
             f"# TYPE {p}_batch_compute_seconds summary",
             f'{p}_batch_compute_seconds{{quantile="0.5"}} {s["compute_p50_s"]:.6f}',
             # inter-completion interval under sustained load — the

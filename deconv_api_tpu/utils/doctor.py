@@ -96,7 +96,12 @@ def check_rtt(platform: str | None = None) -> dict:
         + "import json, time, statistics, jax.numpy as jnp\n"
         "f = jax.jit(lambda i: jnp.float32(i) + 1.0)\n"
         "vals = [f(i) for i in range(6)]\n"
-        "float(vals[0])  # settle dispatch + compile\n"
+        "# settle ALL executions before timing: the device runs programs in\n"
+        "# dispatch order, so fetching a program dispatched AFTER vals[1:]\n"
+        "# guarantees they have all completed — without fetching vals\n"
+        "# themselves (a fetched jax.Array caches its host copy, which would\n"
+        "# make the timed re-fetch free and the RTT read ~0)\n"
+        "float(f(99))\n"
         "ts = []\n"
         "for v in vals[1:]:\n"
         "    t0 = time.perf_counter()\n"

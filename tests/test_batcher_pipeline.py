@@ -350,3 +350,126 @@ def test_stop_fails_queued_items_fast():
         assert len(ok) + len(failed) == 6 and failed  # queued tail failed fast
 
     asyncio.run(go())
+
+
+def test_submit_after_stop_fails_fast():
+    """A request racing stop() must get an immediate Unavailable, not sit
+    in a dispatcherless queue until its full request-timeout 504
+    (ADVICE r3)."""
+    from deconv_api_tpu import errors
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: ["r"] * len(i), max_batch=1, window_ms=1.0,
+            request_timeout_s=30.0,
+        )
+        await d.start()
+        await d.stop()
+        t0 = time.perf_counter()
+        with pytest.raises(errors.Unavailable):
+            await d.submit(_img(), "a")
+        assert time.perf_counter() - t0 < 1.0  # immediate, not a 504 wait
+
+    asyncio.run(go())
+
+
+def test_stop_grace_bounds_wedged_fetch():
+    """A wedged device_get (hangs, never raises — the documented backend
+    failure mode) must not stall graceful shutdown: stop(grace_s) cancels
+    the straggler after the grace budget and fails its futures with
+    Unavailable (ADVICE r3)."""
+    from deconv_api_tpu import errors
+
+    wedge = threading.Event()  # never set: the fetch thunk hangs "forever"
+
+    def dispatch(key, images):
+        def thunk():
+            wedge.wait(30)  # far beyond the grace budget
+            return ["late"] * len(images)
+
+        return thunk
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: [None], dispatch_runner=dispatch,
+            pipeline_depth=2, max_batch=1, window_ms=1.0,
+        )
+        await d.start()
+        fut = asyncio.create_task(d.submit(_img(), "a"))
+        await asyncio.sleep(0.1)  # dispatched; fetch task now wedged
+        t0 = time.perf_counter()
+        await d.stop(grace_s=0.5)
+        stop_wall = time.perf_counter() - t0
+        assert stop_wall < 5.0, f"stop() stalled {stop_wall:.1f}s on a wedged fetch"
+        with pytest.raises(errors.Unavailable):
+            await fut
+        wedge.set()  # unblock the worker thread for clean teardown
+
+    asyncio.run(go())
+
+
+def test_serial_stop_mid_execution_fails_items_fast():
+    """Serial mode (pipeline_depth=1): items inside the batch being
+    executed when stop() cancels the dispatcher must fail with Unavailable
+    immediately, not hang to the full request-timeout 504 (r4 review)."""
+    from deconv_api_tpu import errors
+
+    release = threading.Event()
+
+    def runner(key, images):
+        release.wait(30)  # simulate a long device call
+        return ["late"] * len(images)
+
+    async def go():
+        d = BatchingDispatcher(
+            runner, max_batch=1, window_ms=1.0,
+            request_timeout_s=60.0, pipeline_depth=1,
+        )
+        await d.start()
+        fut = asyncio.create_task(d.submit(_img(), "a"))
+        await asyncio.sleep(0.1)  # runner now blocking in its worker thread
+        t0 = time.perf_counter()
+        await d.stop(grace_s=0.5)
+        assert time.perf_counter() - t0 < 5.0
+        with pytest.raises(errors.Unavailable):
+            await asyncio.wait_for(fut, 2.0)  # fails NOW, not after 60s
+        release.set()
+
+    asyncio.run(go())
+
+
+def test_wedged_worker_does_not_block_interpreter_exit():
+    """A device_get wedged forever in a worker thread must not block
+    process exit: workers are daemon threads, so after stop() the
+    interpreter exits instead of hanging in the executor's atexit join
+    (r4 review).  Runs in a subprocess to observe real interpreter exit."""
+    import subprocess
+    import sys
+
+    code = """
+import asyncio, threading, numpy as np
+from deconv_api_tpu.serving.batcher import BatchingDispatcher
+
+def dispatch(key, images):
+    def thunk():
+        threading.Event().wait()  # wedged FOREVER — never returns
+    return thunk
+
+async def go():
+    d = BatchingDispatcher(lambda k, i: [None], dispatch_runner=dispatch,
+                           pipeline_depth=2, max_batch=1, window_ms=1.0)
+    await d.start()
+    t = asyncio.create_task(d.submit(np.zeros((2, 2, 3), np.float32), "a"))
+    await asyncio.sleep(0.2)
+    await d.stop(grace_s=0.3)
+    t.cancel()
+
+asyncio.run(go())
+print("EXITED-CLEANLY", flush=True)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, timeout=60,
+    )
+    assert b"EXITED-CLEANLY" in proc.stdout, proc.stderr.decode()[-500:]
+    assert proc.returncode == 0
