@@ -26,30 +26,35 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TIMEOUTS = {1: 1800, 2: 2400, 3: 5400, 4: 3600, 5: 2400}
 
 
-def run_one(n: int, timeout_s: float) -> dict:
-    code = (
-        "import json, sys\n"
-        "from deconv_api_tpu.config import ServerConfig, enable_compilation_cache\n"
-        "enable_compilation_cache(ServerConfig.from_env())\n"
-        "from deconv_api_tpu.bench.suite import run_config\n"
-        f"print(json.dumps(run_config({n})), flush=True)\n"
-    )
+def run_cmd_json(
+    cmd: list[str], timeout_s: float, env: dict | None = None
+) -> dict:
+    """Run a child under a hard timeout; return its last stdout JSON line.
+
+    Failures return an {"error": ...} row instead of raising — timeout,
+    nonzero rc (with a stderr tail), or no JSON on stdout.  Shared by the
+    bench suite and the tunnel watcher so error classification lives in
+    exactly one place."""
+    full_env = None
+    if env:
+        full_env = dict(os.environ)
+        full_env.update(env)
     t0 = time.monotonic()
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", code],
+            cmd,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             timeout=timeout_s,
             cwd=REPO,
+            env=full_env,
         )
     except subprocess.TimeoutExpired:
-        return {"config": n, "error": f"timeout after {timeout_s:.0f}s"}
+        return {"error": f"timeout after {timeout_s:.0f}s"}
     wall = time.monotonic() - t0
     sys.stderr.write(proc.stderr.decode(errors="replace")[-4000:])
     if proc.returncode != 0:
         return {
-            "config": n,
             "error": f"rc={proc.returncode}",
             "stderr_tail": proc.stderr.decode(errors="replace")[-800:],
         }
@@ -62,7 +67,20 @@ def run_one(n: int, timeout_s: float) -> dict:
                 return out
             except json.JSONDecodeError:
                 continue
-    return {"config": n, "error": "no JSON output"}
+    return {"error": "no JSON output"}
+
+
+def run_one(n: int, timeout_s: float) -> dict:
+    code = (
+        "import json, sys\n"
+        "from deconv_api_tpu.config import ServerConfig, enable_compilation_cache\n"
+        "enable_compilation_cache(ServerConfig.from_env())\n"
+        "from deconv_api_tpu.bench.suite import run_config\n"
+        f"print(json.dumps(run_config({n})), flush=True)\n"
+    )
+    row = run_cmd_json([sys.executable, "-c", code], timeout_s)
+    row.setdefault("config", n)
+    return row
 
 
 def preflight(timeout_s: float = 120.0) -> bool:

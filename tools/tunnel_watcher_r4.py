@@ -1,0 +1,126 @@
+"""Round-4 tunnel watcher: run the owed hardware measurements when the
+axon TPU tunnel returns (VERDICT r3 items 2 and 3).
+
+The round-3 tunnel outage left three measurements owed: the headline with
+the pipelined dispatcher + single device_get serving changes (configs 5
+and 2), and the sustained-dispatch anomaly probe.  This watcher polls
+tunnel liveness (subprocess preflight under a hard timeout — a dead
+tunnel HANGS at backend init) and, on recovery, runs each measurement in
+its own child, strictly sequentially (two processes on the tunnel at once
+wedge the backend).  Results append to bench_suite_results.jsonl with a
+"which" tag and date.
+
+Usage: python tools/tunnel_watcher_r4.py [--max-hours 10] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from run_bench_suite import TIMEOUTS, preflight, run_cmd_json, run_one  # noqa: E402
+
+
+def log(msg: str) -> None:
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    print(f"[watcher {ts}] {msg}", file=sys.stderr, flush=True)
+
+
+def append(out_path: str, row: dict) -> None:
+    row = dict(row, date=datetime.date.today().isoformat())
+    with open(out_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    log(f"recorded: {json.dumps(row)[:200]}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "bench_suite_results.jsonl")
+    )
+    args = ap.parse_args()
+    deadline = time.monotonic() + args.max_hours * 3600
+
+    # measurement plan, in order of evidentiary value
+    plan = [
+        (
+            "headline_r4",
+            lambda: run_cmd_json(
+                [sys.executable, os.path.join(REPO, "bench.py"), "--breakdown"],
+                1200,
+                env={"DECONV_BENCH_BUDGET": "1100", "DECONV_BENCH_TIMEOUT": "600"},
+            ),
+        ),
+        (
+            "sustained_probe",
+            lambda: run_cmd_json(
+                [sys.executable, os.path.join(REPO, "tools", "sustained_probe.py")],
+                1800,
+            ),
+        ),
+        ("config5_r4", lambda: run_one(5, TIMEOUTS[5])),
+        ("config2_r4", lambda: run_one(2, TIMEOUTS[2])),
+    ]
+
+    MAX_ATTEMPTS = 3
+    succeeded: set[str] = set()
+    attempts: dict[str, int] = {w: 0 for w, _ in plan}
+
+    def exhausted(which: str) -> bool:
+        return attempts[which] >= MAX_ATTEMPTS
+
+    def all_settled() -> bool:
+        return all(w in succeeded or exhausted(w) for w, _ in plan)
+
+    delay = 60.0
+    while not all_settled() and time.monotonic() < deadline:
+        if not preflight():
+            log(f"tunnel down; retry in {delay:.0f}s")
+            time.sleep(min(delay, max(1.0, deadline - time.monotonic())))
+            delay = min(delay * 1.5, 300.0)
+            continue
+        delay = 60.0
+        log("tunnel UP — running owed measurements")
+        for which, fn in plan:
+            if which in succeeded or exhausted(which):
+                continue
+            attempts[which] += 1
+            log(f"running {which} (attempt {attempts[which]}/{MAX_ATTEMPTS})")
+            row = fn()
+            row["which"] = which
+            row["attempt"] = attempts[which]
+            append(args.out, row)
+            if "error" in row:
+                # ANY failure (timeout, crash, signal-killed child) is
+                # retried on a later tunnel-up pass until attempts run out —
+                # an error row recorded is not a measurement taken
+                log(f"{which} failed ({row['error']}); re-probing tunnel")
+                break
+            succeeded.add(which)
+    abandoned = [w for w, _ in plan if w not in succeeded]
+    append(
+        args.out,
+        {
+            "which": "watcher_r4_summary",
+            "succeeded": sorted(succeeded),
+            "unfinished": abandoned,
+            "attempts": attempts,
+        },
+    )
+    if abandoned:
+        log(f"finished with unmeasured items: {abandoned}")
+        return 1
+    log("all owed measurements recorded")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
