@@ -184,6 +184,32 @@ def _pack_boundary(entries, ups, i, max_chan: int) -> int:
     return jb
 
 
+def _select_top(output, top_k):
+    """Reference top-filter selection (app/deepdream.py:369-380) in-graph:
+    positive channel sums ranked descending; non-positive ranks surface in
+    the `valid` mask (static shapes) rather than shrinking the result."""
+    n_chan = output.shape[-1]
+    k = min(top_k, n_chan)
+    reduce_axes = tuple(range(output.ndim - 1))
+    sums = jnp.sum(output, axis=reduce_axes)
+    masked = jnp.where(sums > 0, sums, -jnp.inf)
+    top_sums, top_idx = lax.top_k(masked, k)
+    return top_idx, top_sums, top_sums > 0
+
+
+def _seed_fmap(output, idx, mode):
+    """One projection seed: the selected channel's feature map, mode-masked
+    (app/deepdream.py:454-457), re-embedded at its channel position.
+    `output` is (1, h, w, C); returns (1, h, w, C)."""
+    n_chan = output.shape[-1]
+    chan = jax.nn.one_hot(idx, n_chan, dtype=output.dtype)
+    fmap = jnp.sum(output * chan, axis=-1)  # == output[..., idx]
+    if mode == "max":
+        # Keep only positions equal to the global max (ties all kept).
+        fmap = fmap * (fmap == jnp.max(fmap)).astype(fmap.dtype)
+    return fmap[..., None] * chan
+
+
 def _visualize_entry(
     entries, params, ups, switches, i, top_k, mode, bug_compat, backward_dtype,
     kpack_chan=0,
@@ -204,13 +230,7 @@ def _visualize_entry(
     packing saves.  Default OFF; kept as the measurement harness for
     revisiting on future toolchains (same policy as ops/pallas_pool.py)."""
     output = ups[i]
-    n_chan = output.shape[-1]
-    k = min(top_k, n_chan)
-    reduce_axes = tuple(range(output.ndim - 1))
-    sums = jnp.sum(output, axis=reduce_axes)
-    masked = jnp.where(sums > 0, sums, -jnp.inf)
-    top_sums, top_idx = lax.top_k(masked, k)
-    valid = top_sums > 0
+    top_idx, top_sums, valid = _select_top(output, top_k)
 
     jb = _pack_boundary(entries, ups, i, kpack_chan) if kpack_chan > 0 else -1
 
@@ -219,13 +239,7 @@ def _visualize_entry(
         entry `stop_after`, matching _down_chain's exclusive bound; -1
         walks the full chain to pixels.  With stop_after=jb the packed
         tail owns entry jb itself."""
-        chan = jax.nn.one_hot(idx, n_chan, dtype=output.dtype)
-        fmap = jnp.sum(output * chan, axis=-1)  # == output[..., idx]
-        if mode == "max":
-            # Keep only positions equal to the global max (ties all kept),
-            # reference app/deepdream.py:454-457.
-            fmap = fmap * (fmap == jnp.max(fmap)).astype(fmap.dtype)
-        x = fmap[..., None] * chan
+        x = _seed_fmap(output, idx, mode)
         if backward_dtype is not None:
             # Mixed precision: selection ran on the exact forward; the
             # projection chain (8/9 of the FLOPs) runs in e.g. bfloat16.
@@ -262,6 +276,62 @@ def _visualize_entry(
     }
 
 
+def _sweep_merged(
+    entries, params, ups, switches, vis_indices, top_k, mode, bug_compat,
+    backward_dtype,
+):
+    """All-layers sweep with cross-layer projections MERGED through the
+    shared tail (VERDICT r3 item 7; BASELINE config 2).
+
+    The separate-per-layer sweep walks the chain below layer L once per
+    layer above it: for VGG16's 15-entry sweep the block1/2 segments — the
+    chain's HBM-bound, lane-underfilled part (BASELINE.md layer-sweep
+    localisation) — execute 15 x 8 projections in 15 separate K=8 batches.
+    Every projection from every layer traverses the SAME lower entries
+    with the same spatial/channel shapes, so instead: walk the chain once,
+    deepest entry first, concatenating each layer's K fresh seeds onto the
+    in-flight batch at that layer's boundary.  The shallow segments then
+    run ONE batch of up to K x n_layers projections — identical FLOPs,
+    ~n_layers x fewer program segments, and far better MXU occupancy on
+    the low-channel tail.
+
+    Results are bit-identical per projection up to XLA reduction-order
+    fusion differences (same ops, same order, bigger batch); the engine
+    parity tests bound the delta.
+    """
+    results = {}
+    spans = []  # (name, start_offset, k) in carry order, deepest first
+    carry = None
+    offset = 0
+    for pos, i in enumerate(vis_indices):
+        output = ups[i]
+        top_idx, top_sums, valid = _select_top(output, top_k)
+        k = top_idx.shape[0]
+        # Seeds for this layer, K folded into the leading (batch) axis —
+        # ops are batch-agnostic and the pool switches (batch 1) broadcast.
+        seeds = jax.vmap(lambda t: _seed_fmap(output, t, mode))(top_idx)
+        seeds = seeds.reshape((k,) + output.shape[1:])
+        if backward_dtype is not None:
+            seeds = seeds.astype(backward_dtype)
+        carry = seeds if carry is None else jnp.concatenate(
+            [carry.astype(seeds.dtype), seeds], axis=0
+        )
+        results[entries[i].name] = {
+            "indices": top_idx, "sums": top_sums, "valid": valid,
+        }
+        spans.append((entries[i].name, offset, k))
+        offset += k
+        next_stop = vis_indices[pos + 1] if pos + 1 < len(vis_indices) else -1
+        carry = _down_chain(
+            entries, params, ups, switches, carry, i, next_stop, bug_compat
+        )
+    out_dtype = ups[0].dtype
+    carry = carry.astype(out_dtype)
+    for name, start, k in spans:
+        results[name]["images"] = carry[start : start + k]
+    return results
+
+
 def get_visualizer(
     spec: ModelSpec,
     layer_name: str,
@@ -272,6 +342,7 @@ def get_visualizer(
     batched: bool = False,
     backward_dtype: str | None = None,
     kpack_chan: int | None = None,
+    sweep_merged: bool | None = None,
 ):
     """Build (and cache) the jitted visualizer for a static configuration.
 
@@ -285,17 +356,26 @@ def get_visualizer(
     ``kpack_chan`` sets the channel threshold below which the backward
     tail runs K-packed into the channel dim (see ``_visualize_entry`` —
     measured slower end-to-end, so the default is OFF); ``None`` reads
-    ``DECONV_KPACK_CHAN`` (default 0 = disabled).  The env var is resolved
-    HERE, outside the cache, so changing it between calls always takes
+    ``DECONV_KPACK_CHAN`` (default 0 = disabled).  ``sweep_merged``
+    selects the merged cross-layer sweep (``_sweep_merged``); ``None``
+    reads ``DECONV_SWEEP_MERGED`` (default 1 = ON); a nonzero
+    ``kpack_chan`` always takes the separate-per-layer path (the merged
+    sweep has no packed tail).  Env vars are resolved
+    HERE, outside the cache, so changing them between calls always takes
     effect (the cache never keys on a stale environment read).
     """
-    if kpack_chan is None:
-        import os
+    import os
 
+    if kpack_chan is None:
         kpack_chan = int(os.environ.get("DECONV_KPACK_CHAN", "0"))
+    if sweep_merged is None:
+        # same falsy vocabulary as DECONV_PALLAS (ops/pallas_pool.py)
+        sweep_merged = os.environ.get(
+            "DECONV_SWEEP_MERGED", "1"
+        ).lower() not in ("0", "false", "off", "no", "")
     return _get_visualizer_cached(
         spec, layer_name, top_k, mode, bug_compat, sweep, batched,
-        backward_dtype, kpack_chan,
+        backward_dtype, kpack_chan, bool(sweep_merged),
     )
 
 
@@ -310,6 +390,7 @@ def _get_visualizer_cached(
     batched: bool,
     backward_dtype: str | None,
     kpack_chan: int,
+    sweep_merged: bool = True,
 ):
     if mode not in ("all", "max"):
         # The reference sys.exit()s the server here (app/deepdream.py:458-460);
@@ -339,6 +420,14 @@ def _get_visualizer_cached(
         for e in entries:
             x = _up_step(e, params, x, switches)
             ups.append(x)
+        # An explicit K-packed-tail request uses the separate-per-layer
+        # path (_sweep_merged has no packed tail; silently ignoring the
+        # requested kpack_chan would make A/B measurements meaningless).
+        if sweep and sweep_merged and kpack_chan == 0 and len(vis_indices) > 1:
+            return _sweep_merged(
+                entries, params, ups, switches, vis_indices, top_k, mode,
+                bug_compat, bwd_dtype,
+            )
         return {
             entries[i].name: _visualize_entry(
                 entries, params, ups, switches, i, top_k, mode, bug_compat,

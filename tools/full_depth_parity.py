@@ -42,10 +42,14 @@ def psnr_db(a: np.ndarray, b: np.ndarray, peak: float) -> float:
     return 10 * np.log10(peak**2 / max(mse, 1e-20))
 
 
-def run(layer: str = "block5_conv1", top_k: int = 8) -> dict:
+def run(layer: str = "block5_conv1", top_k: int = 8, mode: str = "all") -> dict:
     """Full-depth parity measurement: fixed seeds, returns the results
     dict.  Callable from the `-m slow` test (tests/test_full_depth_parity)
-    so future engine changes cannot silently regress bug-compat parity."""
+    so future engine changes cannot silently regress bug-compat parity.
+
+    ``mode`` is the reference's visualize_mode: 'all' projects the whole
+    feature map, 'max' only its argmax positions (ties included —
+    app/deepdream.py:454-457)."""
     import jax
 
     # Force CPU only while backends are uninitialised: jax.default_backend()
@@ -92,8 +96,11 @@ def run(layer: str = "block5_conv1", top_k: int = 8) -> dict:
     oracle_imgs = []
     t0 = time.perf_counter()
     for rank, (fidx, _) in enumerate(top):
+        fmap = output[..., fidx]
+        if mode == "max":
+            fmap = fmap * (fmap == fmap.max())  # app/deepdream.py:454-457
         seed = np.zeros_like(output)
-        seed[..., fidx] = output[..., fidx]
+        seed[..., fidx] = fmap
         sig = entries[target_i].down(seed)
         for j in range(target_i - 1, -1, -1):
             sig = entries[j].down(sig)
@@ -104,13 +111,13 @@ def run(layer: str = "block5_conv1", top_k: int = 8) -> dict:
     oracle_imgs = np.stack(oracle_imgs)
 
     # ---- engine (exact fp32 and the bf16-backward serving path) ----
-    results = {"layer": layer, "top_k": len(top),
+    results = {"layer": layer, "top_k": len(top), "mode": mode,
                "oracle_forward_s": round(fwd_s, 1),
                "oracle_backward_s": round(bwd_s, 1)}
     for label, bwd_dtype in (("fp32", None), ("bf16_backward", "bfloat16")):
         t0 = time.perf_counter()
         fn = get_visualizer(
-            spec, layer, top_k, "all", True, backward_dtype=bwd_dtype
+            spec, layer, top_k, mode, True, backward_dtype=bwd_dtype
         )
         out = fn(params, jnp.asarray(img, jnp.float32))[layer]
         dt = time.perf_counter() - t0
@@ -141,8 +148,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--layer", default="block5_conv1")
     ap.add_argument("--top-k", type=int, default=8)
+    ap.add_argument("--mode", default="all", choices=("all", "max"))
     args = ap.parse_args()
-    print(json.dumps(run(args.layer, args.top_k)))
+    print(json.dumps(run(args.layer, args.top_k, args.mode)))
 
 
 if __name__ == "__main__":
