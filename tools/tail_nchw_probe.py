@@ -1,0 +1,87 @@
+"""A/B the NCHW low-channel backward tail on hardware (VERDICT r3 item 4).
+
+The headline program's remaining ~55 ms of roofline slack sits in the
+block1/2 backward segments, where NHWC C<128 tensors pad the lane dim 2x
+(BASELINE.md layer-sweep localisation).  DECONV_TAIL_NCHW re-lays that
+tail channels-major (engine/deconv.py:_down_chain_nchw); whether XLA:TPU
+preserves the layout win or canonicalises it away is measurable only on
+the chip.
+
+Measures the full headline program (batch 64, fp32 fwd + bf16 bwd,
+pipelined dispatch-all / fetch-one-trailing-checksum timing — BASELINE.md
+tunnel anatomy) at nchw_chan in {0 (off), 64 (block1 only), 128
+(block1+2)}.  Prints one JSON line with ms/batch per variant.
+
+Run AFTER the round-4 watcher finishes (one process on the tunnel at a
+time): python tools/tail_nchw_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+BATCH = int(os.environ.get("DECONV_BENCH_BATCH", "64"))
+ITERS = int(os.environ.get("DECONV_BENCH_ITERS", "10"))
+
+
+def main() -> None:
+    if "--cpu" in sys.argv:
+        # config-level override — the only form that prevents axon plugin
+        # init (env JAX_PLATFORMS does not; bench.py docstring)
+        jax.config.update("jax_platforms", "cpu")
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    enable_compilation_cache(ServerConfig.from_env())
+    print(f"device: {jax.devices()[0]}", file=sys.stderr, flush=True)
+
+    spec, params = vgg16_init()
+    batches = [
+        jax.random.normal(jax.random.PRNGKey(100 + i), (BATCH, 224, 224, 3))
+        for i in range(ITERS)
+    ]
+
+    @jax.jit
+    def checksum(out):
+        return sum(
+            jnp.sum(leaf.astype(jnp.float32))
+            for leaf in jax.tree_util.tree_leaves(out)
+        )
+
+    out = {"batch": BATCH, "iters": ITERS, "which": "tail_nchw_probe"}
+    for thr in (0, 64, 128):
+        fn = get_visualizer(
+            spec, "block5_conv1", 8, "all", True, batched=True,
+            backward_dtype="bfloat16", nchw_chan=thr,
+        )
+        t0 = time.perf_counter()
+        val = float(checksum(fn(params, batches[0])))
+        compile_s = time.perf_counter() - t0
+        print(
+            f"nchw_chan={thr}: compile+first {compile_s:.1f}s "
+            f"(checksum {val:.3e})", file=sys.stderr, flush=True,
+        )
+        t0 = time.perf_counter()
+        sums = [checksum(fn(params, b)) for b in batches]
+        float(sums[-1])  # one trailing fetch covers all executions
+        dt = time.perf_counter() - t0
+        for s in sums[:-1]:
+            assert float(s) == float(s)
+        ms = dt / ITERS * 1e3
+        out[f"nchw{thr}_ms_per_batch"] = round(ms, 1)
+        out[f"nchw{thr}_img_s"] = round(BATCH * ITERS / dt, 1)
+        print(f"nchw_chan={thr}: {ms:.1f} ms/batch", file=sys.stderr, flush=True)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
