@@ -96,3 +96,23 @@ def make_train_step(
         return init_jit, step_jit
 
     return build
+
+
+def make_eval_step(spec: ModelSpec, mesh):
+    """Jitted held-out evaluation over the mesh: (params, images, labels)
+    -> (mean loss, accuracy).  Batch dp-sharded like the train step; the
+    scalar metrics come back replicated (XLA inserts the psum)."""
+
+    def eval_fn(params, images, labels):
+        logits = forward(spec, params, images, logits=True)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+        return loss, acc
+
+    return jax.jit(
+        eval_fn,
+        in_shardings=(None, batch_sharding(mesh), batch_sharding(mesh)),
+        out_shardings=(replicated(mesh), replicated(mesh)),
+    )
