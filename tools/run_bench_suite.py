@@ -70,7 +70,7 @@ def run_cmd_json(
     return {"error": "no JSON output"}
 
 
-def run_one(n: int, timeout_s: float) -> dict:
+def run_one(n: int, timeout_s: float, env: dict | None = None) -> dict:
     code = (
         "import json, sys\n"
         "from deconv_api_tpu.config import ServerConfig, enable_compilation_cache\n"
@@ -78,7 +78,7 @@ def run_one(n: int, timeout_s: float) -> dict:
         "from deconv_api_tpu.bench.suite import run_config\n"
         f"print(json.dumps(run_config({n})), flush=True)\n"
     )
-    row = run_cmd_json([sys.executable, "-c", code], timeout_s)
+    row = run_cmd_json([sys.executable, "-c", code], timeout_s, env=env)
     row.setdefault("config", n)
     return row
 

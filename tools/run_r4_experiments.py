@@ -1,0 +1,121 @@
+"""Round-4 perf experiments, chained AFTER the tunnel watcher completes.
+
+The watcher (tools/tunnel_watcher_r4.py) owns the tunnel first — it
+records the measurements round 3 left owed.  Once its summary row lands
+in bench_suite_results.jsonl this runner takes the tunnel (one process at
+a time) and A/Bs the round-4 perf work:
+
+1. `tail_nchw_probe` — NCHW low-channel tail at thresholds 0/64/128
+   (VERDICT r3 item 4; tools/tail_nchw_probe.py);
+2. `config2_sweep_separate` — BASELINE config 2 with
+   DECONV_SWEEP_MERGED=0, the A/B partner of the watcher's `config2_r4`
+   row (which measures the new merged sweep, default ON).
+
+Usage: python tools/run_r4_experiments.py [--max-hours 9]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from run_bench_suite import TIMEOUTS, preflight, run_cmd_json, run_one  # noqa: E402
+
+
+def log(msg: str) -> None:
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    print(f"[r4-exp {ts}] {msg}", file=sys.stderr, flush=True)
+
+
+def append(out_path: str, row: dict) -> None:
+    row = dict(row, date=datetime.date.today().isoformat())
+    with open(out_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    log(f"recorded: {json.dumps(row)[:200]}")
+
+
+def watcher_done(out_path: str) -> bool:
+    try:
+        with open(out_path) as f:
+            return any('"watcher_r4_summary"' in line for line in f)
+    except OSError:
+        return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=9.0)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "bench_suite_results.jsonl")
+    )
+    args = ap.parse_args()
+    deadline = time.monotonic() + args.max_hours * 3600
+
+    log("waiting for the tunnel watcher to finish its owed measurements")
+    while not watcher_done(args.out):
+        if time.monotonic() > deadline:
+            log("deadline reached before the watcher finished; giving up")
+            return 1
+        time.sleep(120)
+
+    plan = [
+        (
+            "tail_nchw_probe",
+            lambda: run_cmd_json(
+                [sys.executable, os.path.join(REPO, "tools", "tail_nchw_probe.py")],
+                2400,
+            ),
+        ),
+        (
+            "config2_sweep_separate",
+            lambda: run_one(2, TIMEOUTS[2], env={"DECONV_SWEEP_MERGED": "0"}),
+        ),
+    ]
+
+    attempts = {w: 0 for w, _ in plan}
+    succeeded: set[str] = set()
+    while (
+        any(w not in succeeded and attempts[w] < 3 for w, _ in plan)
+        and time.monotonic() < deadline
+    ):
+        if not preflight():
+            log("tunnel down; retry in 120s")
+            time.sleep(120)
+            continue
+        for which, fn in plan:
+            if which in succeeded or attempts[which] >= 3:
+                continue
+            if time.monotonic() > deadline:
+                # a pass entered near the deadline must not overshoot it by
+                # a full item (the driver's outer timeout would SIGKILL
+                # mid-experiment and lose the summary row)
+                log("deadline reached mid-pass; stopping")
+                break
+            attempts[which] += 1
+            log(f"running {which} (attempt {attempts[which]}/3)")
+            row = fn()
+            row["which"] = which
+            row["attempt"] = attempts[which]
+            append(args.out, row)
+            if "error" in row:
+                log(f"{which} failed ({row['error']}); re-probing tunnel")
+                break
+            succeeded.add(which)
+    missing = [w for w, _ in plan if w not in succeeded]
+    append(
+        args.out,
+        {"which": "r4_experiments_summary", "succeeded": sorted(succeeded),
+         "unfinished": missing},
+    )
+    return 0 if not missing else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
