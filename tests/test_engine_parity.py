@@ -291,6 +291,21 @@ def test_merged_sweep_matches_separate():
                 np.asarray(mrg[name]["images"]),
                 rtol=1e-4, atol=1e-5, err_msg=f"{mode}/{name}",
             )
+    # full dense head: merged seeds must also concatenate correctly across
+    # the flatten/dense boundaries (sweep from 'predictions' is a legal
+    # reference request, app/main.py:57)
+    sep = get_visualizer(
+        TINY, "predictions", 4, "all", True, sweep=True, sweep_merged=False
+    )(params, img)
+    mrg = get_visualizer(
+        TINY, "predictions", 4, "all", True, sweep=True, sweep_merged=True
+    )(params, img)
+    assert set(sep) == set(mrg)
+    for name in sep:
+        np.testing.assert_allclose(
+            np.asarray(sep[name]["images"]), np.asarray(mrg[name]["images"]),
+            rtol=1e-4, atol=1e-5, err_msg=f"dense-head {name}",
+        )
     # bf16-backward, batched (the serving sweep configuration)
     batch = img[None].repeat(3, 0)
     sep = get_visualizer(

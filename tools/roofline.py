@@ -33,8 +33,43 @@ HBM_BW = 819e9  # B/s
 RIDGE = PEAK_BF16 / HBM_BW  # FLOP/byte needed to be MXU-bound (~240)
 
 
+def _conv_segs(l, in_shape, out, batch, nsig):
+    """Forward + backward accounting for one conv layer, with `nsig`
+    projection signals crossing it downward (headline: top_k; sweep:
+    top_k x vis-layers-above).  ONE formula set for both rooflines so the
+    modeling assumptions cannot drift between them."""
+    oh, ow, cout = out
+    kh, kw = l.kernel_size
+    cin = in_shape[-1]
+    flops = 2.0 * batch * oh * ow * cout * kh * kw * cin
+    # weights read once per program: fp32 forward copy, bf16 backward copy
+    fbytes = batch * (
+        in_shape[0] * in_shape[1] * cin + oh * ow * cout
+    ) * 4 + kh * kw * cin * cout * 4
+    fwd = (f"fwd {l.name}", flops, fbytes)
+    bbytes = nsig * batch * (
+        in_shape[0] * in_shape[1] * cin + oh * ow * cout
+    ) * 2 + kh * kw * cin * cout * 2
+    bwd = (f"bwd {l.name} x{nsig}", flops * nsig, bbytes)
+    return fwd, bwd
+
+
+def _pool_segs(l, in_shape, out, batch, nsig):
+    """Forward switch-pool + backward unpool accounting; the int8 switch
+    read is counted once per crossing signal in BOTH rooflines (the
+    separate sweep re-reads it per segment; merged reads it once per
+    signal batch — per-signal is the consistent, conservative choice)."""
+    h, w, c = in_shape
+    oh, ow, _ = out
+    fbytes = batch * (h * w * c * 4 + oh * ow * c * 4 + oh * ow * c)
+    fwd = (f"fwd {l.name} (switch pool)", 0.0, fbytes)
+    bbytes = nsig * batch * (oh * ow * c * 2 + oh * ow * c + h * w * c * 2)
+    bwd = (f"bwd {l.name} (unpool+relu) x{nsig}", 0.0, bbytes)
+    return fwd, bwd
+
+
 def segments(batch: int, top_k: int, layer: str = "block5_conv1"):
-    """Yield (name, flops, bytes) per program segment."""
+    """Yield (name, flops, bytes) per program segment (headline config)."""
     from deconv_api_tpu.models.spec import layer_output_shapes
     from deconv_api_tpu.models.vgg16 import VGG16_SPEC
 
@@ -45,36 +80,9 @@ def segments(batch: int, top_k: int, layer: str = "block5_conv1"):
     for l in spec.layers:
         out = shapes[l.name]
         if l.kind == "conv":
-            oh, ow, cout = out
-            kh, kw = l.kernel_size
-            cin = in_shape[-1]
-            flops = 2.0 * batch * oh * ow * cout * kh * kw * cin
-            # weights read once per program, counted in the fwd segment
-            # (fp32); the backward reads a bf16 copy once
-            wbytes_fwd = kh * kw * cin * cout * 4
-            wbytes_bwd = kh * kw * cin * cout * 2
-            # forward fp32: read in, write out (ReLU fuses into epilogue)
-            fbytes = batch * (
-                in_shape[0] * in_shape[1] * cin + oh * ow * cout
-            ) * 4 + wbytes_fwd
-            segs.append((f"fwd {l.name}", flops, fbytes))
-            # backward (xK, bf16): transposed conv out->in, same MACs
-            bflops = flops * top_k
-            bbytes = top_k * batch * (
-                in_shape[0] * in_shape[1] * cin + oh * ow * cout
-            ) * 2 + wbytes_bwd
-            segs.append((f"bwd {l.name} x{top_k}", bflops, bbytes))
+            segs.extend(_conv_segs(l, in_shape, out, batch, top_k))
         elif l.kind == "pool":
-            h, w, c = in_shape
-            oh, ow, _ = out
-            # fwd: read in fp32, write pooled fp32 + int8 switches
-            fbytes = batch * (h * w * c * 4 + oh * ow * c * 4 + oh * ow * c)
-            segs.append((f"fwd {l.name} (switch pool)", 0.0, fbytes))
-            # bwd xK bf16: read pooled-grad + switches, write unpooled
-            bbytes = top_k * batch * (
-                oh * ow * c * 2 + oh * ow * c + h * w * c * 2
-            )
-            segs.append((f"bwd {l.name} (unpool+relu) x{top_k}", 0.0, bbytes))
+            segs.extend(_pool_segs(l, in_shape, out, batch, top_k))
         in_shape = out
     # selection (sums + top_k): one read of the target activation
     oh, ow, c = shapes[layer]
@@ -85,15 +93,70 @@ def segments(batch: int, top_k: int, layer: str = "block5_conv1"):
     return segs
 
 
+def sweep_segments(batch: int, top_k: int, layer: str = "block5_conv1"):
+    """(name, flops, bytes) per segment for the ALL-LAYERS sweep (BASELINE
+    config 2): every model layer from `layer` down projects top-K, and all
+    projections traverse the shared chain below their layer.
+
+    A chain op at depth d is crossed by K x (number of vis layers at or
+    above d) signals — the identical totals hold for the separate and
+    merged sweep forms (engine/deconv.py:_sweep_merged); merging changes
+    segment COUNT and batch shape, not roofline arithmetic, so this is the
+    ceiling for both."""
+    from deconv_api_tpu.models.spec import layer_output_shapes
+    from deconv_api_tpu.models.vgg16 import VGG16_SPEC
+
+    spec = VGG16_SPEC.truncated(layer)
+    shapes = layer_output_shapes(spec)
+    model_layers = [l for l in spec.layers if l.kind != "input"]
+    n_vis = len(model_layers)  # every non-input layer projects (15 for b5c1)
+
+    segs = []
+    in_shape = tuple(spec.input_shape)
+    seen = 0  # model layers at or below the current one (depth order)
+    for l in spec.layers:
+        out = shapes[l.name]
+        if l.kind in ("conv", "pool"):
+            seen += 1
+            # signals crossing this op downward: top_k per vis layer at or
+            # above it (layers deeper than l in the chain)
+            nsig = top_k * (n_vis - seen + 1)
+            make = _conv_segs if l.kind == "conv" else _pool_segs
+            segs.extend(make(l, in_shape, out, batch, nsig))
+            # per-layer selection read
+            oc = out[-1]
+            segs.append(
+                (f"select {l.name}", 0.0, batch * out[0] * out[1] * oc * 4.0)
+            )
+        in_shape = out
+    # output: K projections per vis layer at input res, fp32
+    H, W, C = spec.input_shape
+    segs.append(
+        (
+            "output write (K x n_layers, fp32)",
+            0.0,
+            n_vis * top_k * batch * H * W * C * 4.0,
+        )
+    )
+    return segs
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--top-k", type=int, default=8)
+    ap.add_argument("--sweep", action="store_true",
+                    help="model the all-layers sweep (BASELINE config 2) "
+                    "instead of the single-layer headline")
     ap.add_argument("--measured-ms", type=float, default=None,
                     help="measured ms/batch to compare against the ceiling")
     args = ap.parse_args()
 
-    segs = segments(args.batch, args.top_k)
+    segs = (
+        sweep_segments(args.batch, args.top_k)
+        if args.sweep
+        else segments(args.batch, args.top_k)
+    )
     tot_f = sum(f for _, f, _ in segs)
     tot_b = sum(b for _, _, b in segs)
     t_roof = 0.0
