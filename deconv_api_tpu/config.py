@@ -31,6 +31,13 @@ class ServerConfig:
     # burst otherwise pays a per-bucket XLA compile at request time); off =
     # warm only the smallest bucket (fast dev/test startup).
     warmup_all_buckets: bool = True
+    # Also compile the all-layers sweep program at startup: its program is
+    # ~15x a single-layer request and the first sweep request otherwise
+    # pays that compile (minutes over a remote tunnel) inside its own
+    # sweep_timeout_s window.  Off by default — sweeps are an opt-in
+    # surface and the compile is large; the XLA persistent cache makes it
+    # one-time either way.
+    warmup_sweep: bool = False
     request_timeout_s: float = 60.0
     dream_timeout_s: float = 300.0  # dreams run minutes; own queue + timeout
     # Layer sweeps project ~13x a single-layer request and compile a large

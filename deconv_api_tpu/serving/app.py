@@ -385,6 +385,20 @@ class DeconvService:
                 (layer, self.cfg.visualize_mode, self.cfg.top_k, "tiles"),
                 [img] * size,
             )
+        if self.cfg.warmup_sweep:
+            # the sweep program is ~15x a single-layer request; compiling
+            # it here keeps the first sweep request out of its own
+            # sweep_timeout_s window (sequential-spec models only)
+            try:
+                self.bundle.check_sweep()
+            except ValueError:
+                pass  # DAG models have no sweep; nothing to warm
+            else:
+                self._run_batch(
+                    (layer, self.cfg.visualize_mode, self.cfg.top_k,
+                     "tiles", True),
+                    [img] * self._bucket_for(1),
+                )
         self.ready = True
 
     # ----------------------------------------------------------- pipeline

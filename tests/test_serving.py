@@ -839,3 +839,38 @@ def test_sigterm_graceful_shutdown():
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_warmup_sweep_precompiles_sweep_program():
+    """cfg.warmup_sweep compiles the all-layers sweep program at startup,
+    so the first sweep request doesn't pay the large compile inside its
+    own timeout window; a sweep request then serves 200 immediately."""
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=2,
+        warmup_all_buckets=False,
+        warmup_sweep=True,
+        compilation_cache_dir="",
+    )
+    with ServiceFixture(cfg) as s:
+        s.service.warmup()
+        # the sweep executable is in the bundle's visualizer cache now
+        sweep_keys = [
+            k for k in s.service.bundle._vis_cache if k[-1] is True
+        ]
+        assert sweep_keys, "warmup did not compile a sweep program"
+        warmed_layer = sweep_keys[0][0]
+        cache_size = len(s.service.bundle._vis_cache)
+        # request the LAYER WARMUP CHOSE: it must ride the warmed program
+        # (no new cache entry), pinning the first-request-pays-compile
+        # regression this feature exists to prevent
+        r = httpx.post(
+            s.base_url + "/v1/deconv",
+            data={"file": _data_url(0), "layer": warmed_layer, "sweep": "1"},
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        assert r.json()["sweep"] is True
+        assert len(s.service.bundle._vis_cache) == cache_size, (
+            "sweep request compiled a NEW program despite warmup"
+        )
