@@ -171,6 +171,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         mesh_shape=mesh_shape,
         save_dir=args.save,
         seed=args.seed,
+        save_every=args.save_every,
+        resume=args.resume,
         progress=lambda i, loss: print(
             f"step {i}: loss {loss:.4f}", file=sys.stderr, flush=True
         ),
@@ -251,6 +253,14 @@ def main(argv: list[str] | None = None) -> int:
     )
     s.add_argument("--save", default="", help="orbax checkpoint output dir")
     s.add_argument("--seed", type=int, default=0)
+    s.add_argument(
+        "--save-every", type=int, default=0,
+        help="checkpoint the full TrainState to <save>.state every N steps",
+    )
+    s.add_argument(
+        "--resume", action="store_true",
+        help="restore <save>.state and continue from its recorded step",
+    )
     _add_common(s)
     s.set_defaults(fn=cmd_train)
 

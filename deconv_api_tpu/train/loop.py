@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Callable
 
 import jax
@@ -57,6 +58,8 @@ def train_synthetic(
     mesh_shape: tuple[int, ...] = (),
     save_dir: str = "",
     seed: int = 0,
+    save_every: int = 0,
+    resume: bool = False,
     progress: Callable[[int, float], None] | None = None,
 ) -> dict:
     """Fine-tune ``spec``/``params`` on synthetic data; returns a summary
@@ -65,6 +68,13 @@ def train_synthetic(
     ``mesh_shape`` is (dp,) or (dp, tp); default uses every visible device
     on dp.  ``batch`` is rounded up to a dp multiple so every step shards
     evenly (same rule as serving's _bucket_for).
+
+    ``save_every > 0`` checkpoints the FULL TrainState (params + optimizer
+    moments + step) to ``<save_dir>.state`` every that many steps;
+    ``resume=True`` restores it and continues from the recorded step.  Data
+    batches are keyed by fold_in(seed, step index), so a resumed run sees
+    the identical stream an uninterrupted run would — resumption is exact,
+    not approximate (tests/test_train_cli.py pins this).
     """
     import optax
 
@@ -120,11 +130,63 @@ def train_synthetic(
         loss_d, acc_d = eval_jit(state.params, eval_images, eval_labels)
         return float(loss_d), float(acc_d)
 
-    eval_loss0, eval_acc0 = run_eval()  # pre-training reference point
-    key = jax.random.PRNGKey(seed)
+    if (save_every > 0 or resume) and not save_dir:
+        raise ValueError(
+            "--save-every/--resume need --save: the TrainState checkpoint "
+            "lives at <save>.state"
+        )
+    # SIBLING of save_dir, not nested: the final save_params(save_dir)
+    # replaces that directory wholesale (orbax force=True), which would
+    # silently delete a nested state checkpoint
+    state_dir = save_dir.rstrip("/") + ".state" if save_dir else ""
+    meta_path = state_dir + ".meta.json" if state_dir else ""
+    # run config stored beside the state: resuming with different
+    # hyperparameters would silently blend two runs (old optimizer moments
+    # under a new lr, a different data stream) while claiming exactness
+    run_meta = {
+        "model": spec.name, "seed": seed, "lr": lr, "batch": batch,
+        "mesh": list(mesh_shape),
+    }
+    start_step = 0
+    if resume:
+        if not (state_dir and os.path.isdir(state_dir)):
+            raise FileNotFoundError(
+                f"resume requested but no checkpoint at {state_dir!r}"
+            )
+        import json as _json
+
+        if os.path.exists(meta_path):
+            saved_meta = _json.loads(open(meta_path).read())
+            diffs = {
+                k: (saved_meta.get(k), v)
+                for k, v in run_meta.items()
+                if saved_meta.get(k) != v
+            }
+            if diffs:
+                raise ValueError(
+                    "resume config mismatch (checkpointed vs requested): "
+                    f"{diffs} — resumption would silently blend two runs"
+                )
+        from deconv_api_tpu.utils.checkpoint import restore_train_state
+
+        like = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state
+        )
+        state = restore_train_state(state_dir, like)
+        start_step = int(state.step)
+        if start_step >= steps:
+            raise ValueError(
+                f"checkpoint is already at step {start_step} >= --steps "
+                f"{steps}; nothing to resume (raise --steps to continue)"
+            )
+
+    eval_loss0, eval_acc0 = run_eval()  # reference point (resume: mid-run)
+    base_key = jax.random.PRNGKey(seed)
     loss = float("nan")
-    for i in range(steps):
-        key, sub = jax.random.split(key)
+    for i in range(start_step, steps):
+        # fold_in by step index — NOT a sequential split chain — so a
+        # resumed run regenerates the exact stream from step i onward
+        sub = jax.random.fold_in(base_key, i)
         images, labels = _synthetic_batch(sub, batch, spec.input_shape, num_classes)
         state, loss_dev = step_jit(state, images, labels)
         loss = float(loss_dev)
@@ -132,6 +194,14 @@ def train_synthetic(
             raise RuntimeError(f"non-finite loss {loss} at step {i}")
         if progress is not None:
             progress(i, loss)
+        if state_dir and save_every > 0 and (i + 1) % save_every == 0:
+            import json as _json
+
+            from deconv_api_tpu.utils.checkpoint import save_train_state
+
+            save_train_state(state_dir, jax.device_get(state))
+            with open(meta_path, "w") as f:
+                f.write(_json.dumps(run_meta))
     eval_loss, eval_acc = run_eval()
 
     final_params = jax.device_get(state.params)
@@ -145,6 +215,7 @@ def train_synthetic(
         "batch": batch,
         "mesh": list(mesh_shape),
         "final_loss": loss,
+        "resumed_from": start_step,
         "eval_loss_initial": eval_loss0,
         "eval_loss": eval_loss,
         "eval_accuracy_initial": eval_acc0,

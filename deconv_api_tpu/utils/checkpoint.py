@@ -28,3 +28,16 @@ def restore_params(path: str, like):
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(path, like)
+
+
+def save_train_state(path: str, state) -> None:
+    """Persist a FULL TrainState (params + optimizer moments + step) so an
+    interrupted run resumes exactly, not just its weights (SURVEY §5
+    checkpoint/resume row; train/loop.py wires save_every/resume)."""
+    save_params(path, state)
+
+
+def restore_train_state(path: str, like):
+    """Restore a TrainState saved by `save_train_state`; `like` is a
+    matching concrete or abstract (ShapeDtypeStruct) TrainState."""
+    return restore_params(path, like)

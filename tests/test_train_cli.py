@@ -109,3 +109,78 @@ def test_heldout_eval_improves():
     assert r["eval_accuracy"] >= 1.5 * chance, (
         f"held-out accuracy {r['eval_accuracy']:.3f} vs chance {chance:.3f}"
     )
+
+
+def test_checkpoint_resume_is_exact(tmp_path):
+    """Interrupt-and-resume must reproduce the uninterrupted run EXACTLY:
+    the full TrainState (params + optimizer moments + step) round-trips
+    through orbax and the fold_in data keying regenerates the identical
+    batch stream from the resume step (SURVEY §5 checkpoint/resume row —
+    beyond the params-only train->serve plumbing)."""
+    from tests.test_engine_parity import TINY
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.train.loop import train_synthetic
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    common = dict(batch=16, lr=1e-3, mesh_shape=(8,), seed=3)
+
+    straight = train_synthetic(TINY, params, steps=8, **common)
+
+    ck = str(tmp_path / "run")
+    first = train_synthetic(
+        TINY, params, steps=4, save_dir=ck, save_every=4, **common
+    )
+    assert (tmp_path / "run.state").is_dir()
+    resumed = train_synthetic(
+        TINY, params, steps=8, save_dir=ck, save_every=4, resume=True,
+        **common
+    )
+
+    assert resumed["final_loss"] == straight["final_loss"], (
+        f"resumed {resumed['final_loss']} != straight {straight['final_loss']}"
+    )
+    for name, leaf in straight["params"].items():
+        for k in leaf:
+            np.testing.assert_array_equal(
+                np.asarray(leaf[k]), np.asarray(resumed["params"][name][k]),
+                err_msg=f"{name}/{k}",
+            )
+    # resuming without a checkpoint is a clean error
+    with pytest.raises(FileNotFoundError):
+        train_synthetic(
+            TINY, params, steps=8, save_dir=str(tmp_path / "none"),
+            resume=True, **common
+        )
+
+
+def test_resume_guardrails(tmp_path):
+    """Mismatched hyperparameters, completed runs, and missing --save are
+    clean errors, not silent run-blending (r4 review findings)."""
+    from tests.test_engine_parity import TINY
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.train.loop import train_synthetic
+
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    ck = str(tmp_path / "run")
+    train_synthetic(
+        TINY, params, steps=2, batch=16, lr=1e-3, mesh_shape=(8,),
+        save_dir=ck, save_every=2,
+    )
+    # different lr -> config-mismatch error, not a blended run
+    with pytest.raises(ValueError, match="config mismatch"):
+        train_synthetic(
+            TINY, params, steps=4, batch=16, lr=5e-4, mesh_shape=(8,),
+            save_dir=ck, save_every=2, resume=True,
+        )
+    # checkpoint already at steps -> explicit error, not a NaN summary
+    with pytest.raises(ValueError, match="nothing to resume"):
+        train_synthetic(
+            TINY, params, steps=2, batch=16, lr=1e-3, mesh_shape=(8,),
+            save_dir=ck, save_every=2, resume=True,
+        )
+    # save_every without save_dir -> explicit error, not silent no-op
+    with pytest.raises(ValueError, match="need --save"):
+        train_synthetic(
+            TINY, params, steps=2, batch=16, lr=1e-3, mesh_shape=(8,),
+            save_every=2,
+        )
