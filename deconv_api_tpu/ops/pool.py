@@ -17,7 +17,7 @@ the exact same switch semantics.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 from typing import Sequence
 
 import jax

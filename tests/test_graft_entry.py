@@ -6,7 +6,6 @@ import sys
 from pathlib import Path
 
 import jax
-import pytest
 
 
 def _load_graft():

@@ -98,7 +98,6 @@ def test_configure_is_explicit_not_import_side_effect():
 
 
 def test_bad_log_level_falls_back_to_info(monkeypatch):
-    import importlib
 
     monkeypatch.setenv("DECONV_LOG_LEVEL", "verbose")
     monkeypatch.setattr(slog, "_CONFIGURED", False)
