@@ -1,0 +1,91 @@
+"""Unit tests for the measurement-pipeline child runner
+(tools/run_bench_suite.py:run_cmd_json) — the shared path every hardware
+artifact (bench suite, tunnel watcher, r4 experiments) flows through.
+A regression here silently classifies real measurements as errors or
+vice versa, so the error taxonomy is pinned directly."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_suite():
+    spec = importlib.util.spec_from_file_location(
+        "run_bench_suite", REPO / "tools" / "run_bench_suite.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_last_json_line_wins_and_wall_time_attached():
+    mod = _load_suite()
+    row = mod.run_cmd_json(
+        [
+            sys.executable,
+            "-c",
+            "print('noise'); print('{\"a\": 1}'); print('{\"a\": 2}')",
+        ],
+        timeout_s=30,
+    )
+    assert row["a"] == 2
+    assert row["wall_s_total"] >= 0
+
+
+def test_timeout_yields_error_row():
+    mod = _load_suite()
+    row = mod.run_cmd_json(
+        [sys.executable, "-c", "import time; time.sleep(30)"], timeout_s=1
+    )
+    assert row == {"error": "timeout after 1s"}
+
+
+def test_nonzero_rc_yields_error_row_with_stderr_tail():
+    mod = _load_suite()
+    row = mod.run_cmd_json(
+        [sys.executable, "-c", "import sys; print('x', file=sys.stderr); sys.exit(3)"],
+        timeout_s=30,
+    )
+    assert row["error"] == "rc=3"
+    assert "x" in row["stderr_tail"]
+
+
+def test_no_json_output_is_an_error_row():
+    mod = _load_suite()
+    row = mod.run_cmd_json([sys.executable, "-c", "print('hello')"], timeout_s=30)
+    assert row["error"] == "no JSON output"
+
+
+def test_env_overrides_merge_over_parent_env():
+    mod = _load_suite()
+    os.environ["BENCH_TOOLS_KEEP"] = "kept"
+    try:
+        row = mod.run_cmd_json(
+            [
+                sys.executable,
+                "-c",
+                "import json, os; print(json.dumps({"
+                "'set': os.environ.get('BENCH_TOOLS_SET'),"
+                "'kept': os.environ.get('BENCH_TOOLS_KEEP')}))",
+            ],
+            timeout_s=30,
+            env={"BENCH_TOOLS_SET": "v"},
+        )
+    finally:
+        del os.environ["BENCH_TOOLS_KEEP"]
+    assert row["set"] == "v"  # override applied
+    assert row["kept"] == "kept"  # parent env preserved
+
+
+def test_run_one_error_rows_carry_config_number(monkeypatch):
+    mod = _load_suite()
+    monkeypatch.setattr(
+        mod, "run_cmd_json", lambda cmd, t, env=None: {"error": "rc=1"}
+    )
+    row = mod.run_one(4, 10)
+    assert row["config"] == 4 and row["error"] == "rc=1"
