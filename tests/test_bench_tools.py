@@ -7,7 +7,6 @@ vice versa, so the error taxonomy is pinned directly."""
 from __future__ import annotations
 
 import importlib.util
-import os
 import sys
 from pathlib import Path
 
@@ -61,23 +60,20 @@ def test_no_json_output_is_an_error_row():
     assert row["error"] == "no JSON output"
 
 
-def test_env_overrides_merge_over_parent_env():
+def test_env_overrides_merge_over_parent_env(monkeypatch):
     mod = _load_suite()
-    os.environ["BENCH_TOOLS_KEEP"] = "kept"
-    try:
-        row = mod.run_cmd_json(
-            [
-                sys.executable,
-                "-c",
-                "import json, os; print(json.dumps({"
-                "'set': os.environ.get('BENCH_TOOLS_SET'),"
-                "'kept': os.environ.get('BENCH_TOOLS_KEEP')}))",
-            ],
-            timeout_s=30,
-            env={"BENCH_TOOLS_SET": "v"},
-        )
-    finally:
-        del os.environ["BENCH_TOOLS_KEEP"]
+    monkeypatch.setenv("BENCH_TOOLS_KEEP", "kept")
+    row = mod.run_cmd_json(
+        [
+            sys.executable,
+            "-c",
+            "import json, os; print(json.dumps({"
+            "'set': os.environ.get('BENCH_TOOLS_SET'),"
+            "'kept': os.environ.get('BENCH_TOOLS_KEEP')}))",
+        ],
+        timeout_s=30,
+        env={"BENCH_TOOLS_SET": "v"},
+    )
     assert row["set"] == "v"  # override applied
     assert row["kept"] == "kept"  # parent env preserved
 
