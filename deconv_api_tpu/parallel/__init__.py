@@ -17,6 +17,7 @@ distributed-comm row).
 
 from deconv_api_tpu.parallel.mesh import (
     batch_sharding,
+    init_distributed,
     make_mesh,
     param_shardings,
     replicated,
@@ -25,6 +26,7 @@ from deconv_api_tpu.parallel.batch import sharded_visualizer
 
 __all__ = [
     "batch_sharding",
+    "init_distributed",
     "make_mesh",
     "param_shardings",
     "replicated",

@@ -2,6 +2,7 @@
 batches and the (dp, tp) sharded training step."""
 
 import jax
+from pathlib import Path
 import pytest
 import jax.numpy as jnp
 import numpy as np
@@ -146,3 +147,46 @@ def test_mesh_vgg16_full_shape_matches_single_device():
     )
     shard_devs = {s.device for s in out["images"].addressable_shards}
     assert len(shard_devs) == 8, f"outputs on {len(shard_devs)} devices"
+
+
+def test_init_distributed_single_process_runtime():
+    """init_distributed brings up a real (single-process) JAX distributed
+    runtime and the mesh machinery composes with it — run in a subprocess
+    because jax.distributed holds process-global state the rest of the
+    suite must not inherit."""
+    import subprocess
+    import sys
+
+    code = """
+import os, socket
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from deconv_api_tpu.parallel import init_distributed, make_mesh, batch_sharding
+import jax.numpy as jnp
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()  # free port for the coordinator
+info = init_distributed(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=1, process_id=0
+)
+assert info["process_count"] == 1, info
+assert info["global_devices"] == 8, info
+mesh = make_mesh((8,), axis_names=("dp",))
+x = jax.device_put(jnp.arange(8.0), batch_sharding(mesh))
+total = jax.jit(lambda v: v.sum(), out_shardings=None)(x)
+assert float(total) == 28.0
+# idempotent: an identical second call must hit the already-initialized
+# probe and no-op (re-initializing would raise)
+info2 = init_distributed(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=1, process_id=0
+)
+assert info2["process_count"] == 1
+print("DISTRIBUTED-OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, timeout=300,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    assert b"DISTRIBUTED-OK" in proc.stdout, proc.stderr.decode()[-800:]
