@@ -270,6 +270,10 @@ def main_parent(force_cpu: bool = False) -> None:
                 time.sleep(delay)
                 delay = min(delay * 2, 60.0)
         log("default backend unusable; falling back to forced-CPU measurement")
+        # the child's --cpu flag is the same either way; the REASON (operator
+        # request vs tunnel-down fallback) rides the environment so the
+        # artifact's note can't misrecord a non-existent outage
+        os.environ["DECONV_BENCH_CPU_REASON"] = "tpu_unavailable"
     cpu_timeout = max(30.0, remaining() - 15.0)
     result = _run_child(force_cpu=True, timeout_s=cpu_timeout)
     if result is not None:
@@ -502,7 +506,16 @@ def main_child(force_cpu: bool) -> None:
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / NORTH_STAR_IMG_S, 3),
+        "platform": platform,
     }
+    if not on_tpu:
+        fallback = os.environ.get("DECONV_BENCH_CPU_REASON") == "tpu_unavailable"
+        payload["note"] = (
+            ("TPU tunnel unavailable; guaranteed CPU-fallback measurement"
+             if fallback else "forced-CPU run (--cpu)")
+            + " — for driver-verified TPU figures see BENCH_r02.json and "
+            "BASELINE.md's hardware record."
+        )
     if tflops_s is not None:
         payload["tflops"] = round(tflops_s, 2)
     if mfu_pct is not None:
