@@ -132,16 +132,23 @@ def _emit_error(reason: str) -> None:
 # --------------------------------------------------------------------------
 
 
-def _run_child(force_cpu: bool, timeout_s: float) -> dict | None:
+def _run_child(
+    force_cpu: bool, timeout_s: float, cpu_reason: str | None = None
+) -> dict | None:
     """One measurement attempt in a subprocess; returns parsed JSON or None.
 
     stderr streams through (diagnostics); stdout is captured and the last
-    JSON-parseable line is the result.
+    JSON-parseable line is the result.  ``cpu_reason`` labels WHY a --cpu
+    child runs (operator request vs tunnel-down fallback) via an explicit
+    argv flag — env-var plumbing would leak into every subprocess and an
+    ambient value could mislabel the artifact.
     """
     global _CURRENT_CHILD
     cmd = [sys.executable, os.path.abspath(__file__), "--child"]
     if force_cpu:
         cmd.append("--cpu")
+    if cpu_reason:
+        cmd.append(f"--cpu-reason={cpu_reason}")
     if "--breakdown" in sys.argv:
         cmd.append("--breakdown")
     # mask net signals across spawn + tracking assignment: a SIGTERM landing
@@ -270,12 +277,13 @@ def main_parent(force_cpu: bool = False) -> None:
                 time.sleep(delay)
                 delay = min(delay * 2, 60.0)
         log("default backend unusable; falling back to forced-CPU measurement")
-        # the child's --cpu flag is the same either way; the REASON (operator
-        # request vs tunnel-down fallback) rides the environment so the
-        # artifact's note can't misrecord a non-existent outage
-        os.environ["DECONV_BENCH_CPU_REASON"] = "tpu_unavailable"
+        cpu_reason = "tpu_unavailable"
+    else:
+        cpu_reason = "requested"
     cpu_timeout = max(30.0, remaining() - 15.0)
-    result = _run_child(force_cpu=True, timeout_s=cpu_timeout)
+    result = _run_child(
+        force_cpu=True, timeout_s=cpu_timeout, cpu_reason=cpu_reason
+    )
     if result is not None:
         emit(result)
         return
@@ -509,12 +517,15 @@ def main_child(force_cpu: bool) -> None:
         "platform": platform,
     }
     if not on_tpu:
-        fallback = os.environ.get("DECONV_BENCH_CPU_REASON") == "tpu_unavailable"
+        if "--cpu-reason=tpu_unavailable" in sys.argv:
+            why = "TPU tunnel unavailable; guaranteed CPU-fallback measurement"
+        elif force_cpu:
+            why = "forced-CPU run (--cpu)"
+        else:
+            why = "default backend resolved to a non-TPU device"
         payload["note"] = (
-            ("TPU tunnel unavailable; guaranteed CPU-fallback measurement"
-             if fallback else "forced-CPU run (--cpu)")
-            + " — for driver-verified TPU figures see BENCH_r02.json and "
-            "BASELINE.md's hardware record."
+            why + " — for driver-verified TPU figures see BENCH_r02.json "
+            "and BASELINE.md's hardware record."
         )
     if tflops_s is not None:
         payload["tflops"] = round(tflops_s, 2)

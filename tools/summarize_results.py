@@ -1,0 +1,72 @@
+"""Summarize bench_suite_results.jsonl into one table, newest row per tag.
+
+Rows accumulate append-only across rounds (bench suite, tunnel watcher,
+round-4 experiments); this prints the latest row per (which|config) tag so
+the current state of the measurement record is readable at a glance, plus
+an attempt/error trail for tags that have failures.
+
+Usage: python tools/summarize_results.py [path]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def tag_of(row: dict) -> str:
+    if "which" in row:
+        return str(row["which"])
+    if "config" in row:
+        return f"config{row['config']}"
+    return "untagged"
+
+
+def headline_of(row: dict) -> str:
+    for key in (
+        "img_per_sec", "images_per_sec", "requests_per_sec", "value",
+        "ms_per_batch", "dreams_per_min",
+    ):
+        if key in row and row[key] is not None:
+            return f"{key}={row[key]}"
+    if "error" in row:
+        return f"ERROR: {str(row['error'])[:60]}"
+    keys = [k for k in row if k not in ("which", "config", "date", "attempt")]
+    return ", ".join(f"{k}={row[k]}" for k in keys[:4])
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_suite_results.jsonl",
+    )
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    latest: dict[str, dict] = {}
+    errors: dict[str, int] = {}
+    for row in rows:
+        tag = tag_of(row)
+        latest[tag] = row
+        if "error" in row:
+            errors[tag] = errors.get(tag, 0) + 1
+    print(f"{len(rows)} rows, {len(latest)} tags ({path})")
+    print(f"{'tag':28s} {'date':12s} {'errs':>4s}  latest")
+    for tag in sorted(latest):
+        row = latest[tag]
+        print(
+            f"{tag:28s} {str(row.get('date', '?')):12s} "
+            f"{errors.get(tag, 0):4d}  {headline_of(row)}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
