@@ -15,6 +15,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -36,45 +37,81 @@ def _json_lines(stdout: bytes) -> list[dict]:
 def test_sigterm_mid_run_still_emits_one_parseable_line():
     """External ``timeout`` sends SIGTERM first; the artifact must survive.
 
-    The measurement child takes tens of seconds even on CPU, so a SIGTERM
-    at ~2s always lands mid-measurement — the round-3 failure window."""
+    The SIGTERM is sent once the parent logs its first "bench attempt"
+    line — the signal net is installed by then and the measurement child
+    (tens of seconds even on CPU) is starting, so the signal lands
+    mid-measurement, the round-3 failure window.  A fixed sleep is not
+    enough: this image's sitecustomize costs ~2s of interpreter startup
+    before bench.py's first line executes."""
     mark = f"bench-test-{os.getpid()}-{time.monotonic_ns()}"
     env = dict(os.environ, DECONV_BENCH_TEST_MARK=mark)
+    # own process group so failure paths can reap the measurement
+    # grandchild too (SIGKILL to the parent bypasses its signal net,
+    # which is what normally kills the child)
     proc = subprocess.Popen(
         [sys.executable, str(BENCH)],
         stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
         cwd=BENCH.parent,
         env=env,
+        start_new_session=True,
     )
-    time.sleep(2.0)
-    proc.send_signal(signal.SIGTERM)
-    try:
-        stdout, _ = proc.communicate(timeout=30)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.communicate()
-        pytest.fail("bench parent did not exit after SIGTERM")
-    lines = _json_lines(stdout)
-    assert len(lines) == 1, f"expected exactly one JSON line, got {lines!r}"
-    payload = lines[0]
-    assert REQUIRED_KEYS <= set(payload), payload
-    assert payload["value"] is None
-    assert "signal 15" in payload["error"]
-    # no orphaned measurement child from THIS run (identified by the env
-    # marker, so concurrent legitimate bench runs don't trip the check)
-    time.sleep(0.5)
-    live = []
-    for p in Path("/proc").iterdir():
-        if not p.name.isdigit():
-            continue
+    ready = threading.Event()
+    stderr_chunks: list[bytes] = []
+
+    def _drain_stderr() -> None:
+        for raw in proc.stderr:
+            stderr_chunks.append(raw)
+            if b"bench attempt" in raw:
+                ready.set()
+        ready.set()  # EOF: unblock the waiter either way
+
+    def _killpg() -> None:
         try:
-            environ = (p / "environ").read_bytes()
-        except OSError:
-            continue
-        if mark.encode() in environ and int(p.name) != proc.pid:
-            live.append(p.name)
-    assert not live, f"orphaned bench children: {live}"
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    reader = threading.Thread(target=_drain_stderr, daemon=True)
+    reader.start()
+    try:
+        assert ready.wait(timeout=60), "parent never reached its attempt loop"
+        assert proc.poll() is None, (
+            f"parent exited early: {b''.join(stderr_chunks)!r}"
+        )
+        time.sleep(0.5)  # let the measurement child spawn: mid-measurement
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pytest.fail("bench parent did not exit after SIGTERM")
+        stdout = proc.stdout.read()  # stderr is owned by the reader thread
+        reader.join(timeout=5)
+        lines = _json_lines(stdout)
+        assert len(lines) == 1, f"expected exactly one JSON line, got {lines!r}"
+        payload = lines[0]
+        assert REQUIRED_KEYS <= set(payload), payload
+        assert payload["value"] is None
+        assert "signal 15" in payload["error"]
+        # no orphaned measurement child from THIS run (identified by the env
+        # marker, so concurrent legitimate bench runs don't trip the check);
+        # the scan runs BEFORE the finally's group kill, so a leak is
+        # detected rather than silently reaped
+        time.sleep(0.5)
+        live = []
+        for p in Path("/proc").iterdir():
+            if not p.name.isdigit():
+                continue
+            try:
+                environ = (p / "environ").read_bytes()
+            except OSError:
+                continue
+            if mark.encode() in environ and int(p.name) != proc.pid:
+                live.append(p.name)
+        assert not live, f"orphaned bench children: {live}"
+    finally:
+        _killpg()  # no-op on the happy path (group is already gone)
+        proc.wait()
 
 
 @pytest.mark.slow
