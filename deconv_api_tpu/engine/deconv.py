@@ -404,6 +404,7 @@ def get_visualizer(
     kpack_chan: int | None = None,
     sweep_merged: bool | None = None,
     nchw_chan: int | None = None,
+    sweep_chunk: int | None = None,
 ):
     """Build (and cache) the jitted visualizer for a static configuration.
 
@@ -440,9 +441,20 @@ def get_visualizer(
         sweep_merged = os.environ.get(
             "DECONV_SWEEP_MERGED", "1"
         ).lower() not in ("0", "false", "off", "no", "")
+    # Batch chunk for the BATCHED merged sweep.  The merged carry holds
+    # K x n_layers projections per example (120 for VGG16 K=8); a plain
+    # vmap over batch 8 makes the block1-segment tensors
+    # (8*120, 224, 224, 64) — ~6 GB each in bf16, several live at once —
+    # which RESOURCE_EXHAUSTs a 16 GB v5e-1 (measured, config2_r4
+    # 2026-07-31).  lax.map over chunks of the batch bounds peak memory at
+    # chunk/B of that while keeping the merged tail's occupancy (240-wide
+    # block1 batches at chunk 2).  0 disables chunking.
+    if sweep_chunk is None:
+        sweep_chunk = int(os.environ.get("DECONV_SWEEP_CHUNK", "2"))
     return _get_visualizer_cached(
         spec, layer_name, top_k, mode, bug_compat, sweep, batched,
         backward_dtype, kpack_chan, bool(sweep_merged), nchw_chan,
+        sweep_chunk,
     )
 
 
@@ -459,6 +471,7 @@ def _get_visualizer_cached(
     kpack_chan: int,
     sweep_merged: bool = True,
     nchw_chan: int = 0,
+    sweep_chunk: int = 0,
 ):
     if mode not in ("all", "max"):
         # The reference sys.exit()s the server here (app/deepdream.py:458-460);
@@ -481,6 +494,14 @@ def _get_visualizer_cached(
 
     bwd_dtype = jnp.dtype(backward_dtype) if backward_dtype else None
 
+    # An explicit K-packed- or NCHW-tail request uses the separate-
+    # per-layer path (_sweep_merged has neither; silently ignoring the
+    # requested variant would make A/B measurements meaningless).
+    merged_active = (
+        sweep and sweep_merged and kpack_chan == 0 and nchw_chan == 0
+        and len(vis_indices) > 1
+    )
+
     def single(params, image):
         x = image[None]
         switches: dict[str, jnp.ndarray] = {}
@@ -488,13 +509,7 @@ def _get_visualizer_cached(
         for e in entries:
             x = _up_step(e, params, x, switches)
             ups.append(x)
-        # An explicit K-packed- or NCHW-tail request uses the separate-
-        # per-layer path (_sweep_merged has neither; silently ignoring the
-        # requested variant would make A/B measurements meaningless).
-        if (
-            sweep and sweep_merged and kpack_chan == 0 and nchw_chan == 0
-            and len(vis_indices) > 1
-        ):
+        if merged_active:
             return _sweep_merged(
                 entries, params, ups, switches, vis_indices, top_k, mode,
                 bug_compat, bwd_dtype,
@@ -507,7 +522,41 @@ def _get_visualizer_cached(
             for i in vis_indices
         }
 
-    fn = jax.vmap(single, in_axes=(None, 0)) if batched else single
+    if batched:
+        vm = jax.vmap(single, in_axes=(None, 0))
+        if merged_active and sweep_chunk > 0:
+
+            def fn(params, images):
+                b = images.shape[0]
+                if b <= sweep_chunk:
+                    return vm(params, images)
+                # full chunks via lax.map + a vmapped remainder, so the
+                # memory bound holds for EVERY batch size (a silent
+                # whole-batch fallback on b % chunk != 0 would reopen the
+                # OOM this knob exists to prevent)
+                n, rem = divmod(b, sweep_chunk)
+                head = images[: n * sweep_chunk].reshape(
+                    (n, sweep_chunk) + images.shape[1:]
+                )
+                outs = lax.map(lambda c: vm(params, c), head)
+                outs = jax.tree_util.tree_map(
+                    lambda leaf: leaf.reshape(
+                        (n * sweep_chunk,) + leaf.shape[2:]
+                    ),
+                    outs,
+                )
+                if rem:
+                    tail = vm(params, images[n * sweep_chunk :])
+                    outs = jax.tree_util.tree_map(
+                        lambda a, z: jnp.concatenate([a, z], axis=0),
+                        outs, tail,
+                    )
+                return outs
+
+        else:
+            fn = vm
+    else:
+        fn = single
     return jax.jit(fn)
 
 

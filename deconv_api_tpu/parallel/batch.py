@@ -25,7 +25,15 @@ def shard_batched_fn(fn, mesh):
     ModelBundle.batched_visualizer with a mesh) go through it, so the two
     cannot drift.  Per-call batch sizes must be a multiple of the dp axis
     size; the serving dispatcher rounds its buckets up to that multiple
-    (serving/app.py:_bucket_for)."""
+    (serving/app.py:_bucket_for).
+
+    Invariant for sweep callers: build the visualizer with
+    ``sweep_chunk=0`` before sharding it.  The merged sweep's batch
+    chunking (a single-chip OOM guard) reshapes the batch axis and runs
+    lax.map over chunks — under dp sharding that serializes work GSPMD
+    should spread across the mesh, and the per-device carry is already
+    B/dp so the guard is unnecessary.  (serving/models.py and
+    __graft_entry__.py both do this.)"""
     return jax.jit(
         fn,
         in_shardings=(replicated(mesh), batch_sharding(mesh)),

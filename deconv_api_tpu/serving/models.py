@@ -122,10 +122,16 @@ class ModelBundle:
         key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep)
         if key not in self._vis_cache:
             if self.spec is not None:
+                # On a dp mesh the merged-sweep batch chunking must stay
+                # OFF: its (B,)->(n,chunk) reshape + sequential lax.map
+                # would serialize chunks that GSPMD should spread across
+                # the dp axis, and the per-device carry is already B/dp so
+                # the single-chip OOM it guards against does not apply.
                 raw = get_visualizer(
                     self.spec, layer, top_k, mode, bug_compat,
                     sweep=sweep, batched=True,
                     backward_dtype=backward_dtype or None,
+                    sweep_chunk=0 if self.mesh is not None else None,
                 )
             else:
                 vmapped = jax.vmap(

@@ -324,6 +324,34 @@ def test_merged_sweep_matches_separate():
         )
 
 
+def test_merged_sweep_batch_chunking_matches_unchunked():
+    """The batched merged sweep runs lax.map over batch chunks to bound
+    peak memory (DECONV_SWEEP_CHUNK; the unchunked carry RESOURCE_EXHAUSTs
+    a v5e-1 at batch 8 — config2_r4 2026-07-31).  Chunked and unchunked
+    must agree exactly: same program per chunk, only the batching loop
+    differs.  Also covers the remainder path when the chunk does not
+    divide the batch (full chunks via lax.map + a vmapped remainder)."""
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    batch = jax.random.normal(jax.random.PRNGKey(2), (4, 16, 16, 3)) * 30
+    unchunked = get_visualizer(
+        TINY, "b2c1", 4, "all", True, sweep=True, batched=True,
+        sweep_merged=True, sweep_chunk=0,
+    )(params, batch)
+    for chunk in (1, 2, 3, 4):  # 3 does not divide 4: remainder path
+        chunked = get_visualizer(
+            TINY, "b2c1", 4, "all", True, sweep=True, batched=True,
+            sweep_merged=True, sweep_chunk=chunk,
+        )(params, batch)
+        assert set(chunked) == set(unchunked)
+        for name in unchunked:
+            for field in ("indices", "sums", "valid", "images"):
+                np.testing.assert_allclose(
+                    np.asarray(unchunked[name][field], np.float32),
+                    np.asarray(chunked[name][field], np.float32),
+                    rtol=1e-5, atol=1e-6, err_msg=f"chunk={chunk} {name}.{field}",
+                )
+
+
 def test_nchw_tail_matches_default():
     """The NCHW low-channel tail (DECONV_TAIL_NCHW, VERDICT r3 item 4:
     channels-major layout for the C<128 backward segments) must reproduce

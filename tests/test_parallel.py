@@ -88,7 +88,12 @@ def test_mesh_sweep_visualizer_matches_single_device():
     params = init_params(TINY, jax.random.PRNGKey(11))
     batch = jax.random.normal(jax.random.PRNGKey(12), (8, 16, 16, 3))
 
-    raw = get_visualizer(TINY, "b2c1", 4, "all", True, sweep=True, batched=True)
+    # sweep_chunk=0: the production mesh configuration (serving/models.py)
+    # — batch chunking is a single-chip OOM guard and must stay off under
+    # dp sharding, where lax.map would serialize what GSPMD parallelizes
+    raw = get_visualizer(
+        TINY, "b2c1", 4, "all", True, sweep=True, batched=True, sweep_chunk=0
+    )
     single = jax.jit(raw)(params, batch)
 
     mesh = make_mesh((8,), axis_names=("dp",), devices=jax.devices()[:8])
