@@ -1,0 +1,139 @@
+"""Round-4b perf experiments (after the r4 watcher + A/B batch).
+
+Follow-ups to the 2026-07-31 measurement morning:
+
+1. `config2_merged_chunked` — the merged sweep OOM'd HBM at batch 8
+   (config2_r4 rc=1 RESOURCE_EXHAUSTED); re-measure with the lax.map
+   batch chunking fix (DECONV_SWEEP_CHUNK, default 2).  A/B partner of
+   `config2_sweep_separate` (7.15 img/s same day).
+2. `config5_depth2_rerun` / `config5_depth1` — config5_r4 measured
+   8.4 req/s, WORSE than r3's 13.5 and r2's 14.7, and it was the first
+   hardware run of the pipelined dispatcher.  Re-measure depth 2 on a
+   quiet host, then depth 1 (serial dispatch->fetch) via
+   DECONV_PIPELINE_DEPTH — the suite's config5 now builds its server
+   config from the environment.
+3. `headline_fused` — bench.py with the sync checksum reduced inside
+   the measured program (DECONV_BENCH_FUSED_SYNC=1): sustained_probe's
+   fused loop measured the identical forward at 34.5 ms/iter vs the
+   two-program loop's 102.9, so the r4 headline (400.6 img/s) likely
+   undercounts device throughput by ~1 relay dispatch per iteration.
+4. `config2_stream` / `config2_stream_separate` / `config4_stream` —
+   the throughput configs re-measured under bench.py's sync methodology
+   (DECONV_SUITE_STREAM_SYNC=1; rows carry a "sync" tag).
+
+Usage: python tools/run_r4b_experiments.py [--max-hours 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from run_bench_suite import TIMEOUTS, preflight, run_cmd_json, run_one  # noqa: E402
+
+
+def log(msg: str) -> None:
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    print(f"[r4b-exp {ts}] {msg}", file=sys.stderr, flush=True)
+
+
+def append(out_path: str, row: dict) -> None:
+    row = dict(row, date=datetime.date.today().isoformat())
+    with open(out_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    log(f"recorded: {json.dumps(row)[:200]}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=6.0)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "bench_suite_results.jsonl")
+    )
+    args = ap.parse_args()
+    deadline = time.monotonic() + args.max_hours * 3600
+
+    plan = [
+        ("config2_merged_chunked", lambda: run_one(2, TIMEOUTS[2])),
+        ("config5_depth2_rerun", lambda: run_one(5, TIMEOUTS[5])),
+        (
+            "config5_depth1",
+            lambda: run_one(5, TIMEOUTS[5], env={"DECONV_PIPELINE_DEPTH": "1"}),
+        ),
+        (
+            "headline_fused",
+            lambda: run_cmd_json(
+                [sys.executable, os.path.join(REPO, "bench.py"), "--breakdown"],
+                1200,
+                env={
+                    "DECONV_BENCH_FUSED_SYNC": "1",
+                    "DECONV_BENCH_BUDGET": "1100",
+                    "DECONV_BENCH_TIMEOUT": "600",
+                },
+            ),
+        ),
+        (
+            "config2_stream",
+            lambda: run_one(2, TIMEOUTS[2], env={"DECONV_SUITE_STREAM_SYNC": "1"}),
+        ),
+        (
+            "config2_stream_separate",
+            lambda: run_one(
+                2,
+                TIMEOUTS[2],
+                env={
+                    "DECONV_SUITE_STREAM_SYNC": "1",
+                    "DECONV_SWEEP_MERGED": "0",
+                },
+            ),
+        ),
+        (
+            "config4_stream",
+            lambda: run_one(4, TIMEOUTS[4], env={"DECONV_SUITE_STREAM_SYNC": "1"}),
+        ),
+    ]
+
+    attempts = {w: 0 for w, _ in plan}
+    succeeded: set[str] = set()
+    while (
+        any(w not in succeeded and attempts[w] < 3 for w, _ in plan)
+        and time.monotonic() < deadline
+    ):
+        if not preflight():
+            log("tunnel down; retry in 120s")
+            time.sleep(120)
+            continue
+        for which, fn in plan:
+            if which in succeeded or attempts[which] >= 3:
+                continue
+            if time.monotonic() > deadline:
+                log("deadline reached mid-pass; stopping")
+                break
+            attempts[which] += 1
+            log(f"running {which} (attempt {attempts[which]}/3)")
+            row = fn()
+            row["which"] = which
+            row["attempt"] = attempts[which]
+            append(args.out, row)
+            if "error" in row:
+                log(f"{which} failed ({row['error']}); re-probing tunnel")
+                break
+            succeeded.add(which)
+    missing = [w for w, _ in plan if w not in succeeded]
+    append(
+        args.out,
+        {"which": "r4b_experiments_summary", "succeeded": sorted(succeeded),
+         "unfinished": missing},
+    )
+    return 0 if not missing else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
