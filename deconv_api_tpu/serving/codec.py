@@ -52,8 +52,16 @@ def decode_data_url(uri: str) -> np.ndarray:
         raw = base64.b64decode(payload, validate=False)
     except Exception as e:
         raise CodecError(f"invalid base64 image payload: {e}") from e
+    if not raw:
+        # b64decode(validate=False) silently drops ALL non-alphabet chars,
+        # so pure garbage ('@@@@') decodes to b'' rather than raising
+        raise CodecError("empty image payload after base64 decode")
     if _HAVE_CV2:
-        img = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+        try:
+            img = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+        except Exception as e:  # OpenCV >= 5 raises cv2.error on
+            # undecodable/empty buffers instead of returning None
+            raise CodecError(f"could not decode image bytes: {e}") from e
         if img is None:
             raise CodecError("could not decode image bytes")
         return img

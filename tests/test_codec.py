@@ -36,6 +36,12 @@ def test_decode_garbage_raises_codec_error():
         codec.decode_data_url("data:image/png;base64,%%%%not-base64")
     with pytest.raises(codec.CodecError):
         codec.decode_data_url("data:image/png;base64," + base64.b64encode(b"nope").decode())
+    # pure non-alphabet payload: b64decode(validate=False) strips it to
+    # b'', and OpenCV >= 5 RAISES on an empty buffer instead of returning
+    # None — must still surface as CodecError, not a 500 (found by the
+    # verify drive 2026-07-31)
+    with pytest.raises(codec.CodecError):
+        codec.decode_data_url("data:image/png;base64,@@@@")
 
 
 def test_preprocess_vgg_flips_and_subtracts():
