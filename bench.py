@@ -379,7 +379,7 @@ def main_child(force_cpu: bool) -> None:
     # overhead to the device and undercounts throughput.  The checksum
     # still synchronizes (it cannot be produced without executing the
     # whole program) and its FLOPs are negligible.
-    fused_sync = os.environ.get("DECONV_BENCH_FUSED_SYNC", "0") == "1"
+    fused_sync = os.environ.get("DECONV_BENCH_FUSED_SYNC", "1") != "0"
     if fused_sync:
         base = fn
         step = jax.jit(
@@ -548,6 +548,7 @@ def main_child(force_cpu: bool) -> None:
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / NORTH_STAR_IMG_S, 3),
         "platform": platform,
+        "sync": "fused" if fused_sync else "two-program",
     }
     if not on_tpu:
         if "--cpu-reason=tpu_unavailable" in sys.argv:

@@ -61,12 +61,14 @@ def _stream_sync() -> bool:
     program (one dispatch per call instead of two) and ONE trailing fetch
     inside the timer.  _timed's per-call fetch charges a full tunnel RTT
     (~71 ms — BASELINE.md tunnel anatomy) plus a second program dispatch
-    to every iteration, which a local-PCIe deployment would not pay.
-    Default off so rows stay comparable with rounds 2-3; rows record
-    which form produced them."""
+    to every iteration, which a local-PCIe deployment would not pay —
+    measured 2026-07-31, the overhead understated config 4 by ~11x
+    (20.4 ms/batch device time under 228.3 ms percall) and config 2 by
+    ~5x.  Default ON since then; rows record which form produced them,
+    and DECONV_SUITE_STREAM_SYNC=0 restores the round-2/3 form."""
     import os
 
-    return os.environ.get("DECONV_SUITE_STREAM_SYNC", "0") == "1"
+    return os.environ.get("DECONV_SUITE_STREAM_SYNC", "1") != "0"
 
 
 def _timed_stream(step, batches) -> float:
@@ -312,6 +314,12 @@ def config5_load(n_requests: int = 256, concurrency: int = 64) -> dict:
         t0 = time.perf_counter()
         await asyncio.gather(*(one(i) for i in range(n_requests)))
         wall = time.perf_counter() - t0
+        # server-side attribution BEFORE stop(): batch sizes, per-batch
+        # cadence, queue wait and decode/compute/encode stage times — the
+        # breakdown that says whether the wall clock went to the device,
+        # the queue, or the tunnel (VERDICT r3 item 2's "written
+        # attribution of exactly where the time goes")
+        snap = service.metrics.snapshot()
         await service.stop()
         lat = sorted(latencies)
         return {
@@ -323,6 +331,18 @@ def config5_load(n_requests: int = 256, concurrency: int = 64) -> dict:
             "requests_per_sec": round(n_requests / wall, 1),
             "p50_ms": round(lat[len(lat) // 2] * 1e3, 1),
             "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 1),
+            "server": {
+                "batches_total": snap["batches_total"],
+                "batch_size_p50": round(snap["batch_size_p50"], 1),
+                "batch_cadence_p50_ms": round(
+                    snap["batch_cadence_p50_s"] * 1e3, 1
+                ),
+                "queue_wait_p50_ms": round(snap["queue_wait_p50_s"] * 1e3, 1),
+                "stages_p50_ms": {
+                    k: round(v["p50_s"] * 1e3, 1)
+                    for k, v in snap["stages"].items()
+                },
+            },
         }
 
     return asyncio.run(drive())

@@ -420,7 +420,8 @@ def get_visualizer(
     measured slower end-to-end, so the default is OFF); ``None`` reads
     ``DECONV_KPACK_CHAN`` (default 0 = disabled).  ``sweep_merged``
     selects the merged cross-layer sweep (``_sweep_merged``); ``None``
-    reads ``DECONV_SWEEP_MERGED`` (default 1 = ON); a nonzero
+    reads ``DECONV_SWEEP_MERGED`` (default 0 = OFF — measured slower
+    than the separate sweep under honest sync, 2026-07-31); a nonzero
     ``kpack_chan`` always takes the separate-per-layer path (the merged
     sweep has no packed tail).  Env vars are resolved
     HERE, outside the cache, so changing them between calls always takes
@@ -437,9 +438,17 @@ def get_visualizer(
         # hardware-measured (tools/tail_nchw_probe.py).
         nchw_chan = int(os.environ.get("DECONV_TAIL_NCHW", "0"))
     if sweep_merged is None:
-        # same falsy vocabulary as DECONV_PALLAS (ops/pallas_pool.py)
+        # same falsy vocabulary as DECONV_PALLAS (ops/pallas_pool.py).
+        # Default OFF (measured negative 2026-07-31): under honest
+        # fused-sync timing the merged sweep runs 440.9 ms/batch-8 vs the
+        # separate sweep's 207.2 on a v5e-1 — the "15x fewer program
+        # segments" win it chased turned out to be measurement-harness
+        # dispatch overhead, not device time, and the concatenated carry
+        # needs batch chunking (DECONV_SWEEP_CHUNK) to fit HBM at all.
+        # Kept as the measured-negative record (same policy as kpack and
+        # pallas_pool).
         sweep_merged = os.environ.get(
-            "DECONV_SWEEP_MERGED", "1"
+            "DECONV_SWEEP_MERGED", "0"
         ).lower() not in ("0", "false", "off", "no", "")
     # Batch chunk for the BATCHED merged sweep.  The merged carry holds
     # K x n_layers projections per example (120 for VGG16 K=8); a plain
