@@ -1,0 +1,115 @@
+"""Honest per-depth slack map of the headline program (round 4).
+
+The r3 layer-sweep localisation ("block1/2 backward at 2.3-2.4x their
+per-segment roofline") was measured with loops that either dispatched two
+programs per iteration or fetched every checksum inside the timer — the
+same instrument overhead that understated config 4 by ~11x
+(BASELINE.md, sync-methodology finding).  This probe re-derives the map
+with the clean form: checksum reduced INSIDE the jitted program, all
+iterations dispatched, ONE trailing fetch in-timer, remaining checksums
+validated after.
+
+For each start layer L in the truncation ladder it times
+  vis(L): forward to L + top-8 selection + 8 backward projections to pixels
+  fwd(L): forward to L + selection only (switch argmaxes kept live)
+at batch 64.  Successive differences then attribute time:
+  vis(L2) - vis(L1) = dfwd(L1->L2) + 8 x bwd_segment(L1->L2)
+  => bwd_segment = (dvis - dfwd) / 8   per projection,
+with dfwd measured directly from the fwd ladder.
+
+Prints one JSON line with per-L times and the derived per-segment
+backward costs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+LADDER = [
+    "block1_conv2",
+    "block2_conv2",
+    "block3_conv3",
+    "block4_conv3",
+    "block5_conv1",
+]
+BATCH = 64
+ITERS = 15
+
+
+def tree_checksum(out):
+    return sum(
+        jnp.sum(leaf.astype(jnp.float32))
+        for leaf in jax.tree_util.tree_leaves(out)
+    )
+
+
+def timed(step, iters=ITERS, seed0=0):
+    """ms/iter: dispatch all, one trailing in-timer fetch, validate after."""
+    def mk(i):
+        return jax.random.normal(
+            jax.random.PRNGKey(seed0 + i), (BATCH, 224, 224, 3)
+        )
+
+    float(step(mk(9999)))  # compile + warm
+    xs = [mk(i) for i in range(iters)]
+    t0 = time.perf_counter()
+    sums = [step(x) for x in xs]
+    last = float(sums[-1])
+    dt = time.perf_counter() - t0
+    vals = [float(s) for s in sums[:-1]] + [last]
+    assert all(v == v for v in vals)
+    return dt / iters * 1e3
+
+
+def main() -> None:
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.engine.deconv import get_forward_only
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    enable_compilation_cache(ServerConfig.from_env())
+    print(f"device: {jax.devices()[0]}", file=sys.stderr, flush=True)
+
+    spec, params = vgg16_init()
+    out: dict[str, float] = {"batch": BATCH, "iters": ITERS}
+
+    for layer in LADDER:
+        vis = get_visualizer(
+            spec, layer, 8, "all", True, batched=True,
+            backward_dtype="bfloat16",
+        )
+        step_v = jax.jit(lambda p, b, _f=vis: tree_checksum(_f(p, b)))
+        fwd = get_forward_only(spec, layer, top_k=8, batched=True)
+        step_f = jax.jit(lambda p, b, _f=fwd: tree_checksum(_f(p, b)))
+        ms_v = timed(lambda b: step_v(params, b))
+        ms_f = timed(lambda b: step_f(params, b))
+        out[f"vis_{layer}_ms"] = round(ms_v, 2)
+        out[f"fwd_{layer}_ms"] = round(ms_f, 2)
+        print(
+            f"{layer}: vis {ms_v:.1f} ms  fwd {ms_f:.1f} ms",
+            file=sys.stderr, flush=True,
+        )
+
+    # successive segment attribution (per single projection, bf16 backward)
+    for lo, hi in zip(LADDER, LADDER[1:]):
+        dvis = out[f"vis_{hi}_ms"] - out[f"vis_{lo}_ms"]
+        dfwd = out[f"fwd_{hi}_ms"] - out[f"fwd_{lo}_ms"]
+        out[f"bwd_seg_{lo}_to_{hi}_ms"] = round((dvis - dfwd) / 8.0, 3)
+    # the deepest vis includes the block1 backward tail + output write:
+    # vis(block1_conv2) - fwd(block1_conv2) = 8 x (block1 tail)
+    out["bwd_tail_to_pixels_ms"] = round(
+        (out["vis_block1_conv2_ms"] - out["fwd_block1_conv2_ms"]) / 8.0, 3
+    )
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
