@@ -46,8 +46,10 @@ The measured path is mixed precision — fp32 forward/selection/switches,
 bfloat16 backward projection — which is parity-safe: the deprocessed uint8
 output measures ~168 dB PSNR against full fp32 (selection is exact; the
 linear projection chain's bf16 rounding disappears under deprocess
-quantisation), far above the 40 dB target.  Full-bf16 forward is NOT used:
-it lands at ~38.7 dB.  DECONV_BACKWARD_DTYPE=float32 forces full fp32.
+quantisation), far above the 40 dB target.  Full-bf16 forward is NOT the
+default: it lands at 35.3 dB deprocessed (raw 36.9) vs the fp64 oracle —
+measured round 4c, +4.3% throughput, opt-in via DECONV_DTYPE=bfloat16.
+DECONV_BACKWARD_DTYPE=float32 forces full fp32.
 
 MFU accounting: FLOPs come from XLA's own cost analysis of the compiled
 program (fallback: analytic conv-chain model in bench/flops.py); peak is

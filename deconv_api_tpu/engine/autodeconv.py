@@ -22,8 +22,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
+from deconv_api_tpu.engine.deconv import _select_top
 from deconv_api_tpu.models.blocks import DECONV_RULES
 
 
@@ -51,10 +51,10 @@ def autodeconv_visualizer(forward_fn, layer: str, top_k: int = 8, mode: str = "a
 
         act, vjp_fn = jax.vjp(acts_of, x)
         n_chan = act.shape[-1]
-        k = min(top_k, n_chan)
-        sums = jnp.sum(act, axis=tuple(range(act.ndim - 1)))
-        masked = jnp.where(sums > 0, sums, -jnp.inf)
-        top_sums, top_idx = lax.top_k(masked, k)
+        # The sequential engine's _select_top, shared so the selection
+        # semantics (fp32 ranking accumulator, positive mask, top-K)
+        # cannot drift between the two engines.
+        top_idx, top_sums, valid = _select_top(act, top_k)
 
         def backproject(idx):
             chan = jax.nn.one_hot(idx, n_chan, dtype=act.dtype)
@@ -69,7 +69,7 @@ def autodeconv_visualizer(forward_fn, layer: str, top_k: int = 8, mode: str = "a
             "images": images[:, 0],
             "indices": top_idx,
             "sums": top_sums,
-            "valid": top_sums > 0,
+            "valid": valid,
         }
 
     return jax.jit(single)

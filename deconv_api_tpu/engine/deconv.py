@@ -233,10 +233,15 @@ def _select_top(output, top_k):
     n_chan = output.shape[-1]
     k = min(top_k, n_chan)
     reduce_axes = tuple(range(output.ndim - 1))
-    sums = jnp.sum(output, axis=reduce_axes)
+    # Accumulate the ranking sums in fp32 even when the forward runs
+    # bfloat16 (DECONV_DTYPE): a bf16 accumulator over a 14x14 spatial
+    # extent loses ~3 decimal digits, enough to swap near-tied ranks, and
+    # the selection is the one part of the program whose output is
+    # discrete.  Free for fp32 forwards (no-op cast).
+    sums = jnp.sum(output.astype(jnp.float32), axis=reduce_axes)
     masked = jnp.where(sums > 0, sums, -jnp.inf)
     top_sums, top_idx = lax.top_k(masked, k)
-    return top_idx, top_sums, top_sums > 0
+    return top_idx, top_sums.astype(output.dtype), top_sums > 0
 
 
 def _seed_fmap(output, idx, mode):
@@ -585,9 +590,9 @@ def get_forward_only(spec: ModelSpec, layer_name: str, top_k: int = 8,
         switches: dict[str, jnp.ndarray] = {}
         for e in entries:
             x = _up_step(e, params, x, switches)
-        sums = jnp.sum(x, axis=tuple(range(x.ndim - 1)))
-        masked = jnp.where(sums > 0, sums, -jnp.inf)
-        top_sums, top_idx = lax.top_k(masked, min(top_k, x.shape[-1]))
+        # The shared _select_top: the probed forward must select
+        # identically to the measured program.
+        top_idx, top_sums, _ = _select_top(x, top_k)
         sw = [jnp.sum(i.astype(jnp.int32)) for i, _ in switches.values()]
         return top_sums, top_idx, sw
 

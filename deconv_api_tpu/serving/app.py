@@ -246,9 +246,10 @@ class DeconvService:
         batch = np.stack(images + [images[-1]] * (bucket - len(images)))
         # cfg.dtype is the forward/selection dtype (the engine follows the
         # input dtype).  float32 is the parity-safe default; bfloat16 trades
-        # selection exactness for throughput and is an explicit opt-in —
-        # full-bf16 forward measures ~38.7 dB vs the oracle, under the 40 dB
-        # bar (bench.py docstring).
+        # seed/switch exactness for throughput (+4.3% measured, round 4c)
+        # and is an explicit opt-in — full-depth bf16-forward parity is
+        # 35.3 dB deprocessed vs the fp64 oracle, under the 40 dB bar
+        # (BASELINE.md round-4c; floors in tests/test_full_depth_parity.py).
         fwd_dtype = (
             jnp.bfloat16 if self.cfg.dtype == "bfloat16" else jnp.float32
         )

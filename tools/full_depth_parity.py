@@ -114,32 +114,74 @@ def run(layer: str = "block5_conv1", top_k: int = 8, mode: str = "all") -> dict:
     results = {"layer": layer, "top_k": len(top), "mode": mode,
                "oracle_forward_s": round(fwd_s, 1),
                "oracle_backward_s": round(bwd_s, 1)}
-    for label, bwd_dtype in (("fp32", None), ("bf16_backward", "bfloat16")):
+    variants = (
+        ("fp32", None, jnp.float32),
+        ("bf16_backward", "bfloat16", jnp.float32),
+        # bf16 FORWARD as well (DECONV_DTYPE=bfloat16): params and input
+        # cast to bf16, selection sums still fp32 (_select_top).  The
+        # round-4c headline candidate — parity floor required before any
+        # default flip (BASELINE.md round-4c section).
+        ("bf16_full", "bfloat16", jnp.bfloat16),
+    )
+    for label, bwd_dtype, fwd_dtype in variants:
         t0 = time.perf_counter()
         fn = get_visualizer(
             spec, layer, top_k, mode, True, backward_dtype=bwd_dtype
         )
-        out = fn(params, jnp.asarray(img, jnp.float32))[layer]
+        run_params = (
+            jax.tree.map(lambda a: a.astype(fwd_dtype), params)
+            if fwd_dtype != jnp.float32
+            else params
+        )
+        out = fn(run_params, jnp.asarray(img, fwd_dtype))[layer]
         dt = time.perf_counter() - t0
         n = int(np.asarray(out["valid"]).sum())
         idx = np.asarray(out["indices"])[:n]
         imgs = np.asarray(out["images"], np.float64)[:n]
-        assert n == len(top), f"{label}: engine found {n} filters, oracle {len(top)}"
-        idx_match = bool((idx == [i for i, _ in top]).all())
+        if fwd_dtype == jnp.float32:
+            # Exact-forward variants must reproduce the oracle's selection
+            # bit-for-bit; the bf16 forward may legitimately swap near-tied
+            # ranks, so for it the count is reported (and pinned by the
+            # slow test's valid_count floor), not asserted here.
+            assert n == len(top), (
+                f"{label}: engine found {n} filters, oracle {len(top)}"
+            )
+        assert n > 0, f"{label}: engine found NO valid filters, oracle {len(top)}"
+        idx_match = bool(
+            n == len(top) and (idx == [i for i, _ in top]).all()
+        )
+        # Pair engine and oracle projections BY CHANNEL, not by rank: the
+        # bf16 forward may legitimately swap near-tied ranks, and a
+        # rank-position pairing would then compare channel-A's image with
+        # channel-B's and crater PSNR on a semantically fine output.  For
+        # the exact variants (indices asserted equal above) this pairing
+        # is the identity.
+        by_chan = {int(c): imgs[r] for r, c in enumerate(idx)}
+        pairs = [
+            (by_chan[fidx], oracle_imgs[r])
+            for r, (fidx, _) in enumerate(top)
+            if fidx in by_chan
+        ]
+        assert pairs, f"{label}: no overlap between engine and oracle top-K"
+        imgs = np.stack([p[0] for p in pairs])
+        ref_imgs = np.stack([p[1] for p in pairs])
 
-        raw_peak = float(np.abs(oracle_imgs).max())
-        raw = psnr_db(imgs, oracle_imgs, raw_peak)
+        raw_peak = float(np.abs(ref_imgs).max())
+        raw = psnr_db(imgs, ref_imgs, raw_peak)
         a = np.stack([deprocess_image(v) for v in imgs])
-        b = np.stack([deprocess_image(v) for v in oracle_imgs])
+        b = np.stack([deprocess_image(v) for v in ref_imgs])
         dep = psnr_db(a, b, 255.0)
         results[label] = {
             "engine_s": round(dt, 1),
             "indices_match": idx_match,
+            "valid_count": n,
+            "paired_count": len(pairs),
             "raw_psnr_db": round(raw, 1),
             "deprocessed_psnr_db": round(dep, 1),
         }
-        print(f"{label}: idx_match={idx_match} raw={raw:.1f}dB "
-              f"deprocessed={dep:.1f}dB ({dt:.1f}s)", flush=True)
+        print(f"{label}: idx_match={idx_match} paired={len(pairs)} "
+              f"raw={raw:.1f}dB deprocessed={dep:.1f}dB ({dt:.1f}s)",
+              flush=True)
 
     return results
 

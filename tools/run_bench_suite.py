@@ -124,6 +124,73 @@ def wait_for_device(max_wait_s: float) -> bool:
         delay = min(delay * 1.5, 300.0)
 
 
+def plan_log(tag: str, msg: str) -> None:
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    print(f"[{tag} {ts}] {msg}", file=sys.stderr, flush=True)
+
+
+def append_row(out_path: str, row: dict, tag: str) -> None:
+    row = dict(row, date=datetime.date.today().isoformat())
+    with open(out_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    plan_log(tag, f"recorded: {json.dumps(row)[:200]}")
+
+
+def run_plan(
+    plan: list[tuple],
+    out_path: str,
+    tag: str,
+    max_hours: float,
+    summary_which: str,
+    max_attempts: int = 3,
+) -> list[str]:
+    """Shared scaffolding for the tools/run_r4*_experiments scripts: run
+    each ``(which, thunk)`` up to ``max_attempts`` times, preflighting the
+    tunnel before every pass, appending date-stamped rows to ``out_path``,
+    and closing with a ``summary_which`` row listing what finished.
+    Returns the unfinished experiment names (empty = all succeeded).
+
+    One retry-loop implementation instead of one per script: an
+    experiment-accounting fix lands here once, for every runner."""
+    deadline = time.monotonic() + max_hours * 3600
+    attempts = {w: 0 for w, _ in plan}
+    succeeded: set[str] = set()
+    while (
+        any(w not in succeeded and attempts[w] < max_attempts for w, _ in plan)
+        and time.monotonic() < deadline
+    ):
+        if not preflight():
+            plan_log(tag, "tunnel down; retry in 120s")
+            time.sleep(120)
+            continue
+        for which, fn in plan:
+            if which in succeeded or attempts[which] >= max_attempts:
+                continue
+            if time.monotonic() > deadline:
+                plan_log(tag, "deadline reached mid-pass; stopping")
+                break
+            attempts[which] += 1
+            plan_log(
+                tag, f"running {which} (attempt {attempts[which]}/{max_attempts})"
+            )
+            row = fn()
+            row["which"] = which
+            row["attempt"] = attempts[which]
+            append_row(out_path, row, tag)
+            if "error" in row:
+                plan_log(tag, f"{which} failed ({row['error']}); re-probing tunnel")
+                break
+            succeeded.add(which)
+    missing = [w for w, _ in plan if w not in succeeded]
+    append_row(
+        out_path,
+        {"which": summary_which, "succeeded": sorted(succeeded),
+         "unfinished": missing},
+        tag,
+    )
+    return missing
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="2,3,4,5")

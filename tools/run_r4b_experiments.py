@@ -27,28 +27,18 @@ Usage: python tools/run_r4b_experiments.py [--max-hours 6]
 from __future__ import annotations
 
 import argparse
-import datetime
-import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from run_bench_suite import TIMEOUTS, preflight, run_cmd_json, run_one  # noqa: E402
-
-
-def log(msg: str) -> None:
-    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
-    print(f"[r4b-exp {ts}] {msg}", file=sys.stderr, flush=True)
-
-
-def append(out_path: str, row: dict) -> None:
-    row = dict(row, date=datetime.date.today().isoformat())
-    with open(out_path, "a") as f:
-        f.write(json.dumps(row) + "\n")
-    log(f"recorded: {json.dumps(row)[:200]}")
+from run_bench_suite import (  # noqa: E402
+    TIMEOUTS,
+    run_cmd_json,
+    run_one,
+    run_plan,
+)
 
 
 def main() -> int:
@@ -58,7 +48,6 @@ def main() -> int:
         "--out", default=os.path.join(REPO, "bench_suite_results.jsonl")
     )
     args = ap.parse_args()
-    deadline = time.monotonic() + args.max_hours * 3600
 
     plan = [
         ("config2_merged_chunked", lambda: run_one(2, TIMEOUTS[2])),
@@ -100,37 +89,8 @@ def main() -> int:
         ),
     ]
 
-    attempts = {w: 0 for w, _ in plan}
-    succeeded: set[str] = set()
-    while (
-        any(w not in succeeded and attempts[w] < 3 for w, _ in plan)
-        and time.monotonic() < deadline
-    ):
-        if not preflight():
-            log("tunnel down; retry in 120s")
-            time.sleep(120)
-            continue
-        for which, fn in plan:
-            if which in succeeded or attempts[which] >= 3:
-                continue
-            if time.monotonic() > deadline:
-                log("deadline reached mid-pass; stopping")
-                break
-            attempts[which] += 1
-            log(f"running {which} (attempt {attempts[which]}/3)")
-            row = fn()
-            row["which"] = which
-            row["attempt"] = attempts[which]
-            append(args.out, row)
-            if "error" in row:
-                log(f"{which} failed ({row['error']}); re-probing tunnel")
-                break
-            succeeded.add(which)
-    missing = [w for w, _ in plan if w not in succeeded]
-    append(
-        args.out,
-        {"which": "r4b_experiments_summary", "succeeded": sorted(succeeded),
-         "unfinished": missing},
+    missing = run_plan(
+        plan, args.out, "r4b-exp", args.max_hours, "r4b_experiments_summary"
     )
     return 0 if not missing else 1
 

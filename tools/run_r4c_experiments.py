@@ -1,0 +1,70 @@
+"""Round-4c perf experiment: bf16 FORWARD (fifth attack on the C<=128 slack).
+
+The clean slack map (BASELINE.md, 2026-07-31) attributes 29.1 ms of the
+39.8 ms forward time to fp32 HBM traffic in the block1/2 segments — the
+forward has always run fp32 while only the backward projections run
+bf16.  `DECONV_DTYPE=bfloat16` (ServerConfig.dtype) casts params and
+input batches to bf16, halving the forward's HBM bytes end to end; the
+knob has existed since round 2 (bench.py:343-352) but was never
+hardware-measured.  Expected win if the forward slack is really
+traffic-bound: ~15 ms/batch -> ~455 img/s.
+
+MEASURED 2026-07-31 (rows in bench_suite_results.jsonl): bf16 forward
+417.5 img/s vs 400.3 fp32-forward same-session control (+4.3%; forward
+36.7 -> 27.6 ms/batch) — but full-depth parity drops to 35.3 dB
+deprocessed (below the north star's 40 dB bar), so the default stays
+fp32-forward and bf16-forward is the documented opt-in.  Record:
+BASELINE.md "Round-4c".
+
+Usage: python tools/run_r4c_experiments.py [--max-hours 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from run_bench_suite import run_cmd_json, run_plan  # noqa: E402
+
+
+def bench(extra_env: dict) -> dict:
+    env = {
+        "DECONV_BENCH_FUSED_SYNC": "1",
+        "DECONV_BENCH_BUDGET": "1100",
+        "DECONV_BENCH_TIMEOUT": "600",
+    }
+    env.update(extra_env)
+    return run_cmd_json(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--breakdown"],
+        1200,
+        env=env,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=2.0)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "bench_suite_results.jsonl")
+    )
+    args = ap.parse_args()
+
+    plan = [
+        ("headline_fwd_bf16", lambda: bench({"DECONV_DTYPE": "bfloat16"})),
+        # Control pins fp32 explicitly: run_cmd_json merges over
+        # os.environ, so an exported DECONV_DTYPE would otherwise turn the
+        # A/B into bf16-vs-bf16.
+        ("headline_fused_ctl", lambda: bench({"DECONV_DTYPE": "float32"})),
+    ]
+    missing = run_plan(
+        plan, args.out, "r4c-exp", args.max_hours, "r4c_experiments_summary"
+    )
+    return 0 if not missing else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
