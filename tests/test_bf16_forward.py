@@ -1,0 +1,101 @@
+"""The bf16-forward opt-in (`DECONV_DTYPE=bfloat16`, round 4c) on every
+engine surface, at test scale.
+
+The full-depth parity characterisation lives in the slow test
+(tests/test_full_depth_parity.py: 35.3 dB deprocessed, below the 40 dB
+north-star bar — which is why bf16 forward is opt-in, not default).
+These fast tests pin that the opt-in *works*: selection stays stable
+(fp32 ranking accumulator in the shared _select_top), projections stay
+close to the fp32 engine, and the serving path accepts the config.
+"""
+
+import base64
+from urllib.parse import unquote
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.engine import autodeconv_visualizer, get_visualizer
+from deconv_api_tpu.models.apply import spec_forward
+from deconv_api_tpu.models.spec import init_params
+from tests.test_engine_parity import TINY
+from tests.test_serving import ServiceFixture, _data_url
+
+
+def _rel_l2(a, b):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    return float(np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12))
+
+
+def _paired_rel_l2(got, ref):
+    """Channel-paired projection error + selection-set check.
+
+    Rank ORDER under a bf16 forward is backend-dependent (near-tied
+    channel sums round differently on native-TPU vs CPU-emulated bf16 —
+    the flake class tools/full_depth_parity.py pairs by channel for), so
+    assert the selected channel SET and compare images channel-to-channel
+    rather than rank-to-rank."""
+    gi = np.asarray(got["indices"]).tolist()
+    ri = np.asarray(ref["indices"]).tolist()
+    assert set(gi) == set(ri), (gi, ri)
+    assert int(np.asarray(got["valid"]).sum()) == int(np.asarray(ref["valid"]).sum())
+    by_chan = {c: np.asarray(got["images"])[r] for r, c in enumerate(gi)}
+    a = np.stack([by_chan[c] for c in ri])
+    return _rel_l2(a, np.asarray(ref["images"]))
+
+
+def _cast_tree(params, dtype):
+    return jax.tree.map(lambda p: p.astype(dtype), params)
+
+
+def test_sequential_engine_bf16_forward_matches_fp32():
+    params = init_params(TINY, jax.random.PRNGKey(42))
+    img = jax.random.normal(jax.random.PRNGKey(7), (16, 16, 3))
+    fn = get_visualizer(TINY, "b2c1", 8, "all", True, backward_dtype="bfloat16")
+
+    ref = fn(params, img.astype(jnp.float32))["b2c1"]
+    got = fn(
+        _cast_tree(params, jnp.bfloat16), img.astype(jnp.bfloat16)
+    )["b2c1"]
+
+    assert got["images"].dtype == jnp.bfloat16
+    # projections carry bf16 forward rounding, amplified at 16x16 toy scale
+    # where one flipped pool switch moves a visible fraction of the norm
+    # (measured 0.07 rel-L2 here; full-depth parity is pinned in dB by the
+    # slow test).  The bound catches a broken chain (wrong kernel/switch
+    # wiring reads ~1.0), not precision drift.
+    assert _paired_rel_l2(got, ref) < 0.3
+
+
+def test_autodeconv_engine_bf16_forward_matches_fp32():
+    params = init_params(TINY, jax.random.PRNGKey(42))
+    img = jax.random.normal(jax.random.PRNGKey(7), (16, 16, 3))
+    fn = autodeconv_visualizer(spec_forward(TINY), "b2c1", top_k=8)
+
+    ref = fn(params, img.astype(jnp.float32))
+    got = fn(_cast_tree(params, jnp.bfloat16), img.astype(jnp.bfloat16))
+
+    assert _paired_rel_l2(got, ref) < 0.3
+
+
+def test_serving_with_bf16_forward_config():
+    import cv2
+    import httpx
+
+    cfg = ServerConfig(
+        image_size=16, max_batch=2, batch_window_ms=1.0,
+        compilation_cache_dir="", dtype="bfloat16",
+    )
+    with ServiceFixture(cfg) as s:
+        r = httpx.post(
+            s.base_url + "/",
+            data={"file": _data_url(), "layer": "b2c1"},
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+        raw = base64.b64decode(unquote(r.json().split(",", 1)[1]))
+        img = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+        assert img.shape == (32, 32, 3)  # 2x2 grid of 16x16 tiles
+        assert img.std() > 0  # not a blank grid
