@@ -1,4 +1,4 @@
-"""Model zoo: layer-spec IR plus VGG16 / ResNet50 / InceptionV3.
+"""Model zoo: layer-spec IR plus VGG16 / VGG19 / ResNet50 / InceptionV3.
 
 Models are (spec, params) pairs: an immutable layer specification that the
 engine traces into a single XLA program, and a params pytree.  This replaces
@@ -15,15 +15,18 @@ from deconv_api_tpu.models.spec import (
     layer_output_shapes,
 )
 from deconv_api_tpu.models.vgg16 import VGG16_SPEC, vgg16_init
+from deconv_api_tpu.models.vgg19 import VGG19_SPEC, vgg19_init
 
 __all__ = [
     "Layer",
     "ModelSpec",
     "VGG16_SPEC",
+    "VGG19_SPEC",
     "entry_chain",
     "init_params",
     "layer_output_shapes",
     "vgg16_init",
+    "vgg19_init",
 ]
 
 # DAG models (params pytree + pure apply fn) import lazily from their own

@@ -1,0 +1,57 @@
+"""VGG19 (ImageNet classifier topology) as a ModelSpec.
+
+Same family as VGG16 (reference app/main.py:17 serves VGG16) with four
+convolutions in blocks 3-5 instead of three; layer names match Keras'
+`keras.applications.vgg19.VGG19(include_top=True)` exactly, so the
+name-keyed h5 loader (models/weights.py) and the switch-deconv engine
+apply unchanged — the spec IR is the only thing that differs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+from deconv_api_tpu.models.vgg16 import _conv, _pool
+
+VGG19_SPEC = ModelSpec(
+    name="vgg19",
+    input_shape=(224, 224, 3),
+    layers=(
+        Layer("input_1", "input"),
+        _conv("block1_conv1", 64),
+        _conv("block1_conv2", 64),
+        _pool("block1_pool"),
+        _conv("block2_conv1", 128),
+        _conv("block2_conv2", 128),
+        _pool("block2_pool"),
+        _conv("block3_conv1", 256),
+        _conv("block3_conv2", 256),
+        _conv("block3_conv3", 256),
+        _conv("block3_conv4", 256),
+        _pool("block3_pool"),
+        _conv("block4_conv1", 512),
+        _conv("block4_conv2", 512),
+        _conv("block4_conv3", 512),
+        _conv("block4_conv4", 512),
+        _pool("block4_pool"),
+        _conv("block5_conv1", 512),
+        _conv("block5_conv2", 512),
+        _conv("block5_conv3", 512),
+        _conv("block5_conv4", 512),
+        _pool("block5_pool"),
+        Layer("flatten", "flatten"),
+        Layer("fc1", "dense", activation="relu", filters=4096),
+        Layer("fc2", "dense", activation="relu", filters=4096),
+        Layer("predictions", "dense", activation="softmax", filters=1000),
+    ),
+)
+
+
+def vgg19_init(key: jax.Array | None = None, dtype=jnp.float32):
+    """(spec, params) with He-normal weights; pretrained Keras h5 loads
+    through the same name-keyed loader as VGG16 (models/weights.py)."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    return VGG19_SPEC, init_params(VGG19_SPEC, key, dtype)

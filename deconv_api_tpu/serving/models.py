@@ -204,6 +204,15 @@ def _vgg16_bundle() -> ModelBundle:
     )
 
 
+def _vgg19_bundle() -> ModelBundle:
+    from deconv_api_tpu.models.vgg19 import vgg19_init
+
+    spec, params = vgg19_init()
+    return spec_bundle(
+        spec, params, dream_layers=("block4_conv4", "block5_conv1")
+    )
+
+
 def _resnet50_bundle() -> ModelBundle:
     from deconv_api_tpu.models.resnet50 import (
         DECONV_LAYERS,
@@ -246,6 +255,7 @@ def _inception_v3_bundle() -> ModelBundle:
 
 REGISTRY: dict[str, Callable[[], ModelBundle]] = {
     "vgg16": _vgg16_bundle,
+    "vgg19": _vgg19_bundle,
     "resnet50": _resnet50_bundle,
     "inception_v3": _inception_v3_bundle,
 }
@@ -257,6 +267,7 @@ def registry_info() -> list[dict]:
     from deconv_api_tpu.models.inception_v3 import DREAM_LAYERS
     from deconv_api_tpu.models.resnet50 import DECONV_LAYERS
     from deconv_api_tpu.models.vgg16 import VGG16_SPEC as spec
+    from deconv_api_tpu.models.vgg19 import VGG19_SPEC as spec19
     return [
         {
             "model": "vgg16",
@@ -264,6 +275,13 @@ def registry_info() -> list[dict]:
             "engine": "switch-deconv (sequential spec)",
             "layers": [l.name for l in spec.layers if l.kind != "input"],
             "dream_layers": ["block4_conv3", "block5_conv1"],
+        },
+        {
+            "model": "vgg19",
+            "image_size": 224,
+            "engine": "switch-deconv (sequential spec)",
+            "layers": [l.name for l in spec19.layers if l.kind != "input"],
+            "dream_layers": ["block4_conv4", "block5_conv1"],
         },
         {
             "model": "resnet50",

@@ -25,7 +25,9 @@ def png(tmp_path, rng):
 def test_models_lists_registry(capsys):
     assert main(["models"]) == 0
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
-    assert {l["model"] for l in lines} == {"vgg16", "resnet50", "inception_v3"}
+    assert {l["model"] for l in lines} == {
+        "vgg16", "vgg19", "resnet50", "inception_v3",
+    }
     assert all("layers" in l and "engine" in l for l in lines)
 
 

@@ -340,10 +340,13 @@ def test_v1_dream_no_default_layers_400(server):
 def test_model_registry_bundles():
     from deconv_api_tpu.serving.models import REGISTRY
 
-    assert set(REGISTRY) == {"vgg16", "resnet50", "inception_v3"}
+    assert set(REGISTRY) == {"vgg16", "vgg19", "resnet50", "inception_v3"}
     b = REGISTRY["vgg16"]()
     assert b.image_size == 224 and "block5_conv1" in b.layer_names
     assert b.spec is not None
+    b19 = REGISTRY["vgg19"]()
+    assert b19.image_size == 224 and "block5_conv4" in b19.layer_names
+    assert b19.spec is not None and b19.spec.name == "vgg19"
 
 
 def test_config_not_mutated_by_service():

@@ -172,6 +172,30 @@ def test_vgg16_golden(keras_h5):
     _check_acts(expected, acts)
 
 
+def test_vgg19_golden(keras_h5):
+    """VGG19 rides the same name-keyed h5 loader as VGG16; the golden pins
+    the extra block3/4/5 conv4 layers against Keras's own activations."""
+    import dataclasses
+
+    import jax
+
+    from deconv_api_tpu.models.apply import spec_forward
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.models.vgg19 import VGG19_SPEC
+    from deconv_api_tpu.models.weights import load_weights
+
+    names = ["block1_conv2", "block3_conv4", "block4_conv4", "block5_conv4", "block5_pool"]
+    path, x, expected = keras_h5(
+        keras.applications.VGG19, (64, 64, 3), names, rng_seed=3
+    )
+    spec = dataclasses.replace(
+        VGG19_SPEC.truncated("block5_pool"), input_shape=(64, 64, 3)
+    )
+    params = load_weights(spec, path, init_params(spec, jax.random.PRNGKey(0)))
+    _, acts = spec_forward(spec)(params, x)
+    _check_acts(expected, acts)
+
+
 def test_resnet50_golden(keras_h5):
     from deconv_api_tpu.models.dag_weights import load_resnet50_h5
     from deconv_api_tpu.models.resnet50 import resnet50_forward, resnet50_init
