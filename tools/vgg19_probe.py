@@ -1,0 +1,68 @@
+"""VGG19 headline-style throughput probe (cross-family perf datapoint).
+
+Same methodology as bench.py's fused-sync loop (checksum reduced inside
+the measured program, one trailing fetch, distinct inputs per iteration)
+on VGG19 block5_conv1 batch 64 — the VGG16 headline's shape with the
+deeper 16-conv chain (one extra conv in each of blocks 3/4/5 below the
+target).  Appends a row to bench_suite_results.jsonl via the shared
+runner helpers when invoked through run_cmd_json; standalone it prints
+the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from deconv_api_tpu.bench.suite import tree_checksum
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.models.vgg19 import vgg19_init
+
+    batch = int(os.environ.get("DECONV_BENCH_BATCH", "64"))
+    iters = int(os.environ.get("DECONV_BENCH_ITERS", "10"))
+    layer = "block5_conv1"
+    spec, params = vgg19_init()
+    fn = get_visualizer(
+        spec, layer, 8, "all", True, sweep=False, batched=True,
+        backward_dtype="bfloat16",
+    )
+    step = jax.jit(lambda p, b: tree_checksum(fn(p, b)))
+
+    batches = [
+        jax.random.normal(jax.random.PRNGKey(i), (batch, 224, 224, 3))
+        for i in range(iters)
+    ]
+    t0 = time.perf_counter()
+    val = float(step(params, batches[0]))
+    compile_s = time.perf_counter() - t0
+    print(f"compile+run: {compile_s:.1f}s ({val:.3e})", file=sys.stderr, flush=True)
+
+    t0 = time.perf_counter()
+    sums = [step(params, b) for b in batches]
+    last = float(sums[-1])
+    dt = time.perf_counter() - t0
+    assert all(math.isfinite(float(s)) for s in sums[:-1] + [last])
+    row = {
+        "metric": f"VGG19 {layer} deconv images/sec (224x224, batch {batch})",
+        "value": round(batch * iters / dt, 2),
+        "unit": "images/sec",
+        "ms_per_batch": round(dt / iters * 1e3, 1),
+        "platform": jax.devices()[0].platform,
+        "sync": "fused",
+    }
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
