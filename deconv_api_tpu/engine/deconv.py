@@ -226,6 +226,69 @@ def _pack_boundary(entries, ups, i, max_chan: int) -> int:
     return jb
 
 
+def _fwd_lowc_default() -> int:
+    """The DECONV_FWD_LOWC_BF16 env default, resolved in exactly one place
+    so get_visualizer and get_forward_only can never drift apart (the
+    prober must compile the same forward the visualizer measures)."""
+    import os
+
+    return int(os.environ.get("DECONV_FWD_LOWC_BF16", "0"))
+
+
+def _lowc_is_active(entries, fwd_lowc_bf16: int) -> bool:
+    """Whether the DECONV_FWD_LOWC_BF16 bf16 prefix applies to this chain:
+    some weighted layer must actually run inside it — if the chain's FIRST
+    conv/dense is already wider than the threshold, enabling it would
+    bf16-round the input pixels for zero bf16 compute."""
+    first_weighted = next(
+        (
+            e.layer
+            for e in entries
+            if not e.is_companion_act and e.layer.kind in ("conv", "dense")
+        ),
+        None,
+    )
+    return (
+        fwd_lowc_bf16 > 0
+        and first_weighted is not None
+        and (first_weighted.filters or 0) <= fwd_lowc_bf16
+    )
+
+
+def _forward_chain(entries, params, image, switches, lowc_active, lowc_thresh):
+    """The forward walk shared by the visualizer and the forward-only
+    prober (the probed forward must never drift from the measured
+    program).  With ``lowc_active`` the signal runs bfloat16 while at most
+    ``lowc_thresh`` channels wide and is cast up at the first wider
+    conv/dense; after the walk any activation still bf16 (shallow chains,
+    the sweep's block1/2 entries) is upcast so the prefix can never leak
+    into selection seeds or outputs — free for deep layers, where unused
+    ups are dead code and XLA drops the casts with them."""
+    x = image[None]
+    if lowc_active:
+        x = x.astype(jnp.bfloat16)
+    ups = []
+    for e in entries:
+        if (
+            lowc_active
+            and x.dtype == jnp.bfloat16
+            and not e.is_companion_act
+            and e.layer.kind in ("conv", "dense")
+            and (e.layer.filters or 0) > lowc_thresh
+        ):
+            # First layer wider than the threshold: the bf16 prefix ends
+            # here.  No-op when the input itself is bf16 (DECONV_DTYPE).
+            x = x.astype(image.dtype)
+        x = _up_step(e, params, x, switches)
+        ups.append(x)
+    if lowc_active and image.dtype != jnp.bfloat16:
+        ups = [
+            u.astype(image.dtype) if u.dtype == jnp.bfloat16 else u
+            for u in ups
+        ]
+    return ups
+
+
 def _select_top(output, top_k):
     """Reference top-filter selection (app/deepdream.py:369-380) in-graph:
     positive channel sums ranked descending; non-positive ranks surface in
@@ -410,6 +473,7 @@ def get_visualizer(
     sweep_merged: bool | None = None,
     nchw_chan: int | None = None,
     sweep_chunk: int | None = None,
+    fwd_lowc_bf16: int | None = None,
 ):
     """Build (and cache) the jitted visualizer for a static configuration.
 
@@ -465,10 +529,21 @@ def get_visualizer(
     # block1 batches at chunk 2).  0 disables chunking.
     if sweep_chunk is None:
         sweep_chunk = int(os.environ.get("DECONV_SWEEP_CHUNK", "2"))
+    if fwd_lowc_bf16 is None:
+        # Partial bf16 forward (round 4c follow-up): run the forward in
+        # bf16 only while the signal has <= this many channels — for VGG
+        # the high-resolution block1/2 segments, where the clean slack
+        # map localises ALL the forward's fp32-traffic slack — then cast
+        # up to the input dtype at the first wider conv.  Measured
+        # 439.3 img/s vs 411.5 control (b64) / 445.8 (b96) but 36.7 dB
+        # parity — below the 40 dB bar like the whole-chain
+        # DECONV_DTYPE=bfloat16 (35.3 dB), so 0 (exact) stays the
+        # default; see BASELINE.md round-4c.
+        fwd_lowc_bf16 = _fwd_lowc_default()
     return _get_visualizer_cached(
         spec, layer_name, top_k, mode, bug_compat, sweep, batched,
         backward_dtype, kpack_chan, bool(sweep_merged), nchw_chan,
-        sweep_chunk,
+        sweep_chunk, fwd_lowc_bf16,
     )
 
 
@@ -486,6 +561,7 @@ def _get_visualizer_cached(
     sweep_merged: bool = True,
     nchw_chan: int = 0,
     sweep_chunk: int = 0,
+    fwd_lowc_bf16: int = 0,
 ):
     if mode not in ("all", "max"):
         # The reference sys.exit()s the server here (app/deepdream.py:458-460);
@@ -516,13 +592,13 @@ def _get_visualizer_cached(
         and len(vis_indices) > 1
     )
 
+    lowc_active = _lowc_is_active(entries, fwd_lowc_bf16)
+
     def single(params, image):
-        x = image[None]
         switches: dict[str, jnp.ndarray] = {}
-        ups = []
-        for e in entries:
-            x = _up_step(e, params, x, switches)
-            ups.append(x)
+        ups = _forward_chain(
+            entries, params, image, switches, lowc_active, fwd_lowc_bf16
+        )
         if merged_active:
             return _sweep_merged(
                 entries, params, ups, switches, vis_indices, top_k, mode,
@@ -575,24 +651,29 @@ def _get_visualizer_cached(
 
 
 def get_forward_only(spec: ModelSpec, layer_name: str, top_k: int = 8,
-                     batched: bool = False):
+                     batched: bool = False, fwd_lowc_bf16: int | None = None):
     """Jitted forward chain + top-K selection ONLY — the engine's forward
     half with the pool switch argmaxes kept live via tiny int32 reductions
     (so XLA cannot dead-code the switch recording that the full program
     pays for).  This is the single forward-prober shared by bench.py
     --breakdown and tools/*_probe.py: it is built from the same
     entry_chain/_up_step the real visualizer traces, so the probed forward
-    can never drift from the measured program."""
+    can never drift from the measured program — including the
+    DECONV_FWD_LOWC_BF16 low-channel bf16 prefix, resolved from the same
+    env default as get_visualizer."""
+    if fwd_lowc_bf16 is None:
+        fwd_lowc_bf16 = _fwd_lowc_default()
     entries = entry_chain(spec.truncated(layer_name))
+    lowc_active = _lowc_is_active(entries, fwd_lowc_bf16)
 
     def fwd(params, image):
-        x = image[None]
         switches: dict[str, jnp.ndarray] = {}
-        for e in entries:
-            x = _up_step(e, params, x, switches)
+        ups = _forward_chain(
+            entries, params, image, switches, lowc_active, fwd_lowc_bf16
+        )
         # The shared _select_top: the probed forward must select
         # identically to the measured program.
-        top_idx, top_sums, _ = _select_top(x, top_k)
+        top_idx, top_sums, _ = _select_top(ups[-1], top_k)
         sw = [jnp.sum(i.astype(jnp.int32)) for i, _ in switches.values()]
         return top_sums, top_idx, sw
 

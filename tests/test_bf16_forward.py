@@ -53,7 +53,12 @@ def _cast_tree(params, dtype):
 def test_sequential_engine_bf16_forward_matches_fp32():
     params = init_params(TINY, jax.random.PRNGKey(42))
     img = jax.random.normal(jax.random.PRNGKey(7), (16, 16, 3))
-    fn = get_visualizer(TINY, "b2c1", 8, "all", True, backward_dtype="bfloat16")
+    # fwd_lowc_bf16 pinned: the env fallback must not leak an exported
+    # DECONV_FWD_LOWC_BF16 into the reference arms of these comparisons.
+    fn = get_visualizer(
+        TINY, "b2c1", 8, "all", True, backward_dtype="bfloat16",
+        fwd_lowc_bf16=0,
+    )
 
     ref = fn(params, img.astype(jnp.float32))["b2c1"]
     got = fn(
@@ -67,6 +72,52 @@ def test_sequential_engine_bf16_forward_matches_fp32():
     # slow test).  The bound catches a broken chain (wrong kernel/switch
     # wiring reads ~1.0), not precision drift.
     assert _paired_rel_l2(got, ref) < 0.3
+
+
+def test_sequential_engine_partial_bf16_forward():
+    """DECONV_FWD_LOWC_BF16: bf16 only below the channel threshold, fp32
+    above — selection set and output dtype must match the fp32 engine
+    (the selection layer sits above the threshold)."""
+    params = init_params(TINY, jax.random.PRNGKey(42))
+    img = jax.random.normal(jax.random.PRNGKey(7), (16, 16, 3))
+    ref = get_visualizer(
+        TINY, "b2c1", 8, "all", True, fwd_lowc_bf16=0
+    )(params, img)["b2c1"]
+    got = get_visualizer(
+        TINY, "b2c1", 8, "all", True, fwd_lowc_bf16=8
+    )(params, img)["b2c1"]
+    assert got["images"].dtype == ref["images"].dtype  # fp32 above threshold
+    assert _paired_rel_l2(got, ref) < 0.3
+
+
+def test_partial_bf16_never_leaks_into_outputs():
+    """A requested layer whose whole truncated chain sits inside the bf16
+    prefix (every conv <= threshold) must still return fp32 images and
+    select on upcast activations — the prefix may not leak out of the
+    forward walk."""
+    params = init_params(TINY, jax.random.PRNGKey(42))
+    img = jax.random.normal(jax.random.PRNGKey(7), (16, 16, 3))
+    got = get_visualizer(
+        TINY, "b1c2", 8, "all", True, fwd_lowc_bf16=8
+    )(params, img)["b1c2"]
+    assert got["images"].dtype == jnp.float32
+    assert got["sums"].dtype == jnp.float32
+    assert bool(np.isfinite(np.asarray(got["images"], np.float64)).all())
+
+
+def test_partial_bf16_disabled_when_first_conv_too_wide():
+    """Threshold below the first conv's width: no layer would run bf16,
+    so the knob must be a no-op (bit-identical to fp32), not an input
+    quantization for zero gain."""
+    params = init_params(TINY, jax.random.PRNGKey(42))
+    img = jax.random.normal(jax.random.PRNGKey(7), (16, 16, 3))
+    ref = get_visualizer(
+        TINY, "b2c1", 8, "all", True, fwd_lowc_bf16=0
+    )(params, img)["b2c1"]
+    got = get_visualizer(
+        TINY, "b2c1", 8, "all", True, fwd_lowc_bf16=4
+    )(params, img)["b2c1"]
+    np.testing.assert_array_equal(np.asarray(got["images"]), np.asarray(ref["images"]))
 
 
 def test_autodeconv_engine_bf16_forward_matches_fp32():

@@ -59,6 +59,18 @@ def test_full_depth_parity_bounds():
     assert results["bf16_full"]["deprocessed_psnr_db"] >= 30.0
     assert results["bf16_full"]["raw_psnr_db"] >= 31.0
 
+    # Partial bf16 forward (DECONV_FWD_LOWC_BF16=128): bf16 only in the
+    # C<=128 block1/2 segments.  Measured 2026-07-31: raw 38.3 dB /
+    # deprocessed 36.7 dB — the best perf opt-in (439.3 img/s vs the
+    # 411.5 same-session control at batch 64) and slightly better parity
+    # than whole-chain bf16, but STILL below the 40 dB bar: the PSNR loss
+    # is dominated by pool-switch near-tie flips, which any forward
+    # perturbation triggers, not by seed precision.  Hence also opt-in.
+    assert results["bf16_lowc_fwd"]["valid_count"] == 8
+    assert results["bf16_lowc_fwd"]["paired_count"] >= 7
+    assert results["bf16_lowc_fwd"]["deprocessed_psnr_db"] >= 31.0
+    assert results["bf16_lowc_fwd"]["raw_psnr_db"] >= 33.0
+
 
 @pytest.mark.slow
 def test_full_depth_parity_bounds_max_mode():
