@@ -28,13 +28,18 @@ class Rules:
 
     - ``relu``: plain ReLU for inference/training/DeepDream (true gradients)
       or `ops.deconv_relu` for deconvnet projection via vjp.
+    - ``relu6``: same pairing for MobileNet's capped ReLU.
     """
 
+    # No defaults: a Rules construction must pair BOTH activations
+    # explicitly, or a custom variant would silently mix deconv relu with
+    # inference relu6 (a semantic mismatch nothing would catch).
     relu: Callable[[jnp.ndarray], jnp.ndarray]
+    relu6: Callable[[jnp.ndarray], jnp.ndarray]
 
 
-INFERENCE_RULES = Rules(relu=ops.relu)
-DECONV_RULES = Rules(relu=ops.deconv_relu)
+INFERENCE_RULES = Rules(relu=ops.relu, relu6=ops.relu6)
+DECONV_RULES = Rules(relu=ops.deconv_relu, relu6=ops.deconv_relu6)
 
 
 def maxpool(
@@ -100,6 +105,16 @@ def conv_bn_init(
     }
 
 
+def bn_affine(p: dict, y: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Inference-mode BatchNorm as the folded per-channel affine — shared
+    by the dense and depthwise conv blocks so the fold can never drift."""
+    scale = (p["gamma"] * lax.rsqrt(p["var"] + eps)).astype(y.dtype)
+    shift = (p["beta"] - p["mean"] * p["gamma"] * lax.rsqrt(p["var"] + eps)).astype(
+        y.dtype
+    )
+    return y * scale + shift
+
+
 def conv_bn(
     p: dict,
     x: jnp.ndarray,
@@ -114,14 +129,46 @@ def conv_bn(
     XLA fuses into the conv epilogue (one MXU pass + one VPU pass)."""
     w = p["w"].astype(x.dtype)
     y = ops.conv2d(x, w, None, strides=strides, padding=padding)
-    scale = (p["gamma"] * lax.rsqrt(p["var"] + eps)).astype(x.dtype)
-    shift = (p["beta"] - p["mean"] * p["gamma"] * lax.rsqrt(p["var"] + eps)).astype(
-        x.dtype
-    )
-    y = y * scale + shift
+    y = bn_affine(p, y, eps)
     if relu:
         y = rules.relu(y)
     return y
+
+
+def depthwise_bn_init(key: jax.Array, c: int, kernel: tuple[int, int] = (3, 3)) -> dict:
+    """Depthwise conv (no bias, depth multiplier 1) + inference BN params.
+    Kernel stored HWIO with I=1 (the `feature_group_count=C` layout);
+    Keras's (kh, kw, C, 1) depthwise kernel transposes into it."""
+    kh, kw = kernel
+    return {
+        "w": jax.random.normal(key, (kh, kw, 1, c)) * math.sqrt(2.0 / (kh * kw)),
+        "gamma": jnp.ones((c,)),
+        "beta": jnp.zeros((c,)),
+        "mean": jnp.zeros((c,)),
+        "var": jnp.ones((c,)),
+    }
+
+
+def depthwise_conv_bn(
+    p: dict,
+    x: jnp.ndarray,
+    rules: Rules,
+    *,
+    strides: tuple[int, int] = (1, 1),
+    padding: str | tuple[tuple[int, int], tuple[int, int]] = "SAME",
+    eps: float = 1e-3,
+) -> jnp.ndarray:
+    """depthwise conv → BN(inference) → ReLU6 (the MobileNet separable
+    block's first half).  `feature_group_count = C` makes each channel its
+    own group; its VJP is the per-channel flipped-kernel convolution, so
+    autodiff deconv (engine/autodeconv.py) handles it with no extra code."""
+    w = p["w"].astype(x.dtype)  # (kh, kw, 1, C)
+    y = ops.conv2d(
+        x, w, None, strides=strides, padding=padding,
+        feature_group_count=x.shape[-1],
+    )
+    y = bn_affine(p, y, eps)
+    return rules.relu6(y)
 
 
 def dense_init(key: jax.Array, din: int, dout: int) -> dict:

@@ -242,3 +242,47 @@ def load_inception_v3_h5(path: str, init_params: dict) -> dict:
             layers["predictions"], params["predictions"], "predictions"
         )
     return params
+
+
+# ---------------------------------------------------------------- MobileNetV1
+
+
+def load_mobilenet_v1_h5(path: str, init_params: dict) -> dict:
+    """Map a Keras MobileNet (v1, alpha=1.0) .h5 into the
+    models/mobilenet_v1.py pytree.  Names are explicit in Keras (conv1,
+    conv_dw_1 … conv_pw_13 + `_bn` partners), so the mapping is
+    name-keyed; the depthwise kernel transposes from Keras's
+    (kh, kw, C, 1) to the feature_group_count layout (kh, kw, 1, C).
+    A missing classifier (notop files) keeps its init values."""
+    layers = read_h5_layers(path)
+    params = {k: (dict(v) if isinstance(v, dict) else v) for k, v in init_params.items()}
+
+    def take(conv_name: str, like: dict) -> dict:
+        if conv_name not in layers:
+            raise ValueError(f"mobilenet_v1 h5 {path!r} missing layer {conv_name!r}")
+        conv = dict(layers[conv_name])
+        # Depthwise kernels are (kh, kw, C, mult=1) in Keras — under the
+        # dataset name `depthwise_kernel` (keras 2) or plain `kernel`
+        # (keras 3) — and transpose to HWIO-with-I=1 (kh, kw, 1, C), the
+        # feature_group_count layout.
+        dw = conv.pop("depthwise_kernel", None)
+        if dw is None and conv_name.startswith("conv_dw_"):
+            dw = conv.pop("kernel", None)
+        if dw is not None:
+            conv["kernel"] = np.transpose(dw, (0, 1, 3, 2))
+        return _conv_bn_entry(conv, layers.get(f"{conv_name}_bn"), like, conv_name)
+
+    params["conv1"] = take("conv1", params["conv1"])
+    for key in list(params):
+        if key.startswith(("conv_dw_", "conv_pw_")):
+            params[key] = take(key, params[key])
+    # Keras MobileNet's classifier is a 1x1 conv (conv_preds) over the
+    # pooled map; squeeze it into our dense head.
+    if "conv_preds" in layers:
+        t = dict(layers["conv_preds"])
+        if "kernel" in t and t["kernel"].ndim == 4:
+            t["kernel"] = t["kernel"].reshape(t["kernel"].shape[2:])
+        params["predictions"] = _dense_entry(
+            t, params["predictions"], "conv_preds"
+        )
+    return params

@@ -1,0 +1,104 @@
+"""MobileNetV1 (Keras topology, alpha=1.0) as a pure function + params pytree.
+
+Depthwise-separable convolutions — a conv type neither VGG nor
+ResNet/Inception exercises — projected through the autodiff deconv engine
+(engine/autodeconv.py): `feature_group_count=C` depthwise convs VJP to
+per-channel flipped-kernel convolutions, and ReLU6 runs under the
+deconvnet rule via `ops.deconv_relu6`.  The reference's sequential
+D-layer machinery can express none of this (app/deepdream.py:418-421
+sys.exit()s on unknown layer types).
+
+Layer/activation names mirror `keras.applications.MobileNet` exactly
+(conv1, conv_dw_1 … conv_pw_13) so the h5 mapping is name-keyed
+(models/dag_weights.py) and served layer names match Keras docs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.models import blocks as B
+
+# (block index, pointwise out-channels, depthwise stride) — Keras MobileNet
+# alpha=1.0: conv1 32 then 13 separable blocks.
+_BLOCKS = (
+    (1, 64, 1),
+    (2, 128, 2),
+    (3, 128, 1),
+    (4, 256, 2),
+    (5, 256, 1),
+    (6, 512, 2),
+    (7, 512, 1),
+    (8, 512, 1),
+    (9, 512, 1),
+    (10, 512, 1),
+    (11, 512, 1),
+    (12, 1024, 2),
+    (13, 1024, 1),
+)
+
+# Keras BatchNormalization default epsilon — MobileNet leaves it unset.
+_BN_EPS = 1e-3
+
+
+def mobilenet_v1_init(key: jax.Array | None = None, num_classes: int = 1000) -> dict:
+    ks = B.KeySeq(key if key is not None else jax.random.PRNGKey(0))
+    params: dict = {"conv1": B.conv_bn_init(ks(), 3, 32, (3, 3))}
+    cin = 32
+    for i, cout, _stride in _BLOCKS:
+        params[f"conv_dw_{i}"] = B.depthwise_bn_init(ks(), cin)
+        params[f"conv_pw_{i}"] = B.conv_bn_init(ks(), cin, cout, (1, 1))
+        cin = cout
+    params["predictions"] = B.dense_init(ks(), 1024, num_classes)
+    return params
+
+
+def mobilenet_v1_forward(
+    params: dict,
+    x: jnp.ndarray,
+    *,
+    rules: B.Rules = B.INFERENCE_RULES,
+    logits: bool = False,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Returns (output, activations) with Keras-named endpoints.
+
+    Keras MobileNet pads stride-2 convs explicitly (ZeroPadding2D
+    ((0,1),(0,1)) + VALID) — NOT XLA SAME, which pads symmetrically where
+    it can and shifts the grid.  Load-bearing for pretrained-weight
+    activation parity (tests/test_weights_golden.py).
+    """
+    acts: dict[str, jnp.ndarray] = {}
+    y = B.conv_bn(
+        params["conv1"], x, rules, strides=(2, 2), padding=((0, 1), (0, 1)),
+        relu=False, eps=_BN_EPS,
+    )
+    y = rules.relu6(y)
+    acts["conv1_relu"] = y
+    for i, _cout, stride in _BLOCKS:
+        pad = ((0, 1), (0, 1)) if stride == 2 else "SAME"
+        y = B.depthwise_conv_bn(
+            params[f"conv_dw_{i}"], y, rules, strides=(stride, stride),
+            padding=pad, eps=_BN_EPS,
+        )
+        acts[f"conv_dw_{i}_relu"] = y
+        y = B.conv_bn(
+            params[f"conv_pw_{i}"], y, rules, relu=False, eps=_BN_EPS
+        )
+        y = rules.relu6(y)
+        acts[f"conv_pw_{i}_relu"] = y
+    y = B.global_avg_pool(y)
+    acts["global_average_pooling2d"] = y
+    w, b = params["predictions"]["w"], params["predictions"]["b"]
+    y = ops.dense(y, w.astype(y.dtype), b.astype(y.dtype))
+    if not logits:
+        y = ops.softmax(y)
+    acts["predictions"] = y
+    return y, acts
+
+
+DECONV_LAYERS = tuple(
+    [f"conv_pw_{i}_relu" for i, _c, _s in _BLOCKS] + ["conv1_relu"]
+)
+DREAM_LAYERS = ("conv_pw_7_relu", "conv_pw_11_relu")

@@ -164,6 +164,7 @@ def load_model_weights(
         loaders = {
             "resnet50": dag_weights.load_resnet50_h5,
             "inception_v3": dag_weights.load_inception_v3_h5,
+            "mobilenet_v1": dag_weights.load_mobilenet_v1_h5,
         }
         if model_name not in loaders:
             raise ValueError(

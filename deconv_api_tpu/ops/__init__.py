@@ -9,7 +9,9 @@ fuse into a single program.
 from deconv_api_tpu.ops.activations import (
     apply_activation,
     deconv_relu,
+    deconv_relu6,
     relu,
+    relu6,
     softmax,
 )
 from deconv_api_tpu.ops.conv import (
@@ -36,6 +38,7 @@ __all__ = [
     "conv2d",
     "conv2d_input_backward",
     "deconv_relu",
+    "deconv_relu6",
     "dense",
     "dense_input_backward",
     "flatten",
@@ -45,6 +48,7 @@ __all__ = [
     "maxpool_switched",
     "unpool_with_argmax",
     "relu",
+    "relu6",
     "softmax",
     "unflatten",
     "unpool_with_switches",

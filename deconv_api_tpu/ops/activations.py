@@ -24,9 +24,15 @@ def softmax(x: jnp.ndarray) -> jnp.ndarray:
     return jax.nn.softmax(x, axis=-1)
 
 
+def relu6(x: jnp.ndarray) -> jnp.ndarray:
+    """min(max(x, 0), 6) — MobileNet's activation (Keras `ReLU(6.)`)."""
+    return jnp.minimum(jnp.maximum(x, 0), 6.0).astype(x.dtype)
+
+
 _ACTIVATIONS = {
     "linear": lambda x: x,
     "relu": relu,
+    "relu6": relu6,
     "softmax": softmax,
 }
 
@@ -60,3 +66,23 @@ def _deconv_relu_bwd(_, g):
 
 
 deconv_relu.defvjp(_deconv_relu_fwd, _deconv_relu_bwd)
+
+
+@jax.custom_vjp
+def deconv_relu6(x: jnp.ndarray) -> jnp.ndarray:
+    """ReLU6 under the deconvnet rule: bwd(g) = relu6(g) — the reference's
+    "same activation in both directions" generalised to MobileNet's capped
+    ReLU (app/deepdream.py:227-235 applies whatever `layer.activation` is
+    on the way down; for relu6 that caps the descending signal too)."""
+    return relu6(x)
+
+
+def _deconv_relu6_fwd(x):
+    return relu6(x), None
+
+
+def _deconv_relu6_bwd(_, g):
+    return (relu6(g),)
+
+
+deconv_relu6.defvjp(_deconv_relu6_fwd, _deconv_relu6_bwd)

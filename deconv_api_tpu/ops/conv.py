@@ -28,10 +28,13 @@ def conv2d(
     *,
     strides: Sequence[int] = (1, 1),
     padding: str | Sequence[tuple[int, int]] = "SAME",
+    feature_group_count: int = 1,
 ) -> jnp.ndarray:
     """Forward convolution: NHWC input, HWIO kernel.  ``padding`` is an XLA
     padding string or explicit per-spatial-dim (lo, hi) pairs (Keras
-    ZeroPadding2D parity for ResNet50's conv1).
+    ZeroPadding2D parity for ResNet50's conv1).  ``feature_group_count``
+    groups the channels (``= C`` with an (kh, kw, 1, C) kernel is a
+    depthwise conv, MobileNet's separable first half).
 
     Mirrors the reference's `DConvolution2D.up` (app/deepdream.py:91-100)
     minus the fused activation, which the engine applies explicitly.
@@ -40,8 +43,9 @@ def conv2d(
         x,
         w,
         window_strides=tuple(strides),
-        padding=padding,
+        padding=padding if isinstance(padding, str) else tuple(padding),
         dimension_numbers=DIMENSION_NUMBERS,
+        feature_group_count=feature_group_count,
     )
     if b is not None:
         y = y + b

@@ -232,6 +232,28 @@ def _resnet50_bundle() -> ModelBundle:
     )
 
 
+def _mobilenet_v1_bundle() -> ModelBundle:
+    from deconv_api_tpu.models.mobilenet_v1 import (
+        DECONV_LAYERS,
+        DREAM_LAYERS,
+        mobilenet_v1_forward,
+        mobilenet_v1_init,
+    )
+
+    params = mobilenet_v1_init(jax.random.PRNGKey(0))
+    return ModelBundle(
+        name="mobilenet_v1",
+        params=params,
+        image_size=224,
+        preprocess=codec.preprocess_tf,  # Keras mobilenet uses 'tf' mode
+        layer_names=DECONV_LAYERS,
+        dream_layers=DREAM_LAYERS,
+        forward_fn=mobilenet_v1_forward,
+        unpreprocess=codec.unpreprocess_tf,
+        min_dream_size=32,  # five (0,1)-padded stride-2 convs
+    )
+
+
 def _inception_v3_bundle() -> ModelBundle:
     from deconv_api_tpu.models.inception_v3 import (
         DREAM_LAYERS,
@@ -258,12 +280,14 @@ REGISTRY: dict[str, Callable[[], ModelBundle]] = {
     "vgg19": _vgg19_bundle,
     "resnet50": _resnet50_bundle,
     "inception_v3": _inception_v3_bundle,
+    "mobilenet_v1": _mobilenet_v1_bundle,
 }
 
 
 def registry_info() -> list[dict]:
     """Static metadata for each registered model — no weight init, no
     device touch (the CLI's `models` listing must work instantly)."""
+    from deconv_api_tpu.models import mobilenet_v1 as mb
     from deconv_api_tpu.models.inception_v3 import DREAM_LAYERS
     from deconv_api_tpu.models.resnet50 import DECONV_LAYERS
     from deconv_api_tpu.models.vgg16 import VGG16_SPEC as spec
@@ -296,5 +320,12 @@ def registry_info() -> list[dict]:
             "engine": "autodiff-deconv (DAG)",
             "layers": [f"mixed{i}" for i in range(11)],
             "dream_layers": list(DREAM_LAYERS),
+        },
+        {
+            "model": "mobilenet_v1",
+            "image_size": 224,
+            "engine": "autodiff-deconv (DAG, depthwise-separable)",
+            "layers": list(mb.DECONV_LAYERS),
+            "dream_layers": list(mb.DREAM_LAYERS),
         },
     ]

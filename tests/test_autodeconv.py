@@ -85,6 +85,48 @@ def test_resnet50_autodeconv_strided_path(resnet):
     assert not np.allclose(np.asarray(out["images"]), np.asarray(out2["images"]))
 
 
+# -------------------------------------------------------------- MobileNetV1
+
+
+def test_mobilenet_v1_forward_shapes():
+    from deconv_api_tpu.models.mobilenet_v1 import (
+        mobilenet_v1_forward,
+        mobilenet_v1_init,
+    )
+
+    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 128, 3))
+    probs, acts = jax.jit(lambda p, x: mobilenet_v1_forward(p, x))(params, x)
+    assert probs.shape == (1, 10)
+    np.testing.assert_allclose(float(probs.sum()), 1.0, rtol=1e-4)
+    assert acts["conv1_relu"].shape == (1, 64, 64, 32)
+    assert acts["conv_pw_6_relu"].shape == (1, 8, 8, 512)
+    assert acts["conv_pw_13_relu"].shape == (1, 4, 4, 1024)
+    # relu6 cap actually applies
+    assert float(max(np.max(np.asarray(acts[k])) for k in acts if k != "predictions")) <= 6.0
+
+
+def test_mobilenet_v1_autodeconv_depthwise_path():
+    """Deconv through depthwise-separable convs + ReLU6 under the deconv
+    rule — conv types and activations the other three families never
+    exercise, handled by the same autodiff engine."""
+    from deconv_api_tpu.models.mobilenet_v1 import (
+        mobilenet_v1_forward,
+        mobilenet_v1_init,
+    )
+
+    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=10)
+    img = jax.random.normal(jax.random.PRNGKey(2), (128, 128, 3))
+    fn = autodeconv_visualizer(mobilenet_v1_forward, "conv_pw_11_relu", top_k=4)
+    out = fn(params, img)
+    assert out["images"].shape == (4, 128, 128, 3)
+    assert bool(jnp.isfinite(out["images"]).all())
+    assert bool(out["valid"].any())
+    img2 = jax.random.normal(jax.random.PRNGKey(3), (128, 128, 3))
+    out2 = fn(params, img2)
+    assert not np.allclose(np.asarray(out["images"]), np.asarray(out2["images"]))
+
+
 # -------------------------------------------------------------- InceptionV3
 
 

@@ -340,7 +340,9 @@ def test_v1_dream_no_default_layers_400(server):
 def test_model_registry_bundles():
     from deconv_api_tpu.serving.models import REGISTRY
 
-    assert set(REGISTRY) == {"vgg16", "vgg19", "resnet50", "inception_v3"}
+    assert set(REGISTRY) == {
+        "vgg16", "vgg19", "resnet50", "inception_v3", "mobilenet_v1",
+    }
     b = REGISTRY["vgg16"]()
     assert b.image_size == 224 and "block5_conv1" in b.layer_names
     assert b.spec is not None

@@ -212,6 +212,28 @@ def test_resnet50_golden(keras_h5):
     _check_acts(expected, acts)
 
 
+def test_mobilenet_v1_golden(keras_h5):
+    """MobileNetV1: name-keyed conv/dw/pw mapping incl. the depthwise
+    kernel transpose ((kh,kw,C,1) -> feature_group_count HWIO) and the
+    (0,1)-padded stride-2 grid, pinned against Keras's own activations."""
+    from deconv_api_tpu.models.dag_weights import load_mobilenet_v1_h5
+    from deconv_api_tpu.models.mobilenet_v1 import (
+        mobilenet_v1_forward,
+        mobilenet_v1_init,
+    )
+
+    names = [
+        "conv1_relu", "conv_dw_1_relu", "conv_pw_2_relu", "conv_pw_6_relu",
+        "conv_pw_11_relu", "conv_pw_13_relu",
+    ]
+    path, x, expected = keras_h5(
+        keras.applications.MobileNet, (128, 128, 3), names, rng_seed=4
+    )
+    params = load_mobilenet_v1_h5(path, mobilenet_v1_init())
+    _, acts = mobilenet_v1_forward(params, x)
+    _check_acts(expected, acts)
+
+
 @pytest.fixture(scope="module")
 def inception_golden(keras_h5):
     names = [f"mixed{i}" for i in range(11)]
