@@ -1,0 +1,86 @@
+"""Generic tunnel watcher: when the TPU returns, record the round's rows.
+
+The axon tunnel was down at the START of builder sessions in rounds 3
+and 4 (BASELINE.md outage notes); both times an automated watcher that
+waited for preflight and then ran the owed measurements was what closed
+the loop.  This is that pattern, made round-agnostic — run it first
+thing in a session when the tunnel is down:
+
+    python tools/tunnel_watcher.py --tag r5 [--max-hours 10]
+
+It waits for preflight, then records (tagged `<tag>_<name>`):
+  1. `headline`  — bench.py --breakdown (driver methodology, fused sync);
+  2. `config2` / `config4` / `config5` — the BASELINE throughput/serving
+     configs under the honest stream-sync methodology;
+  3. `sustained` — the N-sweep dispatch probe (tools/sustained_probe.py).
+
+Each experiment retries up to 3x on any child failure with a tunnel
+re-probe between passes (run_plan, tools/run_bench_suite.py); a summary
+row closes the record either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from run_bench_suite import (  # noqa: E402
+    TIMEOUTS,
+    run_cmd_json,
+    run_one,
+    run_plan,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tag", required=True, help="round tag, e.g. r5")
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "bench_suite_results.jsonl")
+    )
+    args = ap.parse_args()
+
+    plan = [
+        (
+            f"{args.tag}_headline",
+            lambda: run_cmd_json(
+                [sys.executable, os.path.join(REPO, "bench.py"), "--breakdown"],
+                1200,
+                env={
+                    "DECONV_BENCH_FUSED_SYNC": "1",
+                    "DECONV_BENCH_BUDGET": "1100",
+                    "DECONV_BENCH_TIMEOUT": "600",
+                },
+            ),
+        ),
+        (
+            f"{args.tag}_config2",
+            lambda: run_one(2, TIMEOUTS[2], env={"DECONV_SUITE_STREAM_SYNC": "1"}),
+        ),
+        (
+            f"{args.tag}_config4",
+            lambda: run_one(4, TIMEOUTS[4], env={"DECONV_SUITE_STREAM_SYNC": "1"}),
+        ),
+        (f"{args.tag}_config5", lambda: run_one(5, TIMEOUTS[5])),
+        (
+            f"{args.tag}_sustained",
+            lambda: run_cmd_json(
+                [sys.executable, os.path.join(REPO, "tools", "sustained_probe.py")],
+                2400,
+            ),
+        ),
+    ]
+    missing = run_plan(
+        plan, args.out, f"watch-{args.tag}", args.max_hours,
+        f"{args.tag}_watcher_summary",
+    )
+    return 0 if not missing else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
