@@ -16,7 +16,7 @@ import sys
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--model", default=None,
-        help="vgg16 | vgg19 | resnet50 | inception_v3 | mobilenet_v1",
+        help="vgg16 | vgg19 | resnet50 | inception_v3 | mobilenet_v1 | mobilenet_v2",
     )
     p.add_argument("--platform", default=None, help="force jax backend (e.g. cpu)")
     p.add_argument(

@@ -244,33 +244,44 @@ def load_inception_v3_h5(path: str, init_params: dict) -> dict:
     return params
 
 
-# ---------------------------------------------------------------- MobileNetV1
+# ----------------------------------------------------------------- MobileNets
+
+
+def _mobilenet_take(
+    layers: dict, conv_name: str, bn_name: str, like: dict,
+    is_depthwise: bool, family: str,
+) -> dict:
+    """One conv(+BN) h5 entry for either MobileNet family.  Depthwise
+    kernels are (kh, kw, C, mult=1) in Keras — under the dataset name
+    `depthwise_kernel` (keras 2) or plain `kernel` (keras 3) — and
+    transpose to HWIO-with-I=1 (kh, kw, 1, C), the feature_group_count
+    layout.  ONE implementation so a future Keras-export naming change
+    cannot be fixed in one family and silently missed in the other."""
+    if conv_name not in layers:
+        raise ValueError(f"{family} h5 missing layer {conv_name!r}")
+    conv = dict(layers[conv_name])
+    dw = conv.pop("depthwise_kernel", None)
+    if dw is None and is_depthwise:
+        dw = conv.pop("kernel", None)
+    if dw is not None:
+        conv["kernel"] = np.transpose(dw, (0, 1, 3, 2))
+    return _conv_bn_entry(conv, layers.get(bn_name), like, conv_name)
 
 
 def load_mobilenet_v1_h5(path: str, init_params: dict) -> dict:
     """Map a Keras MobileNet (v1, alpha=1.0) .h5 into the
     models/mobilenet_v1.py pytree.  Names are explicit in Keras (conv1,
     conv_dw_1 … conv_pw_13 + `_bn` partners), so the mapping is
-    name-keyed; the depthwise kernel transposes from Keras's
-    (kh, kw, C, 1) to the feature_group_count layout (kh, kw, 1, C).
-    A missing classifier (notop files) keeps its init values."""
+    name-keyed.  A missing classifier (notop files) keeps its init
+    values."""
     layers = read_h5_layers(path)
     params = {k: (dict(v) if isinstance(v, dict) else v) for k, v in init_params.items()}
 
     def take(conv_name: str, like: dict) -> dict:
-        if conv_name not in layers:
-            raise ValueError(f"mobilenet_v1 h5 {path!r} missing layer {conv_name!r}")
-        conv = dict(layers[conv_name])
-        # Depthwise kernels are (kh, kw, C, mult=1) in Keras — under the
-        # dataset name `depthwise_kernel` (keras 2) or plain `kernel`
-        # (keras 3) — and transpose to HWIO-with-I=1 (kh, kw, 1, C), the
-        # feature_group_count layout.
-        dw = conv.pop("depthwise_kernel", None)
-        if dw is None and conv_name.startswith("conv_dw_"):
-            dw = conv.pop("kernel", None)
-        if dw is not None:
-            conv["kernel"] = np.transpose(dw, (0, 1, 3, 2))
-        return _conv_bn_entry(conv, layers.get(f"{conv_name}_bn"), like, conv_name)
+        return _mobilenet_take(
+            layers, conv_name, f"{conv_name}_bn", like,
+            conv_name.startswith("conv_dw_"), "mobilenet_v1",
+        )
 
     params["conv1"] = take("conv1", params["conv1"])
     for key in list(params):
@@ -284,5 +295,44 @@ def load_mobilenet_v1_h5(path: str, init_params: dict) -> dict:
             t["kernel"] = t["kernel"].reshape(t["kernel"].shape[2:])
         params["predictions"] = _dense_entry(
             t, params["predictions"], "conv_preds"
+        )
+    return params
+
+
+def load_mobilenet_v2_h5(path: str, init_params: dict) -> dict:
+    """Map a Keras MobileNetV2 (alpha=1.0) .h5 into the
+    models/mobilenet_v2.py pytree.  Names are explicit in Keras
+    (`Conv1`/`bn_Conv1`, `expanded_conv_{depthwise,project}`,
+    `block_{i}_{expand,depthwise,project}` + BN partners, `Conv_1`);
+    depthwise kernels transpose like MobileNetV1's."""
+    layers = read_h5_layers(path)
+    params = {k: (dict(v) if isinstance(v, dict) else v) for k, v in init_params.items()}
+
+    def take(conv_name: str, bn_name: str, like: dict) -> dict:
+        return _mobilenet_take(
+            layers, conv_name, bn_name, like,
+            conv_name.endswith("depthwise"), "mobilenet_v2",
+        )
+
+    params["Conv1"] = take("Conv1", "bn_Conv1", params["Conv1"])
+    params["Conv_1"] = take("Conv_1", "Conv_1_bn", params["Conv_1"])
+    blk = dict(params["expanded_conv"])
+    blk["depthwise"] = take(
+        "expanded_conv_depthwise", "expanded_conv_depthwise_BN", blk["depthwise"]
+    )
+    blk["project"] = take(
+        "expanded_conv_project", "expanded_conv_project_BN", blk["project"]
+    )
+    params["expanded_conv"] = blk
+    for key in list(params):
+        if not key.startswith("block_"):
+            continue
+        blk = dict(params[key])
+        for part in ("expand", "depthwise", "project"):
+            blk[part] = take(f"{key}_{part}", f"{key}_{part}_BN", blk[part])
+        params[key] = blk
+    if "predictions" in layers:
+        params["predictions"] = _dense_entry(
+            layers["predictions"], params["predictions"], "predictions"
         )
     return params

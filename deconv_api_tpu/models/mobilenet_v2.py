@@ -1,0 +1,132 @@
+"""MobileNetV2 (Keras topology, alpha=1.0) as a pure function + params pytree.
+
+Inverted residual blocks with LINEAR bottlenecks: expand 1x1 (+BN+ReLU6)
+-> depthwise 3x3 (+BN+ReLU6) -> project 1x1 (+BN, no activation), with a
+residual add when stride is 1 and channels match.  The linear projection
+and the residual adds are exactly the structures the reference's
+sequential walk cannot express (app/deepdream.py:418-421); the autodiff
+engine projects through them for free.
+
+Layer/activation names mirror `keras.applications.MobileNetV2`
+(`Conv1`, `expanded_conv_*`, `block_1_expand` ... `block_16_project`,
+`Conv_1`, `out_relu`) so the h5 mapping is name-keyed and golden tests
+probe real Keras endpoints.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.models import blocks as B
+
+# (block index, expansion, out-channels, depthwise stride) — Keras
+# MobileNetV2 alpha=1.0: block 0 ("expanded_conv") has no expansion;
+# groups (24,2,x2), (32,2,x3), (64,2,x4), (96,1,x3), (160,2,x3), (320,1,x1).
+_BLOCKS = (
+    (1, 6, 24, 2),
+    (2, 6, 24, 1),
+    (3, 6, 32, 2),
+    (4, 6, 32, 1),
+    (5, 6, 32, 1),
+    (6, 6, 64, 2),
+    (7, 6, 64, 1),
+    (8, 6, 64, 1),
+    (9, 6, 64, 1),
+    (10, 6, 96, 1),
+    (11, 6, 96, 1),
+    (12, 6, 96, 1),
+    (13, 6, 160, 2),
+    (14, 6, 160, 1),
+    (15, 6, 160, 1),
+    (16, 6, 320, 1),
+)
+
+_BN_EPS = 1e-3
+
+
+def mobilenet_v2_init(key: jax.Array | None = None, num_classes: int = 1000) -> dict:
+    ks = B.KeySeq(key if key is not None else jax.random.PRNGKey(0))
+    params: dict = {"Conv1": B.conv_bn_init(ks(), 3, 32, (3, 3))}
+    params["expanded_conv"] = {
+        "depthwise": B.depthwise_bn_init(ks(), 32),
+        "project": B.conv_bn_init(ks(), 32, 16, (1, 1)),
+    }
+    cin = 16
+    for i, t, cout, _stride in _BLOCKS:
+        mid = cin * t
+        params[f"block_{i}"] = {
+            "expand": B.conv_bn_init(ks(), cin, mid, (1, 1)),
+            "depthwise": B.depthwise_bn_init(ks(), mid),
+            "project": B.conv_bn_init(ks(), mid, cout, (1, 1)),
+        }
+        cin = cout
+    params["Conv_1"] = B.conv_bn_init(ks(), cin, 1280, (1, 1))
+    params["predictions"] = B.dense_init(ks(), 1280, num_classes)
+    return params
+
+
+def _inverted_residual(
+    p: dict, x: jnp.ndarray, rules: B.Rules, stride: int, acts: dict, name: str
+) -> jnp.ndarray:
+    y = x
+    if "expand" in p:
+        y = B.conv_bn(p["expand"], y, rules, relu=False, eps=_BN_EPS)
+        y = rules.relu6(y)
+        acts[f"{name}_expand_relu"] = y
+    pad = ((0, 1), (0, 1)) if stride == 2 else "SAME"
+    y = B.depthwise_conv_bn(
+        p["depthwise"], y, rules, strides=(stride, stride), padding=pad,
+        eps=_BN_EPS,
+    )
+    acts[f"{name}_depthwise_relu"] = y
+    y = B.conv_bn(p["project"], y, rules, relu=False, eps=_BN_EPS)
+    acts[f"{name}_project_BN"] = y
+    if stride == 1 and x.shape[-1] == y.shape[-1]:
+        y = x + y
+        acts[f"{name}_add"] = y
+    return y
+
+
+def mobilenet_v2_forward(
+    params: dict,
+    x: jnp.ndarray,
+    *,
+    rules: B.Rules = B.INFERENCE_RULES,
+    logits: bool = False,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Returns (output, activations) with Keras-named endpoints.  Stride-2
+    convs pad explicitly ((0,1),(0,1)) + VALID like Keras's
+    `correct_pad`, not XLA SAME."""
+    acts: dict[str, jnp.ndarray] = {}
+    y = B.conv_bn(
+        params["Conv1"], x, rules, strides=(2, 2), padding=((0, 1), (0, 1)),
+        relu=False, eps=_BN_EPS,
+    )
+    y = rules.relu6(y)
+    acts["Conv1_relu"] = y
+    y = _inverted_residual(
+        params["expanded_conv"], y, rules, 1, acts, "expanded_conv"
+    )
+    for i, _t, _cout, stride in _BLOCKS:
+        y = _inverted_residual(
+            params[f"block_{i}"], y, rules, stride, acts, f"block_{i}"
+        )
+    y = B.conv_bn(params["Conv_1"], y, rules, relu=False, eps=_BN_EPS)
+    y = rules.relu6(y)
+    acts["out_relu"] = y
+    y = B.global_avg_pool(y)
+    acts["global_average_pooling2d"] = y
+    w, b = params["predictions"]["w"], params["predictions"]["b"]
+    y = ops.dense(y, w.astype(y.dtype), b.astype(y.dtype))
+    if not logits:
+        y = ops.softmax(y)
+    acts["predictions"] = y
+    return y, acts
+
+
+DECONV_LAYERS = tuple(
+    [f"block_{i}_expand_relu" for i, _t, _c, _s in _BLOCKS] + ["out_relu", "Conv1_relu"]
+)
+DREAM_LAYERS = ("block_6_expand_relu", "block_13_expand_relu")

@@ -165,6 +165,7 @@ def load_model_weights(
             "resnet50": dag_weights.load_resnet50_h5,
             "inception_v3": dag_weights.load_inception_v3_h5,
             "mobilenet_v1": dag_weights.load_mobilenet_v1_h5,
+            "mobilenet_v2": dag_weights.load_mobilenet_v2_h5,
         }
         if model_name not in loaders:
             raise ValueError(

@@ -254,6 +254,28 @@ def _mobilenet_v1_bundle() -> ModelBundle:
     )
 
 
+def _mobilenet_v2_bundle() -> ModelBundle:
+    from deconv_api_tpu.models.mobilenet_v2 import (
+        DECONV_LAYERS,
+        DREAM_LAYERS,
+        mobilenet_v2_forward,
+        mobilenet_v2_init,
+    )
+
+    params = mobilenet_v2_init(jax.random.PRNGKey(0))
+    return ModelBundle(
+        name="mobilenet_v2",
+        params=params,
+        image_size=224,
+        preprocess=codec.preprocess_tf,  # Keras mobilenet_v2 uses 'tf' mode
+        layer_names=DECONV_LAYERS,
+        dream_layers=DREAM_LAYERS,
+        forward_fn=mobilenet_v2_forward,
+        unpreprocess=codec.unpreprocess_tf,
+        min_dream_size=32,
+    )
+
+
 def _inception_v3_bundle() -> ModelBundle:
     from deconv_api_tpu.models.inception_v3 import (
         DREAM_LAYERS,
@@ -281,6 +303,7 @@ REGISTRY: dict[str, Callable[[], ModelBundle]] = {
     "resnet50": _resnet50_bundle,
     "inception_v3": _inception_v3_bundle,
     "mobilenet_v1": _mobilenet_v1_bundle,
+    "mobilenet_v2": _mobilenet_v2_bundle,
 }
 
 
@@ -288,6 +311,7 @@ def registry_info() -> list[dict]:
     """Static metadata for each registered model — no weight init, no
     device touch (the CLI's `models` listing must work instantly)."""
     from deconv_api_tpu.models import mobilenet_v1 as mb
+    from deconv_api_tpu.models import mobilenet_v2 as mb2
     from deconv_api_tpu.models.inception_v3 import DREAM_LAYERS
     from deconv_api_tpu.models.resnet50 import DECONV_LAYERS
     from deconv_api_tpu.models.vgg16 import VGG16_SPEC as spec
@@ -327,5 +351,12 @@ def registry_info() -> list[dict]:
             "engine": "autodiff-deconv (DAG, depthwise-separable)",
             "layers": list(mb.DECONV_LAYERS),
             "dream_layers": list(mb.DREAM_LAYERS),
+        },
+        {
+            "model": "mobilenet_v2",
+            "image_size": 224,
+            "engine": "autodiff-deconv (DAG, inverted residuals)",
+            "layers": list(mb2.DECONV_LAYERS),
+            "dream_layers": list(mb2.DREAM_LAYERS),
         },
     ]

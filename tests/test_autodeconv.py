@@ -127,6 +127,23 @@ def test_mobilenet_v1_autodeconv_depthwise_path():
     assert not np.allclose(np.asarray(out["images"]), np.asarray(out2["images"]))
 
 
+def test_mobilenet_v2_autodeconv_inverted_residual_path():
+    """Deconv through inverted residuals with LINEAR bottlenecks and
+    residual adds — structures the reference exits on."""
+    from deconv_api_tpu.models.mobilenet_v2 import (
+        mobilenet_v2_forward,
+        mobilenet_v2_init,
+    )
+
+    params = mobilenet_v2_init(jax.random.PRNGKey(0), num_classes=10)
+    img = jax.random.normal(jax.random.PRNGKey(2), (128, 128, 3))
+    fn = autodeconv_visualizer(mobilenet_v2_forward, "block_6_expand_relu", top_k=4)
+    out = fn(params, img)
+    assert out["images"].shape == (4, 128, 128, 3)
+    assert bool(jnp.isfinite(out["images"]).all())
+    assert bool(out["valid"].any())
+
+
 # -------------------------------------------------------------- InceptionV3
 
 

@@ -342,6 +342,7 @@ def test_model_registry_bundles():
 
     assert set(REGISTRY) == {
         "vgg16", "vgg19", "resnet50", "inception_v3", "mobilenet_v1",
+        "mobilenet_v2",
     }
     b = REGISTRY["vgg16"]()
     assert b.image_size == 224 and "block5_conv1" in b.layer_names

@@ -234,6 +234,29 @@ def test_mobilenet_v1_golden(keras_h5):
     _check_acts(expected, acts)
 
 
+def test_mobilenet_v2_golden(keras_h5):
+    """MobileNetV2: inverted residuals with linear bottlenecks — the
+    name-keyed expand/depthwise/project mapping and the residual-add
+    placement pinned against Keras's own activations."""
+    from deconv_api_tpu.models.dag_weights import load_mobilenet_v2_h5
+    from deconv_api_tpu.models.mobilenet_v2 import (
+        mobilenet_v2_forward,
+        mobilenet_v2_init,
+    )
+
+    names = [
+        "Conv1_relu", "expanded_conv_project_BN", "block_1_expand_relu",
+        "block_3_depthwise_relu", "block_6_project_BN", "block_12_add",
+        "out_relu",
+    ]
+    path, x, expected = keras_h5(
+        keras.applications.MobileNetV2, (128, 128, 3), names, rng_seed=5
+    )
+    params = load_mobilenet_v2_h5(path, mobilenet_v2_init())
+    _, acts = mobilenet_v2_forward(params, x)
+    _check_acts(expected, acts)
+
+
 @pytest.fixture(scope="module")
 def inception_golden(keras_h5):
     names = [f"mixed{i}" for i in range(11)]
