@@ -12,6 +12,7 @@ and inputs vary per iteration to defeat content-addressed result caching.
 | 3 | DeepDream InceptionV3 mixed3-5, 10 octaves           | config3_dream   |
 | 4 | ResNet50 deconv backbone (conv_transpose, no switches)| config4_resnet |
 | 5 | 256-concurrent-request serving load                  | config5_load    |
+| 6 | ResNet50 all-layers sweep (DAG engine, r5)           | config6_resnet_sweep |
 
 The reference itself can run none of these as written (no batching, no
 InceptionV3/ResNet50, no concurrency > 1 — SURVEY §2.2.5, §0.2); its
@@ -251,6 +252,37 @@ def config4_resnet(iters: int = 10) -> dict:
     }
 
 
+def config6_resnet_sweep(iters: int = 3) -> dict:
+    """ResNet50 all-layers sweep (DAG engine, r5): every projectable layer
+    from conv4_block6_out down in one program — the reference's signature
+    always-on behaviour (app/deepdream.py:441-474) on a topology it could
+    never express.  One shared forward, per-layer vjp seeds."""
+    import jax
+
+    from deconv_api_tpu.serving.models import REGISTRY
+
+    bundle = REGISTRY["resnet50"]()
+    layer = "conv4_block6_out"
+    fn = bundle.batched_visualizer(layer, "all", 8, sweep=True)
+    checksum = _checksum_fn()
+    batch = 4
+    batches = [
+        jax.random.normal(jax.random.PRNGKey(i), (batch, 224, 224, 3))
+        for i in range(iters)
+    ]
+    layers_projected = len(jax.eval_shape(fn, bundle.params, batches[0]))
+    per_batch_s, sync = _timed_either(fn, bundle.params, batches, checksum)
+    return {
+        "config": 6,
+        "batch": batch,
+        "layer": layer,
+        "layers_projected": layers_projected,
+        "sync": sync,
+        "batch_latency_ms": round(per_batch_s * 1e3, 1),
+        "images_per_sec": round(batch / per_batch_s, 2),
+    }
+
+
 def config5_load(n_requests: int = 256, concurrency: int = 64) -> dict:
     """Serving load: concurrent POST / requests against a live server
     (in-process, real HTTP over loopback), exercising the batching
@@ -354,6 +386,7 @@ CONFIGS: dict[int, Callable[[], dict]] = {
     3: config3_dream,
     4: config4_resnet,
     5: config5_load,
+    6: config6_resnet_sweep,
 }
 
 

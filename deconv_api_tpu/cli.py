@@ -67,8 +67,6 @@ def cmd_visualize(args: argparse.Namespace) -> int:
     svc = _load_service(args)
     try:
         svc.bundle.check_layer(args.layer)
-        if args.sweep:
-            svc.bundle.check_sweep()
     except ValueError as e:
         print(e, file=sys.stderr)
         return 2
@@ -164,10 +162,9 @@ def cmd_train(args: argparse.Namespace) -> int:
     from deconv_api_tpu.train.loop import train_synthetic
 
     svc = _load_service(args)
+    bundle = svc.bundle
     mesh_shape = tuple(int(x) for x in args.mesh.split(",") if x)
-    result = train_synthetic(
-        svc.bundle.spec,
-        svc.bundle.params,
+    common = dict(
         steps=args.steps,
         batch=args.batch,
         lr=args.lr,
@@ -180,6 +177,26 @@ def cmd_train(args: argparse.Namespace) -> int:
             f"step {i}: loss {loss:.4f}", file=sys.stderr, flush=True
         ),
     )
+    if bundle.spec is not None:
+        result = train_synthetic(bundle.spec, bundle.params, **common)
+    else:
+        # DAG family: class count read from the forward's output shape
+        # (abstract trace, no compute), input shape from the bundle.
+        import jax
+        import numpy as np
+
+        size = bundle.image_size
+        dummy = jax.ShapeDtypeStruct((1, size, size, 3), np.float32)
+        out, _ = jax.eval_shape(bundle.forward_fn, bundle.params, dummy)
+        result = train_synthetic(
+            None,
+            bundle.params,
+            forward_fn=bundle.forward_fn,
+            model_name=bundle.name,
+            num_classes=int(out.shape[-1]),
+            input_shape=(size, size, 3),
+            **common,
+        )
     result.pop("params")  # not printable
     print(json.dumps(result))
     return 0

@@ -16,6 +16,14 @@ strided convs — where the reference's sequential D-layer walk could only
 
 Used for ResNet50 (BASELINE config 4) and InceptionV3.  The sequential
 engine (engine/deconv.py) remains the bug-compat parity path for VGG16.
+
+The all-layers sweep (the reference's always-on behaviour,
+app/deepdream.py:441-474) generalises the same way: `acts_of` returns a
+TUPLE of every named activation at/below the requested layer, so one
+`jax.vjp` call shares ONE forward (and one set of saved residuals) across
+every swept layer, and each projection is a cotangent tuple that seeds
+exactly one layer (the rest are literal zeros, which XLA's algebraic
+simplifier folds out of the unused deeper backward segments).
 """
 
 from __future__ import annotations
@@ -27,49 +35,76 @@ from deconv_api_tpu.engine.deconv import _select_top
 from deconv_api_tpu.models.blocks import DECONV_RULES
 
 
-def autodeconv_visualizer(forward_fn, layer: str, top_k: int = 8, mode: str = "all"):
+def autodeconv_visualizer(
+    forward_fn,
+    layer: str,
+    top_k: int = 8,
+    mode: str = "all",
+    sweep_layers: tuple[str, ...] | None = None,
+):
     """Build a jitted ``fn(params, image) -> {images, indices, sums, valid}``.
 
     ``forward_fn(params, x, rules=...) -> (out, acts)`` is any model forward
     accepting execution rules (models/resnet50.py, models/inception_v3.py).
     Selection semantics are identical to the sequential engine: positive
     activation sums, top-K, 'all'/'max' masking.
+
+    With ``sweep_layers`` (a tuple of named activations, deepest first,
+    normally produced by ``ModelBundle.sweep_layers``) the returned fn
+    instead yields ``{name: {images, indices, sums, valid}}`` with one
+    entry per swept layer — the DAG analog of the sequential engine's
+    all-layers sweep (reference app/deepdream.py:441-474), from one shared
+    forward pass.
     """
     if mode not in ("all", "max"):
         raise ValueError(f"illegal visualize mode {mode!r}; expected 'all' or 'max'")
+    names = tuple(sweep_layers) if sweep_layers else (layer,)
 
     def single(params, image):
         x = image[None]
 
         def acts_of(xx):
             _, acts = forward_fn(params, xx, rules=DECONV_RULES)
-            if layer not in acts:
+            missing = [n for n in names if n not in acts]
+            if missing:
                 raise KeyError(
-                    f"model has no activation {layer!r}; known: {sorted(acts)}"
+                    f"model has no activation(s) {missing!r}; known: {sorted(acts)}"
                 )
-            return acts[layer]
+            return tuple(acts[n] for n in names)
 
-        act, vjp_fn = jax.vjp(acts_of, x)
-        n_chan = act.shape[-1]
-        # The sequential engine's _select_top, shared so the selection
-        # semantics (fp32 ranking accumulator, positive mask, top-K)
-        # cannot drift between the two engines.
-        top_idx, top_sums, valid = _select_top(act, top_k)
+        acts_t, vjp_fn = jax.vjp(acts_of, x)
 
-        def backproject(idx):
-            chan = jax.nn.one_hot(idx, n_chan, dtype=act.dtype)
-            fmap = jnp.sum(act * chan, axis=-1)
-            if mode == "max":
-                fmap = fmap * (fmap == jnp.max(fmap)).astype(fmap.dtype)
-            (x_bar,) = vjp_fn(fmap[..., None] * chan)
-            return x_bar
+        results = {}
+        for li, name in enumerate(names):
+            act = acts_t[li]
+            n_chan = act.shape[-1]
+            top_idx, top_sums, valid = _select_top(act, top_k)
 
-        images = jax.vmap(backproject)(top_idx)  # (K, 1, H, W, C)
-        return {
-            "images": images[:, 0],
-            "indices": top_idx,
-            "sums": top_sums,
-            "valid": valid,
-        }
+            def backproject(idx, li=li, act=act, n_chan=n_chan):
+                chan = jax.nn.one_hot(idx, n_chan, dtype=act.dtype)
+                fmap = jnp.sum(act * chan, axis=-1)
+                if mode == "max":
+                    fmap = fmap * (fmap == jnp.max(fmap)).astype(fmap.dtype)
+                seed = fmap[..., None] * chan
+                # Only this layer's slot carries signal; zero cotangents for
+                # the other swept layers keep the vjp identical to the
+                # single-layer projection from `name` down.
+                cots = tuple(
+                    seed if j == li else jnp.zeros_like(acts_t[j])
+                    for j in range(len(names))
+                )
+                (x_bar,) = vjp_fn(cots)
+                return x_bar
+
+            images = jax.vmap(backproject)(top_idx)  # (K, 1, H, W, C)
+            results[name] = {
+                "images": images[:, 0],
+                "indices": top_idx,
+                "sums": top_sums,
+                "valid": valid,
+            }
+        if sweep_layers is None:
+            return results[layer]
+        return results
 
     return jax.jit(single)

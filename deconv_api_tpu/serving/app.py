@@ -389,17 +389,12 @@ class DeconvService:
         if self.cfg.warmup_sweep:
             # the sweep program is ~15x a single-layer request; compiling
             # it here keeps the first sweep request out of its own
-            # sweep_timeout_s window (sequential-spec models only)
-            try:
-                self.bundle.check_sweep()
-            except ValueError:
-                pass  # DAG models have no sweep; nothing to warm
-            else:
-                self._run_batch(
-                    (layer, self.cfg.visualize_mode, self.cfg.top_k,
-                     "tiles", True),
-                    [img] * self._bucket_for(1),
-                )
+            # sweep_timeout_s window
+            self._run_batch(
+                (layer, self.cfg.visualize_mode, self.cfg.top_k,
+                 "tiles", True),
+                [img] * self._bucket_for(1),
+            )
         self.ready = True
 
     # ----------------------------------------------------------- pipeline
@@ -594,13 +589,10 @@ class DeconvService:
                 raise errors.BadRequest("top_k must be in [1, 64]")
             sweep = form.get("sweep", "").lower() in ("1", "true", "yes", "on")
             if sweep:
-                try:
-                    # fail fast at the route, before decode/queue/dispatch
-                    self.bundle.check_sweep()
-                except ValueError as e:
-                    raise errors.IllegalMode(str(e)) from None
                 # every layer from the requested one down — the reference's
-                # always-on behaviour (SURVEY §2.2.3) as an explicit opt-in
+                # always-on behaviour (SURVEY §2.2.3) as an explicit opt-in,
+                # on every registry family (sequential specs walk their
+                # D-layer chain; DAG models vjp-seed per layer)
                 result = await self._project(form, mode, top_k, "tiles", sweep=True)
                 layers = await asyncio.to_thread(
                     lambda: {

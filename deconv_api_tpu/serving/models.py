@@ -37,15 +37,38 @@ class ModelBundle:
     _vis_cache: dict = dataclasses.field(default_factory=dict)
     _dream_cache: dict = dataclasses.field(default_factory=dict)
 
-    def check_sweep(self) -> None:
-        """Single source of truth for the sweep precondition — raised here,
-        surfaced as IllegalMode (422) by the route and as a clean stderr
-        message by the CLI."""
-        if self.spec is None:
-            raise ValueError(
-                f"model {self.name!r} (autodiff engine) has no layer "
-                "sweep; sweep is a sequential-spec feature"
+    def sweep_layers(self, layer: str) -> tuple[str, ...]:
+        """The projectable layers at/below `layer` in forward order,
+        deepest first — what an all-layers sweep from `layer` projects.
+        Sequential specs read their layer list; DAG models recover the
+        forward (topological) order of their named activations from an
+        abstract trace (no compute, no device touch).  The DAG analog of
+        the reference's reversed model-layer walk
+        (app/deepdream.py:431-437)."""
+        self.check_layer(layer)
+        if self.spec is not None:
+            names = [
+                l.name for l in self.spec.layers if l.kind != "input"
+            ]
+        else:
+            # Record the acts dict's INSERTION order during tracing —
+            # reading keys off eval_shape's return value would be wrong:
+            # jax pytree flattening sorts dict keys, which is not forward
+            # order for names like mixed10 or conv_pw_13_relu.
+            order: list[str] = []
+
+            def capture(p, x):
+                _, acts = self.forward_fn(p, x)
+                order.extend(acts)
+                return 0.0
+
+            dummy = jax.ShapeDtypeStruct(
+                (1, self.image_size, self.image_size, 3), np.float32
             )
+            jax.eval_shape(capture, self.params, dummy)
+            known = set(self.layer_names)
+            names = [n for n in order if n in known]
+        return tuple(reversed(names[: names.index(layer) + 1]))
 
     def check_layer(self, layer: str) -> None:
         """Single source of truth for layer-name validation — surfaced as
@@ -111,12 +134,12 @@ class ModelBundle:
         crosses to the host.  ``post=None`` keeps the raw projections (the
         library/bench surface).
 
-        ``sweep=True`` (sequential specs only) projects EVERY model layer
-        from ``layer`` down — the reference's always-on behaviour
-        (SURVEY §2.2.3) as an explicit opt-in; the result dict then carries
-        one entry per projected layer."""
-        if sweep:
-            self.check_sweep()
+        ``sweep=True`` projects EVERY projectable layer from ``layer``
+        down — the reference's always-on behaviour (SURVEY §2.2.3) as an
+        explicit opt-in; the result dict then carries one entry per
+        projected layer.  Sequential specs walk their D-layer chain; DAG
+        models share one forward across per-layer vjp seeds
+        (engine/autodeconv.py)."""
         if self.spec is None:
             backward_dtype = None
         key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep)
@@ -134,11 +157,18 @@ class ModelBundle:
                     sweep_chunk=0 if self.mesh is not None else None,
                 )
             else:
+                sweep_names = self.sweep_layers(layer) if sweep else None
                 vmapped = jax.vmap(
-                    autodeconv_visualizer(self.forward_fn, layer, top_k, mode),
+                    autodeconv_visualizer(
+                        self.forward_fn, layer, top_k, mode,
+                        sweep_layers=sweep_names,
+                    ),
                     in_axes=(None, 0),
                 )
-                raw = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
+                if sweep:
+                    raw = vmapped  # already {name: entry} per swept layer
+                else:
+                    raw = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
 
             fn = raw if post is None else _fuse_post(raw, post)
             if self.mesh is not None:

@@ -1,20 +1,33 @@
 """The sharded training step.
 
 Design: classification fine-tuning (softmax cross-entropy, optax optimizer)
-of any ModelSpec classifier, jitted once over a (dp, tp) mesh:
+of ANY registry model, jitted once over a (dp, tp) mesh:
 
 - batch axis sharded over ``dp`` → XLA emits a gradient all-reduce (psum)
   over ICI, the TPU-native equivalent of the data-parallel NCCL all-reduce
   the reference never had (SURVEY §2.4);
-- parameters sharded over ``tp`` on their output-channel axis → matmul/conv
-  partials stay local, activations re-shard automatically;
+- parameters sharded over ``tp`` on their output-channel axis via the one
+  tree-mapped rule (parallel/mesh.py:param_shardings) → matmul/conv
+  partials stay local, activations re-shard automatically — generic over
+  sequential-spec 2-level dicts AND the DAG families' nested block
+  pytrees (VERDICT r4 item 4);
 - `jax.checkpoint` on the loss keeps peak HBM bounded for deep models
   (rematerialise instead of storing every conv activation).
+
+The model argument is either a sequential ``ModelSpec`` (classifier
+forward from models/apply.py) or any callable
+``apply_fn(params, images) -> logits`` — DAG families pass an adapter over
+their ``forward_fn(..., logits=True)``.  DAG BatchNorm enters the graph
+in inference form (running-stat normalisation folded to a per-channel
+affine, models/blocks.py:bn_affine); under fine-tuning every BN
+parameter — scale, offset, and the folded statistics — updates as an
+ordinary weight, which keeps the trained checkpoint exactly congruent
+with the stats-free serving forward.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -31,10 +44,22 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
-def train_state_shardings(spec: ModelSpec, state: TrainState, mesh):
+def _as_apply_fn(model) -> Callable:
+    """ModelSpec -> its classifier forward; callables pass through."""
+    if isinstance(model, ModelSpec):
+        return lambda p, x: forward(model, p, x, logits=True)
+    if callable(model):
+        return model
+    raise TypeError(
+        f"model must be a ModelSpec or apply_fn(params, images) -> logits, "
+        f"got {type(model).__name__}"
+    )
+
+
+def train_state_shardings(state: TrainState, mesh):
     """Shardings congruent with a TrainState: params (and their optimizer
     moments) over tp, scalars replicated."""
-    p_shard = param_shardings(spec, state.params, mesh)
+    p_shard = param_shardings(state.params, mesh)
 
     # Optimizer moments mirror param leaves; match them up by (shape, dtype).
     flat_p = jax.tree.leaves(state.params)
@@ -53,7 +78,7 @@ def train_state_shardings(spec: ModelSpec, state: TrainState, mesh):
 
 
 def make_train_step(
-    spec: ModelSpec,
+    model,
     mesh,
     optimizer: optax.GradientTransformation | None = None,
     *,
@@ -61,14 +86,16 @@ def make_train_step(
 ):
     """Build (init_fn, step_fn), both jitted over the mesh.
 
-    ``init_fn(params) -> TrainState`` places params/opt state with their
-    shardings; ``step_fn(state, images, labels) -> (state, loss)`` runs one
-    sharded SGD step.
+    ``model`` is a sequential ModelSpec or ``apply_fn(params, images) ->
+    logits``.  ``init_fn(params) -> TrainState`` places params/opt state
+    with their shardings; ``step_fn(state, images, labels) -> (state,
+    loss)`` runs one sharded SGD step.
     """
     optimizer = optimizer or optax.adamw(1e-4)
+    apply_fn = _as_apply_fn(model)
 
     def loss_fn(params, images, labels):
-        logits = forward(spec, params, images, logits=True)
+        logits = apply_fn(params, images)
         return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
     loss_c = jax.checkpoint(loss_fn) if remat else loss_fn
@@ -85,7 +112,7 @@ def make_train_step(
     # Trace once to learn state sharding layout, then jit with shardings.
     def build(params):
         state = jax.eval_shape(init_fn, params)
-        sh = train_state_shardings(spec, state, mesh)
+        sh = train_state_shardings(state, mesh)
         init_jit = jax.jit(init_fn, out_shardings=sh)
         step_jit = jax.jit(
             step_fn,
@@ -98,13 +125,15 @@ def make_train_step(
     return build
 
 
-def make_eval_step(spec: ModelSpec, mesh):
+def make_eval_step(model, mesh):
     """Jitted held-out evaluation over the mesh: (params, images, labels)
-    -> (mean loss, accuracy).  Batch dp-sharded like the train step; the
-    scalar metrics come back replicated (XLA inserts the psum)."""
+    -> (mean loss, accuracy).  ``model`` as in make_train_step.  Batch
+    dp-sharded like the train step; the scalar metrics come back
+    replicated (XLA inserts the psum)."""
+    apply_fn = _as_apply_fn(model)
 
     def eval_fn(params, images, labels):
-        logits = forward(spec, params, images, logits=True)
+        logits = apply_fn(params, images)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels
         ).mean()

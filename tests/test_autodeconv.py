@@ -39,6 +39,34 @@ def test_autodeconv_illegal_mode():
         autodeconv_visualizer(spec_forward(TINY), "b1c1", mode="nope")
 
 
+def test_autodeconv_sweep_matches_sequential_sweep(tiny_setup):
+    """The DAG all-layers sweep (one shared forward, one zero-padded vjp
+    cotangent per swept layer) vs the sequential engine's sweep in clean
+    mode — two independent sweep formulations must agree on every layer,
+    including the pool entry."""
+    from deconv_api_tpu.engine import visualize_all_layers
+
+    params, img = tiny_setup
+    names = ("b2c1", "b1p", "b1c2", "b1c1")
+    fn = autodeconv_visualizer(
+        spec_forward(TINY), "b2c1", top_k=8, sweep_layers=names
+    )
+    got = fn(params, img)
+    want = visualize_all_layers(TINY, params, img, "b2c1", bug_compat=False)
+    assert set(got) == set(want)
+    for name in names:
+        np.testing.assert_array_equal(
+            np.asarray(got[name]["indices"]), np.asarray(want[name]["indices"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[name]["images"]), np.asarray(want[name]["images"]),
+            rtol=1e-4, atol=1e-5, err_msg=name,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got[name]["valid"]), np.asarray(want[name]["valid"])
+        )
+
+
 # ----------------------------------------------------------------- ResNet50
 
 
@@ -67,6 +95,28 @@ def test_resnet50_param_count(resnet):
     # published ResNet50 (include_top, 1000 classes) ~= 25.6M; ours has
     # 10 classes (-2.03M head) and inference-only BN (mean/var counted too)
     assert 23e6 < n < 28e6
+
+
+def test_resnet50_autodeconv_sweep(resnet):
+    """All-layers sweep on a residual/strided DAG — the reference's
+    signature always-on behaviour (app/deepdream.py:441-474), which its
+    sequential walk could never express for this topology.  Each swept
+    entry must equal the single-layer projection from that layer."""
+    params, fwd = resnet
+    img = jax.random.normal(jax.random.PRNGKey(2), (64, 64, 3))
+    names = ("conv3_block1_out", "conv2_block3_out", "conv2_block2_out")
+    fn = autodeconv_visualizer(fwd, "conv3_block1_out", top_k=2, sweep_layers=names)
+    got = fn(params, img)
+    assert set(got) == set(names)
+    for name in names:
+        single = autodeconv_visualizer(fwd, name, top_k=2)(params, img)
+        np.testing.assert_array_equal(
+            np.asarray(got[name]["indices"]), np.asarray(single["indices"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[name]["images"]), np.asarray(single["images"]),
+            rtol=1e-4, atol=1e-5, err_msg=name,
+        )
 
 
 def test_resnet50_autodeconv_strided_path(resnet):

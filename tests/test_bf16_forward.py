@@ -30,20 +30,27 @@ def _rel_l2(a, b):
 
 
 def _paired_rel_l2(got, ref):
-    """Channel-paired projection error + selection-set check.
+    """Channel-paired projection error + selection-overlap floor.
 
     Rank ORDER under a bf16 forward is backend-dependent (near-tied
-    channel sums round differently on native-TPU vs CPU-emulated bf16 —
-    the flake class tools/full_depth_parity.py pairs by channel for), so
-    assert the selected channel SET and compare images channel-to-channel
-    rather than rank-to-rank."""
+    channel sums round differently on native-TPU vs CPU-emulated bf16),
+    and top-K MEMBERSHIP itself can flip for a near-threshold channel, so
+    require k-1 overlap (mirroring tools/full_depth_parity.py's
+    paired_count tolerance) and compare images channel-to-channel over
+    the overlapping channels only, rather than rank-to-rank."""
     gi = np.asarray(got["indices"]).tolist()
     ri = np.asarray(ref["indices"]).tolist()
-    assert set(gi) == set(ri), (gi, ri)
-    assert int(np.asarray(got["valid"]).sum()) == int(np.asarray(ref["valid"]).sum())
-    by_chan = {c: np.asarray(got["images"])[r] for r, c in enumerate(gi)}
-    a = np.stack([by_chan[c] for c in ri])
-    return _rel_l2(a, np.asarray(ref["images"]))
+    overlap = set(gi) & set(ri)
+    assert len(overlap) >= len(ri) - 1, (gi, ri)
+    assert abs(
+        int(np.asarray(got["valid"]).sum()) - int(np.asarray(ref["valid"]).sum())
+    ) <= 1
+    got_by_chan = {c: np.asarray(got["images"])[r] for r, c in enumerate(gi)}
+    ref_by_chan = {c: np.asarray(ref["images"])[r] for r, c in enumerate(ri)}
+    paired = [c for c in ri if c in overlap]
+    a = np.stack([got_by_chan[c] for c in paired])
+    b = np.stack([ref_by_chan[c] for c in paired])
+    return _rel_l2(a, b)
 
 
 def _cast_tree(params, dtype):

@@ -126,9 +126,12 @@ def test_visualize_sweep_writes_one_grid_per_layer(tmp_path, monkeypatch, capsys
         assert img.shape == (32, 32, 3)  # 2x2 grid of 16x16 tiles
 
 
-def test_visualize_sweep_rejects_autodiff_models(tmp_path, monkeypatch, capsys):
-    """--sweep on a DAG/autodiff bundle must exit cleanly (rc 2, message on
-    stderr), mirroring the route-level IllegalMode guard (app.py)."""
+def test_visualize_sweep_on_autodiff_models(tmp_path, monkeypatch, capsys):
+    """--sweep on a DAG/autodiff bundle writes one grid per swept layer —
+    the r4 sequential-only restriction is lifted (engine/autodeconv.py
+    sweep_layers: one shared forward, per-layer vjp seeds)."""
+    import json
+
     import jax
     import numpy as np
     from PIL import Image
@@ -140,7 +143,6 @@ def test_visualize_sweep_rejects_autodiff_models(tmp_path, monkeypatch, capsys):
     from tests.test_engine_parity import TINY
 
     params = init_params(TINY, jax.random.PRNGKey(3))
-    fwd = spec_forward(TINY)
     bundle = m.ModelBundle(
         name="tiny_dag",
         params=params,
@@ -148,21 +150,27 @@ def test_visualize_sweep_rejects_autodiff_models(tmp_path, monkeypatch, capsys):
         preprocess=lambda x: x,
         layer_names=tuple(l.name for l in TINY.layers if l.kind != "input"),
         dream_layers=(),
-        forward_fn=lambda p, x: fwd(p, x),
+        forward_fn=spec_forward(TINY),
     )
     monkeypatch.setitem(m.REGISTRY, "tiny_dag", lambda: bundle)
 
     src = tmp_path / "in.png"
-    Image.fromarray(np.zeros((16, 16, 3), np.uint8), "RGB").save(src)
+    rng = np.random.default_rng(0)
+    Image.fromarray(rng.integers(0, 255, (16, 16, 3), np.uint8), "RGB").save(src)
+    out = tmp_path / "o.png"
     rc = cli_main(
         [
             "visualize", "--model", "tiny_dag", "--image", str(src),
-            "--layer", "b2c1", "--sweep", "--output", str(tmp_path / "o.png"),
+            "--layer", "b2c1", "--sweep", "--output", str(out),
         ]
     )
-    assert rc == 2
-    err = capsys.readouterr().err
-    assert "no layer sweep" in err
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(result["outputs"]) <= {"b2c1", "b1p", "b1c2", "b1c1"}
+    assert result["outputs"], "no layers produced output"
+    for path in result["outputs"].values():
+        img = np.asarray(Image.open(path))
+        assert img.shape == (32, 32, 3)  # 2x2 grid of 16x16 tiles
 
 
 def test_visualize_unknown_layer_clean_error(tmp_path, monkeypatch, capsys):

@@ -1,0 +1,98 @@
+"""tools/fetch_weights.py — the one-command weights recipe's verification
+logic, exercised in-env against the committed real-Keras fixture
+(VERDICT r4 item 6).  The download itself needs egress the build host
+doesn't have; what CAN be tested is everything that judges the file after
+download: sha256, structural load through the serving loader, the
+every-leaf-replaced rule, and the forward smoke."""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import os
+import pathlib
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURE = REPO / "tests" / "fixtures" / "golden" / "vgg16_block1.h5"
+
+_spec = importlib.util.spec_from_file_location(
+    "fetch_weights", REPO / "tools" / "fetch_weights.py"
+)
+fw = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(fw)
+
+
+def _block1_spec_params():
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.models.vgg16 import VGG16_SPEC
+
+    spec = dataclasses.replace(
+        VGG16_SPEC.truncated("block1_pool"), input_shape=(64, 64, 3)
+    )
+    return spec, init_params(spec, jax.random.PRNGKey(0))
+
+
+def test_verify_accepts_real_keras_h5():
+    """The committed Keras-written h5 passes the full verification: every
+    parameter leaf replaced, finite forward."""
+    spec, params = _block1_spec_params()
+    report = fw.verify_h5(
+        "vgg16", str(FIXTURE), spec=spec, init_params=params
+    )
+    assert report["replaced_fraction"] == 1.0
+    assert report["forward"] == "ok"
+    assert len(report["sha256"]) == 64
+
+
+def test_sha256_matches_golden_pin():
+    """fetch_weights' hash function agrees with the fixture pin in
+    tests/test_weights_golden.py — one hash implementation, one truth."""
+    from tests.test_weights_golden import H5_SHA256
+
+    assert fw.sha256_of(str(FIXTURE)) == H5_SHA256
+
+
+def test_verify_rejects_partial_load():
+    """A block1-only h5 against the FULL VGG16 model must fail the
+    every-leaf-replaced rule (the silently-partial-load failure mode that
+    shape checks alone cannot catch)."""
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    spec, params = vgg16_init()
+    with pytest.raises(ValueError, match="leaves were replaced"):
+        fw.verify_h5(
+            "vgg16", str(FIXTURE), spec=spec, init_params=params,
+            forward_smoke=False,
+        )
+
+
+def test_verify_rejects_wrong_shape(tmp_path):
+    """A kernel with the wrong shape raises through the loader, naming the
+    layer — corruption is loud, not silently truncated."""
+    h5py = pytest.importorskip("h5py")
+    bad = tmp_path / "bad.h5"
+    shutil.copy(FIXTURE, bad)
+    with h5py.File(bad, "r+") as f:
+        grp = f["model_weights"]["block1_conv1"]["block1_conv1"]
+        data = np.asarray(grp["kernel"])[:, :, :, :32]  # drop half the filters
+        del grp["kernel"]
+        grp.create_dataset("kernel", data=data)
+    spec, params = _block1_spec_params()
+    with pytest.raises(ValueError, match="block1_conv1"):
+        fw.verify_h5(
+            "vgg16", str(bad), spec=spec, init_params=params,
+            forward_smoke=False,
+        )
+
+
+def test_manifest_covers_registry():
+    """Every registry family has a fetch entry — a new model family must
+    ship its weights recipe."""
+    from deconv_api_tpu.serving.models import REGISTRY
+
+    assert set(fw.MANIFEST) == set(REGISTRY)

@@ -42,10 +42,29 @@ def test_sharded_visualizer_matches_single_device():
 def test_param_shardings_tp_axis():
     mesh = make_mesh((4, 2))
     params = init_params(TINY, jax.random.PRNGKey(1))
-    sh = param_shardings(TINY, params, mesh)
+    sh = param_shardings(params, mesh)
     # conv filters divisible by 2 → sharded on last axis
     assert sh["b1c1"]["w"].spec[-1] == "tp"
     assert sh["predictions"]["w"].spec[-1] == "tp"
+
+
+def test_param_shardings_generic_over_dag_pytrees():
+    """The tree-mapped rule must handle the DAG families' nested block
+    pytrees (conv+BN dicts three levels deep), not just the sequential
+    2-level layout — VERDICT r4 item 4."""
+    from deconv_api_tpu.models.resnet50 import resnet50_init
+
+    mesh = make_mesh((4, 2))
+    params = resnet50_init(jax.random.PRNGKey(0), num_classes=10)
+    sh = param_shardings(params, mesh)
+    # conv kernel: trailing (output-channel) axis over tp
+    assert sh["conv2_block1"]["c1"]["w"].spec[-1] == "tp"
+    # BN per-channel vectors shard too (divisible), scalars replicated
+    assert sh["conv1"]["gamma"].spec[-1] == "tp"
+    # 10-class head doesn't divide tp=2... 10 % 2 == 0, so it shards
+    assert sh["predictions"]["w"].spec[-1] == "tp"
+    # structure congruent with params
+    jax.tree.map(lambda a, b: None, params, sh)
 
 
 def test_train_step_dp_tp_runs_and_descends():

@@ -165,14 +165,14 @@ def test_v1_illegal_mode_422(server):
     assert r.json()["error"] == "illegal_visualize_mode"
 
 
-def test_v1_sweep_on_autodiff_model_422(monkeypatch):
-    """sweep=true against a DAG/autodiff bundle must 422 at the route
-    (check_sweep -> IllegalMode), before decode/queue/dispatch."""
+def test_v1_sweep_on_autodiff_model(monkeypatch):
+    """sweep=true against a DAG/autodiff bundle serves every projectable
+    layer from the requested one down — the r4 sequential-only restriction
+    is lifted (engine/autodeconv.py sweep_layers)."""
     from deconv_api_tpu.models.apply import spec_forward
     from deconv_api_tpu.serving import models as m
 
     params = init_params(TINY, jax.random.PRNGKey(3))
-    fwd = spec_forward(TINY)
     bundle = m.ModelBundle(
         name="tiny_dag",
         params=params,
@@ -180,7 +180,7 @@ def test_v1_sweep_on_autodiff_model_422(monkeypatch):
         preprocess=lambda x: x,
         layer_names=tuple(l.name for l in TINY.layers if l.kind != "input"),
         dream_layers=(),
-        forward_fn=lambda p, x: fwd(p, x),
+        forward_fn=spec_forward(TINY),
     )
     monkeypatch.setitem(m.REGISTRY, "tiny_dag", lambda: bundle)
     cfg = ServerConfig(
@@ -191,10 +191,16 @@ def test_v1_sweep_on_autodiff_model_422(monkeypatch):
         r = httpx.post(
             s.base_url + "/v1/deconv",
             data={"file": _data_url(), "layer": "b2c1", "sweep": "true"},
+            timeout=120,
         )
-        assert r.status_code == 422
-        assert r.json()["error"] == "illegal_visualize_mode"
-        assert "no layer sweep" in r.json()["detail"]
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["sweep"] is True
+        # b2c1 down through TINY's projectable layers, deepest first
+        assert set(body["layers"]) == {"b2c1", "b1p", "b1c2", "b1c1"}
+        for entry in body["layers"].values():
+            assert len(entry["filters"]) == len(entry["images"])
+            assert all(u.startswith("data:image/") for u in entry["images"])
 
 
 def test_ready_and_metrics_endpoints(server):

@@ -380,32 +380,74 @@ def test_v1_deconv_sweep_over_http():
         assert r.status_code == 200 and "images" in r.json()
 
 
-def test_v1_deconv_sweep_rejected_for_dag_models():
-    """DAG (autodiff-engine) models have no layer sweep: the bundle refuses,
-    and the ROUTE fails fast with 422 before decode/queue/dispatch."""
-    import json as _json
-
-    from deconv_api_tpu.serving.http import Request
+def test_dag_sweep_layers_forward_order_not_sorted_order():
+    """sweep_layers must follow the forward (topological) order of the
+    acts dict, NOT sorted-key order — jax pytree flattening sorts dict
+    keys, which misorders names like mixed10 (between mixed1 and mixed2)
+    and conv_pw_13_relu (before conv_pw_2_relu).  A sorted-order bug
+    silently drops layers from the sweep set (r5 review finding)."""
     from deconv_api_tpu.serving.models import REGISTRY
 
-    bundle = REGISTRY["resnet50"]()
-    with pytest.raises(ValueError, match="sweep"):
-        bundle.batched_visualizer("conv4_block6_out", "all", 4, sweep=True)
+    mb = REGISTRY["mobilenet_v1"]()
+    got = mb.sweep_layers("conv_pw_3_relu")
+    assert got == (
+        "conv_pw_3_relu", "conv_pw_2_relu", "conv_pw_1_relu", "conv1_relu"
+    ), got
+    deep = mb.sweep_layers("conv_pw_12_relu")
+    # deepest-first: contiguous conv_pw_12 .. conv_pw_1, then the stem
+    assert deep == tuple(
+        f"conv_pw_{i}_relu" for i in range(12, 0, -1)
+    ) + ("conv1_relu",), deep
 
-    svc = DeconvService(
-        ServerConfig(
-            model="resnet50", compilation_cache_dir="", warmup_all_buckets=False
+    inc = REGISTRY["inception_v3"]()
+    assert inc.sweep_layers("mixed2") == ("mixed2", "mixed1", "mixed0")
+    assert inc.sweep_layers("mixed10") == tuple(
+        f"mixed{i}" for i in range(10, -1, -1)
+    )
+
+
+def test_dag_bundle_sweep_matches_single_layer_programs():
+    """A DAG bundle's sweep visualizer (one shared forward, per-layer vjp
+    seeds) must reproduce the per-layer single visualizers exactly: the
+    zero cotangents in the other layers' slots may not perturb the seeded
+    projection."""
+    import jax
+    import numpy as np
+
+    from deconv_api_tpu.models.apply import spec_forward
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving import models as m
+    from tests.test_engine_parity import TINY
+
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    bundle = m.ModelBundle(
+        name="tiny_dag",
+        params=params,
+        image_size=16,
+        preprocess=lambda x: x,
+        layer_names=tuple(l.name for l in TINY.layers if l.kind != "input"),
+        dream_layers=(),
+        forward_fn=spec_forward(TINY),
+    )
+    assert bundle.sweep_layers("b2c1") == ("b2c1", "b1p", "b1c2", "b1c1")
+
+    batch = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(9), (2, 16, 16, 3)), np.float32
+    )
+    swept = bundle.batched_visualizer("b2c1", "all", 4, sweep=True)(
+        bundle.params, batch
+    )
+    assert set(swept) == {"b2c1", "b1p", "b1c2", "b1c1"}
+    for name in swept:
+        single = bundle.batched_visualizer(name, "all", 4)(bundle.params, batch)
+        np.testing.assert_array_equal(
+            np.asarray(swept[name]["indices"]), np.asarray(single[name]["indices"])
         )
-    )
-    svc.ready = True
-    req = Request(
-        "POST", "/v1/deconv", {},
-        {"content-type": "application/x-www-form-urlencoded"},
-        b"file=x&layer=conv4_block6_out&sweep=1",
-    )
-    resp = asyncio.run(svc._deconv_v1(req))
-    assert resp.status == 422
-    assert _json.loads(resp.body)["error"] == "illegal_visualize_mode"
+        np.testing.assert_allclose(
+            np.asarray(swept[name]["images"]),
+            np.asarray(single[name]["images"]),
+            rtol=1e-5, atol=1e-6, err_msg=name,
+        )
 
 
 def test_http_parser_fuzz_never_kills_server():

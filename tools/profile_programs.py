@@ -1,0 +1,187 @@
+"""On-chip jax.profiler captures of the two open perf ledgers (VERDICT r4
+next-round items 1+3): the headline batch-64 single-layer program and the
+config-2 SEPARATE sweep batch-8 program.
+
+Captures each program under `jax.profiler` (the same profile_trace scope
+the serving /v1/profile surface uses — this dogfoods that plumbing on real
+hardware for the first time), parses the Chrome-trace artifact
+(*.trace.json.gz) into per-op device-time tables, and prints one JSON line
+per program:
+
+    {"which": "profile_headline", "iters": N, "tracks": {...},
+     "top_ops": [{"name": ..., "total_ms": ..., "calls": ...}, ...]}
+
+Usage: python tools/profile_programs.py [--out DIR] [--iters 3]
+       [--programs headline,sweep]
+
+The trace directories are left on disk for TensorBoard/xprof inspection;
+the JSON summaries are what BASELINE.md's op-level ledger cites.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_headline():
+    import jax
+
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    spec, params = vgg16_init()
+    fn = get_visualizer(
+        spec, "block5_conv1", 8, "all", True,
+        batched=True, backward_dtype="bfloat16",
+    )
+    batch = jax.random.normal(jax.random.PRNGKey(0), (64, 224, 224, 3))
+    return fn, (params, batch)
+
+
+def build_sweep():
+    import jax
+
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    spec, params = vgg16_init()
+    fn = get_visualizer(
+        spec, "block5_conv1", 8, "all", True,
+        sweep=True, batched=True, backward_dtype="bfloat16",
+        sweep_merged=False,
+    )
+    batch = jax.random.normal(jax.random.PRNGKey(0), (8, 224, 224, 3))
+    return fn, (params, batch)
+
+
+PROGRAMS = {"headline": build_headline, "sweep": build_sweep}
+
+
+def capture(tag: str, build, root: str, iters: int) -> tuple[str, float]:
+    import jax
+
+    from deconv_api_tpu.utils.tracing import profile_trace
+
+    fn, args = build()
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))  # compile
+    compile_s = time.perf_counter() - t0
+    jax.block_until_ready(fn(*args))  # steady-state warm
+    trace_dir = os.path.join(root, tag)
+    t0 = time.perf_counter()
+    with profile_trace(trace_dir):
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    wall = time.perf_counter() - t0
+    print(
+        f"[{tag}] compile {compile_s:.1f}s, {iters} traced iters in "
+        f"{wall:.3f}s ({wall / iters * 1e3:.1f} ms/iter)",
+        file=sys.stderr, flush=True,
+    )
+    return trace_dir, wall / iters
+
+
+def parse_trace(trace_dir: str, top_n: int = 40) -> dict:
+    """Aggregate the Chrome-trace events: per-track totals + top ops on the
+    device track (largest non-python track)."""
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    if not paths:
+        return {"error": f"no trace.json.gz under {trace_dir}"}
+    events, pid_names = [], {}
+    for p in paths:
+        with gzip.open(p, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pid_names[ev["pid"]] = ev.get("args", {}).get("name", "")
+            elif ev.get("ph") == "X":
+                events.append(ev)
+
+    per_track: dict[str, float] = collections.defaultdict(float)
+    per_op: dict[tuple[str, str], list] = collections.defaultdict(
+        lambda: [0.0, 0]
+    )
+    for ev in events:
+        track = pid_names.get(ev.get("pid"), str(ev.get("pid")))
+        dur_ms = float(ev.get("dur", 0)) / 1e3
+        per_track[track] += dur_ms
+        acc = per_op[(track, ev.get("name", "?"))]
+        acc[0] += dur_ms
+        acc[1] += 1
+
+    # the device track: prefer names mentioning TPU/device, else the
+    # largest track that isn't the python host thread
+    device_tracks = [
+        t for t in per_track
+        if "tpu" in t.lower() or "device" in t.lower() or "/device" in t.lower()
+    ]
+    if not device_tracks:
+        device_tracks = [
+            t for t, _ in sorted(
+                per_track.items(), key=lambda kv: -kv[1]
+            )
+            if "python" not in t.lower()
+        ][:1]
+    top = sorted(
+        (
+            {"track": t, "name": n, "total_ms": round(v[0], 3), "calls": v[1]}
+            for (t, n), v in per_op.items()
+            # "$file.py:line fn" entries are the python host sampler, not ops
+            if t in device_tracks and not n.startswith("$")
+        ),
+        key=lambda r: -r["total_ms"],
+    )[:top_n]
+    return {
+        "tracks_ms": {t: round(v, 2) for t, v in sorted(
+            per_track.items(), key=lambda kv: -kv[1]
+        )},
+        "device_tracks": device_tracks,
+        "top_ops": top,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "profiles"))
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--programs", default="headline,sweep")
+    ap.add_argument("--parse-only", default=None, metavar="DIR")
+    args = ap.parse_args()
+
+    if args.parse_only:
+        print(json.dumps(parse_trace(args.parse_only)), flush=True)
+        return 0
+
+    for name in args.programs.split(","):
+        trace_dir, per_iter = capture(
+            name, PROGRAMS[name], args.out, args.iters
+        )
+        summary = parse_trace(trace_dir)
+        summary.update(
+            {
+                "which": f"profile_{name}",
+                "iters": args.iters,
+                "wall_ms_per_iter": round(per_iter * 1e3, 1),
+                "trace_dir": trace_dir,
+            }
+        )
+        print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

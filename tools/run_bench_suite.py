@@ -23,7 +23,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Generous per-config budgets: first compiles over the tunnel are tens of
 # seconds each, and config 3 compiles one executable per octave shape.
-TIMEOUTS = {1: 1800, 2: 2400, 3: 5400, 4: 3600, 5: 2400}
+TIMEOUTS = {1: 1800, 2: 2400, 3: 5400, 4: 3600, 5: 2400, 6: 3600}
 
 
 def run_cmd_json(
@@ -144,8 +144,9 @@ def run_plan(
     summary_which: str,
     max_attempts: int = 3,
 ) -> list[str]:
-    """Shared scaffolding for the tools/run_r4*_experiments scripts: run
-    each ``(which, thunk)`` up to ``max_attempts`` times, preflighting the
+    """Shared scaffolding for the experiment runners (tools/
+    run_experiments.py, tools/tunnel_watcher.py): run each
+    ``(which, thunk)`` up to ``max_attempts`` times, preflighting the
     tunnel before every pass, appending date-stamped rows to ``out_path``,
     and closing with a ``summary_which`` row listing what finished.
     Returns the unfinished experiment names (empty = all succeeded).

@@ -1,0 +1,111 @@
+"""Parameterized perf-experiment runner (replaces the one-shot
+run_r4{,b,c}_experiments.py scripts, VERDICT r4 item 8).
+
+Each experiment is a plan item given on the command line:
+
+    TAG:KIND[:ENV1=V1,ENV2=V2,...]
+
+where KIND is one of
+  - ``configN``      — BASELINE suite config N (deconv_api_tpu.bench.suite)
+  - ``bench``        — bench.py --breakdown under the fused-sync defaults
+  - ``tool/NAME.py`` — a script under tools/ emitting one JSON line
+
+and the optional third field sets child environment variables (the A/B
+knobs: DECONV_SWEEP_MERGED, DECONV_PIPELINE_DEPTH, DECONV_DTYPE, ...).
+Rows append date-stamped to bench_suite_results.jsonl under ``which=TAG``
+via the shared run_plan scaffolding (tunnel preflight, bounded retries,
+closing summary row).
+
+Examples (the round-4 campaigns, re-expressed):
+
+    python tools/run_experiments.py --summary r4_experiments_summary \\
+        tail_nchw:tool/tail_nchw_probe.py \\
+        config2_sweep_separate:config2:DECONV_SWEEP_MERGED=0
+
+    python tools/run_experiments.py --summary r4c_experiments_summary \\
+        headline_fwd_bf16:bench:DECONV_DTYPE=bfloat16 \\
+        headline_fused_ctl:bench:DECONV_DTYPE=float32
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from run_bench_suite import (  # noqa: E402
+    TIMEOUTS,
+    run_cmd_json,
+    run_one,
+    run_plan,
+)
+
+# bench.py children default to the fused-sync methodology the headline
+# rows use (BASELINE.md round-4b); plan-item env overrides win.
+BENCH_DEFAULT_ENV = {
+    "DECONV_BENCH_FUSED_SYNC": "1",
+    "DECONV_BENCH_BUDGET": "1100",
+    "DECONV_BENCH_TIMEOUT": "600",
+}
+
+
+def parse_item(spec: str):
+    """'TAG:KIND[:K=V,...]' -> (tag, thunk)."""
+    parts = spec.split(":", 2)
+    if len(parts) < 2:
+        raise SystemExit(f"bad plan item {spec!r}: want TAG:KIND[:ENV=V,...]")
+    tag, kind = parts[0], parts[1]
+    env: dict[str, str] = {}
+    if len(parts) == 3 and parts[2]:
+        for kv in parts[2].split(","):
+            k, _, v = kv.partition("=")
+            if not k or not _:
+                raise SystemExit(f"bad env assignment {kv!r} in {spec!r}")
+            env[k] = v
+
+    if kind.startswith("config") and kind[6:].isdigit():
+        n = int(kind[6:])
+        return tag, lambda: run_one(n, TIMEOUTS.get(n, 3600), env=env or None)
+    if kind == "bench":
+        benv = dict(BENCH_DEFAULT_ENV)
+        benv.update(env)
+        return tag, lambda: run_cmd_json(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--breakdown"],
+            1200,
+            env=benv,
+        )
+    if kind.startswith("tool/"):
+        path = os.path.join(REPO, "tools", os.path.basename(kind[5:]))
+        if not os.path.exists(path):
+            raise SystemExit(f"no such tool script: {path}")
+        return tag, lambda: run_cmd_json(
+            [sys.executable, path], 2400, env=env or None
+        )
+    raise SystemExit(f"unknown experiment kind {kind!r} in {spec!r}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("items", nargs="+", help="plan items, TAG:KIND[:ENV=V,...]")
+    ap.add_argument("--max-hours", type=float, default=6.0)
+    ap.add_argument("--summary", default="experiments_summary")
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "bench_suite_results.jsonl")
+    )
+    args = ap.parse_args()
+
+    plan = [parse_item(s) for s in args.items]
+    tags = [t for t, _ in plan]
+    if len(set(tags)) != len(tags):
+        raise SystemExit(f"duplicate tags in plan: {tags}")
+    missing = run_plan(plan, args.out, "exp", args.max_hours, args.summary)
+    return 0 if not missing else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
