@@ -1,0 +1,153 @@
+"""Tunnel-free serving measurement (VERDICT r4 item 5).
+
+Config-5's chip rows are dominated by axon-tunnel drift: identical-config
+same-day runs span 11.0-16.7 req/s, which exceeds every knob's A/B delta
+(BASELINE.md).  This probe removes the tunnel entirely: the REAL server
+(HTTP socket -> codec -> batching dispatcher -> engine -> encode) on the
+CPU backend with a tiny injected spec, so device time is negligible and
+the measurement isolates the serving machinery itself — the
+dispatcher+codec overhead per request, and a pipeline_depth A/B in a
+regime where drift cannot mask it.
+
+Prints one JSON row per pipeline_depth; append to
+bench_suite_results.jsonl via tools/run_experiments.py
+(`loopback:tool/loopback_load.py`) or redirect by hand.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import io
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_load(pipeline_depth: int, n_requests: int = 512, concurrency: int = 64) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+    from deconv_api_tpu.serving.app import DeconvService
+
+    # VGG-shaped but tiny: 32x32, three convs + two pools — compiles in
+    # seconds on CPU, runs in microseconds, leaving codec+dispatcher as
+    # the measured quantity.
+    spec = ModelSpec(
+        name="loopback_tiny",
+        input_shape=(32, 32, 3),
+        layers=(
+            Layer("input_1", "input"),
+            Layer("c1", "conv", activation="relu", filters=16),
+            Layer("p1", "pool"),
+            Layer("c2", "conv", activation="relu", filters=32),
+            Layer("p2", "pool"),
+            Layer("c3", "conv", activation="relu", filters=32),
+        ),
+    )
+    params = init_params(spec, jax.random.PRNGKey(0))
+    cfg = ServerConfig(
+        image_size=32,
+        max_batch=32,
+        batch_window_ms=5.0,
+        pipeline_depth=pipeline_depth,
+        warmup_all_buckets=True,
+        compilation_cache_dir="",
+        platform="cpu",
+    )
+    service = DeconvService(cfg, spec=spec, params=params)
+
+    rng = np.random.default_rng(0)
+    uris = []
+    for _ in range(8):
+        img = Image.fromarray(
+            rng.integers(0, 255, (32, 32, 3), np.uint8), "RGB"
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        uris.append(
+            "data:image/jpeg;base64," + base64.b64encode(buf.getvalue()).decode()
+        )
+
+    async def drive():
+        import urllib.parse
+
+        port = await service.start(host="127.0.0.1", port=0)
+        await asyncio.to_thread(service.warmup, "c3")
+        sem = asyncio.Semaphore(concurrency)
+        latencies: list[float] = []
+
+        async def one(i: int):
+            body = urllib.parse.urlencode(
+                {"file": uris[i % len(uris)], "layer": "c3"}
+            ).encode()
+            async with sem:
+                t0 = time.perf_counter()
+                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                req = (
+                    b"POST / HTTP/1.1\r\nHost: x\r\nContent-Type: "
+                    b"application/x-www-form-urlencoded\r\nContent-Length: "
+                    + str(len(body)).encode()
+                    + b"\r\nConnection: close\r\n\r\n"
+                    + body
+                )
+                writer.write(req)
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                latencies.append(time.perf_counter() - t0)
+                assert b" 200 " in raw.split(b"\r\n", 1)[0], raw[:120]
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i) for i in range(n_requests)))
+        wall = time.perf_counter() - t0
+        snap = service.metrics.snapshot()
+        await service.stop()
+        lat = sorted(latencies)
+        return {
+            "which": f"loopback_cpu_depth{pipeline_depth}",
+            "platform": "cpu-loopback",
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "pipeline_depth": pipeline_depth,
+            "wall_s": round(wall, 3),
+            "requests_per_sec": round(n_requests / wall, 1),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 2),
+            "per_request_overhead_ms": round(wall / n_requests * 1e3, 3),
+            "server": {
+                "batches_total": snap["batches_total"],
+                "batch_size_p50": round(snap["batch_size_p50"], 1),
+                "batch_cadence_p50_ms": round(
+                    snap["batch_cadence_p50_s"] * 1e3, 2
+                ),
+                "queue_wait_p50_ms": round(snap["queue_wait_p50_s"] * 1e3, 2),
+                "stages_p50_ms": {
+                    k: round(v["p50_s"] * 1e3, 2)
+                    for k, v in snap["stages"].items()
+                },
+            },
+        }
+
+    return asyncio.run(drive())
+
+
+def main() -> int:
+    depths = [int(x) for x in (sys.argv[1:] or ["2", "1"])]
+    for d in depths:
+        row = run_load(d)
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

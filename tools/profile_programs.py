@@ -94,8 +94,12 @@ def capture(tag: str, build, root: str, iters: int) -> tuple[str, float]:
 
 
 def parse_trace(trace_dir: str, top_n: int = 40) -> dict:
-    """Aggregate the Chrome-trace events: per-track totals + top ops on the
-    device track (largest non-python track)."""
+    """Aggregate the Chrome-trace events into a roofline-attribution table.
+
+    Device-track events carry `bytes_accessed`, `model_flops`, the full
+    HLO `long_name` (shapes + layouts) and the `source` line in this repo,
+    so each hot op reports achieved GB/s and TFLOP/s — the evidence the
+    C<=128 lane-padding ledger needs at op level."""
     paths = glob.glob(
         os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
     )
@@ -112,16 +116,27 @@ def parse_trace(trace_dir: str, top_n: int = 40) -> dict:
                 events.append(ev)
 
     per_track: dict[str, float] = collections.defaultdict(float)
-    per_op: dict[tuple[str, str], list] = collections.defaultdict(
-        lambda: [0.0, 0]
-    )
+    per_op: dict[tuple[str, str], dict] = {}
     for ev in events:
         track = pid_names.get(ev.get("pid"), str(ev.get("pid")))
         dur_ms = float(ev.get("dur", 0)) / 1e3
         per_track[track] += dur_ms
-        acc = per_op[(track, ev.get("name", "?"))]
-        acc[0] += dur_ms
-        acc[1] += 1
+        key = (track, ev.get("name", "?"))
+        acc = per_op.setdefault(
+            key, {"ms": 0.0, "calls": 0, "bytes": 0, "flops": 0, "args": {}}
+        )
+        acc["ms"] += dur_ms
+        acc["calls"] += 1
+        a = ev.get("args", {})
+        acc["bytes"] += int(a.get("bytes_accessed", 0) or 0)
+        acc["flops"] += int(a.get("model_flops", 0) or 0)
+        if not acc["args"] and "long_name" in a:
+            acc["args"] = {
+                "category": a.get("hlo_category", ""),
+                "shape": a.get("shape_with_layout", ""),
+                "source": a.get("source", ""),
+                "long_name": a.get("long_name", "")[:300],
+            }
 
     # the device track: prefer names mentioning TPU/device, else the
     # largest track that isn't the python host thread
@@ -136,9 +151,26 @@ def parse_trace(trace_dir: str, top_n: int = 40) -> dict:
             )
             if "python" not in t.lower()
         ][:1]
+
+    def row(t, n, v):
+        ms = v["ms"]
+        r = {
+            "track": t,
+            "name": n,
+            "total_ms": round(ms, 3),
+            "calls": v["calls"],
+            **{k: x for k, x in v["args"].items() if x},
+        }
+        if ms > 0:
+            if v["bytes"]:
+                r["gb_per_s"] = round(v["bytes"] / 1e9 / (ms / 1e3), 1)
+            if v["flops"]:
+                r["tflop_per_s"] = round(v["flops"] / 1e12 / (ms / 1e3), 1)
+        return r
+
     top = sorted(
         (
-            {"track": t, "name": n, "total_ms": round(v[0], 3), "calls": v[1]}
+            row(t, n, v)
             for (t, n), v in per_op.items()
             # "$file.py:line fn" entries are the python host sampler, not ops
             if t in device_tracks and not n.startswith("$")

@@ -1,0 +1,84 @@
+"""Sweep occupancy-vs-batch probe (round-5 ledger support).
+
+The op-level traces (profiles/sweep_summary.json) show the separate
+sweep's block1-class convs running at 48 TF/s with leading dim 64
+(8 images x 8 projections) while the identical conv reaches 87 TF/s in
+the headline program at leading dim 512.  If that attribution is right,
+the sweep's img/s should scale super-linearly from batch 8 to 32 (more
+images -> bigger per-segment leading dims -> better lane fill).  This
+probe measures the same config-2 program at batch 8/16/32 under the
+fused-sync methodology and prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import jax
+
+    from deconv_api_tpu.bench.suite import tree_checksum
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    spec, params = vgg16_init()
+    fn = get_visualizer(
+        spec, "block5_conv1", 8, "all", True,
+        sweep=True, batched=True, backward_dtype="bfloat16",
+        sweep_merged=False,
+    )
+    step = jax.jit(lambda p, b: tree_checksum(fn(p, b)))
+
+    rows = {}
+    for batch in (8, 16, 32):
+        try:
+            batches = [
+                jax.random.normal(jax.random.PRNGKey(i), (batch, 224, 224, 3))
+                for i in range(4)
+            ]
+            sums = [step(params, b) for b in batches]  # compile + warm
+            for s in sums:
+                float(s)
+            t0 = time.perf_counter()
+            sums = [step(params, b) for b in batches]
+            last = float(sums[-1])
+            dt = (time.perf_counter() - t0) / len(batches)
+            vals = [float(s) for s in sums[:-1]] + [last]
+            assert all(v == v for v in vals)
+            rows[batch] = {
+                "batch_latency_ms": round(dt * 1e3, 1),
+                "images_per_sec": round(batch / dt, 2),
+            }
+        except Exception as e:  # noqa: BLE001 — RESOURCE_EXHAUSTED is the finding
+            msg = str(e)
+            rows[batch] = {
+                "error": "RESOURCE_EXHAUSTED"
+                if "RESOURCE_EXHAUSTED" in msg
+                else msg[:200]
+            }
+        print(f"batch {batch}: {rows[batch]}", file=sys.stderr, flush=True)
+        if "error" in rows[batch]:
+            break  # larger batches only get bigger
+
+    print(
+        json.dumps(
+            {
+                "metric": "VGG16 separate sweep img/s vs batch (fused sync)",
+                "which": "sweep_batch_probe",
+                "per_batch": rows,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
