@@ -285,7 +285,7 @@ def main(argv: list[str] | None = None) -> int:
     s.set_defaults(fn=cmd_train)
 
     s = sub.add_parser("bench", help="run BASELINE benchmark configs")
-    s.add_argument("--config", default="all", help="1-5 or 'all'")
+    s.add_argument("--config", default="all", help="1-6 or 'all'")
     s.set_defaults(fn=cmd_bench)
 
     s = sub.add_parser("models", help="list registered models")
