@@ -39,20 +39,23 @@ def test_autodeconv_illegal_mode():
         autodeconv_visualizer(spec_forward(TINY), "b1c1", mode="nope")
 
 
-def test_autodeconv_sweep_matches_sequential_sweep(tiny_setup):
+@pytest.mark.parametrize("mode", ["all", "max"])
+def test_autodeconv_sweep_matches_sequential_sweep(tiny_setup, mode):
     """The DAG all-layers sweep (one shared forward, one zero-padded vjp
     cotangent per swept layer) vs the sequential engine's sweep in clean
     mode — two independent sweep formulations must agree on every layer,
-    including the pool entry."""
+    including the pool entry, in both visualize modes."""
     from deconv_api_tpu.engine import visualize_all_layers
 
     params, img = tiny_setup
     names = ("b2c1", "b1p", "b1c2", "b1c1")
     fn = autodeconv_visualizer(
-        spec_forward(TINY), "b2c1", top_k=8, sweep_layers=names
+        spec_forward(TINY), "b2c1", top_k=8, mode=mode, sweep_layers=names
     )
     got = fn(params, img)
-    want = visualize_all_layers(TINY, params, img, "b2c1", bug_compat=False)
+    want = visualize_all_layers(
+        TINY, params, img, "b2c1", mode=mode, bug_compat=False
+    )
     assert set(got) == set(want)
     for name in names:
         np.testing.assert_array_equal(
@@ -195,6 +198,41 @@ def test_mobilenet_v2_autodeconv_inverted_residual_path():
 
 
 # -------------------------------------------------------------- InceptionV3
+
+
+def test_inception_v3_autodeconv_branching_path():
+    """Deconv through the inception mixed blocks: the vjp must route
+    cotangents back through CONCATENATED parallel branches (1x1 / factored
+    / pool towers) and the VALID-padded stem — the branching topology no
+    other family exercises.  Includes a two-layer sweep (shared forward,
+    per-layer seeds) across a concat boundary."""
+    from deconv_api_tpu.models.inception_v3 import (
+        inception_v3_forward,
+        inception_v3_init,
+    )
+
+    params = inception_v3_init(jax.random.PRNGKey(0), num_classes=10)
+    img = jax.random.normal(jax.random.PRNGKey(2), (75, 75, 3))
+    single = autodeconv_visualizer(inception_v3_forward, "mixed1", top_k=2)
+    out = single(params, img)
+    assert out["images"].shape == (2, 75, 75, 3)
+    assert bool(jnp.isfinite(out["images"]).all())
+    assert bool(out["valid"].any())
+
+    swept = autodeconv_visualizer(
+        inception_v3_forward, "mixed1", top_k=2,
+        sweep_layers=("mixed1", "mixed0"),
+    )(params, img)
+    assert set(swept) == {"mixed1", "mixed0"}
+    # the swept mixed1 entry must equal the single-layer projection
+    np.testing.assert_array_equal(
+        np.asarray(swept["mixed1"]["indices"]), np.asarray(out["indices"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(swept["mixed1"]["images"]), np.asarray(out["images"]),
+        rtol=1e-4, atol=1e-5,
+    )
+    assert bool(jnp.isfinite(swept["mixed0"]["images"]).all())
 
 
 def test_inception_v3_forward_shapes():
