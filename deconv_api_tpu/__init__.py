@@ -24,7 +24,7 @@ rebuild designed for TPU:
                  dispatcher, image codec, metrics and tracing.
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 # Lazy top-level API: the convenience surface without paying the jax/engine
 # import cost for users who only need, say, the config or codec helpers.
