@@ -37,8 +37,20 @@ def activation_loss(
     return jnp.stack(losses).mean(axis=0)  # (B,)
 
 
-@lru_cache(maxsize=64)
-def _octave_jit(forward_fn, layers: tuple[str, ...], mesh=None):
+# maxsize accounts for the r5 (out_hw, prev_hw) key components: a
+# 10-octave dream holds ~10 entries per (model, layers) config, so 512
+# keeps ~50 dream configs hot.  Total compiled-executable memory is
+# unchanged vs r4 — the per-octave-shape executables previously
+# accumulated inside ONE jit wrapper's internal cache; now they are
+# spread across wrappers where LRU can actually bound them.
+@lru_cache(maxsize=512)
+def _octave_jit(
+    forward_fn,
+    layers: tuple[str, ...],
+    mesh=None,
+    out_hw: tuple[int, int] | None = None,
+    prev_hw: tuple[int, int] | None = None,
+):
     """One jitted program running a full octave of ascent steps, for a
     whole BATCH of independent dreams at once.
 
@@ -49,13 +61,24 @@ def _octave_jit(forward_fn, layers: tuple[str, ...], mesh=None):
     one batched conv chain per step.  At B=1 this is numerically identical
     to the original single-dream form.
 
-    Cached on (forward_fn, layers) only; ``steps`` and ``lr`` are traced
-    arguments so client-chosen values never trigger recompilation (a sweep
-    over lr would otherwise compile a fresh executable per value, per
-    octave shape).  Pair with a stable forward_fn — ModelBundle caches its
-    dream_forward closures for exactly this reason."""
+    With ``out_hw`` the octave-pyramid step is FUSED into the program
+    (r5: profiling showed the dream dispatch-bound, device busy only ~30%
+    of wall over the tunnel — the 3 eager resizes per octave jump each
+    cost a dispatch): the program takes (x, base) and internally resizes
+    x to ``out_hw``, re-injecting the detail base loses between
+    ``prev_hw`` and ``out_hw`` (``prev_hw=None`` = first octave:
+    x := resize(base)).  A 10-octave dream is then exactly 10 device
+    dispatches.  Shapes are static per octave, so the fused form adds no
+    executables beyond the per-octave-shape ones that always existed.
 
-    def run(params, x, steps, lr):
+    Cached on (forward_fn, layers, mesh, hw pair); ``steps`` and ``lr``
+    are traced arguments so client-chosen values never trigger
+    recompilation (a sweep over lr would otherwise compile a fresh
+    executable per value, per octave shape).  Pair with a stable
+    forward_fn — ModelBundle caches its dream_forward closures for
+    exactly this reason."""
+
+    def ascend(params, x, steps, lr):
         def total_loss(xx):
             per_image = activation_loss(forward_fn, params, xx, layers)
             return per_image.sum(), per_image
@@ -75,6 +98,23 @@ def _octave_jit(forward_fn, layers: tuple[str, ...], mesh=None):
         zeros = jnp.zeros((x.shape[0],), x.dtype)
         return jax.lax.fori_loop(0, steps, body, (x, zeros))
 
+    if out_hw is None:
+        run = ascend
+        n_batch_in = 1
+    else:
+
+        def run(params, x, base, steps, lr):
+            if prev_hw is None:
+                x = _resize(base, out_hw)
+            else:
+                lost = _resize(base, out_hw) - _resize(
+                    _resize(base, prev_hw), out_hw
+                )
+                x = _resize(x, out_hw) + lost
+            return ascend(params, x, steps, lr)
+
+        n_batch_in = 2
+
     if mesh is None:
         return jax.jit(run)
     # Mesh-sharded octave program: the dream batch (in and out, losses
@@ -86,21 +126,34 @@ def _octave_jit(forward_fn, layers: tuple[str, ...], mesh=None):
     return jax.jit(
         run,
         in_shardings=(
-            replicated(mesh), batch_sharding(mesh),
-            replicated(mesh), replicated(mesh),
+            (replicated(mesh),)
+            + (batch_sharding(mesh),) * n_batch_in
+            + (replicated(mesh), replicated(mesh))
         ),
         out_shardings=(batch_sharding(mesh), batch_sharding(mesh)),
     )
 
 
 def make_octave_runner(
-    forward_fn, layers: tuple[str, ...], steps: int, lr: float, mesh=None
+    forward_fn,
+    layers: tuple[str, ...],
+    steps: int,
+    lr: float,
+    mesh=None,
+    out_hw: tuple[int, int] | None = None,
+    prev_hw: tuple[int, int] | None = None,
 ):
-    """Bind (steps, lr) over the per-(model, layers) jitted octave program."""
-    fn = _octave_jit(forward_fn, tuple(layers), mesh)
+    """Bind (steps, lr) over the per-(model, layers) jitted octave program.
+
+    Without ``out_hw``: ``fn(params, x)`` runs the ascent at x's own
+    resolution (the library surface).  With it: ``fn(params, x, base)``
+    also performs the fused octave-pyramid step (see _octave_jit)."""
+    fn = _octave_jit(forward_fn, tuple(layers), mesh, out_hw, prev_hw)
     steps = jnp.asarray(steps, jnp.int32)
     lr = jnp.asarray(lr, jnp.float32)
-    return lambda params, x: fn(params, x, steps, lr)
+    if out_hw is None:
+        return lambda params, x: fn(params, x, steps, lr)
+    return lambda params, x, base: fn(params, x, base, steps, lr)
 
 
 def _resize(x: jnp.ndarray, hw: tuple[int, int]) -> jnp.ndarray:
@@ -155,17 +208,18 @@ def deepdream_batch(
     if not shapes:
         shapes = [(h, w)]
 
-    runner = make_octave_runner(
-        forward_fn, tuple(layers), steps_per_octave, lr, mesh
-    )
-
-    x = _resize(base, shapes[0])
+    # The pyramid step (resize + lost-detail reinjection) is fused into
+    # each octave's program: one device dispatch per octave instead of ~4
+    # (r5 profiling: the eager resizes made the dream dispatch-bound over
+    # the tunnel — device busy only ~30% of wall).
+    x = base
     losses = jnp.zeros((base.shape[0],))
     for i, hw in enumerate(shapes):
-        if i > 0:
-            lost_detail = _resize(base, hw) - _resize(_resize(base, shapes[i - 1]), hw)
-            x = _resize(x, hw) + lost_detail
-        x, losses = runner(params, x)
+        runner = make_octave_runner(
+            forward_fn, tuple(layers), steps_per_octave, lr, mesh,
+            out_hw=hw, prev_hw=shapes[i - 1] if i > 0 else None,
+        )
+        x, losses = runner(params, x, base)
     return x, losses
 
 

@@ -64,7 +64,41 @@ def build_sweep():
     return fn, (params, batch)
 
 
-PROGRAMS = {"headline": build_headline, "sweep": build_sweep}
+def build_dream():
+    """Config-3's program shape: InceptionV3 mixed3-5 gradient ascent.
+    The dream is a host loop over per-octave jitted ascent programs, so
+    the trace captures several executables per call — the parser
+    aggregates ops across all of them."""
+    import jax
+    import numpy as np
+
+    from deconv_api_tpu.engine import deepdream
+    from deconv_api_tpu.models.inception_v3 import (
+        inception_v3_forward,
+        inception_v3_init,
+    )
+
+    params = inception_v3_init(jax.random.PRNGKey(0))
+    img = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(1), (299, 299, 3)) * 2 - 1
+    )
+
+    def run(params, img):
+        out, loss = deepdream(
+            inception_v3_forward, params, img,
+            layers=("mixed3", "mixed4", "mixed5"),
+            steps_per_octave=10, num_octaves=10, min_size=75,
+        )
+        return out
+
+    return run, (params, img)
+
+
+PROGRAMS = {
+    "headline": build_headline,
+    "sweep": build_sweep,
+    "dream": build_dream,
+}
 
 
 def capture(tag: str, build, root: str, iters: int) -> tuple[str, float]:
