@@ -4,10 +4,13 @@ A capability extension mandated by BASELINE config 3 (InceptionV3
 mixed3–mixed5, 10 octaves).  The reference has NO DeepDream despite its
 filename (SURVEY §0.2: app/deepdream.py contains zero gradient code).
 
-TPU-first shape: each octave's entire ascent loop is ONE jitted program
-(`lax.fori_loop` over steps, `jax.grad` inside), so a 10-octave dream is 10
-device dispatches total — no per-step host round-trips.  Octave shapes are
-static; the per-shape executables cache across calls.
+TPU-first shape: the ENTIRE multi-octave dream is ONE jitted program —
+every octave's pyramid resize, detail reinjection and ascent loop
+(`lax.fori_loop` over steps, `jax.grad` inside) chain in a single trace,
+so a dream is a single device dispatch with zero per-step or per-octave
+host round-trips.  Octave shapes are static; the whole-dream executable
+caches across calls (the per-octave form survives as the
+`make_octave_runner` library surface).
 """
 
 from __future__ import annotations
@@ -35,6 +38,39 @@ def activation_loss(
         a = acts[name]
         losses.append(jnp.mean(jnp.square(a), axis=tuple(range(1, a.ndim))))
     return jnp.stack(losses).mean(axis=0)  # (B,)
+
+
+def _ascend_builder(forward_fn, layers: tuple[str, ...]):
+    """The gradient-ascent loop shared by the per-octave program and the
+    whole-dream program (one definition, so the two forms cannot drift).
+
+    Per-image decoupling: the differentiated scalar is the SUM of
+    per-image losses (grads decompose per image) and the
+    gradient-magnitude normalisation is per-image — so a batch of B
+    dreams evolves exactly as B separate runs would (bar conv reduction
+    order)."""
+
+    def ascend(params, x, steps, lr):
+        def total_loss(xx):
+            per_image = activation_loss(forward_fn, params, xx, layers)
+            return per_image.sum(), per_image
+
+        loss_grad = jax.value_and_grad(total_loss, has_aux=True)
+
+        def body(_, carry):
+            x, _losses = carry
+            (_total, per_image), g = loss_grad(x)
+            # per-image gradient-magnitude normalisation keeps lr scale-free
+            # across octaves/layers (standard DeepDream practice) AND keeps
+            # batched dreams independent of their batch-mates
+            norm = jnp.mean(jnp.abs(g), axis=tuple(range(1, g.ndim)), keepdims=True)
+            g = g / (norm + 1e-8)
+            return x + lr.astype(x.dtype) * g, per_image
+
+        zeros = jnp.zeros((x.shape[0],), x.dtype)
+        return jax.lax.fori_loop(0, steps, body, (x, zeros))
+
+    return ascend
 
 
 # maxsize accounts for the r5 (out_hw, prev_hw) key components: a
@@ -78,25 +114,7 @@ def _octave_jit(
     forward_fn — ModelBundle caches its dream_forward closures for
     exactly this reason."""
 
-    def ascend(params, x, steps, lr):
-        def total_loss(xx):
-            per_image = activation_loss(forward_fn, params, xx, layers)
-            return per_image.sum(), per_image
-
-        loss_grad = jax.value_and_grad(total_loss, has_aux=True)
-
-        def body(_, carry):
-            x, _losses = carry
-            (_total, per_image), g = loss_grad(x)
-            # per-image gradient-magnitude normalisation keeps lr scale-free
-            # across octaves/layers (standard DeepDream practice) AND keeps
-            # batched dreams independent of their batch-mates
-            norm = jnp.mean(jnp.abs(g), axis=tuple(range(1, g.ndim)), keepdims=True)
-            g = g / (norm + 1e-8)
-            return x + lr.astype(x.dtype) * g, per_image
-
-        zeros = jnp.zeros((x.shape[0],), x.dtype)
-        return jax.lax.fori_loop(0, steps, body, (x, zeros))
+    ascend = _ascend_builder(forward_fn, layers)
 
     if out_hw is None:
         run = ascend
@@ -104,14 +122,9 @@ def _octave_jit(
     else:
 
         def run(params, x, base, steps, lr):
-            if prev_hw is None:
-                x = _resize(base, out_hw)
-            else:
-                lost = _resize(base, out_hw) - _resize(
-                    _resize(base, prev_hw), out_hw
-                )
-                x = _resize(x, out_hw) + lost
-            return ascend(params, x, steps, lr)
+            return ascend(
+                params, _pyramid_step(x, base, out_hw, prev_hw), steps, lr
+            )
 
         n_batch_in = 2
 
@@ -162,6 +175,56 @@ def _resize(x: jnp.ndarray, hw: tuple[int, int]) -> jnp.ndarray:
     )
 
 
+def _pyramid_step(x, base, out_hw, prev_hw):
+    """One octave-pyramid jump: upscale the dreamed image to ``out_hw``,
+    re-injecting the detail ``base`` loses between ``prev_hw`` and
+    ``out_hw`` (``prev_hw=None`` = first octave: just downsample base).
+    The ONE definition shared by the per-octave program and the
+    whole-dream program, so the reinjection formula cannot drift between
+    the two forms."""
+    if prev_hw is None:
+        return _resize(base, out_hw)
+    lost = _resize(base, out_hw) - _resize(_resize(base, prev_hw), out_hw)
+    return _resize(x, out_hw) + lost
+
+
+@lru_cache(maxsize=128)
+def _dream_jit(
+    forward_fn,
+    layers: tuple[str, ...],
+    shapes: tuple[tuple[int, int], ...],
+    mesh=None,
+):
+    """The ENTIRE multi-octave dream as ONE jitted program (r5, second
+    step of the dispatch-fusion work): every octave's pyramid step and
+    ascent loop chain inside a single trace, so a whole dream — any
+    octave count — is exactly one device dispatch and one executable
+    (vs 10 per-octave executables; the per-octave form remains as the
+    library's `make_octave_runner` surface).  Octave shapes are a static
+    tuple in the cache key; `steps`/`lr` stay traced arguments."""
+    ascend = _ascend_builder(forward_fn, layers)
+
+    def run(params, base, steps, lr):
+        x = base
+        for i, hw in enumerate(shapes):
+            x = _pyramid_step(x, base, hw, shapes[i - 1] if i > 0 else None)
+            x, losses = ascend(params, x, steps, lr)
+        return x, losses
+
+    if mesh is None:
+        return jax.jit(run)
+    from deconv_api_tpu.parallel.mesh import batch_sharding, replicated
+
+    return jax.jit(
+        run,
+        in_shardings=(
+            replicated(mesh), batch_sharding(mesh),
+            replicated(mesh), replicated(mesh),
+        ),
+        out_shardings=(batch_sharding(mesh), batch_sharding(mesh)),
+    )
+
+
 def deepdream_batch(
     forward_fn,
     params,
@@ -208,19 +271,18 @@ def deepdream_batch(
     if not shapes:
         shapes = [(h, w)]
 
-    # The pyramid step (resize + lost-detail reinjection) is fused into
-    # each octave's program: one device dispatch per octave instead of ~4
-    # (r5 profiling: the eager resizes made the dream dispatch-bound over
-    # the tunnel — device busy only ~30% of wall).
-    x = base
-    losses = jnp.zeros((base.shape[0],))
-    for i, hw in enumerate(shapes):
-        runner = make_octave_runner(
-            forward_fn, tuple(layers), steps_per_octave, lr, mesh,
-            out_hw=hw, prev_hw=shapes[i - 1] if i > 0 else None,
-        )
-        x, losses = runner(params, x, base)
-    return x, losses
+    # The WHOLE pyramid — every octave's resize + detail reinjection +
+    # ascent loop — is one jitted program: a dream is ONE device dispatch
+    # and one executable (r5 profiling found the dream dispatch-bound:
+    # device busy ~30% of wall over the tunnel with per-octave dispatches
+    # and eager resizes).
+    fn = _dream_jit(forward_fn, tuple(layers), tuple(shapes), mesh)
+    return fn(
+        params,
+        base,
+        jnp.asarray(steps_per_octave, jnp.int32),
+        jnp.asarray(lr, jnp.float32),
+    )
 
 
 def deepdream(
