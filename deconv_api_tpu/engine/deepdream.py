@@ -201,7 +201,14 @@ def _dream_jit(
     octave count — is exactly one device dispatch and one executable
     (vs 10 per-octave executables; the per-octave form remains as the
     library's `make_octave_runner` surface).  Octave shapes are a static
-    tuple in the cache key; `steps`/`lr` stay traced arguments."""
+    tuple in the cache key; `steps`/`lr` stay traced arguments.
+
+    Compile-surface trade (accepted): per-octave executables were shared
+    across octave COUNTS (an n-octave ladder is a suffix of the
+    n+1-octave ladder); the whole-dream program compiles once per
+    distinct shape tuple instead.  The serving route clamps octaves to
+    [1, 16] (app.py), so the executable count stays bounded and each
+    compile fits the dream timeout."""
     ascend = _ascend_builder(forward_fn, layers)
 
     def run(params, base, steps, lr):
