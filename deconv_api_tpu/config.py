@@ -38,6 +38,12 @@ class ServerConfig:
     # surface and the compile is large; the XLA persistent cache makes it
     # one-time either way.
     warmup_sweep: bool = False
+    # Also compile the default whole-dream program at startup: since r5 a
+    # dream is ONE jitted program (engine/deepdream.py:_dream_jit), so the
+    # first /v1/dream request otherwise pays the full multi-octave compile
+    # (~minute over a remote tunnel) inside its dream_timeout_s window.
+    # Off by default for the same reason as warmup_sweep.
+    warmup_dream: bool = False
     request_timeout_s: float = 60.0
     dream_timeout_s: float = 300.0  # dreams run minutes; own queue + timeout
     # Layer sweeps project ~13x a single-layer request and compile a large

@@ -38,6 +38,11 @@ from deconv_api_tpu.serving.http import HttpServer, Request, Response
 from deconv_api_tpu.serving.metrics import Metrics
 from deconv_api_tpu.utils.tracing import stage
 
+# /v1/dream's parameter defaults, shared by the route and warmup_dream so
+# the warmed whole-dream program (whose _dream_jit cache key depends on
+# the octave count) can never drift from what a default request compiles.
+_DREAM_DEFAULTS = {"steps": 10, "octaves": 10, "lr": 0.01}
+
 
 class DeconvService:
     """Owns the model bundle, the dispatcher and the HTTP routes."""
@@ -395,6 +400,30 @@ class DeconvService:
                  "tiles", True),
                 [img] * self._bucket_for(1),
             )
+        if self.cfg.warmup_dream and self.bundle.dream_layers:
+            # the whole-dream program (r5: one executable per octave
+            # ladder) is the route's largest compile; warm the DEFAULT
+            # request shape (the shared _DREAM_DEFAULTS the route uses)
+            # so first dreams serve inside their window — every dream
+            # bucket under warmup_all_buckets, else just the first
+            if self.cfg.warmup_all_buckets:
+                dream_sizes = sorted(
+                    {
+                        self._round_to_dp(pad_bucket(n, self.cfg.dream_max_batch))
+                        for n in range(1, self.cfg.dream_max_batch + 1)
+                    }
+                )
+            else:
+                dream_sizes = [self._round_to_dp(pad_bucket(1, self.cfg.dream_max_batch))]
+            for size in dream_sizes:
+                self._run_batch(
+                    (
+                        "__dream__", self.bundle.dream_layers,
+                        _DREAM_DEFAULTS["steps"], _DREAM_DEFAULTS["octaves"],
+                        _DREAM_DEFAULTS["lr"],
+                    ),
+                    [img] * size,
+                )
         self.ready = True
 
     # ----------------------------------------------------------- pipeline
@@ -640,9 +669,9 @@ class DeconvService:
                     f"model {self.bundle.name!r} has no default dream layers; "
                     "pass 'layers' explicitly"
                 )
-            steps = int(form.get("steps", 10))
-            octaves = int(form.get("octaves", 10))
-            lr = float(form.get("lr", 0.01))
+            steps = int(form.get("steps", _DREAM_DEFAULTS["steps"]))
+            octaves = int(form.get("octaves", _DREAM_DEFAULTS["octaves"]))
+            lr = float(form.get("lr", _DREAM_DEFAULTS["lr"]))
             if not 1 <= steps <= 100 or not 1 <= octaves <= 16:
                 raise errors.BadRequest("steps must be in [1,100], octaves in [1,16]")
             if steps * octaves > 500:

@@ -853,6 +853,38 @@ def test_sigterm_graceful_shutdown():
             proc.kill()
 
 
+def test_warmup_dream_precompiles_dream_program():
+    """cfg.warmup_dream compiles the default whole-dream program at
+    startup (r5: a dream is ONE executable, so the first /v1/dream
+    otherwise pays the full multi-octave compile in its own window); a
+    default-parameter dream request then rides the warmed program."""
+    from deconv_api_tpu.engine.deepdream import _dream_jit
+
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=2,
+        warmup_all_buckets=False,
+        warmup_dream=True,
+        compilation_cache_dir="",
+    )
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    svc = DeconvService(cfg, spec=TINY, params=params)
+    svc.bundle.dream_layers = ("b2c1",)
+    with ServiceFixture(cfg, service=svc) as s:
+        s.service.warmup()
+        misses_before = _dream_jit.cache_info().misses
+        r = httpx.post(
+            s.base_url + "/v1/dream",
+            data={"file": _data_url(0)},
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        assert _dream_jit.cache_info().misses == misses_before, (
+            "default dream request built a NEW whole-dream program "
+            "despite warmup_dream"
+        )
+
+
 def test_warmup_sweep_precompiles_sweep_program():
     """cfg.warmup_sweep compiles the all-layers sweep program at startup,
     so the first sweep request doesn't pay the large compile inside its
