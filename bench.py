@@ -26,6 +26,15 @@ timeout; (b) the parent traps SIGTERM/SIGINT/SIGALRM and emits the error
 JSON line on the spot, so even a mis-sized external timeout (which sends
 SIGTERM first) still yields a parseable artifact.
 
+Round-6 methodology (VERDICT r5 item 1): the timed loop runs as
+best-of-N measurement windows (`DECONV_BENCH_WINDOWS`, default 3) and
+reports the MAX — identical-config same-day tunnel runs span more than
+any knob's A/B delta, so a single window hands the scoreboard to tunnel
+weather; the max is the least-interfered observation of a fixed
+computation.  Every window's rate is logged to stderr and emitted in the
+JSON line (`windows_img_s`).  Each window uses fresh random inputs so a
+content-addressed relay cache can never serve a later window.
+
 Timing methodology: `jax.block_until_ready` does not reliably await remote
 execution over the axon tunnel (observed returning in ~0.1 ms for work that
 measurably takes ~70 ms), so the run is synchronized by fetching a 4-byte
@@ -402,11 +411,11 @@ def main_child(force_cpu: bool) -> None:
             for i in range(n)
         ]
 
-    batches = make_batches(iters, 0)
     # Under donation every fn() call DELETES its input buffer, so the
     # warmup (and later the breakdown loop) must not share arrays with the
-    # timed loop — reuse-after-donation raises.
-    warm_batch = make_batches(1, 9000)[0] if donate else batches[0]
+    # timed loops — reuse-after-donation raises.  Fresh everywhere keeps
+    # both modes on one path.
+    warm_batch = make_batches(1, 9000)[0]
 
     t0 = time.perf_counter()
     val = float(step(params, warm_batch))
@@ -420,19 +429,41 @@ def main_child(force_cpu: bool) -> None:
     trace_cm = (
         profile_trace(cfg.profile_dir) if cfg.profile_dir else nullcontext()
     )
-    with trace_cm:
-        t0 = time.perf_counter()
-        sums = [step(params, b) for b in batches]
-        last = float(sums[-1])  # one in-timer fetch: covers all executions
-        dt = time.perf_counter() - t0
-    vals = [float(s) for s in sums[:-1]] + [last]  # post-timer validation
-    assert all(math.isfinite(v) for v in vals), "non-finite checksum"
-    images_per_sec = batch * iters / dt
+    # Best-of-N measurement windows (VERDICT r5 item 1): identical-config
+    # same-day tunnel runs span 11.0-16.7 req/s — worse than any knob's
+    # A/B delta — so a single window hands the scoreboard to tunnel
+    # weather.  N short windows, report the MAX (the least-interfered
+    # observation of the same fixed computation; means average the noise
+    # IN), log every window for the tail.  Fresh inputs per window so a
+    # content-addressed relay cache can never serve a later window.
+    windows = max(1, int(os.environ.get("DECONV_BENCH_WINDOWS", "3")))
+    window_rates: list[float] = []
+    dt = None
+    for w in range(windows):
+        wbatches = make_batches(iters, 10_000 * (w + 1))
+        with (trace_cm if w == 0 else nullcontext()):
+            t0 = time.perf_counter()
+            sums = [step(params, b) for b in wbatches]
+            last = float(sums[-1])  # one in-timer fetch: covers all executions
+            dt_w = time.perf_counter() - t0
+        vals = [float(s) for s in sums[:-1]] + [last]  # post-timer validation
+        assert all(math.isfinite(v) for v in vals), "non-finite checksum"
+        rate = batch * iters / dt_w
+        window_rates.append(rate)
+        log(
+            f"window {w + 1}/{windows}: {iters} iters x batch {batch} (fwd "
+            f"{cfg.dtype}, bwd {cfg.backward_dtype or cfg.dtype}): "
+            f"{dt_w:.3f}s -> {rate:.1f} img/s"
+        )
+        if dt is None or dt_w < dt:
+            dt = dt_w
+    images_per_sec = max(window_rates)
     ms_per_batch = dt / iters * 1e3
     log(
-        f"{iters} iters x batch {batch} (fwd {cfg.dtype}, bwd "
-        f"{cfg.backward_dtype or cfg.dtype}): {dt:.3f}s -> "
-        f"{images_per_sec:.1f} img/s, {ms_per_batch:.1f} ms/batch"
+        f"best of {windows} windows: {images_per_sec:.1f} img/s, "
+        f"{ms_per_batch:.1f} ms/batch (all: "
+        + ", ".join(f"{r:.1f}" for r in window_rates)
+        + ")"
     )
 
     # --- FLOPs / MFU (dtype-split, VERDICT r2 item 2) ---
@@ -450,7 +481,12 @@ def main_child(force_cpu: bool) -> None:
     # still reported as mfu_pct_conservative to bracket that case.
     # cost-analyse the program the timer actually ran (in fused mode `fn`
     # alone was never compiled; lowering it would trigger a fresh compile)
-    program_flops = _compiled_flops(step if fused_sync else fn, params, batches[0])
+    # abstract example batch: under donation the concrete arrays are all
+    # deleted by the runs above, but lower() only needs avals
+    flops_example = jax.ShapeDtypeStruct((batch, 224, 224, 3), dtype)
+    program_flops = _compiled_flops(
+        step if fused_sync else fn, params, flops_example
+    )
     if program_flops is None:
         try:
             from deconv_api_tpu.bench.flops import vgg16_deconv_flops
@@ -526,8 +562,8 @@ def main_child(force_cpu: bool) -> None:
             def fstep(p, b):
                 return checksum(fwd_b(p, b))
 
-        # the timed loop donated (deleted) `batches` when donation is on
-        bd_batches = make_batches(iters, 9500) if donate else batches
+        # fresh arrays: the timed windows donated (deleted) theirs
+        bd_batches = make_batches(iters, 9500)
         float(fstep(params, bd_batches[0]))  # compile
         t0 = time.perf_counter()
         fsums = [fstep(params, b) for b in bd_batches]
@@ -551,6 +587,9 @@ def main_child(force_cpu: bool) -> None:
         "vs_baseline": round(images_per_sec / NORTH_STAR_IMG_S, 3),
         "platform": platform,
         "sync": "fused" if fused_sync else "two-program",
+        # best-of-N methodology (BASELINE.md): value is the max window;
+        # the full set is the honesty tail
+        "windows_img_s": [round(r, 2) for r in window_rates],
     }
     if not on_tpu:
         if "--cpu-reason=tpu_unavailable" in sys.argv:

@@ -71,6 +71,30 @@ class ServerConfig:
     # window is wide because dreams run for seconds anyway.
     dream_max_batch: int = 4
     dream_window_ms: float = 50.0
+    # --- host I/O pipeline (round 6: serving/codec_pool.py) ---
+    # Codec worker pool: decodes request payloads and encodes response
+    # JPEGs off the event loop on persistent daemon threads.  0 workers =
+    # auto (min(8, cpu/2)); codec_queue_depth bounds queued-or-running
+    # codec jobs — the bound is the decode/encode stages' backpressure.
+    codec_workers: int = 0
+    codec_queue_depth: int = 256
+    # Payloads at or under this many bytes decode INLINE on the event
+    # loop: a pool handoff costs two loop hops + worker wakeup (~5 ms of
+    # latency at high concurrency, measured round 6) which dwarfs a
+    # small image's decode; large payloads still decode off-loop.  0
+    # sends everything to the pool.
+    codec_inline_bytes: int = 16384
+    # Reusable host staging buffers per padded batch shape: batch N+1
+    # assembles into a different buffer than in-flight batch N (the
+    # double-buffered input ring behind donation).  >= 2; 3 leaves one
+    # spare for the fetch tail.
+    input_ring_depth: int = 3
+    # Donate the input batch buffer into the jitted visualizer/dream
+    # programs (jax.jit donate_argnums): the device reuses the input's
+    # memory for outputs instead of holding both live.  Numerically
+    # inert (parity pinned by tests/test_donation_parity.py); 0 is the
+    # escape hatch if a backend mishandles aliasing.
+    donate_inputs: bool = True
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

@@ -41,6 +41,7 @@ def autodeconv_visualizer(
     top_k: int = 8,
     mode: str = "all",
     sweep_layers: tuple[str, ...] | None = None,
+    donate: bool = False,
 ):
     """Build a jitted ``fn(params, image) -> {images, indices, sums, valid}``.
 
@@ -55,9 +56,19 @@ def autodeconv_visualizer(
     entry per swept layer — the DAG analog of the sequential engine's
     all-layers sweep (reference app/deepdream.py:441-474), from one shared
     forward pass.
+
+    ``donate=True`` donates the image argument's device buffer into the
+    program (outputs may reuse its memory; the caller's array is
+    invalidated).  Numerically inert — the serving layer's donation
+    happens at its own outer jit (serving/models.py), so this flag only
+    matters for direct library use.
     """
     if mode not in ("all", "max"):
         raise ValueError(f"illegal visualize mode {mode!r}; expected 'all' or 'max'")
+    if donate:
+        from deconv_api_tpu.engine.deconv import allow_unusable_donation
+
+        allow_unusable_donation()
     names = tuple(sweep_layers) if sweep_layers else (layer,)
 
     def single(params, image):
@@ -107,4 +118,4 @@ def autodeconv_visualizer(
             return results[layer]
         return results
 
-    return jax.jit(single)
+    return jax.jit(single, donate_argnums=(1,) if donate else ())

@@ -460,6 +460,31 @@ def _sweep_merged(
     return results
 
 
+_DONATION_WARNING_FILTERED = False
+
+
+def allow_unusable_donation() -> None:
+    """The visualizer's outputs are uint8 presentations + int32 indices —
+    a donated fp32 input batch can never alias an output, so jax warns
+    'Some donated buffers were not usable' on every donating compile.
+    The donation is still wanted (the input frees as the program consumes
+    it instead of living to program completion — the HBM-pressure case
+    bench.py's DECONV_BENCH_DONATE probes), so the warning is pure noise
+    for these programs; filter it narrowly.  Idempotent via a module
+    flag: filterwarnings appends a fresh entry per call (its dedup
+    compares compiled regexes by identity), and this runs on the serving
+    hot path."""
+    global _DONATION_WARNING_FILTERED
+    if _DONATION_WARNING_FILTERED:
+        return
+    import warnings
+
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
+    )
+    _DONATION_WARNING_FILTERED = True
+
+
 def get_visualizer(
     spec: ModelSpec,
     layer_name: str,
@@ -474,6 +499,7 @@ def get_visualizer(
     nchw_chan: int | None = None,
     sweep_chunk: int | None = None,
     fwd_lowc_bf16: int | None = None,
+    donate: bool = False,
 ):
     """Build (and cache) the jitted visualizer for a static configuration.
 
@@ -495,6 +521,11 @@ def get_visualizer(
     sweep has no packed tail).  Env vars are resolved
     HERE, outside the cache, so changing them between calls always takes
     effect (the cache never keys on a stale environment read).
+    ``donate=True`` donates the image/batch argument's device buffer into
+    the program (``jax.jit`` ``donate_argnums``): outputs may reuse the
+    input's memory, so the CALLER'S array is invalidated by the call —
+    numerically inert (tests/test_donation_parity.py), and the serving
+    dispatcher always passes freshly staged batches.
     """
     import os
 
@@ -543,7 +574,7 @@ def get_visualizer(
     return _get_visualizer_cached(
         spec, layer_name, top_k, mode, bug_compat, sweep, batched,
         backward_dtype, kpack_chan, bool(sweep_merged), nchw_chan,
-        sweep_chunk, fwd_lowc_bf16,
+        sweep_chunk, fwd_lowc_bf16, donate,
     )
 
 
@@ -562,7 +593,10 @@ def _get_visualizer_cached(
     nchw_chan: int = 0,
     sweep_chunk: int = 0,
     fwd_lowc_bf16: int = 0,
+    donate: bool = False,
 ):
+    if donate:
+        allow_unusable_donation()
     if mode not in ("all", "max"):
         # The reference sys.exit()s the server here (app/deepdream.py:458-460);
         # we raise instead (error taxonomy, SURVEY §5).
@@ -647,7 +681,7 @@ def _get_visualizer_cached(
             fn = vm
     else:
         fn = single
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(1,) if donate else ())
 
 
 def get_forward_only(spec: ModelSpec, layer_name: str, top_k: int = 8,

@@ -194,6 +194,7 @@ def _dream_jit(
     layers: tuple[str, ...],
     shapes: tuple[tuple[int, int], ...],
     mesh=None,
+    donate: bool = False,
 ):
     """The ENTIRE multi-octave dream as ONE jitted program (r5, second
     step of the dispatch-fusion work): every octave's pyramid step and
@@ -208,7 +209,18 @@ def _dream_jit(
     n+1-octave ladder); the whole-dream program compiles once per
     distinct shape tuple instead.  The serving route clamps octaves to
     [1, 16] (app.py), so the executable count stays bounded and each
-    compile fits the dream timeout."""
+    compile fits the dream timeout.
+
+    ``donate=True`` donates ``base``'s device buffer into the program
+    (the dreamed output may reuse its memory; the caller's array is
+    invalidated).  deepdream_batch threads the serving config's flag
+    through; library callers default to non-donating."""
+    if not shapes:
+        # an empty ladder would leave `losses` unbound in run()'s loop —
+        # a latent trace-time NameError (ADVICE r5); fail loudly instead.
+        # deepdream_batch guards its own shapes, but _dream_jit is an
+        # independently cached entry point.
+        raise ValueError("shapes must be non-empty")
     ascend = _ascend_builder(forward_fn, layers)
 
     def run(params, base, steps, lr):
@@ -218,8 +230,9 @@ def _dream_jit(
             x, losses = ascend(params, x, steps, lr)
         return x, losses
 
+    donate_argnums = (1,) if donate else ()
     if mesh is None:
-        return jax.jit(run)
+        return jax.jit(run, donate_argnums=donate_argnums)
     from deconv_api_tpu.parallel.mesh import batch_sharding, replicated
 
     return jax.jit(
@@ -229,6 +242,7 @@ def _dream_jit(
             replicated(mesh), replicated(mesh),
         ),
         out_shardings=(batch_sharding(mesh), batch_sharding(mesh)),
+        donate_argnums=donate_argnums,
     )
 
 
@@ -244,9 +258,15 @@ def deepdream_batch(
     octave_scale: float = 1.4,
     min_size: int = 75,
     mesh=None,
+    donate: bool = False,
 ):
     """Run multi-octave DeepDream on a (B, H, W, C) batch of independent
     images; returns (dreamed batch (B, H, W, C), final-octave losses (B,)).
+
+    ``donate=True`` donates the batch's device buffer into the whole-dream
+    program (serving passes its configured policy); the caller's ``images``
+    array must not be reused after the call when it is already a device
+    array.
 
     With ``mesh``, each octave program runs dp-sharded over the mesh (B
     must be a multiple of the dp axis; the serving dispatcher rounds its
@@ -283,7 +303,7 @@ def deepdream_batch(
     # and one executable (r5 profiling found the dream dispatch-bound:
     # device busy ~30% of wall over the tunnel with per-octave dispatches
     # and eager resizes).
-    fn = _dream_jit(forward_fn, tuple(layers), tuple(shapes), mesh)
+    fn = _dream_jit(forward_fn, tuple(layers), tuple(shapes), mesh, donate)
     return fn(
         params,
         base,

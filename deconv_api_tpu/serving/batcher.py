@@ -19,6 +19,28 @@ device while batch N's results stream back.  `pipeline_depth` caps
 dispatched-but-unfetched batches; depth 1 restores the serial
 dispatch->fetch->resolve loop.  Worker threads keep the event loop free in
 both modes.
+
+Round 6 generalised the depth-2 overlap into a THREE-STAGE pipeline:
+
+- collect: the `_run` loop drains the submit queue GREEDILY with
+  `get_nowait` before waiting out the straggler window.  The r5 loopback
+  probe showed the old per-item `wait_for(queue.get(), ...)` drain paying
+  one event-loop scheduling latency PER ITEM — under load it collected
+  ~3 items per window while ~50 sat in the queue, so every batch ran far
+  under max_batch and requests crossed a near-empty-looking queue in
+  ~190 ms.
+- dispatch: collected batches move through a BOUNDED handoff queue to a
+  dedicated dispatch-stage task, so the collect loop never blocks on a
+  device dispatch (or its pipeline-depth permit) and keeps draining while
+  the device works.  The bound is the backpressure: when the device falls
+  behind, the handoff queue fills, collection stalls, queue depth grows,
+  and the load-shed estimator reacts.
+- fetch/encode: unchanged — bounded fetch tasks materialise results while
+  later batches dispatch; JPEG encode happens in the routes on the codec
+  worker pool (serving/codec_pool.py).
+
+Queue-depth gauges (`collect_queue_depth`, `dispatch_queue_depth`,
+`inflight_batches`) are published through Metrics at each stage boundary.
 """
 
 from __future__ import annotations
@@ -123,11 +145,36 @@ class BatchingDispatcher:
         self._fetch_tasks: set[asyncio.Task] = set()
         self._last_done: float | None = None  # cadence observation anchor
         self._stopping = False
+        # Three-stage handoff (round 6): collected batches queue here for
+        # the dispatch-stage task.  The bound is the pipeline's
+        # backpressure — when the device is behind, put() blocks the
+        # collect loop, the submit queue grows, and the shed estimator
+        # sees the depth.
+        self._dispatch_q: asyncio.Queue[list[WorkItem]] = asyncio.Queue(
+            maxsize=max(1, pipeline_depth)
+        )
+        self._dispatch_task: asyncio.Task | None = None
+        self._staged = 0  # items handed to the dispatch stage, not yet dispatched
+        # One PERSISTENT dispatch worker thread (vs a fresh daemon thread
+        # per batch): device dispatch is a short async enqueue, so thread
+        # spawn + first-schedule latency dominated it.  Per-dispatcher, so
+        # one stream's first-use compile (an unwarmed sweep program) can
+        # never stall another's dispatches.  Fetches keep thread-per-call
+        # — a wedged device_get must only ever wedge its own thread.
+        self._dispatch_worker = None
 
     async def start(self) -> None:
         if self._task is None:
             self._stopping = False  # allow a stop() -> start() restart cycle
             self._task = asyncio.create_task(self._run(), name="batch-dispatcher")
+            if self._dispatch_runner is not None:
+                if self._dispatch_worker is None:
+                    from deconv_api_tpu.serving.codec_pool import WorkerPool
+
+                    self._dispatch_worker = WorkerPool(1, name="dispatch")
+                self._dispatch_task = asyncio.create_task(
+                    self._dispatch_stage(), name="batch-dispatch-stage"
+                )
 
     async def stop(self, grace_s: float = 10.0) -> None:
         # Reject new submits immediately: a request racing stop() could
@@ -141,6 +188,28 @@ class BatchingDispatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self._dispatch_task is not None:
+            # Cancel the dispatch stage AFTER the collect loop so nothing
+            # new enters the handoff queue; _execute_pipelined's own
+            # cancellation handling fails the in-flight group's futures.
+            self._dispatch_task.cancel()
+            try:
+                await self._dispatch_task
+            except asyncio.CancelledError:
+                pass
+            self._dispatch_task = None
+        if self._dispatch_worker is not None:
+            self._dispatch_worker.close()
+            self._dispatch_worker = None  # start() builds a fresh one
+        # Batches still staged in the handoff queue were never dispatched:
+        # fail them now or they hang to a full request-timeout 504.
+        while not self._dispatch_q.empty():
+            for item in self._dispatch_q.get_nowait():
+                self._staged -= 1
+                if not item.future.done():
+                    item.future.set_exception(
+                        errors.Unavailable("server shutting down")
+                    )
         if self._fetch_tasks:
             # Bounded drain: a wedged remote device_get HANGS rather than
             # raises (documented backend failure mode), and an unbounded
@@ -182,7 +251,9 @@ class BatchingDispatcher:
         back to compute_p50 before any sustained load has been seen."""
         if self._metrics is None:
             return 0.0
-        depth = self._queue.qsize()
+        # staged items (collected, waiting in the dispatch handoff queue)
+        # are work ahead of a new arrival exactly like queued ones
+        depth = self._queue.qsize() + self._staged
         if depth == 0:
             return 0.0
         p50 = self._metrics.cadence_p50()
@@ -224,22 +295,102 @@ class BatchingDispatcher:
                 f"no result within {self._timeout_s:.0f}s (device saturated?)"
             ) from None
 
+    def _drain_nowait(self, batch: list[WorkItem]) -> None:
+        """Move everything already queued into ``batch`` (up to max_batch)
+        without touching the event loop.  The old per-item
+        ``wait_for(get, ...)`` drain paid one loop-scheduling latency PER
+        ITEM — under load that collected ~3 items per window while ~50 sat
+        in the queue (round-6 loopback diagnosis), capping every batch far
+        below max_batch."""
+        while len(batch) < self._max_batch:
+            try:
+                batch.append(self._queue.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+
     async def _run(self) -> None:
         while True:
             first = await self._queue.get()
             batch = [first]
-            deadline = time.perf_counter() + self._window_s
-            while len(batch) < self._max_batch:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(
-                        await asyncio.wait_for(self._queue.get(), remaining)
+            self._drain_nowait(batch)
+            if self._dispatch_runner is not None:
+                await self._collect_and_stage(batch)
+            else:
+                # serial mode: the straggler window waits per item (the
+                # pre-round-6 behaviour; depth<=1 is the compatibility
+                # fallback, not the hot path)
+                if len(batch) < self._max_batch and self._window_s > 0:
+                    deadline = time.perf_counter() + self._window_s
+                    while len(batch) < self._max_batch:
+                        remaining = deadline - time.perf_counter()
+                        if remaining <= 0:
+                            break
+                        try:
+                            batch.append(
+                                await asyncio.wait_for(
+                                    self._queue.get(), remaining
+                                )
+                            )
+                        except asyncio.TimeoutError:
+                            break
+                        self._drain_nowait(batch)
+                await self._execute(batch)
+
+    async def _collect_and_stage(self, batch: list[WorkItem]) -> None:
+        """Pipelined collect: adaptive straggler window + bounded handoff.
+
+        The window is WORK-CONSERVING: when the pipeline is idle the batch
+        dispatches immediately (waiting would leave the device idle for
+        nothing); when batches are in flight, one sleep() lets stragglers
+        accumulate — a single loop hop for the whole window, where the old
+        per-item ``wait_for`` drain paid a scheduling latency per item.
+        If the device falls further behind, the bounded put blocks the
+        collect loop and the next greedy drain picks up everything that
+        arrived meanwhile — batch size tracks load automatically."""
+        busy = self._inflight > 0 or not self._dispatch_q.empty()
+        if (
+            busy
+            and len(batch) < max(1, self._max_batch // 2)
+            and self._window_s > 0
+        ):
+            # under-filled batch while the device works: one window's
+            # sleep lets stragglers accumulate.  A batch already at half
+            # of max_batch has amortised the fixed per-dispatch cost —
+            # waiting longer would only add latency.
+            await asyncio.sleep(self._window_s)
+            self._drain_nowait(batch)
+        if self._metrics is not None:
+            self._metrics.set_gauge("collect_queue_depth", self._queue.qsize())
+        self._staged += len(batch)
+        try:
+            await self._dispatch_q.put(batch)
+        except asyncio.CancelledError:
+            # stop() interrupts the handoff: these items left the submit
+            # queue, so the stop() drain cannot fail them
+            self._staged -= len(batch)
+            for item in batch:
+                if not item.future.done():
+                    item.future.set_exception(
+                        errors.Unavailable("server shutting down")
                     )
-                except asyncio.TimeoutError:
-                    break
-            await self._execute(batch)
+            raise
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "dispatch_queue_depth", self._dispatch_q.qsize()
+            )
+
+    async def _dispatch_stage(self) -> None:
+        """Stage 2: pull collected batches off the handoff queue and
+        dispatch them (in collection order — one stage task, so device
+        dispatch order is preserved) while the collect loop keeps
+        draining."""
+        while True:
+            batch = await self._dispatch_q.get()
+            self._staged -= len(batch)
+            groups: dict[Any, list[WorkItem]] = {}
+            for item in batch:
+                groups.setdefault(item.key, []).append(item)
+            await self._execute_pipelined(groups)
 
     async def _execute(self, batch: list[WorkItem]) -> None:
         groups: dict[Any, list[WorkItem]] = {}
@@ -308,10 +459,8 @@ class BatchingDispatcher:
                 await self._fetch_sem.acquire()
                 t0 = time.perf_counter()
                 try:
-                    thunk = await _to_daemon_thread(
-                        lambda key=key, images=images: self._dispatch_runner(
-                            key, images
-                        )
+                    thunk = await self._dispatch_worker.run(
+                        self._dispatch_runner, key, images
                     )
                 except asyncio.CancelledError:
                     self._fetch_sem.release()  # held permit must not leak
@@ -383,6 +532,7 @@ class BatchingDispatcher:
                 compute_s=now - t0,
                 queue_s=t0 - min(it.enqueued_at for it in items),
             )
+            self._metrics.set_gauge("inflight_batches", self._inflight)
             # Cadence is only meaningful between completions under
             # SUSTAINED load; going idle clears the anchor, else the next
             # burst's first completion would record the whole idle gap as

@@ -1,0 +1,239 @@
+"""Bounded codec worker pool + reusable host staging buffers (round 6).
+
+The loopback probe (tools/loopback_load.py) put the serving machinery at
+~2 ms/request — the device is no longer the production bottleneck, the
+host is.  Two host-side building blocks live here:
+
+- ``WorkerPool``: a small pool of PERSISTENT daemon worker threads with a
+  bounded pending-job count.  Routes hand JPEG decode and encode jobs to
+  it instead of ``asyncio.to_thread`` — no per-call thread spawn, no
+  unbounded default-executor queue, and the pending bound gives the
+  three-stage pipeline its backpressure (a submit backlog surfaces as
+  awaiting ``run()`` callers + a queue-depth gauge, not silent memory
+  growth).  Daemon threads keep the documented hang-not-raise backend
+  failure mode from blocking interpreter exit, same rationale as the
+  batcher's ``_to_daemon_thread``.
+
+- ``HostBufferRing``: reusable host staging buffers for device batch
+  assembly.  The dispatcher assembles every padded batch into a ring
+  buffer instead of a fresh ``np.stack`` allocation; with the batch
+  buffer DONATED into the jitted program (serving/models.py), batch N+1's
+  host assembly overlaps batch N's device execution on stable storage —
+  the double-buffered input ring.  ``jnp.asarray`` copies host memory
+  into the device buffer, so reuse is race-free by construction; the
+  ring's win is allocator pressure, not aliasing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import queue
+import threading
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+
+class PoolClosed(RuntimeError):
+    """Job submitted to a closed WorkerPool."""
+
+
+def _default_workers() -> int:
+    return max(2, min(8, (os.cpu_count() or 4) // 2))
+
+
+class WorkerPool:
+    """Persistent daemon-thread pool with bounded pending jobs.
+
+    ``run(fn, *args)`` awaits the job's result; at most ``max_pending``
+    jobs may be queued-or-running — excess ``run()`` callers wait on the
+    bound (backpressure), which is exactly the signal the serving
+    pipeline wants to propagate back to the HTTP layer.  Jobs are
+    processed FIFO; ``map`` preserves input order in its results.
+    """
+
+    def __init__(
+        self,
+        workers: int = 0,
+        *,
+        max_pending: int = 0,
+        name: str = "codec",
+        metrics=None,
+    ):
+        self.workers = workers if workers > 0 else _default_workers()
+        self.max_pending = max_pending if max_pending > 0 else self.workers * 32
+        self._name = name
+        self._metrics = metrics
+        self._jobs: queue.SimpleQueue = queue.SimpleQueue()
+        self._sem: asyncio.Semaphore | None = None
+        self._depth = 0  # queued-or-running jobs (the queue-depth gauge)
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._threads = [
+            threading.Thread(
+                target=self._work, daemon=True, name=f"{name}-worker-{i}"
+            )
+            for i in range(self.workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------ internals
+
+    def _work(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            fn, args, loop, fut = job
+            try:
+                result = fn(*args)
+            except BaseException as e:  # noqa: BLE001 — relayed to the future
+                if loop is None:  # concurrent.futures (map_sync) job
+                    fut.set_exception(e)
+                else:
+                    self._post(loop, fut, fut.set_exception, e)
+            else:
+                if loop is None:
+                    fut.set_result(result)
+                else:
+                    self._post(loop, fut, fut.set_result, result)
+
+    @staticmethod
+    def _post(loop, fut, setter, value) -> None:
+        def resolve():
+            if not fut.cancelled():
+                setter(value)
+
+        try:
+            loop.call_soon_threadsafe(resolve)
+        except RuntimeError:  # loop already closed (teardown races)
+            pass
+
+    def _gauge(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(f"{self._name}_queue_depth", self._depth)
+
+    # ------------------------------------------------------------- surface
+
+    async def run(self, fn: Callable[..., Any], *args: Any) -> Any:
+        """Run ``fn(*args)`` on a pool worker; awaits (and bounds) the job."""
+        if self._closed:
+            raise PoolClosed(f"worker pool {self._name!r} is closed")
+        if self._sem is None:
+            # created lazily so the pool can be constructed off-loop
+            self._sem = asyncio.Semaphore(self.max_pending)
+        await self._sem.acquire()
+        self._depth += 1
+        self._gauge()
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        with self._close_lock:
+            if self._closed:  # close() raced the await above
+                self._depth -= 1
+                self._gauge()
+                self._sem.release()
+                raise PoolClosed(f"worker pool {self._name!r} is closed")
+            self._jobs.put((fn, args, loop, fut))
+        try:
+            return await fut
+        finally:
+            self._depth -= 1
+            self._gauge()
+            self._sem.release()
+
+    async def map(self, fn: Callable[[Any], Any], items: Iterable[Any]) -> list:
+        """Run ``fn`` over ``items`` concurrently; results in input order."""
+        return await asyncio.gather(*(self.run(fn, item) for item in items))
+
+    def map_sync(self, fn: Callable[[Any], Any], items: list) -> list:
+        """Thread-caller form of ``map``: fan ``fn`` over ``items`` across
+        the pool and BLOCK for the ordered results.  Used by the batch
+        fetch thread to parallelise a batch's per-request JPEG encodes
+        without an event-loop round trip.  Bypasses the async pending
+        bound (the caller is itself a bounded pipeline stage); falls back
+        to inline execution once the pool is closed."""
+        import concurrent.futures
+
+        futs = []
+        # under the close lock: a close() racing this enqueue could
+        # otherwise land jobs BEHIND the shutdown sentinels, where no
+        # worker would ever run them and f.result() would block forever
+        with self._close_lock:
+            if self._closed or not items:
+                return [fn(item) for item in items]
+            for item in items:
+                f: concurrent.futures.Future = concurrent.futures.Future()
+                self._jobs.put((fn, (item,), None, f))
+                futs.append(f)
+        return [f.result() for f in futs]
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Stop accepting jobs and let the workers drain out.  Idempotent;
+        jobs already queued still complete (daemon threads never block
+        interpreter exit regardless).  Serialised with map_sync's enqueue
+        (the close lock) so no job can land behind a shutdown sentinel."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            for _ in self._threads:
+                self._jobs.put(None)
+
+
+class HostBufferRing:
+    """Reusable host staging buffers for padded device batches.
+
+    ``acquire(shape, dtype)`` hands out a free buffer (allocating when
+    none is free — never blocks, so a leak on an error path costs one
+    allocation, not a deadlock); ``release`` returns it, retaining at
+    most ``depth`` buffers per (shape, dtype) so steady-state serving
+    cycles through stable storage.  With depth >= 2 the dispatcher
+    assembles batch N+1 into a different buffer than in-flight batch N —
+    the double-buffering the donation path relies on.  The dispatcher
+    releases a buffer only after the batch's results are materialised
+    (device execution complete), so a slot is never refilled while its
+    batch could still be consuming it.
+    """
+
+    def __init__(self, depth: int = 3):
+        self.depth = max(1, depth)
+        self._lock = threading.Lock()
+        self._free: dict[tuple, list[np.ndarray]] = {}
+
+    @staticmethod
+    def _key(shape, dtype) -> tuple:
+        return (tuple(int(s) for s in shape), np.dtype(dtype).str)
+
+    def acquire(self, shape, dtype=np.float32) -> np.ndarray:
+        key = self._key(shape, dtype)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                return free.pop()
+        return np.empty(shape, dtype)
+
+    def release(self, buf: np.ndarray) -> None:
+        key = self._key(buf.shape, buf.dtype)
+        with self._lock:
+            free = self._free.setdefault(key, [])
+            if len(free) < self.depth:
+                free.append(buf)
+
+    def assemble(self, images: list, bucket: int) -> np.ndarray:
+        """Stack ``images`` into an acquired ``(bucket, *image.shape)``
+        buffer, padding the tail with the last image (the dispatcher's
+        bucket-padding rule).  Caller must ``release`` the returned
+        buffer once the batch's device execution has completed."""
+        first = np.asarray(images[0])
+        buf = self.acquire((bucket,) + first.shape, first.dtype)
+        for i, img in enumerate(images):
+            buf[i] = img
+        if bucket > len(images):
+            buf[len(images):] = np.asarray(images[-1])
+        return buf

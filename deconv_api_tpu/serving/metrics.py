@@ -60,6 +60,7 @@ class Metrics:
         self._cadence = _Reservoir()
         self._queue_wait = _Reservoir()
         self._stage: dict[str, _Reservoir] = {}
+        self._gauges: dict[str, float] = {}
 
     def observe_request(self, latency_s: float, error_code: str | None = None) -> None:
         with self._lock:
@@ -111,6 +112,13 @@ class Metrics:
         with self._lock:
             self._stage.setdefault(stage, _Reservoir()).add(seconds)
 
+    def set_gauge(self, name: str, value: float) -> None:
+        """Instantaneous pipeline-state gauges (queue depths, inflight
+        batches — round 6's three-stage pipeline observability).  Updated
+        at stage boundaries by the dispatcher and the codec worker pool."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
     def snapshot(self) -> dict:
         with self._lock:
             up = time.time() - self._started
@@ -131,6 +139,7 @@ class Metrics:
                     k: {"p50_s": r.quantile(0.5), "p99_s": r.quantile(0.99)}
                     for k, r in self._stage.items()
                 },
+                "gauges": dict(self._gauges),
             }
 
     def prometheus(self) -> str:
@@ -172,4 +181,12 @@ class Metrics:
             lines.append(
                 f'{p}_stage_seconds{{stage="{stage}",quantile="0.5"}} {q["p50_s"]:.6f}'
             )
+            lines.append(
+                f'{p}_stage_seconds{{stage="{stage}",quantile="0.99"}} {q["p99_s"]:.6f}'
+            )
+        # pipeline-state gauges (round 6): collect/dispatch queue depths,
+        # inflight batches, codec-pool pending jobs
+        for name, v in sorted(s["gauges"].items()):
+            lines.append(f"# TYPE {p}_{name} gauge")
+            lines.append(f"{p}_{name} {v:g}")
         return "\n".join(lines) + "\n"

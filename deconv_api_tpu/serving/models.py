@@ -54,11 +54,17 @@ class ModelBundle:
             # Record the acts dict's INSERTION order during tracing —
             # reading keys off eval_shape's return value would be wrong:
             # jax pytree flattening sorts dict keys, which is not forward
-            # order for names like mixed10 or conv_pw_13_relu.
+            # order for names like mixed10 or conv_pw_13_relu.  Trace with
+            # the SAME rules the visualizer's forward runs under
+            # (DECONV_RULES): if a family ever exposes rule-dependent
+            # activation names, the sweep layer set must match what the
+            # visualizer can actually seed (ADVICE r5).
+            from deconv_api_tpu.models.blocks import DECONV_RULES
+
             order: list[str] = []
 
             def capture(p, x):
-                _, acts = self.forward_fn(p, x)
+                _, acts = self.forward_fn(p, x, rules=DECONV_RULES)
                 order.extend(acts)
                 return 0.0
 
@@ -67,6 +73,14 @@ class ModelBundle:
             )
             jax.eval_shape(capture, self.params, dummy)
             known = set(self.layer_names)
+            missing = [n for n in self.layer_names if n not in set(order)]
+            if missing:
+                raise ValueError(
+                    f"model {self.name!r}: projectable layer(s) {missing} "
+                    f"missing from the traced activation order {order} — "
+                    "layer_names and the forward's named activations have "
+                    "drifted apart"
+                )
             names = [n for n in order if n in known]
         return tuple(reversed(names[: names.index(layer) + 1]))
 
@@ -114,6 +128,7 @@ class ModelBundle:
         backward_dtype: str | None = None,
         post: str | None = None,
         sweep: bool = False,
+        donate: bool = False,
     ):
         """fn(params, batch) -> {layer: {..., indices, sums, valid}} —
         jitted once per static configuration and cached.  ``bug_compat``
@@ -139,10 +154,25 @@ class ModelBundle:
         explicit opt-in; the result dict then carries one entry per
         projected layer.  Sequential specs walk their D-layer chain; DAG
         models share one forward across per-layer vjp seeds
-        (engine/autodeconv.py)."""
+        (engine/autodeconv.py).
+
+        ``donate=True`` donates the batch argument's device buffer into
+        the program at THIS outer jit boundary (inner-jit donation would
+        be ignored once the trace inlines), covering both engine
+        families: outputs may reuse the input's memory, so the dispatcher
+        must pass freshly staged batches (it does — the input ring,
+        serving/codec_pool.py).  Inactive under a mesh
+        (shard_batched_fn owns that jit boundary)."""
         if self.spec is None:
             backward_dtype = None
-        key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep)
+        if self.mesh is not None:
+            donate = False  # sharded jit boundary; donation not threaded
+        if donate:
+            from deconv_api_tpu.engine.deconv import allow_unusable_donation
+
+            allow_unusable_donation()
+        key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep,
+               donate)
         if key not in self._vis_cache:
             if self.spec is not None:
                 # On a dp mesh the merged-sweep batch chunking must stay
@@ -176,7 +206,7 @@ class ModelBundle:
 
                 fn = shard_batched_fn(fn, self.mesh)
             else:
-                fn = jax.jit(fn)
+                fn = jax.jit(fn, donate_argnums=(1,) if donate else ())
             self._vis_cache[key] = fn
         return self._vis_cache[key]
 

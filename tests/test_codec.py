@@ -87,6 +87,23 @@ def test_encode_data_url_wire_format(rng):
     assert raw[:2] == b"\xff\xd8"  # actually JPEG, as in the reference
 
 
+def test_encode_quote_matches_urllib(rng):
+    """The round-6 C-level percent-quote (two bytes.replace calls) must be
+    byte-identical to the reference's urllib quote() over the base64
+    alphabet — the wire-parity pin behind the fast path."""
+    from urllib.parse import quote
+
+    for seed in range(8):
+        img = (
+            np.random.default_rng(seed).random((16, 16, 3)) * 255
+        ).astype(np.uint8)
+        url = codec.encode_data_url(img)
+        fast_quoted = url.split(",", 1)[1]
+        raw = base64.b64decode(unquote(fast_quoted))
+        reference = quote(base64.b64encode(raw).decode("ascii"))
+        assert fast_quoted == reference
+
+
 def test_device_postprocess_matches_host_reference():
     """stitch_grid_device/deprocess_tiles_device must match the NumPy path
     (same truncating uint8 cast, same stitch-then-deprocess order)."""

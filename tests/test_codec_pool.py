@@ -1,0 +1,206 @@
+"""serving/codec_pool.py: the bounded codec worker pool and the host
+buffer ring (round 6's host I/O pipeline building blocks) — ordering,
+error propagation, backpressure, sync fan-out, and ring reuse/retention."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deconv_api_tpu.serving.codec_pool import (
+    HostBufferRing,
+    PoolClosed,
+    WorkerPool,
+)
+
+
+def test_map_preserves_input_order():
+    """Results come back in input order even when earlier items take
+    longer than later ones (4 workers racing)."""
+    pool = WorkerPool(4)
+
+    def job(i):
+        time.sleep(0.02 if i % 2 == 0 else 0.001)  # evens finish LAST
+        return i * 10
+
+    async def go():
+        return await pool.map(job, list(range(12)))
+
+    assert asyncio.run(go()) == [i * 10 for i in range(12)]
+    pool.close()
+
+
+def test_run_propagates_errors_and_pool_survives():
+    pool = WorkerPool(2)
+
+    def boom():
+        raise RuntimeError("codec exploded")
+
+    async def go():
+        with pytest.raises(RuntimeError, match="codec exploded"):
+            await pool.run(boom)
+        # the worker that relayed the error keeps serving
+        return await pool.run(lambda: "ok")
+
+    assert asyncio.run(go()) == "ok"
+    pool.close()
+
+
+def test_map_propagates_first_error():
+    pool = WorkerPool(2)
+
+    def job(i):
+        if i == 3:
+            raise ValueError("bad tile")
+        return i
+
+    async def go():
+        with pytest.raises(ValueError, match="bad tile"):
+            await pool.map(job, range(6))
+
+    asyncio.run(go())
+    pool.close()
+
+
+def test_backpressure_bounds_pending_jobs():
+    """max_pending bounds queued-or-running jobs: excess run() callers
+    wait for a slot instead of growing the queue without limit."""
+    pool = WorkerPool(1, max_pending=2)
+    gate = threading.Event()
+    in_flight = []
+
+    def job(i):
+        in_flight.append(i)
+        gate.wait(5)
+        return i
+
+    async def go():
+        tasks = [asyncio.create_task(pool.run(job, i)) for i in range(5)]
+        await asyncio.sleep(0.3)
+        # 1 running + 1 queued admitted; the other three waited on the bound
+        assert pool._depth <= 2
+        assert len(in_flight) == 1  # single worker: one actually running
+        gate.set()
+        return await asyncio.gather(*tasks)
+
+    assert asyncio.run(go()) == [0, 1, 2, 3, 4]
+    pool.close()
+
+
+def test_closed_pool_rejects_jobs():
+    pool = WorkerPool(1)
+    pool.close()
+    pool.close()  # idempotent
+
+    async def go():
+        with pytest.raises(PoolClosed):
+            await pool.run(lambda: 1)
+
+    asyncio.run(go())
+
+
+def test_map_sync_from_worker_thread():
+    """The batch fetch thread fans per-request encodes through map_sync
+    (ordered, blocking) without touching any event loop."""
+    pool = WorkerPool(4)
+
+    def encode(i):
+        time.sleep(0.001)
+        return f"jpeg-{i}"
+
+    result = {}
+
+    def fetch_thread():
+        result["out"] = pool.map_sync(encode, list(range(8)))
+
+    t = threading.Thread(target=fetch_thread)
+    t.start()
+    t.join(10)
+    assert result["out"] == [f"jpeg-{i}" for i in range(8)]
+    # after close, map_sync degrades to inline execution
+    pool.close()
+    assert pool.map_sync(encode, [1, 2]) == ["jpeg-1", "jpeg-2"]
+
+
+def test_map_sync_propagates_errors():
+    pool = WorkerPool(2)
+
+    def job(i):
+        if i == 1:
+            raise RuntimeError("encode failed")
+        return i
+
+    with pytest.raises(RuntimeError, match="encode failed"):
+        pool.map_sync(job, [0, 1, 2])
+    pool.close()
+
+
+def test_gauge_tracks_depth():
+    class FakeMetrics:
+        def __init__(self):
+            self.values = []
+
+        def set_gauge(self, name, value):
+            self.values.append((name, value))
+
+    m = FakeMetrics()
+    pool = WorkerPool(2, name="codec", metrics=m)
+
+    async def go():
+        await pool.run(lambda: 1)
+
+    asyncio.run(go())
+    names = {n for n, _ in m.values}
+    assert names == {"codec_queue_depth"}
+    assert any(v >= 1 for _, v in m.values)  # saw the job pending
+    assert m.values[-1][1] == 0  # and its completion
+    pool.close()
+
+
+# --------------------------------------------------------------- buffer ring
+
+
+def test_ring_reuses_released_buffers():
+    ring = HostBufferRing(depth=2)
+    a = ring.acquire((4, 8, 8, 3), np.float32)
+    ring.release(a)
+    b = ring.acquire((4, 8, 8, 3), np.float32)
+    assert b is a  # same storage, no fresh allocation
+    c = ring.acquire((4, 8, 8, 3), np.float32)
+    assert c is not a  # a is handed out; a second acquire allocates
+
+
+def test_ring_retention_bounded():
+    ring = HostBufferRing(depth=2)
+    bufs = [ring.acquire((2, 2), np.float32) for _ in range(5)]
+    for b in bufs:
+        ring.release(b)
+    key = ring._key((2, 2), np.float32)
+    assert len(ring._free[key]) == 2  # retains at most `depth`
+
+
+def test_ring_keys_on_shape_and_dtype():
+    ring = HostBufferRing(depth=2)
+    a = ring.acquire((2, 2), np.float32)
+    ring.release(a)
+    b = ring.acquire((2, 2), np.uint8)
+    assert b is not a and b.dtype == np.uint8
+
+
+def test_assemble_pads_with_last_image():
+    ring = HostBufferRing(depth=2)
+    imgs = [np.full((3, 3, 3), i, np.float32) for i in range(3)]
+    buf = ring.assemble(imgs, bucket=8)
+    assert buf.shape == (8, 3, 3, 3)
+    for i in range(3):
+        np.testing.assert_array_equal(buf[i], imgs[i])
+    for i in range(3, 8):
+        np.testing.assert_array_equal(buf[i], imgs[-1])
+    ring.release(buf)
+    # the reused buffer assembles a fresh batch without ghosts of the old
+    imgs2 = [np.full((3, 3, 3), 9, np.float32)] * 2
+    buf2 = ring.assemble(imgs2, bucket=8)
+    assert buf2 is buf
+    np.testing.assert_array_equal(buf2[7], imgs2[-1])

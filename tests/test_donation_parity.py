@@ -1,0 +1,122 @@
+"""Donation safety: donating the input batch buffer into the jitted
+programs (round 6) must be numerically INERT — donated and non-donated
+programs produce identical bits across the deconv, sweep, and dream
+paths.  The dream path (fp32 image out, same shape as the donated base)
+additionally proves the donation is real by observing the consumed
+buffer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deconv_api_tpu.engine import get_visualizer
+from deconv_api_tpu.engine.deepdream import _dream_jit, deepdream_batch
+from deconv_api_tpu.models.apply import spec_forward
+from deconv_api_tpu.models.spec import init_params
+from tests.test_engine_parity import TINY
+
+
+def _tree_equal(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(TINY, jax.random.PRNGKey(7))
+    batch = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(8), (2, 16, 16, 3)) * 2 - 1
+    )
+    return params, batch
+
+
+def test_sequential_visualizer_donation_parity(setup):
+    params, batch = setup
+    plain = get_visualizer(TINY, "b2c1", 4, "all", True, batched=True)
+    donating = get_visualizer(
+        TINY, "b2c1", 4, "all", True, batched=True, donate=True
+    )
+    ref = plain(params, jnp.asarray(batch))
+    got = donating(params, jnp.asarray(batch))
+    _tree_equal(ref, got)
+    # NOTE: no invalidation assert here — the visualizer's outputs are
+    # uint8/int32, so no output can alias the fp32 input and the backend
+    # may decline the donation (jax's "not usable" case); parity is the
+    # contract, donation an allowed optimisation.
+
+
+def test_sequential_sweep_donation_parity(setup):
+    params, batch = setup
+    plain = get_visualizer(TINY, "b2c1", 4, "all", True, sweep=True, batched=True)
+    donating = get_visualizer(
+        TINY, "b2c1", 4, "all", True, sweep=True, batched=True, donate=True
+    )
+    _tree_equal(
+        plain(params, jnp.asarray(batch)),
+        donating(params, jnp.asarray(batch)),
+    )
+
+
+def test_autodeconv_donation_parity(setup):
+    from deconv_api_tpu.engine import autodeconv_visualizer
+
+    params, batch = setup
+    fwd = spec_forward(TINY)
+    plain = autodeconv_visualizer(fwd, "b2c1", top_k=4)
+    donating = autodeconv_visualizer(fwd, "b2c1", top_k=4, donate=True)
+    _tree_equal(
+        plain(params, jnp.asarray(batch[0])),
+        donating(params, jnp.asarray(batch[0])),
+    )
+
+
+def test_serving_visualizer_donation_parity(setup):
+    """The serving-level jit (batched_visualizer, where donation actually
+    runs in production) — donated vs non-donated byte-identical through
+    the fused grid postprocess."""
+    from deconv_api_tpu.serving.models import spec_bundle
+
+    params, batch = setup
+    bundle = spec_bundle(TINY, params)
+    plain = bundle.batched_visualizer("b2c1", "all", 4, True, None, "grid")
+    donating = bundle.batched_visualizer(
+        "b2c1", "all", 4, True, None, "grid", donate=True
+    )
+    _tree_equal(
+        plain(params, jnp.asarray(batch)),
+        donating(params, jnp.asarray(batch)),
+    )
+
+
+def test_dream_donation_parity():
+    params = init_params(TINY, jax.random.PRNGKey(7))
+    fwd = spec_forward(TINY.truncated("b2c1"))
+    img = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(9), (2, 16, 16, 3)) * 2 - 1,
+        np.float32,
+    )
+    kwargs = dict(
+        layers=("b2c1",), steps_per_octave=2, num_octaves=2, min_size=8
+    )
+    out_a, loss_a = deepdream_batch(fwd, params, img, **kwargs)
+    out_b, loss_b = deepdream_batch(fwd, params, img, donate=True, **kwargs)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+    np.testing.assert_array_equal(np.asarray(loss_a), np.asarray(loss_b))
+    # the dreamed fp32 output aliases the donated fp32 base, so here the
+    # donation is REAL: a device-array input is consumed by the call
+    x = jnp.asarray(img)
+    deepdream_batch(fwd, params, x, donate=True, **kwargs)
+    with pytest.raises(RuntimeError):
+        _ = x + 1
+
+
+def test_dream_jit_empty_shapes_raises():
+    """ADVICE r5: an empty octave ladder must fail loudly at build time,
+    not as a latent trace-time NameError."""
+    fwd = spec_forward(TINY.truncated("b2c1"))
+    with pytest.raises(ValueError, match="shapes must be non-empty"):
+        _dream_jit(fwd, ("b2c1",), ())

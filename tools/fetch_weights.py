@@ -227,6 +227,13 @@ def main() -> int:
     )
     args = ap.parse_args()
 
+    if args.sha256 and args.model == "all":
+        # one pin cannot match six different files — every per-model fetch
+        # after the first would fail spuriously against it (ADVICE r5)
+        ap.error(
+            "--sha256 pins a single model's file and cannot be combined "
+            "with model=all; fetch models individually to pin them"
+        )
     names = sorted(MANIFEST) if args.model == "all" else [args.model]
     for name in names:
         if name not in MANIFEST:

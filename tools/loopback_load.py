@@ -12,6 +12,17 @@ regime where drift cannot mask it.
 Prints one JSON row per pipeline_depth; append to
 bench_suite_results.jsonl via tools/run_experiments.py
 (`loopback:tool/loopback_load.py`) or redirect by hand.
+
+Usage: python tools/loopback_load.py [--passes N] [--no-donate] [depth ...]
+
+`--passes N` runs N measurement passes per depth and reports the best
+(all passes carried in `passes_req_s` — the bench.py best-of-N
+methodology); `--no-donate` disables input-buffer donation for a
+donation on/off A/B.  Round 6 rebuilt the serving host path this probe
+measures (greedy queue drain, three-stage collect/dispatch/encode
+pipeline, codec worker pool, inline small-payload decode, fused batch
+encode, donated+ring-buffered batch staging); the r5 rows in
+bench_suite_results.jsonl are the pre-pipeline record.
 """
 
 from __future__ import annotations
@@ -28,7 +39,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def run_load(pipeline_depth: int, n_requests: int = 512, concurrency: int = 64) -> dict:
+def run_load(
+    pipeline_depth: int,
+    n_requests: int = 512,
+    concurrency: int = 64,
+    passes: int = 1,
+    donate: bool = True,
+) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -63,6 +80,7 @@ def run_load(pipeline_depth: int, n_requests: int = 512, concurrency: int = 64) 
         warmup_all_buckets=True,
         compilation_cache_dir="",
         platform="cpu",
+        donate_inputs=donate,
     )
     service = DeconvService(cfg, spec=spec, params=params)
 
@@ -84,9 +102,8 @@ def run_load(pipeline_depth: int, n_requests: int = 512, concurrency: int = 64) 
         port = await service.start(host="127.0.0.1", port=0)
         await asyncio.to_thread(service.warmup, "c3")
         sem = asyncio.Semaphore(concurrency)
-        latencies: list[float] = []
 
-        async def one(i: int):
+        async def one(i: int, latencies: list[float]):
             body = urllib.parse.urlencode(
                 {"file": uris[i % len(uris)], "layer": "c3"}
             ).encode()
@@ -107,13 +124,23 @@ def run_load(pipeline_depth: int, n_requests: int = 512, concurrency: int = 64) 
                 latencies.append(time.perf_counter() - t0)
                 assert b" 200 " in raw.split(b"\r\n", 1)[0], raw[:120]
 
-        t0 = time.perf_counter()
-        await asyncio.gather(*(one(i) for i in range(n_requests)))
-        wall = time.perf_counter() - t0
+        # Best-of-N passes (the bench.py round-6 methodology): one pass is
+        # hostage to scheduler/allocator weather; run N, report the max,
+        # carry every pass in the row.  Latency quantiles come from the
+        # best pass (the one the headline rate describes).
+        runs = []
+        for _ in range(max(1, passes)):
+            latencies: list[float] = []
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(one(i, latencies) for i in range(n_requests))
+            )
+            wall = time.perf_counter() - t0
+            runs.append((wall, sorted(latencies)))
         snap = service.metrics.snapshot()
         await service.stop()
-        lat = sorted(latencies)
-        return {
+        wall, lat = min(runs, key=lambda r: r[0])
+        row = {
             "which": f"loopback_cpu_depth{pipeline_depth}",
             "platform": "cpu-loopback",
             "requests": n_requests,
@@ -121,6 +148,7 @@ def run_load(pipeline_depth: int, n_requests: int = 512, concurrency: int = 64) 
             "pipeline_depth": pipeline_depth,
             "wall_s": round(wall, 3),
             "requests_per_sec": round(n_requests / wall, 1),
+            "passes_req_s": [round(n_requests / w, 1) for w, _ in runs],
             "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
             "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 2),
             "per_request_overhead_ms": round(wall / n_requests * 1e3, 3),
@@ -135,16 +163,35 @@ def run_load(pipeline_depth: int, n_requests: int = 512, concurrency: int = 64) 
                     k: round(v["p50_s"] * 1e3, 2)
                     for k, v in snap["stages"].items()
                 },
+                "gauges": snap["gauges"],
             },
         }
+        if not donate:
+            row["which"] += "_nodonate"
+            row["donate_inputs"] = False
+        return row
 
     return asyncio.run(drive())
 
 
 def main() -> int:
-    depths = [int(x) for x in (sys.argv[1:] or ["2", "1"])]
-    for d in depths:
-        row = run_load(d)
+    args = sys.argv[1:]
+    passes = 1
+    donate = True
+    depths: list[int] = []
+    i = 0
+    while i < len(args):
+        if args[i] == "--passes":
+            passes = int(args[i + 1])
+            i += 2
+        elif args[i] == "--no-donate":
+            donate = False
+            i += 1
+        else:
+            depths.append(int(args[i]))
+            i += 1
+    for d in depths or [2, 1]:
+        row = run_load(d, passes=passes, donate=donate)
         print(json.dumps(row), flush=True)
     return 0
 

@@ -66,9 +66,11 @@ def build_sweep():
 
 def build_dream():
     """Config-3's program shape: InceptionV3 mixed3-5 gradient ascent.
-    The dream is a host loop over per-octave jitted ascent programs, so
-    the trace captures several executables per call — the parser
-    aggregates ops across all of them."""
+    Since round 5 the ENTIRE multi-octave dream is ONE jitted executable
+    (engine/deepdream.py:_dream_jit — every octave's pyramid step and
+    ascent loop chain in a single trace), so the trace captures a single
+    large program per call; the parser's cross-executable aggregation
+    still applies to the warmup compile's artifacts."""
     import jax
     import numpy as np
 
