@@ -32,6 +32,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
         val = getattr(args, flag, None)
         if val is not None:
             argv += [f"--{flag}", str(val)]
+    for flag in ("cache_bytes", "cache_ttl_s"):
+        val = getattr(args, flag, None)
+        if val is not None:
+            argv += [f"--{flag.replace('_', '-')}", str(val)]
+    if getattr(args, "no_singleflight", False):
+        argv += ["--no-singleflight"]
     serve_main(argv)
     return 0
 
@@ -236,6 +242,18 @@ def main(argv: list[str] | None = None) -> int:
     s = sub.add_parser("serve", help="run the HTTP service")
     s.add_argument("--host", default=None)
     s.add_argument("--port", type=int, default=None)
+    s.add_argument(
+        "--cache-bytes", type=int, default=None, dest="cache_bytes",
+        help="response cache byte budget (0 disables; default 256 MiB)",
+    )
+    s.add_argument(
+        "--cache-ttl-s", type=float, default=None, dest="cache_ttl_s",
+        help="positive cache entry TTL in seconds (0 = until evicted)",
+    )
+    s.add_argument(
+        "--no-singleflight", action="store_true",
+        help="disable duplicate-request coalescing",
+    )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)
 

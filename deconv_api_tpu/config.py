@@ -95,6 +95,26 @@ class ServerConfig:
     # inert (parity pinned by tests/test_donation_parity.py); 0 is the
     # escape hatch if a backend mishandles aliasing.
     donate_inputs: bool = True
+    # --- response cache + singleflight (round 7: serving/cache.py) ---
+    # Content-addressed response cache: final encoded payloads keyed by a
+    # digest of (model, route, canonical params, raw image bytes).  A hit
+    # skips decode, device dispatch and encode entirely.  Byte budget for
+    # resident payloads; 0 disables the cache (the escape hatch).
+    cache_bytes: int = 256 * 1024 * 1024
+    # Positive-entry TTL.  0 = entries live until LRU-evicted (responses
+    # are pure functions of the key, so expiry is a freshness policy for
+    # operators who hot-swap weights in place, not a correctness need).
+    cache_ttl_s: float = 0.0
+    # Deterministic 4xxs (unknown layer, bad knobs, undecodable image)
+    # are negative-cached this long so retry loops stop paying the form
+    # parse + validation walk.  0 disables negative caching.
+    cache_negative_ttl_s: float = 2.0
+    cache_shards: int = 8  # LRU shards (per-shard lock + budget slice)
+    # Coalesce concurrent IDENTICAL misses onto one in-flight request:
+    # N duplicates in flight -> exactly 1 decode/dispatch/encode, N
+    # responses.  Works with or without the cache; off restores
+    # independent execution.
+    singleflight: bool = True
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

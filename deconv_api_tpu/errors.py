@@ -64,15 +64,36 @@ class ModelNotReady(DeconvError):
 class Overloaded(DeconvError):
     """Queue drain estimate exceeds the request timeout: shedding now with
     an immediate 503 beats making every excess caller wait out the full
-    timeout for a guaranteed 504 (serving/batcher.py:submit)."""
+    timeout for a guaranteed 504 (serving/batcher.py:submit).
+
+    Carries the drain estimate that triggered the shed so the HTTP layer
+    can emit an actionable ``Retry-After`` header — backoff guidance
+    derived from the queue's actual state, not a magic constant."""
 
     status = 503
     code = "overloaded"
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class RequestTimeout(DeconvError):
     status = 504
     code = "request_timeout"
+
+
+def code_from_body(body: bytes) -> str | None:
+    """Best-effort machine error code out of a JSON error payload (the
+    {"error": code, "detail": ...} shape every route emits).  One place
+    for the cache's negative entries and the coalesced-waiter accounting
+    to share."""
+    import json
+
+    try:
+        return json.loads(body).get("error")
+    except (ValueError, AttributeError):
+        return None
 
 
 class Unavailable(DeconvError):

@@ -34,6 +34,11 @@ from deconv_api_tpu import errors
 from deconv_api_tpu.config import ServerConfig, apply_platform, enable_compilation_cache
 from deconv_api_tpu.serving import codec
 from deconv_api_tpu.serving.batcher import BatchingDispatcher, pad_bucket
+from deconv_api_tpu.serving.cache import (
+    ResponseCache,
+    Singleflight,
+    canonical_digest,
+)
 from deconv_api_tpu.serving.codec_pool import HostBufferRing, WorkerPool
 from deconv_api_tpu.serving.http import HttpServer, Request, Response
 from deconv_api_tpu.serving.metrics import Metrics
@@ -175,6 +180,42 @@ class DeconvService:
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
         )
+        # Content-addressed response cache + singleflight (round 7,
+        # serving/cache.py): every compute response is a pure function of
+        # (model, route, canonical params, raw image bytes), so the final
+        # encoded payload is cached under that digest — a hit skips
+        # decode, device dispatch, and encode, and never touches the
+        # batcher.  The key prefix folds in every response-determining
+        # server setting, so a config change can never serve stale bytes.
+        self.cache = (
+            ResponseCache(
+                self.cfg.cache_bytes,
+                ttl_s=self.cfg.cache_ttl_s,
+                negative_ttl_s=self.cfg.cache_negative_ttl_s,
+                shards=self.cfg.cache_shards,
+                metrics=self.metrics,
+            )
+            if self.cfg.cache_bytes > 0
+            else None
+        )
+        self.flights = Singleflight() if self.cfg.singleflight else None
+        self._cache_prefix = "|".join(
+            str(x)
+            for x in (
+                self.bundle.name,
+                self.cfg.image_size,
+                self.cfg.visualize_mode,
+                self.cfg.stitch_k,
+                self.cfg.top_k,
+                self.cfg.bug_compat,
+                self.cfg.strict_compat,
+                self.cfg.dtype,
+                self.cfg.backward_dtype,
+                self.cfg.weights_path,
+                # engine env knob that changes output bytes (BASELINE r4c)
+                os.environ.get("DECONV_FWD_LOWC_BF16", "0"),
+            )
+        )
         self.server = HttpServer(
             idle_timeout_s=self.cfg.conn_idle_timeout_s,
             body_timeout_s=self.cfg.body_read_timeout_s,
@@ -187,9 +228,15 @@ class DeconvService:
         self.server.route("GET", "/v1/models")(self._models)
         self.server.route("GET", "/v1/config")(self._config)
         self.server.route("POST", "/v1/profile")(self._profile)
-        self.server.route("POST", "/")(self._deconv_compat)
-        self.server.route("POST", "/v1/deconv")(self._deconv_v1)
-        self.server.route("POST", "/v1/dream")(self._dream_v1)
+        self.server.route("POST", "/")(
+            self._cache_wrap("/", self._deconv_compat, self.metrics)
+        )
+        self.server.route("POST", "/v1/deconv")(
+            self._cache_wrap("/v1/deconv", self._deconv_v1, self.metrics)
+        )
+        self.server.route("POST", "/v1/dream")(
+            self._cache_wrap("/v1/dream", self._dream_v1, self.dream_metrics)
+        )
 
     # ---------------------------------------------------------- device side
 
@@ -536,6 +583,107 @@ class DeconvService:
         with stage(self.metrics, "compute"):
             return await self.dispatcher.submit(x, (layer, mode, top_k, post))
 
+    # ----------------------------------------------------- response cache
+
+    def _cache_wrap(self, route: str, handler, metrics: Metrics):
+        """Put the response cache + singleflight table in front of a
+        compute route.
+
+        Hit path: digest the RAW body (before any image decode), look the
+        final encoded payload up, answer — no codec pool, no batcher, no
+        device.  Miss path: the first request in flight becomes the
+        LEADER and runs the real handler; concurrent identical requests
+        await the leader's future and receive its published Response
+        (miss-completion publish), so N identical in-flight requests cost
+        exactly one decode/dispatch/encode.  ``Cache-Control: no-cache``
+        skips the cache read AND the flight table (a forced recompute
+        must not coalesce onto a possibly-stale in-flight result) but
+        still refreshes the stored entry — unless ``no-store`` is also
+        present, which skips the write too.
+
+        Cache counters live on the MAIN metrics stream (one cache);
+        per-request accounting (requests_total, latency) goes to the
+        route's own stream, so dream-route hits don't pollute deconv SLO
+        stats."""
+        if self.cache is None and self.flights is None:
+            return handler
+        prefix = f"{self._cache_prefix}|{route}"
+
+        async def cached(req: Request) -> Response:
+            t0 = time.perf_counter()
+            cc = req.headers.get("cache-control", "").lower()
+            bypass = "no-cache" in cc or "no-store" in cc
+            # passing req shares the memoized form parse with the handler:
+            # one parse per request, key derivation included
+            key = canonical_digest(
+                prefix, req.headers.get("content-type", ""), req.body, req=req
+            )
+            if self.cache is not None and not bypass:
+                entry = self.cache.lookup(key)
+                if entry is not None:
+                    dt = time.perf_counter() - t0
+                    self.metrics.observe_stage("cache_hit", dt)
+                    metrics.observe_request(dt, entry.error_code)
+                    return entry.to_response()
+            if self.flights is not None and not bypass:
+                leader, fut = self.flights.begin(key)
+                if not leader:
+                    self.metrics.inc_counter("cache_coalesced_total")
+                    try:
+                        # shield: cancelling ONE waiter's task must not
+                        # cancel the SHARED future out from under the
+                        # other waiters (Task.cancel cancels the future
+                        # the task is awaiting) — the cancelled waiter
+                        # still re-raises, the flight lives on
+                        resp = await asyncio.shield(fut)
+                    except errors.DeconvError as e:
+                        metrics.observe_request(
+                            time.perf_counter() - t0, e.code
+                        )
+                        err = _error_response(e)
+                        err.headers["x-cache"] = "coalesced"
+                        return err
+                    code = (
+                        errors.code_from_body(resp.body)
+                        if resp.status >= 400
+                        else None
+                    )
+                    metrics.observe_request(time.perf_counter() - t0, code)
+                    return Response(
+                        status=resp.status,
+                        body=resp.body,
+                        headers={**resp.headers, "x-cache": "coalesced"},
+                    )
+                try:
+                    resp = await handler(req)
+                except asyncio.CancelledError:
+                    # waiters must not inherit the leader's cancellation
+                    # (their own tasks are alive); fail them cleanly
+                    self.flights.finish(
+                        key,
+                        exc=errors.Unavailable(
+                            "coalesced request's leader was cancelled"
+                        ),
+                    )
+                    raise
+                except BaseException as e:  # noqa: BLE001 — publish, re-raise
+                    self.flights.finish(key, exc=e)
+                    raise
+                self.flights.finish(key, resp)
+            else:
+                resp = await handler(req)
+            if self.cache is not None and "no-store" not in cc:
+                self.cache.store(
+                    key,
+                    resp.status,
+                    resp.body,
+                    resp.headers.get("content-type", "application/json"),
+                )
+            resp.headers.setdefault("x-cache", "bypass" if bypass else "miss")
+            return resp
+
+        return cached
+
     # ------------------------------------------------------------- routes
 
     async def _health(self, _req: Request) -> Response:
@@ -567,6 +715,13 @@ class DeconvService:
             cfg[key] = bool(cfg[key])
         cfg["mesh_active"] = self.mesh is not None
         cfg["model_active"] = self.bundle.name
+        # live response-cache state (round 7): operators confirm the cache
+        # is on and how full it is without scraping /metrics
+        cfg["cache_active"] = self.cache is not None
+        cfg["singleflight_active"] = self.flights is not None
+        if self.cache is not None:
+            cfg["cache_resident_bytes"] = self.cache.resident_bytes
+            cfg["cache_entries"] = self.cache.entry_count
         # live bind address (start() overrides can differ from cfg.host/port)
         bound = getattr(self, "bound", None)
         cfg["bound_host"], cfg["bound_port"] = bound or (None, None)
@@ -614,7 +769,7 @@ class DeconvService:
             form = _parse_form(req) if req.body else {}
             batches = int(form.get("batches", 4))
         except errors.DeconvError as e:
-            return Response.json({"error": e.code, "detail": e.message}, e.status)
+            return _error_response(e)
         except ValueError:
             return Response.json(
                 {"error": "bad_request", "detail": "batches must be an int"}, 400
@@ -695,7 +850,7 @@ class DeconvService:
             )
         except errors.DeconvError as e:
             self.metrics.observe_request(time.perf_counter() - t0, e.code)
-            return Response.json({"error": e.code, "detail": e.message}, e.status)
+            return _error_response(e)
         except ValueError as e:
             self.metrics.observe_request(time.perf_counter() - t0, "bad_request")
             return Response.json({"error": "bad_request", "detail": str(e)}, 400)
@@ -740,7 +895,7 @@ class DeconvService:
                 payload = await self._encode_tiles_pooled(result)
         except errors.DeconvError as e:
             self.metrics.observe_request(time.perf_counter() - t0, e.code)
-            return Response.json({"error": e.code, "detail": e.message}, e.status)
+            return _error_response(e)
         except ValueError as e:
             self.metrics.observe_request(time.perf_counter() - t0, "bad_request")
             return Response.json({"error": "bad_request", "detail": str(e)}, 400)
@@ -810,7 +965,7 @@ class DeconvService:
                 )
         except errors.DeconvError as e:
             self.dream_metrics.observe_request(time.perf_counter() - t0, e.code)
-            return Response.json({"error": e.code, "detail": e.message}, e.status)
+            return _error_response(e)
         except ValueError as e:
             self.dream_metrics.observe_request(time.perf_counter() - t0, "bad_request")
             return Response.json({"error": "bad_request", "detail": str(e)}, 400)
@@ -877,6 +1032,19 @@ class DeconvService:
         self.codec_pool.close()
 
 
+def _error_response(e: errors.DeconvError) -> Response:
+    """Taxonomy error -> JSON response.  Sheds carry a ``Retry-After``
+    derived from the batcher's live drain estimate (errors.Overloaded),
+    so client backoff is actionable instead of guessed."""
+    resp = Response.json({"error": e.code, "detail": e.message}, e.status)
+    retry_s = getattr(e, "retry_after_s", None)
+    if retry_s:
+        import math
+
+        resp.headers["retry-after"] = str(max(1, math.ceil(retry_s)))
+    return resp
+
+
 def _parse_form(req: Request) -> dict[str, str]:
     try:
         return req.form()
@@ -936,8 +1104,26 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--model", default=None)
     p.add_argument("--weights", default=None)
     p.add_argument("--platform", default=None, help="force jax backend, e.g. cpu")
+    p.add_argument(
+        "--cache-bytes", type=int, default=None,
+        help="response cache byte budget (0 disables the cache)",
+    )
+    p.add_argument(
+        "--cache-ttl-s", type=float, default=None,
+        help="positive cache entry TTL in seconds (0 = until evicted)",
+    )
+    p.add_argument(
+        "--no-singleflight", action="store_true",
+        help="disable duplicate-request coalescing",
+    )
     args = p.parse_args(argv)
     overrides = {}
+    if args.cache_bytes is not None:
+        overrides["cache_bytes"] = args.cache_bytes
+    if args.cache_ttl_s is not None:
+        overrides["cache_ttl_s"] = args.cache_ttl_s
+    if args.no_singleflight:
+        overrides["singleflight"] = False
     if args.host is not None:
         overrides["host"] = args.host
     if args.port is not None:

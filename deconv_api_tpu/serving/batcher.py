@@ -41,6 +41,14 @@ Round 6 generalised the depth-2 overlap into a THREE-STAGE pipeline:
 
 Queue-depth gauges (`collect_queue_depth`, `dispatch_queue_depth`,
 `inflight_batches`) are published through Metrics at each stage boundary.
+
+Round 7 put the content-addressed response cache + singleflight table
+(serving/cache.py) IN FRONT of this dispatcher: cache hits never reach
+submit(), and with singleflight on, concurrent identical requests collapse
+to one submit — the leader's finished response is published to the
+coalesced waiters when its batch completes.  What this file contributes is
+the shed path's actionable backoff: the 503's Retry-After derives from
+`_estimated_drain_s`, the same live estimate that triggered the shed.
 """
 
 from __future__ import annotations
@@ -276,16 +284,18 @@ class BatchingDispatcher:
         # Load shedding (VERDICT r2): when the queue already needs longer
         # than the request timeout to drain, every excess request is a
         # guaranteed 504 after a full timeout's wait — reject it NOW with a
-        # 503 so callers can back off / retry elsewhere.
-        if (
-            self._shed_factor > 0
-            and self._estimated_drain_s() > self._timeout_s * self._shed_factor
-        ):
-            # (route handlers record the error code; no double-count here)
-            raise errors.Overloaded(
-                f"queue drain estimate exceeds {self._timeout_s:.0f}s "
-                f"request timeout; shedding"
-            )
+        # 503 so callers can back off / retry elsewhere.  The drain
+        # estimate rides on the error so the route's 503 carries a
+        # Retry-After derived from the queue's actual state.
+        if self._shed_factor > 0:
+            drain_s = self._estimated_drain_s()
+            if drain_s > self._timeout_s * self._shed_factor:
+                # (route handlers record the error code; no double-count)
+                raise errors.Overloaded(
+                    f"queue drain estimate {drain_s:.1f}s exceeds "
+                    f"{self._timeout_s:.0f}s request timeout; shedding",
+                    retry_after_s=drain_s,
+                )
         item = WorkItem(image=image, key=key)
         await self._queue.put(item)
         try:

@@ -1,0 +1,346 @@
+"""Content-addressed response cache + singleflight request coalescing.
+
+Every deconv/dream/sweep response is a PURE FUNCTION of (model, route,
+canonical request params, raw image bytes): the engine is deterministic
+given params, and the reference even recomputes the full Zeiler-Fergus
+projection per request (PAPER §0.2).  Production traffic at "millions of
+users" scale (ROADMAP north star) is heavily skewed toward hot keys —
+demo images, default layers, dashboards re-polling the same request —
+and PR 1's host pipeline still pays decode → device dispatch → encode
+for every duplicate.  Serving-system practice (TensorFlow Serving's
+request memoization, arXiv:1605.08695; TVM's compiled-artifact caching,
+arXiv:1802.04799) says the next order of magnitude on skewed traffic
+comes from never doing the work twice.  Three pieces live here:
+
+- ``canonical_digest``: the cache key.  Computed from the RAW body bytes
+  before any image decode, prefixed with the response-determining server
+  config (model, image size, mode/k defaults, dtypes, weights) so a
+  config change can never serve a stale payload.  Parseable form bodies
+  (urlencoded / multipart / JSON) are canonicalized to sorted
+  (field, value) pairs first — field order, multipart boundaries, and
+  urlencoded-vs-multipart encoding of the SAME logical request all hash
+  identically, which is exactly what handlers see after ``req.form()``.
+  Unparseable bodies hash raw: identical bytes still coalesce, and the
+  handler 400s them deterministically (→ negative cache).
+
+- ``ResponseCache``: a SHARDED, byte-budgeted LRU over final encoded
+  payloads.  A hit returns the stored (status, body, content-type)
+  without touching codec pool, batcher, or device.  Sharding (per-shard
+  ``OrderedDict`` + lock) keeps eviction-under-load from serializing
+  concurrent hits; the byte budget is split evenly across shards, and an
+  entry larger than one shard's budget is simply not stored (one giant
+  sweep response must not evict the whole hot set).  Deterministic 4xxs
+  (unknown layer, bad knobs, undecodable image) are NEGATIVE-cached
+  under a short TTL so abusive retry loops stop costing form parses of
+  the downstream machinery — 5xxs (shed, timeout, crash) are transient
+  by definition and never cached.
+
+- ``Singleflight``: a flight table coalescing concurrent identical
+  misses onto ONE in-flight future.  N identical requests in flight →
+  exactly one decode / device dispatch / encode; the leader publishes
+  its finished Response to every waiter on completion (the
+  "miss-completion publish").  Leaders that die exceptionally publish
+  the exception instead, so waiters can map it through the normal error
+  taxonomy rather than hanging.
+
+Concurrency: route handlers (and therefore flight begin/finish) run on
+the service's single event loop, but the cache itself is also read and
+written from worker contexts in tests and tools, so every shard is
+lock-protected and counters go through the (already lock-protected)
+Metrics registry.  Time is injected (``clock``) so TTL tests never
+sleep.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.serving.http import Request, Response
+
+# Rough per-entry bookkeeping charged against the byte budget on top of
+# the payload: key string, OrderedDict slot, dataclass fields.  Keeps a
+# budget of N bytes meaning ~N resident bytes even for tiny negative
+# entries.
+ENTRY_OVERHEAD = 256
+
+
+def canonical_digest(
+    prefix: str, content_type: str, body: bytes, req: Request | None = None
+) -> str:
+    """Digest of the canonicalized request — the cache/singleflight key.
+
+    ``prefix`` carries everything response-determining that is NOT in the
+    body (route + server config epoch, built once by the service);
+    ``body`` is hashed in canonical form (see module docstring).  The
+    decode-with-replacement in form parsing is key-safe: handlers consume
+    the SAME decoded fields, so bodies that canonicalize identically
+    produce identical responses by construction.
+
+    Pass the live ``req`` when there is one: ``Request.form()`` memoizes,
+    so the parse done here is the SAME parse the route handler consumes
+    on a miss — one form parse per request, not two.
+    """
+    h = hashlib.blake2b(digest_size=20)
+    h.update(prefix.encode())
+    h.update(b"\x00")
+    try:
+        fields = (
+            req
+            if req is not None
+            else Request("POST", "/", {}, {"content-type": content_type}, body)
+        ).form()
+    except Exception:  # noqa: BLE001 — unparseable: raw-bytes fallback
+        h.update(content_type.encode("utf-8", "replace"))
+        h.update(b"\x00")
+        h.update(body)
+    else:
+        # Length-prefixed chunks, not separators: a separator byte INSIDE
+        # a field name/value would let a crafted single-field body hash
+        # identically to a different multi-field one — a cache-poisoning
+        # primitive.  len:bytes framing is injective.
+        for k in sorted(fields):
+            for chunk in (k.encode("utf-8", "replace"),
+                          fields[k].encode("utf-8", "replace")):
+                h.update(str(len(chunk)).encode())
+                h.update(b":")
+                h.update(chunk)
+    return h.hexdigest()
+
+
+@dataclass
+class CacheEntry:
+    status: int
+    body: bytes
+    content_type: str
+    expires_at: float | None  # None = until evicted
+    negative: bool
+    error_code: str | None  # machine code of a negative entry's payload
+    size: int  # charged bytes (body + overhead)
+
+    def to_response(self) -> Response:
+        """A FRESH Response per hit (headers dicts are per-connection
+        mutable); body bytes are shared — they are immutable."""
+        return Response(
+            status=self.status,
+            body=self.body,
+            headers={
+                "content-type": self.content_type,
+                "x-cache": "hit-negative" if self.negative else "hit",
+            },
+        )
+
+
+class _Shard:
+    """One LRU shard: OrderedDict (insertion→recency order) + lock +
+    byte accounting.  Eviction happens inside the insert's critical
+    section, so a concurrent-insert storm can never overshoot the budget
+    between check and evict."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self.lock = threading.Lock()
+        self.entries: OrderedDict[str, CacheEntry] = OrderedDict()
+        self.bytes = 0
+
+    def get(self, key: str, now: float) -> CacheEntry | str | None:
+        """Entry on hit, the string "expired" on TTL lapse, None on miss."""
+        with self.lock:
+            entry = self.entries.get(key)
+            if entry is None:
+                return None
+            if entry.expires_at is not None and now >= entry.expires_at:
+                del self.entries[key]
+                self.bytes -= entry.size
+                return "expired"
+            self.entries.move_to_end(key)
+            return entry
+
+    def put(self, key: str, entry: CacheEntry) -> int:
+        """Insert/replace; returns how many entries were evicted.
+        Precondition (enforced by ResponseCache.store, put's only
+        caller): entry.size <= max_bytes — so evicting down to the new
+        entry alone always lands within budget."""
+        evicted = 0
+        with self.lock:
+            old = self.entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old.size
+            self.entries[key] = entry
+            self.bytes += entry.size
+            while self.bytes > self.max_bytes and len(self.entries) > 1:
+                _, victim = self.entries.popitem(last=False)
+                self.bytes -= victim.size
+                evicted += 1
+        return evicted
+
+
+class ResponseCache:
+    """Sharded, byte-budgeted LRU over final encoded response payloads.
+
+    ``lookup``/``store`` keep their own hit/miss/eviction counters and
+    publish them (plus resident-bytes / entry-count / hit-ratio gauges)
+    through the injected Metrics registry, so `/metrics` tells the whole
+    story without the caller doing any bookkeeping.
+    """
+
+    def __init__(
+        self,
+        max_bytes: int,
+        *,
+        ttl_s: float = 0.0,
+        negative_ttl_s: float = 2.0,
+        shards: int = 8,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self.negative_ttl_s = float(negative_ttl_s)
+        self._clock = clock
+        self._metrics = metrics
+        n = max(1, int(shards))
+        per_shard = max(1, self.max_bytes // n)
+        self._shards = [_Shard(per_shard) for _ in range(n)]
+        self._stat_lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------ internals
+
+    def _shard_for(self, key: str) -> _Shard:
+        return self._shards[int(key[:8], 16) % len(self._shards)]
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self._metrics is not None:
+            self._metrics.inc_counter(name, n)
+
+    def _publish_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.set_gauge("cache_resident_bytes", self.resident_bytes)
+        self._metrics.set_gauge("cache_entries", self.entry_count)
+        with self._stat_lock:
+            total = self.hits + self.misses
+            ratio = self.hits / total if total else 0.0
+        self._metrics.set_gauge("cache_hit_ratio", ratio)
+
+    # ------------------------------------------------------------- surface
+
+    @property
+    def resident_bytes(self) -> int:
+        return sum(s.bytes for s in self._shards)
+
+    @property
+    def entry_count(self) -> int:
+        return sum(len(s.entries) for s in self._shards)
+
+    def lookup(self, key: str) -> CacheEntry | None:
+        got = self._shard_for(key).get(key, self._clock())
+        if isinstance(got, CacheEntry):
+            with self._stat_lock:
+                self.hits += 1
+            self._count(
+                "cache_negative_hits_total"
+                if got.negative
+                else "cache_hits_total"
+            )
+            self._publish_gauges()
+            return got
+        with self._stat_lock:
+            self.misses += 1
+        if got == "expired":
+            self._count("cache_expired_total")
+        self._count("cache_misses_total")
+        self._publish_gauges()
+        return None
+
+    def store(self, key: str, status: int, body: bytes, content_type: str) -> bool:
+        """Cache a finished response if its status is cacheable: 200 →
+        positive (cache_ttl_s; 0 = until evicted), deterministic 4xx →
+        negative under the short negative TTL.  5xxs (shed/timeout/crash/
+        not-ready) are transient and never stored.  Returns whether the
+        entry was stored."""
+        if status == 200:
+            negative = False
+            expires = (
+                self._clock() + self.ttl_s if self.ttl_s > 0 else None
+            )
+            code = None
+        elif 400 <= status < 500 and self.negative_ttl_s > 0:
+            negative = True
+            expires = self._clock() + self.negative_ttl_s
+            code = errors.code_from_body(body)
+        else:
+            return False
+        entry = CacheEntry(
+            status=status,
+            body=body,
+            content_type=content_type,
+            expires_at=expires,
+            negative=negative,
+            error_code=code,
+            size=len(body) + ENTRY_OVERHEAD,
+        )
+        shard = self._shard_for(key)
+        if entry.size > shard.max_bytes:
+            # one oversized payload must not evict the whole hot set
+            return False
+        evicted = shard.put(key, entry)
+        if evicted:
+            self._count("cache_evictions_total", evicted)
+        self._count("cache_stores_total")
+        self._publish_gauges()
+        return True
+
+
+class Singleflight:
+    """Coalesce concurrent identical misses onto one in-flight future.
+
+    ``begin(key)`` returns ``(True, future)`` for the flight LEADER (who
+    must call ``finish``) and ``(False, future)`` for waiters, who await
+    the leader's published Response.  The table is keyed by the same
+    canonical digest as the cache, so "identical" means identical down to
+    form canonicalization.  Futures belong to the running event loop;
+    the lock makes begin/finish safe against test drivers poking from
+    other threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: dict[str, asyncio.Future] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._flights)
+
+    def begin(self, key: str) -> tuple[bool, asyncio.Future]:
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            fut = self._flights.get(key)
+            if fut is not None:
+                return False, fut
+            fut = loop.create_future()
+            self._flights[key] = fut
+            return True, fut
+
+    def finish(self, key: str, result=None, exc: BaseException | None = None) -> None:
+        """Miss-completion publish: resolve the flight's future for every
+        coalesced waiter (or fail them with the leader's exception) and
+        retire the flight.  Idempotent — a double finish is a no-op."""
+        with self._lock:
+            fut = self._flights.pop(key, None)
+        if fut is None or fut.done():
+            return
+        if exc is not None:
+            fut.set_exception(exc)
+            # mark retrieved: with zero waiters an untouched exception
+            # would log "exception was never retrieved" at GC
+            fut.exception()
+        else:
+            fut.set_result(result)

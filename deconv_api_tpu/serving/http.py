@@ -51,9 +51,20 @@ class Request:
     query: dict[str, str]
     headers: dict[str, str]
     body: bytes
+    # memoized form() result — the response cache derives its key from
+    # the parsed form and the route handler parses the same body again;
+    # one parse serves both (round 7).  None = not parsed yet.
+    _form: dict[str, str] | None = field(default=None, repr=False, compare=False)
 
     def form(self) -> dict[str, str]:
-        """Parse the body as a form: urlencoded or multipart/form-data."""
+        """Parse the body as a form: urlencoded or multipart/form-data.
+        Parsed once per request; repeat calls return the memoized dict
+        (callers treat it as read-only)."""
+        if self._form is None:
+            self._form = self._parse_form_body()
+        return self._form
+
+    def _parse_form_body(self) -> dict[str, str]:
         ctype = self.headers.get("content-type", "")
         if ctype.startswith("application/x-www-form-urlencoded"):
             return {

@@ -61,6 +61,7 @@ class Metrics:
         self._queue_wait = _Reservoir()
         self._stage: dict[str, _Reservoir] = {}
         self._gauges: dict[str, float] = {}
+        self._counters: dict[str, int] = {}
 
     def observe_request(self, latency_s: float, error_code: str | None = None) -> None:
         with self._lock:
@@ -112,6 +113,18 @@ class Metrics:
         with self._lock:
             self._stage.setdefault(stage, _Reservoir()).add(seconds)
 
+    def inc_counter(self, name: str, n: int = 1) -> None:
+        """Named monotonic counters (round 7: the response cache's
+        hit/miss/coalesced/eviction accounting).  Exposed in the JSON
+        snapshot under "counters" and as `# TYPE <prefix>_<name> counter`
+        lines in the Prometheus text."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
     def set_gauge(self, name: str, value: float) -> None:
         """Instantaneous pipeline-state gauges (queue depths, inflight
         batches — round 6's three-stage pipeline observability).  Updated
@@ -140,6 +153,7 @@ class Metrics:
                     for k, r in self._stage.items()
                 },
                 "gauges": dict(self._gauges),
+                "counters": dict(self._counters),
             }
 
     def prometheus(self) -> str:
@@ -184,8 +198,13 @@ class Metrics:
             lines.append(
                 f'{p}_stage_seconds{{stage="{stage}",quantile="0.99"}} {q["p99_s"]:.6f}'
             )
+        # named counters (round 7): cache hit/miss/coalesced/eviction totals
+        for name, n in sorted(s["counters"].items()):
+            lines.append(f"# TYPE {p}_{name} counter")
+            lines.append(f"{p}_{name} {n}")
         # pipeline-state gauges (round 6): collect/dispatch queue depths,
-        # inflight batches, codec-pool pending jobs
+        # inflight batches, codec-pool pending jobs; cache resident bytes /
+        # entries / hit ratio (round 7)
         for name, v in sorted(s["gauges"].items()):
             lines.append(f"# TYPE {p}_{name} gauge")
             lines.append(f"{p}_{name} {v:g}")

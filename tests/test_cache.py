@@ -1,0 +1,495 @@
+"""Response cache + singleflight coalescing (round 7, serving/cache.py).
+
+Fast-lane by design (not `slow`): eviction under concurrent insert, TTL
+and negative-cache expiry, singleflight dispatch counting, and
+cached-vs-uncached BYTE parity across all three compute routes run on
+every tier-1 pass.  Clocks are injected where expiry is pinned, so the
+only real sleeps are sub-second HTTP-level ones.
+"""
+
+import asyncio
+import threading
+import time
+
+import httpx
+import jax
+import numpy as np
+import pytest
+
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.models.spec import init_params
+from deconv_api_tpu.serving.app import DeconvService
+from deconv_api_tpu.serving.cache import (
+    ENTRY_OVERHEAD,
+    ResponseCache,
+    Singleflight,
+    canonical_digest,
+)
+from deconv_api_tpu.serving.metrics import Metrics
+from tests.test_engine_parity import TINY
+from tests.test_serving import ServiceFixture, _data_url
+
+
+# ------------------------------------------------------------ key derivation
+
+
+def test_canonical_digest_field_order_invariant():
+    a = canonical_digest("p", "application/x-www-form-urlencoded", b"a=1&b=2")
+    b = canonical_digest("p", "application/x-www-form-urlencoded", b"b=2&a=1")
+    assert a == b
+
+
+def test_canonical_digest_multipart_equals_urlencoded():
+    """The SAME logical form hashes identically across encodings — and
+    across multipart boundary strings, which differ per client request."""
+    urlenc = canonical_digest(
+        "p", "application/x-www-form-urlencoded", b"file=xyz&layer=c1"
+    )
+
+    def multipart(boundary: str) -> str:
+        body = (
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="layer"\r\n\r\n'
+            "c1\r\n"
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="file"\r\n\r\n'
+            "xyz\r\n"
+            f"--{boundary}--\r\n"
+        ).encode()
+        return canonical_digest(
+            "p", f"multipart/form-data; boundary={boundary}", body
+        )
+
+    assert multipart("abc123") == multipart("zzz999") == urlenc
+
+
+def test_canonical_digest_no_separator_injection():
+    """A field VALUE containing would-be separator bytes must not collide
+    with a genuinely different multi-field form (cache-poisoning vector:
+    a crafted request pre-filling the key a legit request then hits)."""
+    ct = "application/x-www-form-urlencoded"
+    crafted = canonical_digest("p", ct, b"file=XimgX%1Elayer%1Fc3")
+    legit = canonical_digest("p", ct, b"file=XimgX&layer=c3")
+    assert crafted != legit
+    # same via embedded length-lookalike bytes
+    a = canonical_digest("p", ct, b"a=1%3A2&b=3")
+    b = canonical_digest("p", ct, b"a=1&b=%3A23")
+    assert a != b
+
+
+def test_canonical_digest_prefix_and_body_separate_keys():
+    assert canonical_digest("p1", "", b"x") != canonical_digest("p2", "", b"x")
+    # unparseable bodies fall back to raw-byte hashing: identical bytes
+    # still coalesce, different bytes never collide
+    assert canonical_digest("p", "", b"x") == canonical_digest("p", "", b"x")
+    assert canonical_digest("p", "", b"x") != canonical_digest("p", "", b"y")
+
+
+def _key(i: int) -> str:
+    return canonical_digest("t", "", str(i).encode())
+
+
+# ------------------------------------------------------------------- the LRU
+
+
+def test_lru_eviction_order_respects_recency():
+    """Byte budget forces LRU eviction; a lookup refreshes recency, so
+    the untouched entry goes first."""
+    size = 100 + ENTRY_OVERHEAD
+    cache = ResponseCache(3 * size, shards=1, metrics=Metrics())
+    for i in (1, 2, 3):
+        assert cache.store(_key(i), 200, b"x" * 100, "application/json")
+    assert cache.lookup(_key(1)) is not None  # refresh k1: k2 is now LRU
+    assert cache.store(_key(4), 200, b"y" * 100, "application/json")
+    assert cache.lookup(_key(2)) is None, "LRU entry must have been evicted"
+    for i in (1, 3, 4):
+        assert cache.lookup(_key(i)) is not None
+    assert cache.resident_bytes == 3 * size
+
+
+def test_oversized_entry_not_stored():
+    """One giant payload must not evict the whole hot set — it is simply
+    not cached (still served, just never stored)."""
+    cache = ResponseCache(1024, shards=1)
+    assert not cache.store(_key(1), 200, b"z" * 4096, "application/json")
+    assert cache.entry_count == 0
+
+
+def test_eviction_under_concurrent_insert():
+    """The cache-stress fast-lane pin: hammer a small budget from many
+    threads; the budget must hold and the books must balance."""
+    m = Metrics()
+    budget = 32 * 1024
+    cache = ResponseCache(budget, shards=4, metrics=m)
+    errs: list[BaseException] = []
+
+    def worker(t: int):
+        try:
+            for i in range(200):
+                k = _key(t * 1000 + i)
+                cache.store(k, 200, b"b" * 200, "application/json")
+                cache.lookup(k)
+        except BaseException as e:  # noqa: BLE001 — surfaced by the assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+    assert cache.resident_bytes <= budget
+    per_entry = 200 + ENTRY_OVERHEAD
+    assert cache.resident_bytes == cache.entry_count * per_entry
+    stores = m.counter("cache_stores_total")
+    assert stores == 8 * 200
+    # distinct keys, no TTL: whatever was stored is resident or evicted
+    assert stores - m.counter("cache_evictions_total") == cache.entry_count
+    assert m.counter("cache_evictions_total") > 0
+
+
+def test_ttl_expiry_with_injected_clock():
+    clock = [0.0]
+    cache = ResponseCache(
+        1 << 20, ttl_s=10.0, negative_ttl_s=2.0, shards=2,
+        metrics=Metrics(), clock=lambda: clock[0],
+    )
+    cache.store(_key(1), 200, b"pos", "application/json")
+    clock[0] = 9.9
+    assert cache.lookup(_key(1)) is not None
+    clock[0] = 10.1
+    assert cache.lookup(_key(1)) is None, "positive entry must expire at TTL"
+    assert cache.lookup(_key(1)) is None  # stays gone
+
+
+def test_negative_cache_expiry_with_injected_clock():
+    clock = [0.0]
+    m = Metrics()
+    cache = ResponseCache(
+        1 << 20, negative_ttl_s=2.0, shards=2, metrics=m,
+        clock=lambda: clock[0],
+    )
+    body = b'{"error": "unknown_layer", "detail": "nope"}'
+    cache.store(_key(2), 422, body, "application/json")
+    clock[0] = 1.9
+    entry = cache.lookup(_key(2))
+    assert entry is not None and entry.negative
+    assert entry.error_code == "unknown_layer"
+    assert entry.to_response().headers["x-cache"] == "hit-negative"
+    clock[0] = 2.1
+    assert cache.lookup(_key(2)) is None, "negative entry must expire"
+    assert m.counter("cache_negative_hits_total") == 1
+
+
+def test_5xx_never_cached():
+    cache = ResponseCache(1 << 20, shards=1)
+    for status in (500, 503, 504):
+        assert not cache.store(_key(status), status, b"{}", "application/json")
+    assert cache.entry_count == 0
+
+
+# -------------------------------------------------------------- singleflight
+
+
+def test_singleflight_one_leader_many_waiters():
+    async def go():
+        sf = Singleflight()
+        leader, fut = sf.begin("k")
+        assert leader
+        results = []
+
+        async def wait():
+            is_leader, f = sf.begin("k")
+            assert not is_leader
+            results.append(await f)
+
+        tasks = [asyncio.create_task(wait()) for _ in range(50)]
+        await asyncio.sleep(0.01)  # all waiters parked on the future
+        sf.finish("k", "payload")
+        await asyncio.gather(*tasks)
+        assert results == ["payload"] * 50
+        assert len(sf) == 0
+        # the flight is retired: the next identical request leads again
+        leader2, _ = sf.begin("k")
+        assert leader2
+        sf.finish("k", None)
+
+    asyncio.run(go())
+
+
+def test_singleflight_leader_exception_propagates():
+    async def go():
+        sf = Singleflight()
+        assert sf.begin("k")[0]
+        _, fut = sf.begin("k")
+        sf.finish("k", exc=RuntimeError("leader died"))
+        with pytest.raises(RuntimeError, match="leader died"):
+            await fut
+        sf.finish("k", exc=RuntimeError("double"))  # idempotent no-op
+
+    asyncio.run(go())
+
+
+def test_cancelled_waiter_does_not_poison_the_flight(server):
+    """Task.cancel() cancels the future the task awaits — without a
+    shield, one cancelled waiter would cancel the SHARED flight future,
+    dropping every other coalesced waiter and discarding the leader's
+    result.  Run against the live service's _cache_wrap on a private
+    route key."""
+    from deconv_api_tpu.serving.http import Request, Response
+
+    svc = server.service
+
+    async def go():
+        started = asyncio.Event()
+
+        async def slow_handler(_req):
+            started.set()
+            await asyncio.sleep(0.3)
+            return Response.json("computed")
+
+        wrapped = svc._cache_wrap("/flight-test", slow_handler, svc.metrics)
+
+        def req():
+            return Request(
+                "POST", "/flight-test", {},
+                {"content-type": "application/x-www-form-urlencoded"},
+                b"probe=cancelled-waiter",
+            )
+
+        leader = asyncio.create_task(wrapped(req()))
+        await started.wait()
+        victim = asyncio.create_task(wrapped(req()))
+        survivor = asyncio.create_task(wrapped(req()))
+        await asyncio.sleep(0.05)  # both parked on the shared future
+        victim.cancel()
+        r_leader = await leader
+        r_survivor = await asyncio.wait_for(survivor, 5)
+        with pytest.raises(asyncio.CancelledError):
+            await victim
+        assert r_leader.status == 200
+        assert r_survivor.status == 200
+        assert r_survivor.headers["x-cache"] == "coalesced"
+        assert r_survivor.body == r_leader.body
+
+    asyncio.run(go())
+
+
+# ----------------------------------------------------------- HTTP end-to-end
+
+
+@pytest.fixture(scope="module")
+def server():
+    params = init_params(TINY, jax.random.PRNGKey(11))
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=8,
+        batch_window_ms=1.0,
+        warmup_all_buckets=False,
+        compilation_cache_dir="",
+        cache_negative_ttl_s=0.3,
+    )
+    service = DeconvService(cfg, spec=TINY, params=params)
+    with ServiceFixture(cfg, service=service) as s:
+        yield s
+
+
+def _post(server, path, data, **kw):
+    return httpx.post(server.base_url + path, data=data, timeout=120, **kw)
+
+
+@pytest.mark.parametrize(
+    "path,data",
+    [
+        ("/", {"file": None, "layer": "b2c1"}),
+        ("/v1/deconv", {"file": None, "layer": "b1c2", "top_k": "3"}),
+        (
+            "/v1/dream",
+            {"file": None, "layers": "b2c1", "steps": "1", "octaves": "1"},
+        ),
+    ],
+    ids=["compat", "v1_deconv", "v1_dream"],
+)
+def test_cached_response_byte_identical_to_uncached(server, path, data, request):
+    """The parity pin: a cache hit serves the EXACT bytes the full
+    pipeline produced — per route, since each encodes differently."""
+    seed = {"compat": 30, "v1_deconv": 31, "v1_dream": 32}[
+        request.node.callspec.id
+    ]
+    data = dict(data, file=_data_url(seed))
+    r1 = _post(server, path, data)
+    assert r1.status_code == 200, r1.text
+    assert r1.headers["x-cache"] == "miss"
+    r2 = _post(server, path, data)
+    assert r2.status_code == 200
+    assert r2.headers["x-cache"] == "hit"
+    assert r2.content == r1.content, "cached payload must be byte-identical"
+    assert r2.headers["content-type"] == r1.headers["content-type"]
+
+
+def test_singleflight_exactly_one_dispatch_for_concurrent_duplicates(server):
+    """N identical requests in flight -> exactly one device dispatch and
+    N byte-identical 200s (the tentpole's dispatch-count pin)."""
+    svc = server.service
+    calls: list = []
+    orig = svc._dispatch_batch
+
+    def counting(key, images):
+        calls.append((key, len(images)))
+        time.sleep(0.25)  # hold the flight open so duplicates pile up
+        return orig(key, images)
+
+    data = {"file": _data_url(40), "layer": "b1c1"}
+    svc.dispatcher._dispatch_runner = counting
+    coalesced0 = svc.metrics.counter("cache_coalesced_total")
+    hits0 = svc.metrics.counter("cache_hits_total")
+    try:
+        results: list = []
+
+        def one():
+            results.append(_post(server, "/", data))
+
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+    finally:
+        svc.dispatcher._dispatch_runner = orig
+    assert [r.status_code for r in results] == [200] * 8
+    assert len(calls) == 1, f"expected ONE dispatch, saw {calls}"
+    assert sum(1 for c in calls if c[1] == 1) == 1  # one image, not 8
+    bodies = {r.content for r in results}
+    assert len(bodies) == 1, "coalesced waiters must get identical bytes"
+    # every duplicate was answered by the flight or the fresh cache entry
+    coalesced = svc.metrics.counter("cache_coalesced_total") - coalesced0
+    hits = svc.metrics.counter("cache_hits_total") - hits0
+    assert coalesced + hits == 7, (coalesced, hits)
+    kinds = {r.headers["x-cache"] for r in results}
+    assert "miss" in kinds and kinds <= {"miss", "coalesced", "hit"}
+
+
+def test_no_cache_bypass_recomputes(server):
+    """Cache-Control: no-cache honors the bypass: the request skips the
+    cache read (and the flight table) and traverses the full pipeline."""
+    svc = server.service
+    data = {"file": _data_url(41), "layer": "b2c1"}
+    r1 = _post(server, "/", data)
+    assert r1.status_code == 200 and r1.headers["x-cache"] == "miss"
+    batches0 = svc.metrics.snapshot()["batches_total"]
+    r2 = _post(server, "/", data, headers={"cache-control": "no-cache"})
+    assert r2.status_code == 200
+    assert r2.headers["x-cache"] == "bypass"
+    assert r2.content == r1.content
+    assert svc.metrics.snapshot()["batches_total"] > batches0, (
+        "bypass must reach the dispatcher"
+    )
+    # without the header the refreshed entry serves
+    r3 = _post(server, "/", data)
+    assert r3.headers["x-cache"] == "hit"
+
+
+def test_negative_cache_http_roundtrip_and_expiry(server):
+    """Deterministic 4xxs are served from the negative cache inside the
+    TTL (no second validation walk) and recomputed after it lapses."""
+    data = {"file": _data_url(42), "layer": "no_such_layer"}
+    r1 = _post(server, "/", data)
+    assert r1.status_code == 422 and r1.json()["error"] == "unknown_layer"
+    assert r1.headers["x-cache"] == "miss"
+    r2 = _post(server, "/", data)
+    assert r2.status_code == 422
+    assert r2.headers["x-cache"] == "hit-negative"
+    assert r2.content == r1.content
+    time.sleep(0.4)  # cfg.cache_negative_ttl_s = 0.3
+    r3 = _post(server, "/", data)
+    assert r3.status_code == 422 and r3.headers["x-cache"] == "miss"
+
+
+def test_shed_503_carries_retry_after(server):
+    """The load-shed 503 derives Retry-After from the live drain estimate
+    (satellite: actionable backoff, not a magic constant)."""
+    d = server.service.dispatcher
+    orig = d._estimated_drain_s
+    d._estimated_drain_s = lambda: 120.5
+    try:
+        r = _post(server, "/", {"file": _data_url(43), "layer": "b2c1"})
+    finally:
+        d._estimated_drain_s = orig
+    assert r.status_code == 503, r.text
+    assert r.json()["error"] == "overloaded"
+    assert r.headers["retry-after"] == "121"  # ceil(120.5)
+    # sheds are transient: never cached, so recovery serves immediately
+    r2 = _post(server, "/", {"file": _data_url(43), "layer": "b2c1"})
+    assert r2.status_code == 200 and r2.headers["x-cache"] == "miss"
+
+
+def test_v1_config_reports_cache_state(server):
+    c = httpx.get(server.base_url + "/v1/config").json()
+    assert c["cache_active"] is True
+    assert c["singleflight_active"] is True
+    assert c["cache_bytes"] > 0
+    assert isinstance(c["cache_entries"], int)
+    assert isinstance(c["cache_resident_bytes"], int)
+
+
+def test_metrics_exposition_includes_cache_series(server):
+    """/metrics and the JSON snapshot surface the cache counters, gauges
+    and the hit-path latency stage after real traffic."""
+    data = {"file": _data_url(44), "layer": "b1c2"}
+    assert _post(server, "/", data).status_code == 200
+    assert _post(server, "/", data).headers["x-cache"] == "hit"
+    snap = server.service.metrics.snapshot()
+    assert snap["counters"]["cache_hits_total"] >= 1
+    assert snap["counters"]["cache_misses_total"] >= 1
+    assert snap["gauges"]["cache_resident_bytes"] > 0
+    assert 0.0 < snap["gauges"]["cache_hit_ratio"] <= 1.0
+    assert "cache_hit" in snap["stages"]  # hit-path latency quantiles
+    text = httpx.get(server.base_url + "/metrics").text
+    for needle in (
+        "# TYPE deconv_cache_hits_total counter",
+        "# TYPE deconv_cache_misses_total counter",
+        "# TYPE deconv_cache_stores_total counter",
+        "# TYPE deconv_cache_resident_bytes gauge",
+        "# TYPE deconv_cache_hit_ratio gauge",
+        "# TYPE deconv_cache_entries gauge",
+        'deconv_stage_seconds{stage="cache_hit",quantile="0.5"}',
+    ):
+        assert needle in text, needle
+
+
+def test_cache_disabled_escape_hatch():
+    """cache_bytes=0 + singleflight off restores the raw pipeline: no
+    x-cache headers, every request computes."""
+    params = init_params(TINY, jax.random.PRNGKey(12))
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        warmup_all_buckets=False,
+        compilation_cache_dir="",
+        cache_bytes=0,
+        singleflight=False,
+    )
+    service = DeconvService(cfg, spec=TINY, params=params)
+    assert service.cache is None and service.flights is None
+    with ServiceFixture(cfg, service=service) as s:
+        data = {"file": _data_url(50), "layer": "b2c1"}
+        r1 = _post(s, "/", data)
+        r2 = _post(s, "/", data)
+        assert r1.status_code == r2.status_code == 200
+        assert "x-cache" not in r1.headers and "x-cache" not in r2.headers
+        assert s.service.metrics.snapshot()["images_total"] >= 2
+        c = httpx.get(s.base_url + "/v1/config").json()
+        assert c["cache_active"] is False
+        assert c["singleflight_active"] is False
+
+
+def test_dream_negative_knobs_negative_cached(server):
+    """Bad dream knobs (deterministic 400) ride the negative cache too."""
+    data = {"file": _data_url(45), "layers": "b2c1", "steps": "0"}
+    r1 = _post(server, "/v1/dream", data)
+    assert r1.status_code == 400 and r1.headers["x-cache"] == "miss"
+    r2 = _post(server, "/v1/dream", data)
+    assert r2.status_code == 400
+    assert r2.headers["x-cache"] == "hit-negative"
+    assert r2.content == r1.content

@@ -60,3 +60,23 @@ def test_unknown_override_raises():
 def test_every_field_has_an_env_name_without_collisions():
     names = [f"DECONV_{f.name.upper()}" for f in dataclasses.fields(ServerConfig)]
     assert len(names) == len(set(names))
+
+
+def test_cache_knob_defaults_and_env(monkeypatch):
+    """Round 7 response-cache knobs: default-on with escape hatches,
+    every knob reachable over the same DECONV_* env surface."""
+    cfg = ServerConfig()
+    assert cfg.cache_bytes == 256 * 1024 * 1024  # default-on
+    assert cfg.cache_ttl_s == 0.0  # until evicted
+    assert cfg.cache_negative_ttl_s == 2.0
+    assert cfg.cache_shards == 8
+    assert cfg.singleflight is True
+    monkeypatch.setenv("DECONV_CACHE_BYTES", "0")  # the escape hatch
+    monkeypatch.setenv("DECONV_CACHE_TTL_S", "30.5")
+    monkeypatch.setenv("DECONV_CACHE_NEGATIVE_TTL_S", "0.5")
+    monkeypatch.setenv("DECONV_SINGLEFLIGHT", "0")
+    cfg = ServerConfig.from_env()
+    assert cfg.cache_bytes == 0
+    assert cfg.cache_ttl_s == 30.5
+    assert cfg.cache_negative_ttl_s == 0.5
+    assert cfg.singleflight is False

@@ -139,6 +139,9 @@ def test_donation_and_ring_survive_restart_cycle(server):
     for i in range(4):
         r = httpx.post(
             url + "/", data={"file": _data_url(99), "layer": "b1c1"},
+            # no-cache: identical bodies must each traverse the ring —
+            # this test pins buffer reuse, not the response cache
+            headers={"cache-control": "no-cache"},
             timeout=120,
         )
         assert r.status_code == 200

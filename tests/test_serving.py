@@ -221,13 +221,17 @@ def test_404_unknown_route(server):
 
 
 def test_concurrent_requests_are_batched(server):
-    """Fire concurrent requests; the dispatcher must coalesce them."""
+    """Fire concurrent requests; the dispatcher must coalesce them.
+    Cache-Control: no-cache forces every request through the full
+    pipeline — this test pins the BATCHER, and seed-0's body may already
+    sit in the response cache from earlier tests."""
     before = server.service.metrics.snapshot()
 
     def one(i):
         return httpx.post(
             server.base_url + "/",
             data={"file": _data_url(i), "layer": "b2c1"},
+            headers={"cache-control": "no-cache"},
             timeout=60,
         ).status_code
 
@@ -286,13 +290,16 @@ def test_handler_crash_returns_500_not_dropped_conn(server):
             raise RuntimeError("synthetic device failure")
 
         # patch both execution paths: _dispatch_runner drives the pipelined
-        # mode (default), _runner the serial fallback
+        # mode (default), _runner the serial fallback.  no-cache: this
+        # body's 200 may already be cached from earlier tests, and the
+        # point here is to reach the (patched) dispatcher.
         d._runner = boom
         if d._dispatch_runner is not None:
             d._dispatch_runner = boom
         r = httpx.post(
             server.base_url + "/",
             data={"file": _data_url(), "layer": "b2c1"},
+            headers={"cache-control": "no-cache"},
             timeout=30,
         )
         assert r.status_code == 500
@@ -702,14 +709,33 @@ def test_prometheus_exposition_includes_batch_gauges():
     m = Metrics()
     m.observe_batch(size=4, compute_s=0.05, queue_s=0.01)
     m.observe_cadence(0.03)
+    # round 7: the response cache's counters and gauges ride the same
+    # exposition — TYPE'd counter lines plus resident-bytes/hit-ratio
+    m.inc_counter("cache_hits_total", 3)
+    m.inc_counter("cache_misses_total")
+    m.inc_counter("cache_coalesced_total", 2)
+    m.inc_counter("cache_evictions_total", 5)
+    m.set_gauge("cache_resident_bytes", 4096)
+    m.set_gauge("cache_hit_ratio", 0.75)
     text = m.prometheus()
     for needle in (
         "deconv_batch_size{quantile=\"0.5\"} 4.0",
         "deconv_batch_compute_seconds{quantile=\"0.5\"} 0.050000",
         "deconv_batch_cadence_seconds{quantile=\"0.5\"} 0.030000",
         "deconv_queue_wait_seconds{quantile=\"0.5\"} 0.010000",
+        "# TYPE deconv_cache_hits_total counter",
+        "deconv_cache_hits_total 3",
+        "deconv_cache_misses_total 1",
+        "deconv_cache_coalesced_total 2",
+        "deconv_cache_evictions_total 5",
+        "# TYPE deconv_cache_resident_bytes gauge",
+        "deconv_cache_resident_bytes 4096",
+        "deconv_cache_hit_ratio 0.75",
     ):
         assert needle in text, text
+    snap = m.snapshot()
+    assert snap["counters"]["cache_hits_total"] == 3
+    assert snap["counters"]["cache_coalesced_total"] == 2
 
 
 @pytest.mark.parametrize(

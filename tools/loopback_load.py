@@ -13,7 +13,8 @@ Prints one JSON row per pipeline_depth; append to
 bench_suite_results.jsonl via tools/run_experiments.py
 (`loopback:tool/loopback_load.py`) or redirect by hand.
 
-Usage: python tools/loopback_load.py [--passes N] [--no-donate] [depth ...]
+Usage: python tools/loopback_load.py [--passes N] [--no-donate]
+           [--key-dist unique|zipf:<s>|hotset:<k>] [--requests N] [depth ...]
 
 `--passes N` runs N measurement passes per depth and reports the best
 (all passes carried in `passes_req_s` — the bench.py best-of-N
@@ -23,6 +24,23 @@ measures (greedy queue drain, three-stage collect/dispatch/encode
 pipeline, codec worker pool, inline small-payload decode, fused batch
 encode, donated+ring-buffered batch staging); the r5 rows in
 bench_suite_results.jsonl are the pre-pipeline record.
+
+Round 7 added `--key-dist`, the response-cache workload mode
+(serving/cache.py).  WITHOUT it the legacy measurement runs with the
+cache and singleflight DISABLED — the legacy driver reuses 8 images, and
+a default-on cache would turn the row into a cache benchmark, breaking
+same-host comparability with the PR 1 rows.  WITH it the cache serves
+its defaults and the key stream is drawn deterministically (seed 0):
+
+- `unique`  — every request a fresh key: the cold-traffic A/B (pins
+  that key digesting costs nothing measurable on misses);
+- `hotset:<k>` — uniform over k hot keys (dashboards re-polling);
+- `zipf:<s>` — zipf(s) over a 256-key pool (the canonical skewed
+  production distribution).
+
+Rows in this mode carry the hit/miss/coalesced split: client-observed
+per-kind request counts + latency quantiles (from the `x-cache`
+response header) and the server's own cache counters/hit ratio.
 """
 
 from __future__ import annotations
@@ -39,12 +57,55 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _key_streams(
+    key_dist: str | None, n: int, passes: int, rng
+) -> list[list[int]]:
+    """Per-pass image-index streams, deterministic under seed.
+
+    `unique` hands every pass FRESH keys (the cold row must stay cold:
+    reusing pass 1's keys would turn best-of-N into a warm-cache
+    measurement); the skewed distributions draw one long stream and chunk
+    it, so later passes continue the same steady-state key process."""
+    if key_dist is None:
+        return [[i % 8 for i in range(n)]] * passes  # legacy 8-image cycle
+    if key_dist == "unique":
+        return [list(range(p * n, (p + 1) * n)) for p in range(passes)]
+    kind, _, arg = key_dist.partition(":")
+    if kind == "hotset":
+        k = int(arg)
+        if k <= 0:
+            raise ValueError("hotset:<k> needs k >= 1")
+        stream = [int(x) for x in rng.integers(0, k, n * passes)]
+    elif kind == "zipf":
+        import numpy as np
+
+        s = float(arg)
+        pool = 256  # fixed pool: hit ratios stay comparable across --requests
+        w = 1.0 / np.arange(1, pool + 1) ** s
+        stream = [
+            int(x) for x in rng.choice(pool, size=n * passes, p=w / w.sum())
+        ]
+    else:
+        raise ValueError(f"unknown --key-dist {key_dist!r}")
+    return [stream[p * n : (p + 1) * n] for p in range(passes)]
+
+
+def _xcache_kind(raw: bytes) -> str:
+    """Parse the x-cache response header out of a raw HTTP byte blob."""
+    head = raw.split(b"\r\n\r\n", 1)[0].lower()
+    for line in head.split(b"\r\n"):
+        if line.startswith(b"x-cache:"):
+            return line.split(b":", 1)[1].strip().decode()
+    return "none"
+
+
 def run_load(
     pipeline_depth: int,
     n_requests: int = 512,
     concurrency: int = 64,
     passes: int = 1,
     donate: bool = True,
+    key_dist: str | None = None,
 ) -> dict:
     import jax
 
@@ -72,6 +133,7 @@ def run_load(
         ),
     )
     params = init_params(spec, jax.random.PRNGKey(0))
+    cache_on = key_dist is not None
     cfg = ServerConfig(
         image_size=32,
         max_batch=32,
@@ -81,18 +143,24 @@ def run_load(
         compilation_cache_dir="",
         platform="cpu",
         donate_inputs=donate,
+        # legacy mode reuses 8 images; the cache would serve them and the
+        # row would stop measuring the decode->dispatch->encode machinery
+        cache_bytes=cfg_cache_bytes() if cache_on else 0,
+        singleflight=cache_on,
     )
     service = DeconvService(cfg, spec=spec, params=params)
 
     rng = np.random.default_rng(0)
-    uris = []
-    for _ in range(8):
+    streams = _key_streams(key_dist, n_requests, max(1, passes), rng)
+    uris: dict[int, str] = {}
+    for idx in sorted({i for stream in streams for i in stream}):
         img = Image.fromarray(
-            rng.integers(0, 255, (32, 32, 3), np.uint8), "RGB"
+            np.random.default_rng(idx).integers(0, 255, (32, 32, 3), np.uint8),
+            "RGB",
         )
         buf = io.BytesIO()
         img.save(buf, "JPEG")
-        uris.append(
+        uris[idx] = (
             "data:image/jpeg;base64," + base64.b64encode(buf.getvalue()).decode()
         )
 
@@ -103,9 +171,11 @@ def run_load(
         await asyncio.to_thread(service.warmup, "c3")
         sem = asyncio.Semaphore(concurrency)
 
-        async def one(i: int, latencies: list[float]):
+        async def one(
+            i: int, indices: list[int], samples: list[tuple[float, str]]
+        ):
             body = urllib.parse.urlencode(
-                {"file": uris[i % len(uris)], "layer": "c3"}
+                {"file": uris[indices[i]], "layer": "c3"}
             ).encode()
             async with sem:
                 t0 = time.perf_counter()
@@ -121,25 +191,29 @@ def run_load(
                 await writer.drain()
                 raw = await reader.read()
                 writer.close()
-                latencies.append(time.perf_counter() - t0)
+                samples.append((time.perf_counter() - t0, _xcache_kind(raw)))
                 assert b" 200 " in raw.split(b"\r\n", 1)[0], raw[:120]
 
         # Best-of-N passes (the bench.py round-6 methodology): one pass is
         # hostage to scheduler/allocator weather; run N, report the max,
         # carry every pass in the row.  Latency quantiles come from the
-        # best pass (the one the headline rate describes).
+        # best pass (the one the headline rate describes).  In cache mode
+        # later passes run against the warm cache — the steady state a
+        # hot-key workload actually serves in; pass 1 carries the
+        # cold-fill mixture and stays visible in passes_req_s.
         runs = []
-        for _ in range(max(1, passes)):
-            latencies: list[float] = []
+        for indices in streams:
+            samples: list[tuple[float, str]] = []
             t0 = time.perf_counter()
             await asyncio.gather(
-                *(one(i, latencies) for i in range(n_requests))
+                *(one(i, indices, samples) for i in range(n_requests))
             )
             wall = time.perf_counter() - t0
-            runs.append((wall, sorted(latencies)))
+            runs.append((wall, samples))
         snap = service.metrics.snapshot()
         await service.stop()
-        wall, lat = min(runs, key=lambda r: r[0])
+        wall, samples = min(runs, key=lambda r: r[0])
+        lat = sorted(s[0] for s in samples)
         row = {
             "which": f"loopback_cpu_depth{pipeline_depth}",
             "platform": "cpu-loopback",
@@ -166,6 +240,50 @@ def run_load(
                 "gauges": snap["gauges"],
             },
         }
+        if cache_on:
+            # hit/miss/coalesced split, client side (best pass) + server
+            # counters across all passes
+            kinds: dict[str, int] = {}
+            by_kind: dict[str, list[float]] = {}
+            for dt, kind in samples:
+                kinds[kind] = kinds.get(kind, 0) + 1
+                by_kind.setdefault(kind, []).append(dt)
+            hits = kinds.get("hit", 0) + kinds.get("hit-negative", 0)
+            misses = kinds.get("miss", 0)
+            # ratio over ALL requests in the pass: coalesced requests were
+            # NOT served from cache, so a cold-fill pass with heavy
+            # coalescing must not report the ratio of a fully-warm one
+            total = max(1, sum(kinds.values()))
+            row["which"] = (
+                f"loopback_cpu_hot_{key_dist.replace(':', '')}"
+                f"_depth{pipeline_depth}"
+            )
+            row["key_dist"] = key_dist
+            row["unique_keys"] = len({i for s in streams for i in s})
+            row["cache"] = {
+                "client_kinds": kinds,
+                "hit_ratio": round(hits / total, 4),
+                "hit_req_s": round(hits / wall, 1),
+                "miss_req_s": round(misses / wall, 1),
+                "server_counters": {
+                    k: v
+                    for k, v in snap["counters"].items()
+                    if k.startswith("cache_")
+                },
+                "server_hit_ratio": round(
+                    snap["gauges"].get("cache_hit_ratio", 0.0), 4
+                ),
+            }
+            for kind, name in (("hit", "hit"), ("miss", "miss"),
+                               ("coalesced", "coalesced")):
+                if by_kind.get(kind):
+                    ks = sorted(by_kind[kind])
+                    row["cache"][f"{name}_p50_ms"] = round(
+                        ks[len(ks) // 2] * 1e3, 3
+                    )
+                    row["cache"][f"{name}_p99_ms"] = round(
+                        ks[int(len(ks) * 0.99)] * 1e3, 3
+                    )
         if not donate:
             row["which"] += "_nodonate"
             row["donate_inputs"] = False
@@ -174,10 +292,20 @@ def run_load(
     return asyncio.run(drive())
 
 
+def cfg_cache_bytes() -> int:
+    """The cache budget for `--key-dist` runs: the ServerConfig default,
+    overridable via DECONV_CACHE_BYTES like the server itself."""
+    from deconv_api_tpu.config import ServerConfig
+
+    return ServerConfig.from_env().cache_bytes
+
+
 def main() -> int:
     args = sys.argv[1:]
     passes = 1
     donate = True
+    key_dist: str | None = None
+    n_requests = 512
     depths: list[int] = []
     i = 0
     while i < len(args):
@@ -187,11 +315,20 @@ def main() -> int:
         elif args[i] == "--no-donate":
             donate = False
             i += 1
+        elif args[i] == "--key-dist":
+            key_dist = args[i + 1]
+            i += 2
+        elif args[i] == "--requests":
+            n_requests = int(args[i + 1])
+            i += 2
         else:
             depths.append(int(args[i]))
             i += 1
     for d in depths or [2, 1]:
-        row = run_load(d, passes=passes, donate=donate)
+        row = run_load(
+            d, n_requests=n_requests, passes=passes, donate=donate,
+            key_dist=key_dist,
+        )
         print(json.dumps(row), flush=True)
     return 0
 

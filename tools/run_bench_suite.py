@@ -25,6 +25,31 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # seconds each, and config 3 compiles one executable per octave shape.
 TIMEOUTS = {1: 1800, 2: 2400, 3: 5400, 4: 3600, 5: 2400, 6: 3600}
 
+# Host-side (tunnel-free) loopback workloads runnable by config token:
+# "hot" is the response-cache hot-traffic row (round 7), "cold" the
+# cache-on unique-key no-regression check.  CPU-only — no preflight.
+LOOPBACK_CONFIGS = {
+    "hot": ["--key-dist", "hotset:8", "--passes", "3", "2"],
+    "zipf": ["--key-dist", "zipf:1.1", "--passes", "3", "2"],
+    "cold": ["--key-dist", "unique", "--passes", "3", "2"],
+}
+
+
+def run_loopback(token: str, timeout_s: float = 900.0) -> dict:
+    """One tools/loopback_load.py workload as a child under a hard
+    timeout, returning its JSON row (error row on failure)."""
+    row = run_cmd_json(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "loopback_load.py"),
+            *LOOPBACK_CONFIGS[token],
+        ],
+        timeout_s,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    row.setdefault("config", f"loopback_{token}")
+    return row
+
 
 def run_cmd_json(
     cmd: list[str], timeout_s: float, env: dict | None = None
@@ -199,13 +224,30 @@ def main() -> int:
     ap.add_argument("--max-wait-hours", type=float, default=8.0)
     args = ap.parse_args()
     date = datetime.date.today().isoformat()
-    for n in [int(x) for x in args.configs.split(",") if x]:
-        print(f"=== config {n} ===", file=sys.stderr, flush=True)
-        if not wait_for_device(args.max_wait_hours * 3600):
-            result = {"config": n, "error": "device tunnel unavailable", "date": date}
-        else:
-            result = run_one(n, TIMEOUTS.get(n, 3600))
+    for tok in [x for x in args.configs.split(",") if x]:
+        print(f"=== config {tok} ===", file=sys.stderr, flush=True)
+        if tok in LOOPBACK_CONFIGS:
+            # host-side loopback workload: CPU backend, no tunnel needed
+            result = run_loopback(tok)
             result["date"] = date
+        elif not tok.isdigit():
+            # a typo'd token records an error row like any other failure
+            # instead of aborting the rest of the suite
+            result = {
+                "config": tok, "date": date,
+                "error": f"unknown config token {tok!r}; numeric or one of "
+                         f"{sorted(LOOPBACK_CONFIGS)}",
+            }
+        else:
+            n = int(tok)
+            if not wait_for_device(args.max_wait_hours * 3600):
+                result = {
+                    "config": n, "error": "device tunnel unavailable",
+                    "date": date,
+                }
+            else:
+                result = run_one(n, TIMEOUTS.get(n, 3600))
+                result["date"] = date
         with open(args.out, "a") as f:
             f.write(json.dumps(result) + "\n")
         print(json.dumps(result), flush=True)
