@@ -32,7 +32,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
         val = getattr(args, flag, None)
         if val is not None:
             argv += [f"--{flag}", str(val)]
-    for flag in ("cache_bytes", "cache_ttl_s"):
+    for flag in (
+        "cache_bytes", "cache_ttl_s",
+        "trace_ring", "trace_slow_ms", "trace_sample",
+    ):
         val = getattr(args, flag, None)
         if val is not None:
             argv += [f"--{flag.replace('_', '-')}", str(val)]
@@ -253,6 +256,20 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument(
         "--no-singleflight", action="store_true",
         help="disable duplicate-request coalescing",
+    )
+    s.add_argument(
+        "--trace-ring", type=int, default=None, dest="trace_ring",
+        help="flight-recorder ring size per class (0 disables tracing; "
+        "default 256)",
+    )
+    s.add_argument(
+        "--trace-slow-ms", type=float, default=None, dest="trace_slow_ms",
+        help="latency threshold for the slow-trace ring (default 100 ms)",
+    )
+    s.add_argument(
+        "--trace-sample", type=float, default=None, dest="trace_sample",
+        help="head-sample rate for the recent-trace ring (0..1, default 1.0; "
+        "slow/error traces are always kept)",
     )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)

@@ -115,6 +115,22 @@ class ServerConfig:
     # responses.  Works with or without the cache; off restores
     # independent execution.
     singleflight: bool = True
+    # --- per-request tracing spine (round 8: serving/trace.py) ---
+    # Flight-recorder ring size: the last N completed traces, N
+    # tail-sampled slow traces, and N error traces are retained and
+    # served at GET /v1/debug/requests.  0 disables the tracing spine
+    # entirely (responses still carry x-request-id).  The default costs
+    # ≲1% loopback throughput on the hot cache-hit path (the `trace-on`
+    # guard in tools/run_bench_suite.py pins a 3% budget).
+    trace_ring: int = 256
+    # A completed request slower than this lands in the slow ring
+    # regardless of trace_sample (tail sampling): "show me the last N
+    # requests that crossed 100 ms and which stage ate the budget".
+    trace_slow_ms: float = 100.0
+    # Head-sample rate for the RECENT ring (1.0 = every request, 0.25 =
+    # one in four, 0 = only slow/error traces are retained).  Span
+    # aggregates and counters always update; only ring retention thins.
+    trace_sample: float = 1.0
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

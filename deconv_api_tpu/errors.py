@@ -83,6 +83,16 @@ class RequestTimeout(DeconvError):
     code = "request_timeout"
 
 
+def to_payload(e: DeconvError, request_id: str | None = None) -> dict:
+    """The JSON error body every route serves: machine code + detail,
+    plus the request id (round 8 tracing spine) so a client-side error
+    log joins server logs and `/v1/debug/requests` traces on one key."""
+    payload = {"error": e.code, "detail": e.message}
+    if request_id:
+        payload["request_id"] = request_id
+    return payload
+
+
 def code_from_body(body: bytes) -> str | None:
     """Best-effort machine error code out of a JSON error payload (the
     {"error": code, "detail": ...} shape every route emits).  One place

@@ -42,6 +42,8 @@ from deconv_api_tpu.serving.cache import (
 from deconv_api_tpu.serving.codec_pool import HostBufferRing, WorkerPool
 from deconv_api_tpu.serving.http import HttpServer, Request, Response
 from deconv_api_tpu.serving.metrics import Metrics
+from deconv_api_tpu.serving import trace as trace_mod
+from deconv_api_tpu.serving.trace import FlightRecorder, RequestTrace
 from deconv_api_tpu.utils.tracing import stage
 
 # /v1/dream's parameter defaults, shared by the route and warmup_dream so
@@ -199,6 +201,22 @@ class DeconvService:
             else None
         )
         self.flights = Singleflight() if self.cfg.singleflight else None
+        # Per-request tracing spine (round 8, serving/trace.py): every
+        # compute request gets a span-structured trace — decode, cache
+        # lookup/coalesce, queue wait, batch membership, device
+        # dispatch/fetch, encode — and the flight recorder retains the
+        # last N completed / slow / error traces for GET
+        # /v1/debug/requests.  trace_ring=0 disables the spine (request
+        # ids remain — they're minted at the HTTP layer).
+        self.recorder = (
+            FlightRecorder(
+                self.cfg.trace_ring,
+                slow_ms=self.cfg.trace_slow_ms,
+                sample=self.cfg.trace_sample,
+            )
+            if self.cfg.trace_ring > 0
+            else None
+        )
         self._cache_prefix = "|".join(
             str(x)
             for x in (
@@ -227,15 +245,27 @@ class DeconvService:
         self.server.route("GET", "/v1/metrics")(self._metrics)
         self.server.route("GET", "/v1/models")(self._models)
         self.server.route("GET", "/v1/config")(self._config)
+        self.server.route("GET", "/v1/debug/requests")(self._debug_requests)
         self.server.route("POST", "/v1/profile")(self._profile)
+        # compute routes: trace wrap OUTSIDE the cache wrap, so the span
+        # timeline covers the cache lookup / coalesce wait as well as
+        # the full decode→dispatch→encode miss path
         self.server.route("POST", "/")(
-            self._cache_wrap("/", self._deconv_compat, self.metrics)
+            self._trace_wrap(
+                "/", self._cache_wrap("/", self._deconv_compat, self.metrics)
+            )
         )
         self.server.route("POST", "/v1/deconv")(
-            self._cache_wrap("/v1/deconv", self._deconv_v1, self.metrics)
+            self._trace_wrap(
+                "/v1/deconv",
+                self._cache_wrap("/v1/deconv", self._deconv_v1, self.metrics),
+            )
         )
         self.server.route("POST", "/v1/dream")(
-            self._cache_wrap("/v1/dream", self._dream_v1, self.dream_metrics)
+            self._trace_wrap(
+                "/v1/dream",
+                self._cache_wrap("/v1/dream", self._dream_v1, self.dream_metrics),
+            )
         )
 
     # ---------------------------------------------------------- device side
@@ -583,6 +613,92 @@ class DeconvService:
         with stage(self.metrics, "compute"):
             return await self.dispatcher.submit(x, (layer, mode, top_k, post))
 
+    # ----------------------------------------------------- tracing spine
+
+    def _trace_wrap(self, route: str, handler):
+        """Give every request on a compute route a span-structured trace
+        (round 8, serving/trace.py): activate it on the request's task
+        context — the cache wrapper, dispatcher submit and codec-pool
+        handoff all pick it up from there — then classify the finished
+        trace into the flight recorder (recent / slow / error rings).
+        Inert when tracing is disabled (trace_ring=0)."""
+        if self.recorder is None:
+            return handler
+        recorder = self.recorder
+
+        async def traced(req: Request) -> Response:
+            tr = RequestTrace(req.id, route)
+            token = trace_mod.activate(tr)
+            try:
+                resp = await handler(req)
+            except asyncio.CancelledError:
+                # client disconnect / shutdown: no response is ever
+                # produced, so recording a fabricated 500 here would let
+                # impatient clients fill the bounded error ring with
+                # phantom server errors, evicting real crash traces
+                raise
+            except BaseException as e:
+                # handler crash: the 500 is synthesized upstream
+                # (http._dispatch), but the error trace must exist NOW —
+                # the flight recorder's error ring is the whole point
+                # when things go wrong
+                tr.finish(status=500, error=type(e).__name__)
+                recorder.record(tr)
+                raise
+            finally:
+                trace_mod.deactivate(token)
+            code = (
+                errors.code_from_body(resp.body) if resp.status >= 400 else None
+            )
+            tr.finish(
+                status=resp.status,
+                error=code,
+                cache=resp.headers.get("x-cache"),
+            )
+            recorder.record(tr)
+            return resp
+
+        return traced
+
+    async def _debug_requests(self, req: Request) -> Response:
+        """GET /v1/debug/requests — the flight recorder's query surface.
+
+        ``?slow=1`` / ``?error=1`` select the tail-sampled rings (both =
+        union), ``?id=<request-id>`` searches every ring for one
+        request's trace, ``?limit=N`` caps the result (default 50,
+        newest first).  Answers "show me the last N requests that
+        crossed the latency threshold and which stage ate the budget"
+        without logs archaeology."""
+        if self.recorder is None:
+            return _error_response(
+                errors.BadRequest("tracing disabled: set trace_ring > 0"),
+                req.id,
+            )
+
+        def truthy(v: str) -> bool:
+            return v.lower() in ("1", "true", "yes", "on")
+
+        try:
+            limit = int(req.query.get("limit", "50"))
+        except ValueError:
+            return _error_response(
+                errors.BadRequest("limit must be an int"), req.id
+            )
+        traces = self.recorder.query(
+            slow=truthy(req.query.get("slow", "")),
+            error=truthy(req.query.get("error", "")),
+            trace_id=req.query.get("id") or None,
+            limit=max(1, min(limit, 10 * max(1, self.cfg.trace_ring))),
+        )
+        return Response.json(
+            {
+                "requests": traces,
+                "counts": self.recorder.counts(),
+                "slow_ms": self.cfg.trace_slow_ms,
+                "sample": self.cfg.trace_sample,
+            }
+        )
+
     # ----------------------------------------------------- response cache
 
     def _cache_wrap(self, route: str, handler, metrics: Metrics):
@@ -611,6 +727,7 @@ class DeconvService:
 
         async def cached(req: Request) -> Response:
             t0 = time.perf_counter()
+            tr = trace_mod.current_trace()
             cc = req.headers.get("cache-control", "").lower()
             bypass = "no-cache" in cc or "no-store" in cc
             # passing req shares the memoized form parse with the handler:
@@ -620,15 +737,30 @@ class DeconvService:
             )
             if self.cache is not None and not bypass:
                 entry = self.cache.lookup(key)
+                dt = time.perf_counter() - t0
                 if entry is not None:
-                    dt = time.perf_counter() - t0
                     self.metrics.observe_stage("cache_hit", dt)
                     metrics.observe_request(dt, entry.error_code)
+                    if tr is not None:
+                        tr.add_span("cache_hit", t0, dt)
                     return entry.to_response()
+                if tr is not None:
+                    # miss: key digest + shard lookup, so a trace shows
+                    # what the cache cost before compute started
+                    tr.add_span("cache_lookup", t0, dt, hit=False)
             if self.flights is not None and not bypass:
                 leader, fut = self.flights.begin(key)
                 if not leader:
                     self.metrics.inc_counter("cache_coalesced_total")
+                    if tr is not None:
+                        # the flight that actually computes these bytes
+                        # belongs to the LEADER's trace; link it so the
+                        # debug surface can pull its compute spans
+                        tr.annotate(
+                            coalesced_into=getattr(fut, "leader_trace_id", None),
+                            flight=getattr(fut, "flight_id", None),
+                        )
+                    t_wait = time.perf_counter()
                     try:
                         # shield: cancelling ONE waiter's task must not
                         # cancel the SHARED future out from under the
@@ -640,19 +772,36 @@ class DeconvService:
                         metrics.observe_request(
                             time.perf_counter() - t0, e.code
                         )
-                        err = _error_response(e)
+                        err = _error_response(e, req.id)
                         err.headers["x-cache"] = "coalesced"
                         return err
+                    finally:
+                        # one span for every exit (success, leader error,
+                        # even the cancelled waiter's own unwind)
+                        if tr is not None:
+                            tr.add_span(
+                                "coalesce_wait", t_wait,
+                                time.perf_counter() - t_wait,
+                                leader=getattr(fut, "leader_trace_id", None),
+                            )
                     code = (
                         errors.code_from_body(resp.body)
                         if resp.status >= 400
                         else None
                     )
                     metrics.observe_request(time.perf_counter() - t0, code)
+                    # x-request-id OVERRIDDEN, not defaulted: the copied
+                    # headers are the LEADER's dict, and the leader's
+                    # connection handler may already have stamped ITS id
+                    # there — every response must carry its own
                     return Response(
                         status=resp.status,
                         body=resp.body,
-                        headers={**resp.headers, "x-cache": "coalesced"},
+                        headers={
+                            **resp.headers,
+                            "x-cache": "coalesced",
+                            "x-request-id": req.id,
+                        },
                     )
                 try:
                     resp = await handler(req)
@@ -695,11 +844,17 @@ class DeconvService:
         return Response.json({"ready": False}, status=503)
 
     async def _metrics(self, _req: Request) -> Response:
-        return Response.text(
+        text = (
             self.metrics.prometheus()
             + self.dream_metrics.prometheus()
-            + self.sweep_metrics.prometheus(),
-            content_type="text/plain; version=0.0.4",
+            + self.sweep_metrics.prometheus()
+        )
+        if self.recorder is not None:
+            # trace-spine per-stage summary (round 8): span seconds/count
+            # totals + ring occupancy ride the same exposition
+            text += self.recorder.prometheus("deconv")
+        return Response.text(
+            text, content_type="text/plain; version=0.0.4"
         )
 
     async def _config(self, _req: Request) -> Response:
@@ -719,6 +874,11 @@ class DeconvService:
         # is on and how full it is without scraping /metrics
         cfg["cache_active"] = self.cache is not None
         cfg["singleflight_active"] = self.flights is not None
+        # live flight-recorder state (round 8): tracing on/off + ring
+        # occupancy without scraping /metrics
+        cfg["trace_active"] = self.recorder is not None
+        if self.recorder is not None:
+            cfg["trace_counts"] = self.recorder.counts()
         if self.cache is not None:
             cfg["cache_resident_bytes"] = self.cache.resident_bytes
             cfg["cache_entries"] = self.cache.entry_count
@@ -758,25 +918,22 @@ class DeconvService:
         budget so the NEXT N device batches are traced to cfg.profile_dir
         (SURVEY §5 tracing row: on-demand capture without a restart)."""
         if not self.cfg.profile_dir:
-            return Response.json(
-                {
-                    "error": "bad_request",
-                    "detail": "profiling disabled: set DECONV_PROFILE_DIR",
-                },
-                400,
+            return _error_response(
+                errors.BadRequest("profiling disabled: set DECONV_PROFILE_DIR"),
+                req.id,
             )
         try:
             form = _parse_form(req) if req.body else {}
             batches = int(form.get("batches", 4))
         except errors.DeconvError as e:
-            return _error_response(e)
+            return _error_response(e, req.id)
         except ValueError:
-            return Response.json(
-                {"error": "bad_request", "detail": "batches must be an int"}, 400
+            return _error_response(
+                errors.BadRequest("batches must be an int"), req.id
             )
         if not 1 <= batches <= 64:
-            return Response.json(
-                {"error": "bad_request", "detail": "batches must be in [1, 64]"}, 400
+            return _error_response(
+                errors.BadRequest("batches must be in [1, 64]"), req.id
             )
         # under the lock: a worker thread's read-modify-write decrement in
         # _profile_scope must not stomp a concurrent re-arm
@@ -850,10 +1007,10 @@ class DeconvService:
             )
         except errors.DeconvError as e:
             self.metrics.observe_request(time.perf_counter() - t0, e.code)
-            return _error_response(e)
+            return _error_response(e, req.id)
         except ValueError as e:
             self.metrics.observe_request(time.perf_counter() - t0, "bad_request")
-            return Response.json({"error": "bad_request", "detail": str(e)}, 400)
+            return _error_response(errors.BadRequest(str(e)), req.id)
         self.metrics.observe_request(time.perf_counter() - t0)
         # FastAPI JSON-encodes the returned string (reference app/main.py:78).
         return Response.json(data_url)
@@ -895,10 +1052,10 @@ class DeconvService:
                 payload = await self._encode_tiles_pooled(result)
         except errors.DeconvError as e:
             self.metrics.observe_request(time.perf_counter() - t0, e.code)
-            return _error_response(e)
+            return _error_response(e, req.id)
         except ValueError as e:
             self.metrics.observe_request(time.perf_counter() - t0, "bad_request")
-            return Response.json({"error": "bad_request", "detail": str(e)}, 400)
+            return _error_response(errors.BadRequest(str(e)), req.id)
         self.metrics.observe_request(time.perf_counter() - t0)
         return Response.json(
             {"layer": form["layer"], "mode": mode, **payload}
@@ -965,10 +1122,10 @@ class DeconvService:
                 )
         except errors.DeconvError as e:
             self.dream_metrics.observe_request(time.perf_counter() - t0, e.code)
-            return _error_response(e)
+            return _error_response(e, req.id)
         except ValueError as e:
             self.dream_metrics.observe_request(time.perf_counter() - t0, "bad_request")
-            return Response.json({"error": "bad_request", "detail": str(e)}, 400)
+            return _error_response(errors.BadRequest(str(e)), req.id)
         self.dream_metrics.observe_request(time.perf_counter() - t0)
         loss = result["loss"]
         return Response.json(
@@ -1032,11 +1189,13 @@ class DeconvService:
         self.codec_pool.close()
 
 
-def _error_response(e: errors.DeconvError) -> Response:
+def _error_response(e: errors.DeconvError, request_id: str | None = None) -> Response:
     """Taxonomy error -> JSON response.  Sheds carry a ``Retry-After``
     derived from the batcher's live drain estimate (errors.Overloaded),
-    so client backoff is actionable instead of guessed."""
-    resp = Response.json({"error": e.code, "detail": e.message}, e.status)
+    so client backoff is actionable instead of guessed.  The payload
+    carries the request id (round 8) so a client-side error log joins
+    server logs and flight-recorder traces on one key."""
+    resp = Response.json(errors.to_payload(e, request_id), e.status)
     retry_s = getattr(e, "retry_after_s", None)
     if retry_s:
         import math
@@ -1116,12 +1275,30 @@ def main(argv: list[str] | None = None) -> None:
         "--no-singleflight", action="store_true",
         help="disable duplicate-request coalescing",
     )
+    p.add_argument(
+        "--trace-ring", type=int, default=None,
+        help="flight-recorder ring size per class (0 disables tracing)",
+    )
+    p.add_argument(
+        "--trace-slow-ms", type=float, default=None,
+        help="latency threshold for the slow-trace ring (ms)",
+    )
+    p.add_argument(
+        "--trace-sample", type=float, default=None,
+        help="head-sample rate for the recent-trace ring (0..1)",
+    )
     args = p.parse_args(argv)
     overrides = {}
     if args.cache_bytes is not None:
         overrides["cache_bytes"] = args.cache_bytes
     if args.cache_ttl_s is not None:
         overrides["cache_ttl_s"] = args.cache_ttl_s
+    if args.trace_ring is not None:
+        overrides["trace_ring"] = args.trace_ring
+    if args.trace_slow_ms is not None:
+        overrides["trace_slow_ms"] = args.trace_slow_ms
+    if args.trace_sample is not None:
+        overrides["trace_sample"] = args.trace_sample
     if args.no_singleflight:
         overrides["singleflight"] = False
     if args.host is not None:

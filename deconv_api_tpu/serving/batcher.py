@@ -60,6 +60,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from deconv_api_tpu import errors
+from deconv_api_tpu.serving import trace as trace_mod
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.batcher")
@@ -97,6 +98,10 @@ def _to_daemon_thread(fn: Callable[[], Any]) -> asyncio.Future:
 class WorkItem:
     image: Any  # (H, W, C) np/jnp array, preprocessed
     key: Any  # groupable static config, e.g. (layer_name, mode)
+    # the submitting request's trace (round 8), captured at submit time:
+    # the dispatcher stamps queue-wait/dispatch/fetch spans and the
+    # executed batch's id onto it from _resolve
+    trace: Any = None
     future: asyncio.Future = field(default_factory=asyncio.Future)
     enqueued_at: float = field(default_factory=time.perf_counter)
 
@@ -281,6 +286,7 @@ class BatchingDispatcher:
     async def submit(self, image: Any, key: Any) -> Any:
         if self._stopping:
             raise errors.Unavailable("server shutting down")
+        tr = trace_mod.current_trace()
         # Load shedding (VERDICT r2): when the queue already needs longer
         # than the request timeout to drain, every excess request is a
         # guaranteed 504 after a full timeout's wait — reject it NOW with a
@@ -290,17 +296,30 @@ class BatchingDispatcher:
         if self._shed_factor > 0:
             drain_s = self._estimated_drain_s()
             if drain_s > self._timeout_s * self._shed_factor:
+                if tr is not None:
+                    # a shed request never enqueues: its queue-wait span
+                    # is zero-length but carries the drain estimate that
+                    # shed it, so the error trace explains the 503
+                    tr.add_span(
+                        "queue_wait", time.perf_counter(), 0.0,
+                        shed=True, drain_estimate_s=round(drain_s, 3),
+                    )
                 # (route handlers record the error code; no double-count)
                 raise errors.Overloaded(
                     f"queue drain estimate {drain_s:.1f}s exceeds "
                     f"{self._timeout_s:.0f}s request timeout; shedding",
                     retry_after_s=drain_s,
                 )
-        item = WorkItem(image=image, key=key)
+        item = WorkItem(image=image, key=key, trace=tr)
         await self._queue.put(item)
         try:
             return await asyncio.wait_for(item.future, self._timeout_s)
         except asyncio.TimeoutError:
+            if tr is not None:
+                tr.add_span(
+                    "queue_wait", item.enqueued_at,
+                    time.perf_counter() - item.enqueued_at, timeout=True,
+                )
             raise errors.RequestTimeout(
                 f"no result within {self._timeout_s:.0f}s (device saturated?)"
             ) from None
@@ -485,7 +504,8 @@ class BatchingDispatcher:
                     continue
                 handed_off += 1
                 task = asyncio.create_task(
-                    self._finish(items, thunk, t0), name="batch-fetch"
+                    self._finish(items, thunk, t0, time.perf_counter()),
+                    name="batch-fetch",
                 )
                 self._fetch_tasks.add(task)
                 task.add_done_callback(self._fetch_tasks.discard)
@@ -502,7 +522,13 @@ class BatchingDispatcher:
             # unreached) must not leak the inflight count
             self._inflight -= len(group_list) - handed_off
 
-    async def _finish(self, items: list[WorkItem], thunk, t0: float) -> None:
+    async def _finish(
+        self,
+        items: list[WorkItem],
+        thunk,
+        t0: float,
+        dispatched_at: float | None = None,
+    ) -> None:
         try:
             results = await _to_daemon_thread(thunk)
         except asyncio.CancelledError:
@@ -523,12 +549,22 @@ class BatchingDispatcher:
         finally:
             self._inflight -= 1
             self._fetch_sem.release()
-        self._resolve(items, results, t0)
+        self._resolve(items, results, t0, dispatched_at)
 
-    def _resolve(self, items: list[WorkItem], results: list[Any], t0: float) -> None:
+    def _resolve(
+        self,
+        items: list[WorkItem],
+        results: list[Any],
+        t0: float,
+        dispatched_at: float | None = None,
+    ) -> None:
         """Shared epilogue for both execution modes: metrics + futures.
         Cadence (interval between completions while more work is in
-        flight) feeds the load-shed estimator's sustained-rate input."""
+        flight) feeds the load-shed estimator's sustained-rate input.
+        Round 8: each member request's trace gets its queue-wait and
+        dispatch/fetch spans here, stamped with the batch id that
+        observe_batch just recorded — the join key between a single
+        request's timeline and the batch-level metrics."""
         now = time.perf_counter()
         slog.event(
             _log, "batch_done", level=10,  # DEBUG: per-request http_request
@@ -536,8 +572,9 @@ class BatchingDispatcher:
             key=str(items[0].key), size=len(items),
             ms=round((now - t0) * 1e3, 1), inflight=self._inflight,
         )
+        bid = None
         if self._metrics is not None:
-            self._metrics.observe_batch(
+            bid = self._metrics.observe_batch(
                 size=len(items),
                 compute_s=now - t0,
                 queue_s=t0 - min(it.enqueued_at for it in items),
@@ -555,6 +592,19 @@ class BatchingDispatcher:
                 self._last_done = now
             else:
                 self._last_done = None
+        for it in items:
+            if it.trace is not None:
+                it.trace.annotate(batch_id=bid, batch_size=len(items))
+                it.trace.add_span("queue_wait", it.enqueued_at, t0 - it.enqueued_at)
+                if dispatched_at is not None:
+                    it.trace.add_span(
+                        "dispatch", t0, dispatched_at - t0, batch_id=bid
+                    )
+                    it.trace.add_span(
+                        "fetch", dispatched_at, now - dispatched_at, batch_id=bid
+                    )
+                else:
+                    it.trace.add_span("device", t0, now - t0, batch_id=bid)
         for it, res in zip(items, results):
             if not it.future.done():
                 it.future.set_result(res)

@@ -62,6 +62,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from deconv_api_tpu import errors
+from deconv_api_tpu.serving import trace as trace_mod
 from deconv_api_tpu.serving.http import Request, Response
 
 # Rough per-entry bookkeeping charged against the byte budget on top of
@@ -326,6 +327,14 @@ class Singleflight:
             if fut is not None:
                 return False, fut
             fut = loop.create_future()
+            # Waiter→leader-flight linkage (round 8 tracing spine): the
+            # flight carries its own id and the LEADER's request/trace
+            # id, so a coalesced waiter's trace can point at the flight
+            # that actually computed its bytes — `/v1/debug/requests?id=
+            # <leader>` then shows the compute spans the waiter rode.
+            tr = trace_mod.current_trace()
+            fut.flight_id = f"sf-{key[:12]}"
+            fut.leader_trace_id = tr.id if tr is not None else None
             self._flights[key] = fut
             return True, fut
 

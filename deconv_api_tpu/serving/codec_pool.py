@@ -30,9 +30,12 @@ import asyncio
 import os
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable
 
 import numpy as np
+
+from deconv_api_tpu.serving import trace as trace_mod
 
 
 class PoolClosed(RuntimeError):
@@ -124,6 +127,26 @@ class WorkerPool:
         if self._sem is None:
             # created lazily so the pool can be constructed off-loop
             self._sem = asyncio.Semaphore(self.max_pending)
+        # Round 8 tracing spine: surface the pool HANDOFF latency
+        # (semaphore wait + queue time + worker wakeup) as its own span,
+        # so a fat decode span decomposes into "waiting for a codec
+        # worker" vs actual codec work.  The wrapper runs ON the worker
+        # and closes over the trace object (worker threads have no
+        # request context); RequestTrace is lock-protected for exactly
+        # this writer.
+        tr = trace_mod.current_trace()
+        if tr is not None:
+            t_submit = time.perf_counter()
+            inner = fn
+            pool_name = self._name
+
+            def fn(*a):  # noqa: F811 — deliberate timed wrapper
+                tr.add_span(
+                    f"{pool_name}_handoff", t_submit,
+                    time.perf_counter() - t_submit,
+                )
+                return inner(*a)
+
         await self._sem.acquire()
         self._depth += 1
         self._gauge()

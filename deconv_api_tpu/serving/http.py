@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 from urllib.parse import parse_qsl, unquote, urlsplit
 
+from deconv_api_tpu.serving.trace import request_id_from
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.http")
@@ -33,6 +34,10 @@ CORS_HEADERS = {
     "access-control-allow-origin": "*",
     "access-control-allow-methods": "*",
     "access-control-allow-headers": "*",
+    # without this a browser client can SEE only the safelisted headers —
+    # x-request-id (round 8) and x-cache (round 7) would be invisible to
+    # the reference's React client even though curl shows them
+    "access-control-expose-headers": "*",
 }
 
 _STATUS_TEXT = {
@@ -51,6 +56,12 @@ class Request:
     query: dict[str, str]
     headers: dict[str, str]
     body: bytes
+    # Stable per-request id (round 8 tracing spine): a sane inbound
+    # x-request-id header is honored, otherwise the server mints one at
+    # parse time.  Every response echoes it back, every access/error log
+    # line and flight-recorder trace carries it — the one join key
+    # across client logs, server logs, metrics exemplars and traces.
+    id: str = ""
     # memoized form() result — the response cache derives its key from
     # the parsed form and the route handler parses the same body again;
     # one parse serves both (round 7).  None = not parsed yet.
@@ -183,14 +194,21 @@ class HttpServer:
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         if self._max_connections > 0 and self._nconn >= self._max_connections:
+            # minted id even on a connection-cap reject: the 503 body/
+            # header and the http_reject log line join on it (no request
+            # was parsed, so there is no inbound id to honor)
+            rid = request_id_from(None)
             slog.event(
                 _log, "http_reject", level=logging.WARNING,
                 status=503, reason="too_many_connections", nconn=self._nconn,
+                id=rid,
             )
             try:
-                writer.write(
-                    Response.json({"error": "too many connections"}, 503).encode(False)
+                resp = Response.json(
+                    {"error": "too many connections", "request_id": rid}, 503
                 )
+                resp.headers["x-request-id"] = rid
+                writer.write(resp.encode(False))
                 await writer.drain()
                 # Drain briefly before close: closing with unread request
                 # bytes in the socket buffer sends RST, which can destroy
@@ -220,6 +238,10 @@ class HttpServer:
                 keep_alive = req.headers.get("connection", "keep-alive") != "close"
                 t0 = time.perf_counter()
                 resp = await self._dispatch(req)
+                # EVERY response carries the request id — success, 4xx,
+                # shed 503, handler-crash 500 — so a client-side log line
+                # joins server logs and flight-recorder traces on one key
+                resp.headers.setdefault("x-request-id", req.id)
                 # 500 = handler crash -> ERROR.  503/504 are DESIGNED
                 # backpressure (shedding, timeouts) — WARNING, or they
                 # would flood error alerting exactly at peak load.
@@ -231,6 +253,7 @@ class HttpServer:
                 slog.event(
                     _log, "http_request", level=lvl,
                     method=req.method, path=req.path, status=resp.status,
+                    id=req.id,
                     ms=round((time.perf_counter() - t0) * 1e3, 1),
                 )
                 writer.write(resp.encode(keep_alive))
@@ -245,13 +268,22 @@ class HttpServer:
         except _BadRequest as e:
             # protocol-level rejections (400/408/413/431) never reach
             # _dispatch, so they get their own structured line — these are
-            # exactly the abuse signals operators grep for (r3 review)
+            # exactly the abuse signals operators grep for (r3 review).
+            # A Request object may never have been built (the reject can
+            # fire mid-header-parse), so the id is MINTED here; body,
+            # header and log line carry the same one (round 8 contract:
+            # every response joins on x-request-id).
+            rid = request_id_from(None)
             slog.event(
                 _log, "http_reject", level=logging.WARNING,
-                status=e.status, reason=str(e),
+                status=e.status, reason=str(e), id=rid,
             )
             try:
-                writer.write(Response.json({"error": str(e)}, e.status).encode(False))
+                resp = Response.json(
+                    {"error": str(e), "request_id": rid}, e.status
+                )
+                resp.headers["x-request-id"] = rid
+                writer.write(resp.encode(False))
                 await writer.drain()
             except ConnectionResetError:
                 pass
@@ -317,7 +349,10 @@ class HttpServer:
                 raise _BadRequest(408, "body read timed out") from None
         parts = urlsplit(target)
         query = {k: v for k, v in parse_qsl(parts.query, keep_blank_values=True)}
-        return Request(method.upper(), unquote(parts.path), query, headers, body)
+        return Request(
+            method.upper(), unquote(parts.path), query, headers, body,
+            request_id_from(headers.get("x-request-id")),
+        )
 
     async def _read_chunked(self, reader: asyncio.StreamReader) -> bytes:
         chunks = []
@@ -363,10 +398,17 @@ class HttpServer:
             traceback.print_exc()
             slog.event(
                 _log, "handler_crash", level=logging.ERROR,
-                path=req.path, error=f"{type(e).__name__}: {e}",
+                path=req.path, id=req.id, error=f"{type(e).__name__}: {e}",
             )
+            from deconv_api_tpu import errors
+
+            # one payload shape for every error body (errors.to_payload):
+            # the base DeconvError carries internal_error/500
             return Response.json(
-                {"error": "internal_error", "detail": f"{type(e).__name__}: {e}"}, 500
+                errors.to_payload(
+                    errors.DeconvError(f"{type(e).__name__}: {e}"), req.id
+                ),
+                500,
             )
 
 

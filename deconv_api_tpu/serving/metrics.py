@@ -14,6 +14,19 @@ import threading
 import time
 
 
+def escape_label(value: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote and
+    newline must be escaped inside the quoted value (exposition format
+    spec).  Error codes and stage names are identifiers today, but the
+    exposition must stay parseable even if a future code carries one."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class _Reservoir:
     """Bounded sliding-window sample for quantiles (lock-protected).
 
@@ -70,13 +83,18 @@ class Metrics:
             if error_code:
                 self.errors_total[error_code] = self.errors_total.get(error_code, 0) + 1
 
-    def observe_batch(self, size: int, compute_s: float, queue_s: float) -> None:
+    def observe_batch(self, size: int, compute_s: float, queue_s: float) -> int:
+        """Record one executed batch; returns the BATCH ID — the monotone
+        ordinal of this batch on this metrics stream.  The dispatcher
+        stamps it onto every member request's trace (round 8), so a
+        flight-recorder trace and the batch-level metrics join on it."""
         with self._lock:
             self.batches_total += 1
             self.images_total += size
             self._batch_size.add(float(size))
             self._compute.add(compute_s)
             self._queue_wait.add(queue_s)
+            return self.batches_total
 
     def observe_cadence(self, cadence_s: float) -> None:
         """Interval between consecutive batch COMPLETIONS while more work
@@ -189,15 +207,29 @@ class Metrics:
             f"# TYPE {p}_queue_wait_seconds summary",
             f'{p}_queue_wait_seconds{{quantile="0.5"}} {s["queue_wait_p50_s"]:.6f}',
         ]
-        for code, n in s["errors_total"].items():
-            lines.append(f'{p}_errors_total{{code="{code}"}} {n}')
-        for stage, q in s["stages"].items():
+        if s["errors_total"]:
+            # untyped-series fix (round 8): these labeled lines shipped
+            # headerless, so Prometheus ingested them as untyped and the
+            # exposition lint had nothing to hold them to
+            lines.append(f"# HELP {p}_errors_total requests failed, by taxonomy code")
+            lines.append(f"# TYPE {p}_errors_total counter")
+            for code, n in sorted(s["errors_total"].items()):
+                lines.append(
+                    f'{p}_errors_total{{code="{escape_label(code)}"}} {n}'
+                )
+        if s["stages"]:
             lines.append(
-                f'{p}_stage_seconds{{stage="{stage}",quantile="0.5"}} {q["p50_s"]:.6f}'
+                f"# HELP {p}_stage_seconds per-request pipeline stage wall time"
             )
-            lines.append(
-                f'{p}_stage_seconds{{stage="{stage}",quantile="0.99"}} {q["p99_s"]:.6f}'
-            )
+            lines.append(f"# TYPE {p}_stage_seconds summary")
+            for stage, q in sorted(s["stages"].items()):
+                esc = escape_label(stage)
+                lines.append(
+                    f'{p}_stage_seconds{{stage="{esc}",quantile="0.5"}} {q["p50_s"]:.6f}'
+                )
+                lines.append(
+                    f'{p}_stage_seconds{{stage="{esc}",quantile="0.99"}} {q["p99_s"]:.6f}'
+                )
         # named counters (round 7): cache hit/miss/coalesced/eviction totals
         for name, n in sorted(s["counters"].items()):
             lines.append(f"# TYPE {p}_{name} counter")

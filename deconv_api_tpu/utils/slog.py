@@ -37,6 +37,22 @@ class _JsonFormatter(logging.Formatter):
         fields = getattr(record, "fields", None)
         if fields:
             payload.update(fields)
+        if "id" not in payload:
+            # Round 8 tracing spine: any line emitted inside a traced
+            # request's context inherits that request's id, so ad-hoc
+            # handler/engine log lines join access logs, error payloads
+            # and /v1/debug/requests traces without each call site
+            # remembering to thread the id through.  Lazy import keeps
+            # utils importable without the serving layer; formatting
+            # only runs for records that passed the level threshold.
+            try:
+                from deconv_api_tpu.serving.trace import current_trace
+
+                tr = current_trace()
+                if tr is not None:
+                    payload["id"] = tr.id
+            except ImportError:  # pragma: no cover — partial installs
+                pass
         if record.exc_info and record.exc_info[0] is not None:
             payload["exc"] = self.formatException(record.exc_info).splitlines()[-1]
         return json.dumps(payload, default=str)

@@ -17,13 +17,23 @@ import time
 
 @contextlib.contextmanager
 def stage(metrics, name: str):
-    """Time a pipeline stage into the metrics registry (no-op without one)."""
+    """Time a pipeline stage into the metrics registry (no-op without
+    one) AND onto the active request trace (round 8): the same wall-time
+    window feeds the aggregate stage quantiles and the per-request span
+    timeline, so the two can never disagree about where time went."""
+    # lazy import: utils must stay importable without the serving layer
+    from deconv_api_tpu.serving.trace import current_trace
+
+    tr = current_trace()
     t0 = time.perf_counter()
     try:
         yield
     finally:
+        dt = time.perf_counter() - t0
         if metrics is not None:
-            metrics.observe_stage(name, time.perf_counter() - t0)
+            metrics.observe_stage(name, dt)
+        if tr is not None:
+            tr.add_span(name, t0, dt)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,148 @@
+"""Pure-python Prometheus exposition lint (round 8 satellite).
+
+Round 7 shipped `deconv_errors_total{code=…}` and the per-stage
+`stage_seconds` series with NO `# TYPE`/`# HELP` header, so Prometheus
+ingested them as untyped and nothing held the exposition to its own
+format.  This lint walks every emitted line and asserts the contract:
+
+- every sample line parses (name, optional label block, numeric value);
+- every sampled metric family has exactly ONE `# TYPE` line, with a
+  valid kind;
+- label values are correctly escaped (the label block must round-trip
+  through the escaping grammar);
+- counter families are MONOTONE across two snapshots with traffic in
+  between.
+
+Shared by the trace-spine e2e test (tests/test_trace.py lints the live
+``/v1/metrics`` output through the same walker).
+"""
+
+from __future__ import annotations
+
+import re
+
+from deconv_api_tpu.serving.metrics import Metrics, escape_label
+from deconv_api_tpu.serving.trace import FlightRecorder, RequestTrace
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"  # metric name
+    r"(?:\{(.*)\})?"  # optional label block
+    r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|NaN|[+-]?Inf))$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
+_KINDS = ("counter", "gauge", "summary", "histogram", "untyped")
+
+
+def lint_exposition(text: str) -> tuple[dict[str, str], dict[tuple, float]]:
+    """Walk every line of a Prometheus text exposition; returns
+    ``(family -> kind, (family, label-block) -> value)``.  Raises
+    AssertionError on any format violation."""
+    families: dict[str, str] = {}
+    samples: dict[tuple, float] = {}
+    for line in text.rstrip("\n").split("\n"):
+        assert line, "blank line in exposition"
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"malformed TYPE line {line!r}"
+            _, _, name, kind = parts
+            assert name not in families, f"duplicate TYPE line for {name}"
+            assert kind in _KINDS, f"invalid TYPE kind {kind!r}"
+            families[name] = kind
+        elif line.startswith("# HELP "):
+            assert len(line.split(" ")) >= 4, f"malformed HELP line {line!r}"
+        elif line.startswith("#"):
+            raise AssertionError(f"unknown comment line {line!r}")
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line {line!r}"
+            name, labels, value = m.groups()
+            if labels:
+                # the whole label block must round-trip through the
+                # escaping grammar — an unescaped quote/backslash/newline
+                # in a value breaks the reconstruction
+                rebuilt = ",".join(
+                    f'{k}="{v}"' for k, v in _LABEL_RE.findall(labels)
+                )
+                assert rebuilt == labels, f"bad label escaping in {line!r}"
+            samples[(name, labels or "")] = float(value)
+    for name, _ in samples:
+        assert name in families, f"sample {name} has no TYPE header"
+    return families, samples
+
+
+def _traffic(m: Metrics) -> None:
+    m.observe_request(0.012)
+    m.observe_request(0.050, error_code="overloaded")
+    m.observe_request(0.003, error_code="unknown_layer")
+    m.observe_batch(size=4, compute_s=0.04, queue_s=0.01)
+    m.observe_cadence(0.02)
+    m.observe_stage("decode", 0.002)
+    m.observe_stage("compute", 0.030)
+    m.inc_counter("cache_hits_total", 2)
+    m.set_gauge("cache_resident_bytes", 1024)
+
+
+def test_every_family_typed_once_and_labels_escape():
+    m = Metrics()
+    _traffic(m)
+    # hostile label values must come out escaped, not exposition-breaking
+    m.observe_request(0.001, error_code='we"ird\\code\nwith newline')
+    m.observe_stage('sta"ge', 0.001)
+    text = m.prometheus()
+    families, samples = lint_exposition(text)
+    assert families["deconv_errors_total"] == "counter"
+    assert families["deconv_stage_seconds"] == "summary"
+    assert any(name == "deconv_errors_total" for name, _ in samples)
+    # the raw quote must not appear unescaped inside any label block
+    for line in text.splitlines():
+        if "we" in line and "ird" in line:
+            assert '\\"' in line
+
+
+def test_counters_monotone_across_two_snapshots():
+    m = Metrics()
+    _traffic(m)
+    _, first = lint_exposition(m.prometheus())
+    _traffic(m)  # more traffic strictly increases every counter touched
+    families, second = lint_exposition(m.prometheus())
+    counter_families = {n for n, k in families.items() if k == "counter"}
+    checked = 0
+    for key, v2 in second.items():
+        if key[0] in counter_families and key in first:
+            assert v2 >= first[key], f"counter {key} went backwards"
+            checked += 1
+    assert checked >= 5  # requests/images/batches/errors/cache at least
+
+
+def test_multi_stream_exposition_with_trace_block_lints():
+    """The live /v1/metrics response concatenates three prefixed Metrics
+    streams plus the flight recorder's trace block; family uniqueness
+    must hold across the whole concatenation."""
+    main, dream, sweep = Metrics(), Metrics("dream"), Metrics("sweep")
+    for m in (main, dream, sweep):
+        _traffic(m)
+    rec = FlightRecorder(8, slow_ms=1.0, sample=1.0)
+    for i in range(3):
+        tr = RequestTrace(f"rid-{i}", "/")
+        t0 = tr.t0
+        tr.add_span("decode", t0, 0.001)
+        tr.add_span("dispatch", t0 + 0.001, 0.004, batch_id=i + 1)
+        tr.finish(status=200 if i else 422, error=None if i else "unknown_layer")
+        rec.record(tr)
+    text = (
+        main.prometheus() + dream.prometheus() + sweep.prometheus()
+        + rec.prometheus("deconv")
+    )
+    families, samples = lint_exposition(text)
+    assert families["deconv_traces_total"] == "counter"
+    assert families["deconv_trace_span_seconds_total"] == "counter"
+    assert samples[("deconv_traces_total", 'class="all"')] == 3.0
+    assert samples[("deconv_traces_total", 'class="error"')] == 1.0
+    assert samples[("deconv_trace_spans_total", 'span="decode"')] == 3.0
+
+
+def test_escape_label_helper():
+    assert escape_label('a"b') == 'a\\"b'
+    assert escape_label("a\\b") == "a\\\\b"
+    assert escape_label("a\nb") == "a\\nb"
+    assert escape_label("plain_code") == "plain_code"

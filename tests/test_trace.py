@@ -1,0 +1,375 @@
+"""Round-8 tracing spine: request ids on every response, span-structured
+traces in the flight recorder, and the tricky propagation seams —
+coalesced cache waiters referencing the leader flight, shed 503s still
+producing an error trace with their queue-wait span, batched requests
+carrying the batch id that `observe_batch` recorded.  Fast lane: tiny
+injected spec, CPU, real HTTP over a socket."""
+
+import asyncio
+import json
+import logging
+import re
+
+import httpx
+import pytest
+
+import jax
+
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.models.spec import init_params
+from deconv_api_tpu.serving.app import DeconvService
+from deconv_api_tpu.serving.http import Request, Response
+from deconv_api_tpu.serving.trace import (
+    FlightRecorder,
+    RequestTrace,
+    request_id_from,
+)
+from deconv_api_tpu.utils import slog
+from tests.test_engine_parity import TINY
+from tests.test_metrics_exposition import lint_exposition
+from tests.test_serving import ServiceFixture, _data_url
+
+
+@pytest.fixture(scope="module")
+def server():
+    params = init_params(TINY, jax.random.PRNGKey(21))
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        warmup_all_buckets=False,
+        compilation_cache_dir="",
+        # high threshold: tests put traces in the slow ring deliberately,
+        # not as a side effect of a loaded CI host
+        trace_slow_ms=30_000.0,
+    )
+    service = DeconvService(cfg, spec=TINY, params=params)
+    with ServiceFixture(cfg, service=service) as s:
+        yield s
+
+
+def _post(server, path, data, **kw):
+    return httpx.post(server.base_url + path, data=data, timeout=120, **kw)
+
+
+# --------------------------------------------------------- request ids
+
+
+def test_request_id_on_every_response_kind(server):
+    """Success, 4xx, 404 and plain GETs all carry x-request-id."""
+    ok = _post(server, "/", {"file": _data_url(60), "layer": "b2c1"})
+    assert ok.status_code == 200
+    assert re.match(r"^[0-9a-f]{6}-[0-9a-f]{8}$", ok.headers["x-request-id"])
+    err = _post(server, "/", {"file": _data_url(61), "layer": "no_such"})
+    assert err.status_code == 422
+    assert err.headers["x-request-id"]
+    health = httpx.get(server.base_url + "/health-check")
+    assert health.headers["x-request-id"]
+    missing = httpx.get(server.base_url + "/no/such/route")
+    assert missing.status_code == 404 and missing.headers["x-request-id"]
+
+
+def test_protocol_reject_carries_minted_request_id(server):
+    """400/408/413/431 rejects fire before a Request exists; the id is
+    minted server-side and rides header + body + http_reject log line."""
+    import socket
+
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        s.sendall(
+            b"POST / HTTP/1.1\r\nHost: x\r\nContent-Length: -5\r\n\r\n"
+        )
+        raw = s.recv(65536)
+    head, _, body = raw.partition(b"\r\n\r\n")
+    assert b" 400 " in head.split(b"\r\n", 1)[0]
+    rid = None
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"x-request-id:"):
+            rid = line.split(b":", 1)[1].strip().decode()
+    assert rid and re.match(r"^[0-9a-f]{6}-[0-9a-f]{8}$", rid)
+    assert json.loads(body)["request_id"] == rid
+
+
+def test_inbound_request_id_honored_and_sanitized(server):
+    r = httpx.get(
+        server.base_url + "/health-check",
+        headers={"x-request-id": "client-id.42_A-ok"},
+    )
+    assert r.headers["x-request-id"] == "client-id.42_A-ok"
+    # hostile/malformed inbound ids are REPLACED, never echoed (an
+    # unsanitized echo is a header-splitting primitive)
+    r = httpx.get(
+        server.base_url + "/health-check",
+        headers={"x-request-id": "spaces are not ok"},
+    )
+    assert r.headers["x-request-id"] != "spaces are not ok"
+    assert re.match(r"^[0-9a-f]{6}-[0-9a-f]{8}$", r.headers["x-request-id"])
+    assert request_id_from("x" * 65) != "x" * 65  # over-length rejected
+    assert request_id_from("good-id") == "good-id"
+
+
+def test_error_payload_carries_request_id(server):
+    r = _post(server, "/", {"file": _data_url(62), "layer": "definitely_not"})
+    assert r.status_code == 422
+    body = r.json()
+    assert body["error"] == "unknown_layer"
+    assert body["request_id"] == r.headers["x-request-id"]
+
+
+def test_slog_access_line_carries_request_id(server):
+    log = slog.get_logger("deconv.http")
+    records = []
+
+    class H(logging.Handler):
+        def emit(self, record):
+            records.append(slog._JsonFormatter().format(record))
+
+    h = H()
+    log.addHandler(h)
+    log.setLevel(logging.INFO)
+    try:
+        r = httpx.get(
+            server.base_url + "/health-check",
+            headers={"x-request-id": "slog-join-key"},
+        )
+        assert r.headers["x-request-id"] == "slog-join-key"
+    finally:
+        log.removeHandler(h)
+    access = [
+        json.loads(s) for s in records
+        if json.loads(s)["event"] == "http_request"
+    ]
+    assert any(o.get("id") == "slog-join-key" for o in access), records
+
+
+# ------------------------------------------------- span-structured traces
+
+
+def test_compute_trace_spans_consistent_with_latency(server):
+    """A full compute-path trace decomposes into decode / queue-wait /
+    dispatch / fetch spans that all fit inside the recorded total, and
+    the covering compute span reaches (nearly) the total — the
+    "span wall-clock sum is consistent with the response latency"
+    acceptance pin."""
+    svc = server.service
+    r = _post(
+        server, "/", {"file": _data_url(63), "layer": "b2c1"},
+        headers={"cache-control": "no-cache"},  # force the full pipeline
+    )
+    assert r.status_code == 200
+    rid = r.headers["x-request-id"]
+    d = httpx.get(server.base_url + f"/v1/debug/requests?id={rid}").json()
+    assert d["requests"], d
+    t = d["requests"][0]
+    assert t["id"] == rid and t["status"] == 200 and t["route"] == "/"
+    names = {s["name"] for s in t["spans"]}
+    assert {"decode", "compute", "queue_wait"} <= names, names
+    assert "dispatch" in names or "device" in names, names
+    for s in t["spans"]:
+        assert s["start_ms"] >= -0.5, s
+        assert s["start_ms"] + s["ms"] <= t["total_ms"] + 1.0, (s, t["total_ms"])
+    # the compute stage span covers queue+dispatch+fetch: it must reach
+    # most of the total (decode + encode are the only time outside it)
+    compute = max(s for s in t["spans"] if s["name"] == "compute")
+    assert compute["start_ms"] + compute["ms"] >= t["total_ms"] * 0.5
+    # batch membership: the trace carries the id observe_batch recorded
+    assert isinstance(t["batch_id"], int)
+    assert 1 <= t["batch_id"] <= svc.metrics.snapshot()["batches_total"]
+    assert t["batch_size"] >= 1
+    assert t["cache"] == "bypass"
+
+
+def test_cache_hit_trace_is_minimal(server):
+    data = {"file": _data_url(64), "layer": "b1c2"}
+    assert _post(server, "/", data).status_code == 200  # fill
+    hit = _post(server, "/", data)
+    assert hit.headers["x-cache"] == "hit"
+    rid = hit.headers["x-request-id"]
+    t = httpx.get(server.base_url + f"/v1/debug/requests?id={rid}").json()[
+        "requests"
+    ][0]
+    assert t["cache"] == "hit"
+    assert [s["name"] for s in t["spans"]] == ["cache_hit"]
+    assert "batch_id" not in t  # a hit never touched the batcher
+
+
+def test_coalesced_waiter_trace_links_leader_flight(server):
+    """A coalesced cache waiter's trace must point at the flight that
+    actually computed its bytes: `coalesced_into` carries the LEADER's
+    request id, whose own trace holds the compute spans."""
+    svc = server.service
+
+    async def go():
+        started = asyncio.Event()
+
+        async def slow_handler(_req):
+            started.set()
+            await asyncio.sleep(0.2)
+            return Response.json("computed")
+
+        wrapped = svc._trace_wrap(
+            "/flight-trace",
+            svc._cache_wrap("/flight-trace", slow_handler, svc.metrics),
+        )
+
+        def req(rid):
+            return Request(
+                "POST", "/flight-trace", {},
+                {"content-type": "application/x-www-form-urlencoded",
+                 "x-request-id": rid},
+                b"probe=coalesce-trace", rid,
+            )
+
+        leader_task = asyncio.create_task(wrapped(req("leader-req")))
+        await started.wait()
+        waiter_task = asyncio.create_task(wrapped(req("waiter-req")))
+        r_leader = await leader_task
+        r_waiter = await waiter_task
+        assert r_leader.status == 200 and r_waiter.status == 200
+        assert r_waiter.headers["x-cache"] == "coalesced"
+        # the waiter's response must carry its OWN id, not the leader's
+        # (the copied headers are the leader's dict — pinned override)
+        assert r_waiter.headers["x-request-id"] == "waiter-req"
+
+    asyncio.run(go())
+    waiter = svc.recorder.query(trace_id="waiter-req")[0]
+    assert waiter["coalesced_into"] == "leader-req"
+    assert waiter["flight"].startswith("sf-")
+    waits = [s for s in waiter["spans"] if s["name"] == "coalesce_wait"]
+    assert waits and waits[0]["leader"] == "leader-req"
+    assert waits[0]["ms"] >= 100  # parked while the leader computed
+    leader = svc.recorder.query(trace_id="leader-req")[0]
+    assert leader["total_ms"] >= 180  # the flight that did the work
+
+
+def test_shed_503_produces_error_trace_with_queue_wait(server, monkeypatch):
+    """A shed request never enqueues, but its error trace must still
+    carry a queue-wait span — zero-length, annotated with the drain
+    estimate that shed it."""
+    svc = server.service
+    monkeypatch.setattr(svc.dispatcher, "_estimated_drain_s", lambda: 1e9)
+    r = _post(
+        server, "/", {"file": _data_url(65), "layer": "b2c1"},
+        headers={"cache-control": "no-cache"},  # bypass cache + flights
+    )
+    assert r.status_code == 503
+    body = r.json()
+    assert body["error"] == "overloaded"
+    rid = r.headers["x-request-id"]
+    assert body["request_id"] == rid
+    assert "retry-after" in r.headers
+    errs = httpx.get(server.base_url + "/v1/debug/requests?error=1").json()
+    mine = [t for t in errs["requests"] if t["id"] == rid]
+    assert mine, errs
+    t = mine[0]
+    assert t["status"] == 503 and t["error"] == "overloaded"
+    qw = [s for s in t["spans"] if s["name"] == "queue_wait"]
+    assert qw and qw[0]["shed"] is True
+    assert qw[0]["drain_estimate_s"] > 0
+
+
+def test_debug_requests_filters_and_limit(server):
+    errs = httpx.get(server.base_url + "/v1/debug/requests?error=1").json()
+    assert errs["requests"] and all(
+        t["status"] >= 400 for t in errs["requests"]
+    )
+    one = httpx.get(server.base_url + "/v1/debug/requests?limit=1").json()
+    assert len(one["requests"]) == 1
+    none = httpx.get(
+        server.base_url + "/v1/debug/requests?id=no-such-trace"
+    ).json()
+    assert none["requests"] == []
+    bad = httpx.get(server.base_url + "/v1/debug/requests?limit=zap")
+    assert bad.status_code == 400
+    counts = errs["counts"]
+    assert counts["traces_total"] >= counts["error_total"] >= 1
+
+
+def test_config_and_metrics_surface_trace_state(server):
+    c = httpx.get(server.base_url + "/v1/config").json()
+    assert c["trace_active"] is True
+    assert c["trace_ring"] == 256
+    assert c["trace_counts"]["traces_total"] >= 1
+    text = httpx.get(server.base_url + "/v1/metrics").text
+    assert 'deconv_traces_total{class="all"}' in text
+    assert "# TYPE deconv_trace_span_seconds_total counter" in text
+    # the whole live multi-stream exposition (3 prefixes + trace block +
+    # the round-8 errors_total/stage_seconds TYPE fixes) passes the lint
+    families, _ = lint_exposition(text)
+    assert families["deconv_errors_total"] == "counter"
+    assert families["deconv_stage_seconds"] == "summary"
+
+
+def test_trace_disabled_escape_hatch():
+    """trace_ring=0 removes the spine (no recorder, 400 from the debug
+    surface) but request ids keep flowing."""
+    params = init_params(TINY, jax.random.PRNGKey(22))
+    cfg = ServerConfig(
+        image_size=16, max_batch=4, batch_window_ms=1.0,
+        warmup_all_buckets=False, compilation_cache_dir="", trace_ring=0,
+    )
+    service = DeconvService(cfg, spec=TINY, params=params)
+    assert service.recorder is None
+    with ServiceFixture(cfg, service=service) as s:
+        r = _post(s, "/", {"file": _data_url(66), "layer": "b2c1"})
+        assert r.status_code == 200 and r.headers["x-request-id"]
+        d = httpx.get(s.base_url + "/v1/debug/requests")
+        assert d.status_code == 400
+        c = httpx.get(s.base_url + "/v1/config").json()
+        assert c["trace_active"] is False
+
+
+# ------------------------------------------------- flight recorder unit
+
+
+def _fake_trace(rid, status=200, total_s=0.01, route="/"):
+    tr = RequestTrace(rid, route)
+    tr.add_span("decode", tr.t0, total_s / 2)
+    tr.finish(status=status, error="unknown_layer" if status >= 400 else None)
+    tr.total_ms = total_s * 1e3  # deterministic, not wall-clock-bound
+    return tr
+
+
+def test_recorder_rings_bounded_and_classified():
+    rec = FlightRecorder(4, slow_ms=50.0, sample=1.0)
+    for i in range(10):
+        rec.record(_fake_trace(f"ok-{i}", total_s=0.001))
+    rec.record(_fake_trace("slow-1", total_s=0.2))
+    rec.record(_fake_trace("err-1", status=422))
+    c = rec.counts()
+    assert c["recent"] <= 4  # ring bound holds
+    assert c["slow"] == 1 and c["errors"] == 1
+    assert c["traces_total"] == 12
+    assert [t["id"] for t in rec.query(slow=True)] == ["slow-1"]
+    assert [t["id"] for t in rec.query(error=True)] == ["err-1"]
+    assert rec.query(trace_id="err-1")[0]["error"] == "unknown_layer"
+
+
+def test_recorder_tail_sampling_keeps_slow_and_errors():
+    """sample=0 thins the recent ring to nothing, but slow and error
+    traces are ALWAYS retained — the tail-sampling contract."""
+    rec = FlightRecorder(8, slow_ms=50.0, sample=0.0)
+    for i in range(5):
+        rec.record(_fake_trace(f"ok-{i}", total_s=0.001))
+    rec.record(_fake_trace("slow-1", total_s=0.1))
+    rec.record(_fake_trace("err-1", status=503))
+    c = rec.counts()
+    assert c["recent"] == 0
+    assert c["slow"] == 1 and c["errors"] == 1
+
+
+def test_recorder_sampling_rate():
+    for sample, expect in ((0.25, 25), (0.75, 75), (0.4, 40), (1.0, 100)):
+        rec = FlightRecorder(1000, slow_ms=1e9, sample=sample)
+        for i in range(100):
+            rec.record(_fake_trace(f"ok-{i}"))
+        # stratified deterministic sampling: ANY rate retains exactly
+        # floor(N*sample), not the nearest 1-in-k quantization
+        assert rec.counts()["recent"] == expect, sample
+
+
+def test_recorder_union_query_dedups():
+    rec = FlightRecorder(8, slow_ms=50.0, sample=1.0)
+    # slow AND error: same trace dict lands in both rings
+    rec.record(_fake_trace("both-1", status=504, total_s=0.2))
+    union = rec.query(slow=True, error=True)
+    assert [t["id"] for t in union] == ["both-1"]

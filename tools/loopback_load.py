@@ -14,7 +14,17 @@ bench_suite_results.jsonl via tools/run_experiments.py
 (`loopback:tool/loopback_load.py`) or redirect by hand.
 
 Usage: python tools/loopback_load.py [--passes N] [--no-donate]
-           [--key-dist unique|zipf:<s>|hotset:<k>] [--requests N] [depth ...]
+           [--key-dist unique|zipf:<s>|hotset:<k>] [--requests N]
+           [--trace-ring N] [--slow-ms F] [--dump-slow PATH] [depth ...]
+
+Round 8 added the tracing-spine hooks: every request's `x-request-id`
+is captured client-side, `--trace-ring 0` disables the server's trace
+spine (the tracing-overhead A/B that tools/run_bench_suite.py's
+`trace-on` guard runs), and `--dump-slow <path>` fetches
+`/v1/debug/requests?slow=1` after the run and joins client-observed vs
+server-observed latency per request id into a JSON artifact —
+"loopback says 12 ms, server says 3 ms" becomes a diffable table
+(`--slow-ms` tunes the threshold; defaults to 5 ms in dump mode).
 
 `--passes N` runs N measurement passes per depth and reports the best
 (all passes carried in `passes_req_s` — the bench.py best-of-N
@@ -90,13 +100,24 @@ def _key_streams(
     return [stream[p * n : (p + 1) * n] for p in range(passes)]
 
 
-def _xcache_kind(raw: bytes) -> str:
-    """Parse the x-cache response header out of a raw HTTP byte blob."""
-    head = raw.split(b"\r\n\r\n", 1)[0].lower()
+def _resp_meta(raw: bytes) -> tuple[str, str]:
+    """(x-cache kind, x-request-id) out of a raw HTTP byte blob.  The
+    request id is the join key against the server's flight-recorder
+    traces (`--dump-slow`): client-observed vs server-observed latency
+    per ID, instead of two unjoinable aggregates."""
+    head = raw.split(b"\r\n\r\n", 1)[0]
+    kind, rid = "none", ""
     for line in head.split(b"\r\n"):
-        if line.startswith(b"x-cache:"):
-            return line.split(b":", 1)[1].strip().decode()
-    return "none"
+        # case-fold the header NAME only: request ids are case-sensitive
+        # ([A-Za-z0-9._-]) and folding the value would silently break
+        # the --dump-slow join for client-supplied mixed-case ids
+        name, _, value = line.partition(b":")
+        name = name.strip().lower()
+        if name == b"x-cache":
+            kind = value.strip().decode().lower()
+        elif name == b"x-request-id":
+            rid = value.strip().decode()
+    return kind, rid
 
 
 def run_load(
@@ -106,6 +127,9 @@ def run_load(
     passes: int = 1,
     donate: bool = True,
     key_dist: str | None = None,
+    trace_ring: int | None = None,
+    slow_ms: float | None = None,
+    dump_slow: str | None = None,
 ) -> dict:
     import jax
 
@@ -134,6 +158,11 @@ def run_load(
     )
     params = init_params(spec, jax.random.PRNGKey(0))
     cache_on = key_dist is not None
+    trace_kw = {}
+    if trace_ring is not None:
+        trace_kw["trace_ring"] = trace_ring
+    if slow_ms is not None:
+        trace_kw["trace_slow_ms"] = slow_ms
     cfg = ServerConfig(
         image_size=32,
         max_batch=32,
@@ -147,6 +176,7 @@ def run_load(
         # row would stop measuring the decode->dispatch->encode machinery
         cache_bytes=cfg_cache_bytes() if cache_on else 0,
         singleflight=cache_on,
+        **trace_kw,
     )
     service = DeconvService(cfg, spec=spec, params=params)
 
@@ -172,7 +202,7 @@ def run_load(
         sem = asyncio.Semaphore(concurrency)
 
         async def one(
-            i: int, indices: list[int], samples: list[tuple[float, str]]
+            i: int, indices: list[int], samples: list[tuple[float, str, str]]
         ):
             body = urllib.parse.urlencode(
                 {"file": uris[indices[i]], "layer": "c3"}
@@ -191,7 +221,8 @@ def run_load(
                 await writer.drain()
                 raw = await reader.read()
                 writer.close()
-                samples.append((time.perf_counter() - t0, _xcache_kind(raw)))
+                kind, rid = _resp_meta(raw)
+                samples.append((time.perf_counter() - t0, kind, rid))
                 assert b" 200 " in raw.split(b"\r\n", 1)[0], raw[:120]
 
         # Best-of-N passes (the bench.py round-6 methodology): one pass is
@@ -203,7 +234,7 @@ def run_load(
         # cold-fill mixture and stays visible in passes_req_s.
         runs = []
         for indices in streams:
-            samples: list[tuple[float, str]] = []
+            samples: list[tuple[float, str, str]] = []
             t0 = time.perf_counter()
             await asyncio.gather(
                 *(one(i, indices, samples) for i in range(n_requests))
@@ -211,6 +242,55 @@ def run_load(
             wall = time.perf_counter() - t0
             runs.append((wall, samples))
         snap = service.metrics.snapshot()
+        dump = None
+        if dump_slow:
+            # While the server is still up: pull the flight recorder's
+            # slow ring and JOIN it per request id with the client-side
+            # latencies — "loopback says 12 ms, server says 3 ms" becomes
+            # a diffable per-request table instead of a mystery.
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                b"GET /v1/debug/requests?slow=1&limit=2000 HTTP/1.1\r\n"
+                b"Host: x\r\nConnection: close\r\n\r\n"
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            payload = json.loads(raw.split(b"\r\n\r\n", 1)[1])
+            # tracing disabled (--trace-ring 0) answers 400: skip the
+            # join rather than KeyError away a completed measurement
+            payload.setdefault("requests", [])
+            payload.setdefault("slow_ms", None)
+            payload.setdefault("counts", {})
+            client = {}
+            for _, ss in runs:
+                for dt, kind, rid in ss:
+                    if rid:
+                        client[rid] = (dt, kind)
+            joined = []
+            for t in payload["requests"]:
+                cdt = client.get(t["id"])
+                joined.append(
+                    {
+                        "id": t["id"],
+                        "status": t["status"],
+                        "server_ms": t["total_ms"],
+                        "client_ms": round(cdt[0] * 1e3, 3) if cdt else None,
+                        # positive gap = time spent OUTSIDE the traced
+                        # handler: socket, HTTP parse, loop scheduling
+                        "gap_ms": (
+                            round(cdt[0] * 1e3 - t["total_ms"], 3)
+                            if cdt else None
+                        ),
+                        "client_kind": cdt[1] if cdt else None,
+                        "spans": t["spans"],
+                    }
+                )
+            dump = {
+                "slow_ms": payload["slow_ms"],
+                "counts": payload["counts"],
+                "requests": joined,
+            }
         await service.stop()
         wall, samples = min(runs, key=lambda r: r[0])
         lat = sorted(s[0] for s in samples)
@@ -245,7 +325,7 @@ def run_load(
             # counters across all passes
             kinds: dict[str, int] = {}
             by_kind: dict[str, list[float]] = {}
-            for dt, kind in samples:
+            for dt, kind, _rid in samples:
                 kinds[kind] = kinds.get(kind, 0) + 1
                 by_kind.setdefault(kind, []).append(dt)
             hits = kinds.get("hit", 0) + kinds.get("hit-negative", 0)
@@ -287,6 +367,20 @@ def run_load(
         if not donate:
             row["which"] += "_nodonate"
             row["donate_inputs"] = False
+        if trace_ring is not None:
+            row["trace_ring"] = trace_ring
+            if trace_ring == 0:
+                row["which"] += "_notrace"
+        if dump is not None:
+            with open(dump_slow, "w") as f:
+                json.dump({"run": row["which"], **dump}, f, indent=1)
+            row["dump_slow"] = {
+                "path": dump_slow,
+                "traces": len(dump["requests"]),
+                "joined": sum(
+                    1 for j in dump["requests"] if j["client_ms"] is not None
+                ),
+            }
         return row
 
     return asyncio.run(drive())
@@ -306,6 +400,9 @@ def main() -> int:
     donate = True
     key_dist: str | None = None
     n_requests = 512
+    trace_ring: int | None = None
+    slow_ms: float | None = None
+    dump_slow: str | None = None
     depths: list[int] = []
     i = 0
     while i < len(args):
@@ -321,13 +418,34 @@ def main() -> int:
         elif args[i] == "--requests":
             n_requests = int(args[i + 1])
             i += 2
+        elif args[i] == "--trace-ring":
+            trace_ring = int(args[i + 1])
+            i += 2
+        elif args[i] == "--slow-ms":
+            slow_ms = float(args[i + 1])
+            i += 2
+        elif args[i] == "--dump-slow":
+            dump_slow = args[i + 1]
+            i += 2
         else:
             depths.append(int(args[i]))
             i += 1
+    if dump_slow and trace_ring == 0:
+        print(
+            "--dump-slow needs the trace spine; drop --trace-ring 0",
+            file=sys.stderr,
+        )
+        return 2
+    if dump_slow and slow_ms is None:
+        # loopback requests answer in single-digit ms; the server default
+        # threshold (100 ms) would leave the slow ring empty and the dump
+        # vacuous
+        slow_ms = 5.0
     for d in depths or [2, 1]:
         row = run_load(
             d, n_requests=n_requests, passes=passes, donate=donate,
-            key_dist=key_dist,
+            key_dist=key_dist, trace_ring=trace_ring, slow_ms=slow_ms,
+            dump_slow=dump_slow,
         )
         print(json.dumps(row), flush=True)
     return 0

@@ -34,6 +34,53 @@ LOOPBACK_CONFIGS = {
     "cold": ["--key-dist", "unique", "--passes", "3", "2"],
 }
 
+# Tracing-overhead budget on the hot cached path (round 8): the
+# `trace-on` token runs the hot workload with the trace spine on and
+# off and fails LOUDLY in the artifact if on-throughput regresses more
+# than this.
+TRACE_OVERHEAD_BUDGET_PCT = 3.0
+
+
+def run_trace_guard(timeout_s: float = 900.0) -> dict:
+    """Tracing-on vs tracing-off A/B on the hot cache-hit loopback
+    workload — the regression guard for the round-8 tracing spine's
+    "near-zero overhead by default" contract.  The row records both
+    rates and the delta; a delta over TRACE_OVERHEAD_BUDGET_PCT gets an
+    `error` field so the artifact (and any CI grep for '"error"') flags
+    it without special-casing."""
+    base = ["--key-dist", "hotset:8", "--passes", "3", "2"]
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    on = run_cmd_json(
+        [sys.executable, loopback, "--trace-ring", "256", *base], timeout_s, env=env
+    )
+    off = run_cmd_json(
+        [sys.executable, loopback, "--trace-ring", "0", *base], timeout_s, env=env
+    )
+    row = {"config": "trace-on", "which": "loopback_trace_overhead_hot"}
+    if "error" in on or "error" in off:
+        row["error"] = on.get("error") or off.get("error")
+        return row
+    on_rs, off_rs = on["requests_per_sec"], off["requests_per_sec"]
+    overhead = (off_rs - on_rs) / off_rs * 100.0 if off_rs else 0.0
+    row.update(
+        trace_on_req_s=on_rs,
+        trace_off_req_s=off_rs,
+        trace_on_passes=on.get("passes_req_s"),
+        trace_off_passes=off.get("passes_req_s"),
+        trace_on_hit_p50_ms=on.get("cache", {}).get("hit_p50_ms"),
+        trace_off_hit_p50_ms=off.get("cache", {}).get("hit_p50_ms"),
+        overhead_pct=round(overhead, 2),
+        budget_pct=TRACE_OVERHEAD_BUDGET_PCT,
+    )
+    if overhead > TRACE_OVERHEAD_BUDGET_PCT:
+        row["error"] = (
+            f"tracing-on throughput regressed {overhead:.1f}% "
+            f"(> {TRACE_OVERHEAD_BUDGET_PCT:.0f}% budget) on the hot "
+            "cached path"
+        )
+    return row
+
 
 def run_loopback(token: str, timeout_s: float = 900.0) -> dict:
     """One tools/loopback_load.py workload as a child under a hard
@@ -226,7 +273,12 @@ def main() -> int:
     date = datetime.date.today().isoformat()
     for tok in [x for x in args.configs.split(",") if x]:
         print(f"=== config {tok} ===", file=sys.stderr, flush=True)
-        if tok in LOOPBACK_CONFIGS:
+        if tok == "trace-on":
+            # tracing-overhead guard (round 8): hot-path A/B, loud
+            # failure in the artifact past the budget
+            result = run_trace_guard()
+            result["date"] = date
+        elif tok in LOOPBACK_CONFIGS:
             # host-side loopback workload: CPU backend, no tunnel needed
             result = run_loopback(tok)
             result["date"] = date
@@ -236,7 +288,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted(LOOPBACK_CONFIGS)}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on'])}",
             }
         else:
             n = int(tok)
