@@ -35,10 +35,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
     for flag in (
         "cache_bytes", "cache_ttl_s",
         "trace_ring", "trace_slow_ms", "trace_sample",
+        "fault_seed", "breaker_threshold", "breaker_cooldown_s",
+        "drain_grace_s",
     ):
         val = getattr(args, flag, None)
         if val is not None:
             argv += [f"--{flag.replace('_', '-')}", str(val)]
+    for spec in getattr(args, "fault", None) or []:
+        argv += ["--fault", spec]
     if getattr(args, "no_singleflight", False):
         argv += ["--no-singleflight"]
     serve_main(argv)
@@ -270,6 +274,31 @@ def main(argv: list[str] | None = None) -> int:
         "--trace-sample", type=float, default=None, dest="trace_sample",
         help="head-sample rate for the recent-trace ring (0..1, default 1.0; "
         "slow/error traces are always kept)",
+    )
+    s.add_argument(
+        "--fault", action="append", default=None, metavar="SITE=SPEC",
+        help="arm a fault-injection site at startup (repeatable; implies "
+        "fault injection enabled, incl. POST /v1/debug/faults)",
+    )
+    s.add_argument(
+        "--fault-seed", type=int, default=None, dest="fault_seed",
+        help="seed for the fault registry's deterministic RNG (default 0)",
+    )
+    s.add_argument(
+        "--breaker-threshold", type=int, default=None, dest="breaker_threshold",
+        help="consecutive batch failures opening the device circuit "
+        "breaker (default 5; 0 disables)",
+    )
+    s.add_argument(
+        "--breaker-cooldown-s", type=float, default=None,
+        dest="breaker_cooldown_s",
+        help="seconds the breaker stays open before its half-open probe "
+        "(default 5)",
+    )
+    s.add_argument(
+        "--drain-grace-s", type=float, default=None, dest="drain_grace_s",
+        help="seconds /readyz answers 503 before the listener closes on "
+        "SIGTERM (default 0)",
     )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)

@@ -131,6 +131,28 @@ class ServerConfig:
     # one in four, 0 = only slow/error traces are retained).  Span
     # aggregates and counters always update; only ring retention thins.
     trace_sample: float = 1.0
+    # --- robustness layer (round 9: serving/faults.py + supervision) ---
+    # Fault injection master switch: enables the registry, the module
+    # hook, and the POST /v1/debug/faults arm endpoint (404 while off).
+    # NEVER enable on a production server an untrusted party can reach —
+    # the endpoint deliberately breaks things.
+    fault_injection: bool = False
+    # Faults armed at startup: "site=spec,site=spec" (see serving/
+    # faults.py for the grammar).  Non-empty implies fault_injection.
+    faults: str = ""
+    # Seed for the registry's deterministic RNG: probabilistic chaos
+    # runs replay the same firing sequence.
+    fault_seed: int = 0
+    # Device circuit breaker: open after this many CONSECUTIVE batch
+    # failures (fail-fast 503 breaker_open + Retry-After while open,
+    # half-open single-probe recovery after the cooldown).  0 disables.
+    breaker_threshold: int = 5
+    breaker_cooldown_s: float = 5.0
+    # Seconds between /readyz flipping to 503 (drain begin) and the
+    # listener closing on SIGTERM, so load balancers observe the flip
+    # and stop routing before connections start dying.  0 = immediate
+    # (tests, dev loops); set to ~2x the LB probe interval in k8s.
+    drain_grace_s: float = 0.0
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

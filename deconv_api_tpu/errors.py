@@ -113,3 +113,37 @@ class Unavailable(DeconvError):
 
     status = 503
     code = "unavailable"
+
+
+class DeadlineExpired(DeconvError):
+    """The request's own ``x-deadline-ms`` budget lapsed (round 9
+    deadline propagation): queued work whose caller has already given up
+    is reaped at the queue-pop and pre-dispatch boundaries — an
+    immediate 504 instead of dispatching dead work to the device."""
+
+    status = 504
+    code = "deadline_expired"
+
+
+class BreakerOpen(DeconvError):
+    """The device circuit breaker is open (round 9): N consecutive batch
+    failures mean new dispatches are overwhelmingly likely to fail too,
+    so requests fail fast with a Retry-After derived from the breaker's
+    remaining cooldown instead of queueing onto a dead device."""
+
+    status = 503
+    code = "breaker_open"
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class FaultInjected(DeconvError):
+    """An armed fault-injection site fired (serving/faults.py).  Its own
+    taxonomy code so a chaos run's error budget can split EXPECTED
+    failures (this, breaker_open, unavailable, deadline_expired) from
+    collateral ones — the split tools/loopback_load.py --chaos reports."""
+
+    status = 500
+    code = "fault_injected"

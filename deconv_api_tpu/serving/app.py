@@ -33,7 +33,12 @@ import numpy as np
 from deconv_api_tpu import errors
 from deconv_api_tpu.config import ServerConfig, apply_platform, enable_compilation_cache
 from deconv_api_tpu.serving import codec
-from deconv_api_tpu.serving.batcher import BatchingDispatcher, pad_bucket
+from deconv_api_tpu.serving import faults as faults_mod
+from deconv_api_tpu.serving.batcher import (
+    BatchingDispatcher,
+    CircuitBreaker,
+    pad_bucket,
+)
 from deconv_api_tpu.serving.cache import (
     ResponseCache,
     Singleflight,
@@ -117,6 +122,38 @@ class DeconvService:
             self.bundle.mesh = self.mesh
         self.metrics = Metrics()
         self.ready = False
+        # Drain state (round 9): set at shutdown begin, BEFORE the
+        # listener closes — /readyz flips 503 so load balancers stop
+        # routing, and live keep-alive connections start carrying
+        # `connection: close` so clients stop pipelining into a dying
+        # server.
+        self.draining = False
+        # Fault injection (round 9, serving/faults.py): the registry is
+        # built and installed into the module hook ONLY when explicitly
+        # enabled — a default-configured server pays one global load +
+        # None test per site consultation.
+        self.faults = None
+        if self.cfg.fault_injection or self.cfg.faults:
+            self.faults = faults_mod.FaultRegistry(
+                seed=self.cfg.fault_seed, metrics=self.metrics
+            )
+            if self.cfg.faults:
+                self.faults.arm_string(self.cfg.faults)
+            faults_mod.install(self.faults)
+        # Device circuit breaker (round 9): ONE breaker shared by all
+        # three dispatchers — they sit on the same device, so its
+        # failures are correlated.  N consecutive batch failures open
+        # it; open = fail-fast 503 breaker_open with a cooldown-derived
+        # Retry-After; a single half-open probe closes it again.
+        self.breaker = (
+            CircuitBreaker(
+                self.cfg.breaker_threshold,
+                self.cfg.breaker_cooldown_s,
+                metrics=self.metrics,
+            )
+            if self.cfg.breaker_threshold > 0
+            else None
+        )
         # Host I/O pipeline (round 6): decode and encode run on a bounded
         # pool of persistent codec workers (no per-call thread spawn; the
         # pending bound is the decode/encode stages' backpressure), and
@@ -151,6 +188,7 @@ class DeconvService:
             shed_factor=self.cfg.shed_factor,
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
+            breaker=self.breaker,
         )
         # Dreams run for seconds-to-minutes; a separate dispatcher keeps them
         # from head-of-line blocking the deconv queue (the device interleaves
@@ -166,6 +204,7 @@ class DeconvService:
             shed_factor=self.cfg.shed_factor,
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
+            breaker=self.breaker,
         )
         # Sweeps (~13x a single-layer request, large first-use compile) get
         # the dream treatment: own dispatcher so they never head-of-line
@@ -181,6 +220,7 @@ class DeconvService:
             shed_factor=self.cfg.shed_factor,
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
+            breaker=self.breaker,
         )
         # Content-addressed response cache + singleflight (round 7,
         # serving/cache.py): every compute response is a pure function of
@@ -241,6 +281,16 @@ class DeconvService:
         )
         self.server.route("GET", "/health-check")(self._health)
         self.server.route("GET", "/ready")(self._ready)
+        # k8s-shaped probes (round 9): /healthz = liveness (the event
+        # loop answered), /readyz = readiness (warmed, batcher tasks
+        # alive, codec pool at quorum, breaker not open, not draining)
+        self.server.route("GET", "/healthz")(self._healthz)
+        self.server.route("GET", "/readyz")(self._readyz)
+        if self.faults is not None:
+            # registered ONLY when fault injection is enabled: a
+            # default-configured server 404s the path like any unknown
+            # route, so the chaos surface is invisible in production
+            self.server.route("POST", "/v1/debug/faults")(self._debug_faults)
         self.server.route("GET", "/metrics")(self._metrics)
         self.server.route("GET", "/v1/metrics")(self._metrics)
         self.server.route("GET", "/v1/models")(self._models)
@@ -327,6 +377,14 @@ class DeconvService:
     def _dispatch_inner(self, key, images: list[np.ndarray]):
         import jax.numpy as jnp
 
+        # device chaos sites (round 9): a delayed or failing dispatch —
+        # the batcher's breaker sees the failure exactly like a real
+        # wedged backend.  Runs on the dispatch worker thread, so the
+        # delay never blocks the event loop.
+        act = faults_mod.check("device.dispatch_delay_ms")
+        if act is not None:
+            time.sleep((act.param or 100.0) / 1e3)
+        faults_mod.raise_if_armed("device.dispatch_error")
         if key[0] == "__dream__":
             return self._dispatch_dream(key, images)
         # 4-tuple: single-layer (the default); 5-tuple adds sweep=True
@@ -402,12 +460,18 @@ class DeconvService:
                     grids = out["grid"]
                     t_enc = time.perf_counter()
                     to_encode = [i for i in range(n) if valid[i].any()]
-                    encoded = self.codec_pool.map_sync(
+                    # settle, don't raise (round 9): a codec worker that
+                    # crashes mid-encode fails ONE request's fused
+                    # encode, which the route's data_url-is-None
+                    # fallback retries on the pool — never the batch
+                    encoded = self.codec_pool.map_sync_settle(
                         codec.encode_data_url, [grids[i] for i in to_encode]
                     )
                     data_urls: list = [None] * n
                     for i, url in zip(to_encode, encoded):
-                        data_urls[i] = url
+                        data_urls[i] = (
+                            None if isinstance(url, BaseException) else url
+                        )
                     if self.metrics is not None:
                         self.metrics.observe_stage(
                             "encode", time.perf_counter() - t_enc
@@ -575,6 +639,7 @@ class DeconvService:
         top_k: int,
         post: str,
         sweep: bool = False,
+        deadline: float | None = None,
     ):
         if not self.ready:
             # Pre-warmup requests would silently pay a full XLA compile
@@ -608,10 +673,12 @@ class DeconvService:
         if sweep:
             with stage(self.sweep_metrics, "compute"):
                 return await self.sweep_dispatcher.submit(
-                    x, (layer, mode, top_k, post, True)
+                    x, (layer, mode, top_k, post, True), deadline=deadline
                 )
         with stage(self.metrics, "compute"):
-            return await self.dispatcher.submit(x, (layer, mode, top_k, post))
+            return await self.dispatcher.submit(
+                x, (layer, mode, top_k, post), deadline=deadline
+            )
 
     # ----------------------------------------------------- tracing spine
 
@@ -761,14 +828,20 @@ class DeconvService:
                             flight=getattr(fut, "flight_id", None),
                         )
                     t_wait = time.perf_counter()
+                    # the waiter's OWN deadline (round 9), capped by the
+                    # server timeout: a coalesced caller that gave up
+                    # 504s independently — the flight and its other
+                    # waiters live on (Singleflight.wait shields)
+                    wait_deadline = None
+                    if req.deadline is not None:
+                        wait_deadline = min(
+                            req.deadline, t0 + self.cfg.request_timeout_s
+                        )
                     try:
-                        # shield: cancelling ONE waiter's task must not
-                        # cancel the SHARED future out from under the
-                        # other waiters (Task.cancel cancels the future
-                        # the task is awaiting) — the cancelled waiter
-                        # still re-raises, the flight lives on
-                        resp = await asyncio.shield(fut)
+                        resp = await Singleflight.wait(fut, wait_deadline)
                     except errors.DeconvError as e:
+                        if isinstance(e, errors.DeadlineExpired):
+                            self.metrics.inc_counter("deadline_expired_total")
                         metrics.observe_request(
                             time.perf_counter() - t0, e.code
                         )
@@ -815,10 +888,37 @@ class DeconvService:
                         ),
                     )
                     raise
+                except errors.DeadlineExpired:
+                    # the leader's PERSONAL x-deadline-ms lapsed — not a
+                    # property of the shared work.  Waiters (who may have
+                    # no deadline at all) get a retryable 503, never a
+                    # 504 that is not theirs (round 9)
+                    self.flights.finish(
+                        key,
+                        exc=errors.Unavailable(
+                            "coalesced request's leader hit its own deadline"
+                        ),
+                    )
+                    raise
                 except BaseException as e:  # noqa: BLE001 — publish, re-raise
                     self.flights.finish(key, exc=e)
                     raise
-                self.flights.finish(key, resp)
+                if (
+                    resp.status >= 400
+                    and errors.code_from_body(resp.body) == "deadline_expired"
+                ):
+                    # route handlers map DeadlineExpired to a 504
+                    # RESPONSE (they never re-raise), so the deadline
+                    # guard above cannot catch this form — same rule:
+                    # the leader's personal deadline is not the work's
+                    self.flights.finish(
+                        key,
+                        exc=errors.Unavailable(
+                            "coalesced request's leader hit its own deadline"
+                        ),
+                    )
+                else:
+                    self.flights.finish(key, resp)
             else:
                 resp = await handler(req)
             if self.cache is not None and "no-store" not in cc:
@@ -842,6 +942,78 @@ class DeconvService:
         if self.ready:
             return Response.json({"ready": True})
         return Response.json({"ready": False}, status=503)
+
+    async def _healthz(self, _req: Request) -> Response:
+        """GET /healthz — liveness.  Answering at all proves the event
+        loop schedules; the reported lag (one loop round-trip) catches a
+        loop that still answers but is drowning in ready callbacks.
+        Liveness stays 200 through drain, degraded pools, and an open
+        breaker — restarting the process would fix none of those."""
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.sleep(0)
+        return Response.json(
+            {
+                "status": "ok",
+                "event_loop_lag_ms": round((loop.time() - t0) * 1e3, 3),
+            }
+        )
+
+    def _readiness_checks(self) -> dict[str, bool]:
+        """Each gate a load balancer should respect, individually named
+        so a 503's body says WHICH one failed."""
+        return {
+            # weights loaded + serving executables compiled
+            "warmed": self.ready,
+            # drain begun: stop routing BEFORE the listener dies
+            "not_draining": not self.draining,
+            # collect/dispatch pipeline tasks running on every dispatcher
+            "batcher_tasks": all(
+                d.tasks_alive()
+                for d in (
+                    self.dispatcher,
+                    self.dream_dispatcher,
+                    self.sweep_dispatcher,
+                )
+            ),
+            # codec pool above half capacity (worker deaths outran the
+            # respawn budget otherwise)
+            "codec_pool_quorum": self.codec_pool.at_quorum,
+            # device breaker: open-and-cooling means every dispatch
+            # fails fast.  accepting() (not raw state) so an instance
+            # whose cooldown elapsed reports ready — the LB must route
+            # the one request that runs the recovery probe, or an
+            # open breaker and a readiness-gated LB deadlock each other
+            "breaker_not_open": (
+                self.breaker is None or self.breaker.accepting()
+            ),
+        }
+
+    async def _readyz(self, _req: Request) -> Response:
+        checks = self._readiness_checks()
+        ok = all(checks.values())
+        return Response.json(
+            {"ready": ok, "checks": checks}, status=200 if ok else 503
+        )
+
+    async def _debug_faults(self, req: Request) -> Response:
+        """POST /v1/debug/faults — one-shot runtime arm/disarm (only
+        routed when fault_injection is enabled).  Form/JSON fields:
+        ``arm`` = "site=spec,..." (the --fault grammar), ``disarm`` =
+        "all" or one site.  Returns the registry snapshot either way."""
+        try:
+            form = _parse_form(req) if req.body else {}
+        except errors.DeconvError as e:
+            return _error_response(e, req.id)
+        try:
+            disarm = form.get("disarm", "")
+            if disarm:
+                self.faults.disarm(None if disarm == "all" else disarm)
+            if form.get("arm"):
+                self.faults.arm_string(form["arm"])
+        except ValueError as e:
+            return _error_response(errors.BadRequest(str(e)), req.id)
+        return Response.json({"faults": self.faults.snapshot()})
 
     async def _metrics(self, _req: Request) -> Response:
         text = (
@@ -879,6 +1051,15 @@ class DeconvService:
         cfg["trace_active"] = self.recorder is not None
         if self.recorder is not None:
             cfg["trace_counts"] = self.recorder.counts()
+        # robustness layer (round 9): live breaker / fault / drain state
+        cfg["breaker_active"] = self.breaker is not None
+        if self.breaker is not None:
+            cfg["breaker_state"] = self.breaker.state_name
+        cfg["fault_injection_active"] = self.faults is not None
+        if self.faults is not None:
+            cfg["faults_state"] = self.faults.snapshot()
+        cfg["draining"] = self.draining
+        cfg["codec_workers_live"] = self.codec_pool.live_workers
         if self.cache is not None:
             cfg["cache_resident_bytes"] = self.cache.resident_bytes
             cfg["cache_entries"] = self.cache.entry_count
@@ -987,6 +1168,7 @@ class DeconvService:
                 result = await self.dispatcher.submit(
                     x,
                     (layer, self.cfg.visualize_mode, self.cfg.stitch_k, "grid"),
+                    deadline=req.deadline,
                 )
             n_valid = int(result["valid"].sum())
             if n_valid == 0:
@@ -1032,7 +1214,10 @@ class DeconvService:
                 # always-on behaviour (SURVEY §2.2.3) as an explicit opt-in,
                 # on every registry family (sequential specs walk their
                 # D-layer chain; DAG models vjp-seed per layer)
-                result = await self._project(form, mode, top_k, "tiles", sweep=True)
+                result = await self._project(
+                    form, mode, top_k, "tiles", sweep=True,
+                    deadline=req.deadline,
+                )
                 with stage(self.metrics, "encode"):
                     names = list(result)
                     encoded = await asyncio.gather(
@@ -1047,7 +1232,9 @@ class DeconvService:
                     {"layer": form["layer"], "mode": mode, "sweep": True,
                      "layers": layers}
                 )
-            result = await self._project(form, mode, top_k, "tiles")
+            result = await self._project(
+                form, mode, top_k, "tiles", deadline=req.deadline
+            )
             with stage(self.metrics, "encode"):
                 payload = await self._encode_tiles_pooled(result)
         except errors.DeconvError as e:
@@ -1110,7 +1297,8 @@ class DeconvService:
             with stage(self.dream_metrics, "compute"):
                 try:
                     result = await self.dream_dispatcher.submit(
-                        x, ("__dream__", layers, steps, octaves, lr)
+                        x, ("__dream__", layers, steps, octaves, lr),
+                        deadline=req.deadline,
                     )
                 except KeyError as e:
                     raise errors.UnknownLayer(str(e)) from e
@@ -1177,7 +1365,18 @@ class DeconvService:
         self.bound = (bind_host, bound_port)
         return bound_port
 
+    def begin_drain(self) -> None:
+        """Flip into draining BEFORE the listener closes (round 9):
+        /readyz answers 503 so load balancers stop routing, and every
+        response on a live keep-alive connection carries
+        ``connection: close`` so clients stop pipelining into a dying
+        server.  Idempotent; stop() calls it, serve_forever calls it
+        earlier to give LB probes a window (cfg.drain_grace_s)."""
+        self.draining = True
+        self.server.draining = True
+
     async def stop(self, grace_s: float = 10.0) -> None:
+        self.begin_drain()
         await self.server.stop()
         # One SHARED grace deadline across the three dispatchers: they sit
         # on the same device, so a wedge is correlated — sequential
@@ -1187,6 +1386,10 @@ class DeconvService:
         for d in (self.dispatcher, self.dream_dispatcher, self.sweep_dispatcher):
             await d.stop(grace_s=max(0.0, deadline - time.perf_counter()))
         self.codec_pool.close()
+        if self.faults is not None:
+            # release the module hook only if it is still OURS (another
+            # service constructed later may have installed its own)
+            faults_mod.uninstall(self.faults)
 
 
 def _error_response(e: errors.DeconvError, request_id: str | None = None) -> Response:
@@ -1250,6 +1453,12 @@ async def serve_forever(cfg: ServerConfig) -> None:
             pass
     await stop_ev.wait()
     slog.event(slog.get_logger("deconv.app"), "shutdown_begin")
+    # Flip /readyz 503 + connection:close FIRST, then hold the listener
+    # open for drain_grace_s so load balancers observe the flip and stop
+    # routing before connections start dying (round 9).
+    service.begin_drain()
+    if cfg.drain_grace_s > 0:
+        await asyncio.sleep(cfg.drain_grace_s)
     await service.stop()
     slog.event(slog.get_logger("deconv.app"), "shutdown_complete")
 
@@ -1287,6 +1496,29 @@ def main(argv: list[str] | None = None) -> None:
         "--trace-sample", type=float, default=None,
         help="head-sample rate for the recent-trace ring (0..1)",
     )
+    p.add_argument(
+        "--fault", action="append", default=None, metavar="SITE=SPEC",
+        help="arm a fault-injection site at startup (repeatable; implies "
+        "fault injection enabled — see serving/faults.py for sites/specs)",
+    )
+    p.add_argument(
+        "--fault-seed", type=int, default=None,
+        help="seed for the fault registry's deterministic RNG",
+    )
+    p.add_argument(
+        "--breaker-threshold", type=int, default=None,
+        help="consecutive batch failures that open the device circuit "
+        "breaker (0 disables)",
+    )
+    p.add_argument(
+        "--breaker-cooldown-s", type=float, default=None,
+        help="seconds the breaker stays open before a half-open probe",
+    )
+    p.add_argument(
+        "--drain-grace-s", type=float, default=None,
+        help="seconds between /readyz flipping 503 and the listener "
+        "closing on SIGTERM",
+    )
     args = p.parse_args(argv)
     overrides = {}
     if args.cache_bytes is not None:
@@ -1301,6 +1533,17 @@ def main(argv: list[str] | None = None) -> None:
         overrides["trace_sample"] = args.trace_sample
     if args.no_singleflight:
         overrides["singleflight"] = False
+    if args.fault:
+        overrides["faults"] = ",".join(args.fault)
+        overrides["fault_injection"] = True
+    if args.fault_seed is not None:
+        overrides["fault_seed"] = args.fault_seed
+    if args.breaker_threshold is not None:
+        overrides["breaker_threshold"] = args.breaker_threshold
+    if args.breaker_cooldown_s is not None:
+        overrides["breaker_cooldown_s"] = args.breaker_cooldown_s
+    if args.drain_grace_s is not None:
+        overrides["drain_grace_s"] = args.drain_grace_s
     if args.host is not None:
         overrides["host"] = args.host
     if args.port is not None:

@@ -54,16 +54,157 @@ the shed path's actionable backoff: the 503's Retry-After derives from
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from deconv_api_tpu import errors
+from deconv_api_tpu.serving import faults
 from deconv_api_tpu.serving import trace as trace_mod
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.batcher")
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker around device dispatch (round 9).
+
+    The device's documented failure modes (wedged tunnel, dying backend)
+    make EVERY dispatch fail for a while; without a breaker each doomed
+    request still queues, dispatches, and burns its full timeout.  States:
+
+    - CLOSED: normal; ``threshold`` CONSECUTIVE recorded failures open it
+      (any success resets the streak).
+    - OPEN: ``allow()`` answers False — callers fail fast with 503
+      ``breaker_open`` + a Retry-After derived from the remaining
+      cooldown — until ``cooldown_s`` elapses.
+    - HALF_OPEN: after the cooldown exactly ONE caller is admitted as the
+      probe; its success closes the breaker, its failure re-opens (fresh
+      cooldown).  Other callers keep failing fast while the probe is in
+      flight, so a recovering device sees one batch, not a stampede.
+
+    Shared by all dispatchers that sit on one device (they fail
+    together).  Lock-protected: outcomes are recorded from the event
+    loop and from worker threads; state transitions publish the
+    ``breaker_state`` gauge (0 closed / 1 half-open / 2 open) and a
+    ``breaker_open_total`` counter through Metrics, plus slog events.
+    ``clock`` is injectable so cooldown tests never sleep."""
+
+    CLOSED, HALF_OPEN, OPEN = 0, 1, 2
+    _NAMES = {0: "closed", 1: "half-open", 2: "open"}
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        cooldown_s: float = 5.0,
+        *,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0  # consecutive, while closed
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._probe_at = 0.0
+        self._publish()
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    @property
+    def state_name(self) -> str:
+        return self._NAMES[self.state]
+
+    def accepting(self) -> bool:
+        """Would a request arriving now be admitted (or at least be the
+        recovery probe)?  This — not raw state — is what /readyz must
+        report: state only transitions OPEN→HALF_OPEN inside allow(),
+        so a load balancer that pulls traffic on 'open' would starve the
+        breaker of the very probe that closes it.  Reporting ready once
+        the cooldown has elapsed lets one routed request run the probe."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return True
+            return self._clock() >= self._opened_at + self.cooldown_s
+
+    def allow(self) -> tuple[bool, float]:
+        """(admit this request?, retry-after seconds when not)."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True, 0.0
+            remaining = self._opened_at + self.cooldown_s - self._clock()
+            if self._state == self.OPEN and remaining <= 0:
+                # cooldown over: half-open, admit exactly one probe
+                self._state = self.HALF_OPEN
+                self._probe_inflight = True
+                self._probe_at = self._clock()
+                self._transition("breaker_half_open")
+                return True, 0.0
+            if self._state == self.HALF_OPEN and (
+                not self._probe_inflight
+                # a probe that never reported back (shed, reaped, or
+                # lost before dispatch) must not wedge the breaker
+                # half-open forever; its claim expires after a cooldown
+                or self._clock() - self._probe_at >= self.cooldown_s
+            ):
+                self._probe_inflight = True
+                self._probe_at = self._clock()
+                return True, 0.0
+            return False, max(remaining, 1.0)
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == self.OPEN:
+                # a straggler dispatched BEFORE the open; the open
+                # window holds until the cooldown + probe decide, so a
+                # lucky straggler can never flap the breaker shut
+                return
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state == self.HALF_OPEN:
+                self._state = self.CLOSED
+                self._transition("breaker_close")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probe_inflight = False
+            if self._state == self.HALF_OPEN:
+                # failed probe: straight back to open, fresh cooldown
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._transition("breaker_reopen")
+                return
+            if self._state == self.OPEN:
+                return  # in-flight stragglers from before the open
+            self._failures += 1
+            if self._failures >= self.threshold:
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._transition("breaker_open")
+
+    def _transition(self, event: str) -> None:
+        # called under the lock; logging/gauge publication are cheap
+        slog.event(
+            _log, event, level=logging.WARNING,
+            state=self._NAMES[self._state], failures=self._failures,
+            cooldown_s=self.cooldown_s,
+        )
+        if self._metrics is not None and self._state == self.OPEN:
+            self._metrics.inc_counter("breaker_open_total")
+        self._publish()
+
+    def _publish(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge("breaker_state", self._state)
 
 
 def _to_daemon_thread(fn: Callable[[], Any]) -> asyncio.Future:
@@ -102,6 +243,9 @@ class WorkItem:
     # the dispatcher stamps queue-wait/dispatch/fetch spans and the
     # executed batch's id onto it from _resolve
     trace: Any = None
+    # absolute perf_counter deadline (round 9): expired items are reaped
+    # at the queue-pop and pre-dispatch boundaries — never dispatched
+    deadline: float | None = None
     future: asyncio.Future = field(default_factory=asyncio.Future)
     enqueued_at: float = field(default_factory=time.perf_counter)
 
@@ -134,8 +278,13 @@ class BatchingDispatcher:
         dispatch_runner: Callable[[Any, list[Any]], Callable[[], list[Any]]]
         | None = None,
         pipeline_depth: int = 2,
+        breaker: CircuitBreaker | None = None,
     ):
         self._runner = runner
+        # Shared across the dispatchers on one device (they fail
+        # together); outcomes recorded per executed group, admission
+        # gated in submit().
+        self._breaker = breaker
         self._max_batch = max_batch
         self._window_s = window_ms / 1e3
         self._timeout_s = request_timeout_s
@@ -179,15 +328,57 @@ class BatchingDispatcher:
     async def start(self) -> None:
         if self._task is None:
             self._stopping = False  # allow a stop() -> start() restart cycle
-            self._task = asyncio.create_task(self._run(), name="batch-dispatcher")
+            self._task = asyncio.create_task(
+                self._supervised("collect", self._run), name="batch-dispatcher"
+            )
             if self._dispatch_runner is not None:
                 if self._dispatch_worker is None:
                     from deconv_api_tpu.serving.codec_pool import WorkerPool
 
                     self._dispatch_worker = WorkerPool(1, name="dispatch")
                 self._dispatch_task = asyncio.create_task(
-                    self._dispatch_stage(), name="batch-dispatch-stage"
+                    self._supervised("dispatch", self._dispatch_stage),
+                    name="batch-dispatch-stage",
                 )
+
+    async def _supervised(self, name: str, body: Callable) -> None:
+        """Self-healing supervision (round 9): a pipeline task that dies
+        from an unexpected exception is logged, counted, and RESTARTED
+        with exponential backoff — before this, a crashed collect or
+        dispatch task silently wedged the pipeline until every queued
+        request burned its full timeout.  The crashing iteration has
+        already failed its in-flight futures (see the per-iteration
+        guards in _run/_dispatch_stage), so the restart never strands a
+        caller.  Cancellation (stop()) passes through untouched."""
+        backoff = 0.05
+        while True:
+            try:
+                await body()
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — supervised restart
+                slog.event(
+                    _log, "task_crash", level=logging.ERROR,
+                    task=name, error=f"{type(e).__name__}: {e}",
+                    backoff_s=backoff,
+                )
+                if self._metrics is not None:
+                    self._metrics.inc_labeled(
+                        "task_restarts_total", "task", name
+                    )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    def tasks_alive(self) -> bool:
+        """Both pipeline tasks running (the /readyz batcher check).  The
+        supervisor restarts crashed tasks, so False means either not
+        started or cancelled — a server that should not receive traffic."""
+        if self._task is None or self._task.done():
+            return False
+        if self._dispatch_runner is not None:
+            return self._dispatch_task is not None and not self._dispatch_task.done()
+        return True
 
     async def stop(self, grace_s: float = 10.0) -> None:
         # Reject new submits immediately: a request racing stop() could
@@ -283,10 +474,34 @@ class BatchingDispatcher:
         )
         return (depth / eff_batch + self._inflight) * p50
 
-    async def submit(self, image: Any, key: Any) -> Any:
+    async def submit(
+        self, image: Any, key: Any, deadline: float | None = None
+    ) -> Any:
         if self._stopping:
             raise errors.Unavailable("server shutting down")
         tr = trace_mod.current_trace()
+        if self._breaker is not None:
+            allowed, retry_s = self._breaker.allow()
+            if not allowed:
+                # fail fast: with the breaker open every dispatch is
+                # overwhelmingly likely to fail — queueing this request
+                # would only burn its timeout against a dead device
+                if tr is not None:
+                    tr.annotate(breaker="open")
+                raise errors.BreakerOpen(
+                    "device circuit breaker is open; failing fast",
+                    retry_after_s=retry_s,
+                )
+        now = time.perf_counter()
+        if deadline is not None:
+            # the caller's x-deadline-ms budget, capped by the server's
+            # own request timeout (a deadline cannot EXTEND the wait)
+            deadline = min(deadline, now + self._timeout_s)
+            if now >= deadline:
+                self._count_deadline(tr, now, 0.0)
+                raise errors.DeadlineExpired(
+                    "deadline expired before the request could be queued"
+                )
         # Load shedding (VERDICT r2): when the queue already needs longer
         # than the request timeout to drain, every excess request is a
         # guaranteed 504 after a full timeout's wait — reject it NOW with a
@@ -310,19 +525,66 @@ class BatchingDispatcher:
                     f"{self._timeout_s:.0f}s request timeout; shedding",
                     retry_after_s=drain_s,
                 )
-        item = WorkItem(image=image, key=key, trace=tr)
+        item = WorkItem(image=image, key=key, trace=tr, deadline=deadline)
         await self._queue.put(item)
+        wait_s = self._timeout_s
+        if deadline is not None:
+            wait_s = min(wait_s, max(deadline - time.perf_counter(), 0.001))
         try:
-            return await asyncio.wait_for(item.future, self._timeout_s)
+            return await asyncio.wait_for(item.future, wait_s)
         except asyncio.TimeoutError:
+            now = time.perf_counter()
+            if deadline is not None and now >= deadline:
+                # the reap boundaries usually fail the future first; this
+                # covers a deadline that lapses while work is IN FLIGHT
+                self._count_deadline(tr, item.enqueued_at, now - item.enqueued_at)
+                raise errors.DeadlineExpired(
+                    "deadline expired while the request was in flight"
+                ) from None
             if tr is not None:
                 tr.add_span(
                     "queue_wait", item.enqueued_at,
-                    time.perf_counter() - item.enqueued_at, timeout=True,
+                    now - item.enqueued_at, timeout=True,
                 )
             raise errors.RequestTimeout(
                 f"no result within {self._timeout_s:.0f}s (device saturated?)"
             ) from None
+
+    def _count_deadline(self, tr, start_pc: float, waited_s: float) -> None:
+        """Shared accounting for every deadline-expiry path: the counter
+        the exposition lint pins plus the span attr the runbook names."""
+        if self._metrics is not None:
+            self._metrics.inc_counter("deadline_expired_total")
+        if tr is not None:
+            tr.add_span(
+                "queue_wait", start_pc, waited_s, deadline_expired=True
+            )
+
+    def _reap_expired(self, batch: list[WorkItem]) -> list[WorkItem]:
+        """Drop items whose deadline already passed: immediate 504 for
+        their callers, and the device NEVER sees dead work.  Called at
+        the queue-pop boundary (collect) and again pre-dispatch — a
+        deadline can lapse while a batch sits in the handoff queue."""
+        now = time.perf_counter()
+        live: list[WorkItem] = []
+        for it in batch:
+            if it.deadline is not None and now >= it.deadline:
+                # a done future means the submit side already timed out
+                # (wait_for cancels it) and COUNTED this expiry — drop
+                # the item without double-counting or double-spanning
+                if not it.future.done():
+                    self._count_deadline(
+                        it.trace, it.enqueued_at, now - it.enqueued_at
+                    )
+                    it.future.set_exception(
+                        errors.DeadlineExpired(
+                            "deadline expired while queued; request reaped "
+                            "before dispatch"
+                        )
+                    )
+            else:
+                live.append(it)
+        return live
 
     def _drain_nowait(self, batch: list[WorkItem]) -> None:
         """Move everything already queued into ``batch`` (up to max_batch)
@@ -341,29 +603,50 @@ class BatchingDispatcher:
         while True:
             first = await self._queue.get()
             batch = [first]
-            self._drain_nowait(batch)
-            if self._dispatch_runner is not None:
-                await self._collect_and_stage(batch)
-            else:
-                # serial mode: the straggler window waits per item (the
-                # pre-round-6 behaviour; depth<=1 is the compatibility
-                # fallback, not the hot path)
-                if len(batch) < self._max_batch and self._window_s > 0:
-                    deadline = time.perf_counter() + self._window_s
-                    while len(batch) < self._max_batch:
-                        remaining = deadline - time.perf_counter()
-                        if remaining <= 0:
-                            break
-                        try:
-                            batch.append(
-                                await asyncio.wait_for(
-                                    self._queue.get(), remaining
+            try:
+                self._drain_nowait(batch)
+                if self._dispatch_runner is not None:
+                    await self._collect_and_stage(batch)
+                else:
+                    # serial mode: the straggler window waits per item (the
+                    # pre-round-6 behaviour; depth<=1 is the compatibility
+                    # fallback, not the hot path)
+                    if len(batch) < self._max_batch and self._window_s > 0:
+                        window_end = time.perf_counter() + self._window_s
+                        while len(batch) < self._max_batch:
+                            remaining = window_end - time.perf_counter()
+                            if remaining <= 0:
+                                break
+                            try:
+                                batch.append(
+                                    await asyncio.wait_for(
+                                        self._queue.get(), remaining
+                                    )
                                 )
-                            )
-                        except asyncio.TimeoutError:
-                            break
-                        self._drain_nowait(batch)
-                await self._execute(batch)
+                            except asyncio.TimeoutError:
+                                break
+                            self._drain_nowait(batch)
+                    batch = self._reap_expired(batch)
+                    if batch:
+                        await self._execute(batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # collect-iteration crash: these items left the submit
+                # queue, so nothing downstream can fail them — do it NOW
+                # or they hang to a full request-timeout 504, then let
+                # the supervisor restart the loop
+                exc = (
+                    e
+                    if isinstance(e, errors.DeconvError)
+                    else errors.Unavailable(
+                        f"batcher collect task crashed: {type(e).__name__}: {e}"
+                    )
+                )
+                for it in batch:
+                    if not it.future.done():
+                        it.future.set_exception(exc)
+                raise
 
     async def _collect_and_stage(self, batch: list[WorkItem]) -> None:
         """Pipelined collect: adaptive straggler window + bounded handoff.
@@ -388,8 +671,13 @@ class BatchingDispatcher:
             # waiting longer would only add latency.
             await asyncio.sleep(self._window_s)
             self._drain_nowait(batch)
+        # queue-pop reap boundary (round 9): items whose deadline lapsed
+        # while queued 504 NOW instead of riding a doomed dispatch
+        batch[:] = self._reap_expired(batch)
         if self._metrics is not None:
             self._metrics.set_gauge("collect_queue_depth", self._queue.qsize())
+        if not batch:
+            return
         self._staged += len(batch)
         try:
             await self._dispatch_q.put(batch)
@@ -416,10 +704,43 @@ class BatchingDispatcher:
         while True:
             batch = await self._dispatch_q.get()
             self._staged -= len(batch)
-            groups: dict[Any, list[WorkItem]] = {}
-            for item in batch:
-                groups.setdefault(item.key, []).append(item)
-            await self._execute_pipelined(groups)
+            # pre-dispatch reap boundary: a deadline can lapse while the
+            # batch waits in the handoff queue behind a slow device
+            batch = self._reap_expired(batch)
+            if not batch:
+                continue
+            try:
+                faults.raise_if_armed("batcher.dispatch_raise")
+                groups: dict[Any, list[WorkItem]] = {}
+                for item in batch:
+                    groups.setdefault(item.key, []).append(item)
+                await self._execute_pipelined(groups)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # dispatch-task crash: fail the in-flight group's futures
+                # immediately (they are out of every queue — nobody else
+                # can), then re-raise so the supervisor restarts the task
+                exc = (
+                    e
+                    if isinstance(e, errors.DeconvError)
+                    else errors.Unavailable(
+                        f"batcher dispatch task crashed: {type(e).__name__}: {e}"
+                    )
+                )
+                for item in batch:
+                    if not item.future.done():
+                        item.future.set_exception(exc)
+                raise
+
+    def _record_outcome(self, ok: bool) -> None:
+        """One executed group's device outcome into the shared breaker
+        (dispatch raise, fetch raise, or clean completion)."""
+        if self._breaker is not None:
+            if ok:
+                self._breaker.record_success()
+            else:
+                self._breaker.record_failure()
 
     async def _execute(self, batch: list[WorkItem]) -> None:
         groups: dict[Any, list[WorkItem]] = {}
@@ -456,6 +777,7 @@ class BatchingDispatcher:
                                 )
                     raise
                 except Exception as e:  # noqa: BLE001 — propagate to callers
+                    self._record_outcome(False)
                     for it in items:
                         if not it.future.done():
                             it.future.set_exception(e)
@@ -463,6 +785,7 @@ class BatchingDispatcher:
                 finally:
                     self._inflight -= 1
                     pending_groups = pending_groups[1:]
+                self._record_outcome(True)
                 self._resolve(items, results, t0)
         finally:
             self._inflight = 0  # cancellation mid-drain must not leak count
@@ -498,6 +821,7 @@ class BatchingDispatcher:
                     self._fetch_sem.release()
                     self._inflight -= 1
                     handed_off += 1
+                    self._record_outcome(False)
                     for it in items:
                         if not it.future.done():
                             it.future.set_exception(e)
@@ -542,6 +866,7 @@ class BatchingDispatcher:
                     )
             raise
         except Exception as e:  # noqa: BLE001 — propagate to callers
+            self._record_outcome(False)
             for it in items:
                 if not it.future.done():
                     it.future.set_exception(e)
@@ -549,6 +874,7 @@ class BatchingDispatcher:
         finally:
             self._inflight -= 1
             self._fetch_sem.release()
+        self._record_outcome(True)
         self._resolve(items, results, t0, dispatched_at)
 
     def _resolve(

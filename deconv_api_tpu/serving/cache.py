@@ -338,6 +338,29 @@ class Singleflight:
             self._flights[key] = fut
             return True, fut
 
+    @staticmethod
+    async def wait(fut: asyncio.Future, deadline: float | None = None):
+        """Await a flight as a WAITER, honoring the waiter's OWN deadline
+        (round 9): a coalesced request's caller may give up before the
+        flight leader finishes, and its ``x-deadline-ms`` budget must 504
+        it independently — the shared flight (and the other waiters)
+        live on.  The shield keeps a timed-out or cancelled waiter from
+        cancelling the future out from under everyone else (the round-7
+        cancelled-waiter contract)."""
+        if deadline is None:
+            return await asyncio.shield(fut)
+        remaining = deadline - time.perf_counter()
+        if remaining <= 0:
+            raise errors.DeadlineExpired(
+                "deadline expired before the coalesced flight completed"
+            )
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), remaining)
+        except asyncio.TimeoutError:
+            raise errors.DeadlineExpired(
+                "deadline expired while waiting on the coalesced flight"
+            ) from None
+
     def finish(self, key: str, result=None, exc: BaseException | None = None) -> None:
         """Miss-completion publish: resolve the flight's future for every
         coalesced waiter (or fail them with the leader's exception) and

@@ -27,6 +27,8 @@ host is.  Two host-side building blocks live here:
 from __future__ import annotations
 
 import asyncio
+import collections
+import logging
 import os
 import queue
 import threading
@@ -35,7 +37,11 @@ from typing import Any, Callable, Iterable
 
 import numpy as np
 
+from deconv_api_tpu.serving import faults
 from deconv_api_tpu.serving import trace as trace_mod
+from deconv_api_tpu.utils import slog
+
+_log = slog.get_logger("deconv.codec_pool")
 
 
 class PoolClosed(RuntimeError):
@@ -63,6 +69,8 @@ class WorkerPool:
         max_pending: int = 0,
         name: str = "codec",
         metrics=None,
+        respawn_budget: int = 0,
+        respawn_window_s: float = 60.0,
     ):
         self.workers = workers if workers > 0 else _default_workers()
         self.max_pending = max_pending if max_pending > 0 else self.workers * 32
@@ -73,40 +81,177 @@ class WorkerPool:
         self._depth = 0  # queued-or-running jobs (the queue-depth gauge)
         self._closed = False
         self._close_lock = threading.Lock()
-        self._threads = [
-            threading.Thread(
-                target=self._work, daemon=True, name=f"{name}-worker-{i}"
-            )
-            for i in range(self.workers)
-        ]
+        # Self-healing (round 9): a worker that dies from an unexpected
+        # exception is logged, its in-flight task's future failed (never
+        # a hung caller), and a replacement spawned — up to
+        # respawn_budget respawns per sliding respawn_window_s, so a
+        # DETERMINISTIC crash (every job kills its worker) degrades to
+        # loud fail-fast instead of infinite respawn churn.  0 = auto;
+        # generous, because a respawn is just a thread spawn: sustained
+        # probabilistic chaos (the p=0.05 drill) must not exhaust it.
+        self._respawn_budget = (
+            respawn_budget if respawn_budget > 0 else max(64, self.workers * 32)
+        )
+        self._respawn_window_s = respawn_window_s
+        self._respawns: collections.deque[float] = collections.deque()
+        self._spawn_seq = 0
+        self._threads: list[threading.Thread] = []
+        for _ in range(self.workers):
+            self._threads.append(self._make_thread())
         for t in self._threads:
             t.start()
+        self._publish_live()
 
     # ------------------------------------------------------------ internals
 
+    def _make_thread(self) -> threading.Thread:
+        self._spawn_seq += 1
+        return threading.Thread(
+            target=self._work, daemon=True,
+            name=f"{self._name}-worker-{self._spawn_seq}",
+        )
+
     def _work(self) -> None:
-        while True:
-            job = self._jobs.get()
-            if job is None:
-                return
-            fn, args, loop, fut = job
-            try:
-                result = fn(*args)
-            except BaseException as e:  # noqa: BLE001 — relayed to the future
-                if loop is None:  # concurrent.futures (map_sync) job
-                    fut.set_exception(e)
+        # the in-flight job's (loop, fut), visible to the death handler:
+        # a worker that dies MID-TASK must fail that task's future, not
+        # leave its caller hanging (round-9 supervision pin)
+        current: list = [None]
+        try:
+            while True:
+                job = self._jobs.get()
+                if job is None:
+                    return
+                fn, args, loop, fut = job
+                current[0] = (loop, fut)
+                act = faults.check(f"{self._name}.worker_hang")
+                if act is not None:
+                    time.sleep((act.param or 1000.0) / 1e3)
+                act = faults.check(f"{self._name}.worker_raise")
+                if act is not None:
+                    from deconv_api_tpu import errors
+
+                    raise errors.FaultInjected(
+                        f"injected fault at {self._name}.worker_raise"
+                    )
+                try:
+                    result = fn(*args)
+                except BaseException as e:  # noqa: BLE001 — relayed to the future
+                    if loop is None:  # concurrent.futures (map_sync) job
+                        fut.set_exception(e)
+                    else:
+                        self._post(loop, fut, fut.set_exception, e)
                 else:
-                    self._post(loop, fut, fut.set_exception, e)
+                    if loop is None:
+                        fut.set_result(result)
+                    else:
+                        self._post(loop, fut, fut.set_result, result)
+                current[0] = None
+        except BaseException as e:  # noqa: BLE001 — unexpected worker death
+            self._on_worker_death(e, current[0])
+
+    def _on_worker_death(self, exc: BaseException, inflight) -> None:
+        """A worker thread died outside the job-relay protocol: fail the
+        in-flight task's future (only that one), account the death, and
+        respawn within the rate-limited budget."""
+        me = threading.current_thread()
+        with self._close_lock:
+            if me in self._threads:
+                self._threads.remove(me)
+            closed = self._closed
+        if inflight is not None:
+            loop, fut = inflight
+            if loop is None:
+                if not fut.done():
+                    fut.set_exception(exc)
             else:
-                if loop is None:
-                    fut.set_result(result)
-                else:
-                    self._post(loop, fut, fut.set_result, result)
+                self._post(loop, fut, fut.set_exception, exc)
+        slog.event(
+            _log, "worker_death", level=logging.WARNING,
+            pool=self._name, error=f"{type(exc).__name__}: {exc}",
+            live=self.live_workers,
+        )
+        if self._metrics is not None:
+            self._metrics.inc_labeled("worker_deaths_total", "pool", self._name)
+        self._publish_live()
+        if not closed:
+            self._maybe_respawn(from_death=True)
+            self._fail_orphaned_jobs()
+
+    def _fail_orphaned_jobs(self) -> None:
+        """The last worker died and the respawn budget is spent: jobs
+        already queued would wait forever on a queue nobody drains —
+        fail them NOW (their callers see 503 unavailable, not a hang).
+        A job enqueued concurrently is safe either way: it is failed
+        here, or a still-live/respawned worker runs it."""
+        from deconv_api_tpu import errors
+
+        with self._close_lock:
+            if self._threads or self._closed:
+                return
+        exc = errors.Unavailable(
+            f"worker pool {self._name!r} has no live workers "
+            "(respawn budget exhausted); job abandoned"
+        )
+        sentinels = 0
+        while True:
+            try:
+                job = self._jobs.get_nowait()
+            except queue.Empty:
+                break
+            if job is None:  # close sentinel (close raced us): preserve
+                sentinels += 1
+                continue
+            _fn, _args, loop, fut = job
+            if loop is None:
+                if not fut.done():
+                    fut.set_exception(exc)
+            else:
+                self._post(loop, fut, fut.set_exception, exc)
+        for _ in range(sentinels):
+            self._jobs.put(None)
+
+    def _maybe_respawn(self, from_death: bool = False) -> None:
+        """Top the pool back up to ``workers`` live threads, spending the
+        sliding-window respawn budget.  Called on worker death AND on job
+        submission, so capacity lost while the budget was exhausted
+        (e.g. during a chaos storm) self-restores once the window
+        slides — the pool heals without an operator bounce."""
+        now = time.monotonic()
+        spawned: list[threading.Thread] = []
+        with self._close_lock:
+            if self._closed:
+                return
+            while (
+                self._respawns
+                and now - self._respawns[0] > self._respawn_window_s
+            ):
+                self._respawns.popleft()
+            deficit = self.workers - len(self._threads)
+            while deficit > 0 and len(self._respawns) < self._respawn_budget:
+                t = self._make_thread()
+                self._threads.append(t)
+                self._respawns.append(now)
+                spawned.append(t)
+                deficit -= 1
+        for t in spawned:
+            t.start()
+        if spawned:
+            slog.event(
+                _log, "worker_respawn", pool=self._name,
+                n=len(spawned), live=self.live_workers,
+            )
+            self._publish_live()
+        elif deficit > 0 and from_death:
+            slog.event(
+                _log, "worker_respawn_budget_exhausted", level=logging.ERROR,
+                pool=self._name, live=self.live_workers,
+                budget=self._respawn_budget, window_s=self._respawn_window_s,
+            )
 
     @staticmethod
     def _post(loop, fut, setter, value) -> None:
         def resolve():
-            if not fut.cancelled():
+            if not fut.done():  # cancelled or already resolved
                 setter(value)
 
         try:
@@ -114,16 +259,48 @@ class WorkerPool:
         except RuntimeError:  # loop already closed (teardown races)
             pass
 
+    def _publish_live(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                f"{self._name}_workers_live", self.live_workers
+            )
+
     def _gauge(self) -> None:
         if self._metrics is not None:
             self._metrics.set_gauge(f"{self._name}_queue_depth", self._depth)
 
     # ------------------------------------------------------------- surface
 
+    @property
+    def live_workers(self) -> int:
+        """Live worker threads — the `{name}_workers_live` gauge and the
+        /readyz quorum input."""
+        with self._close_lock:
+            return len(self._threads)
+
+    @property
+    def at_quorum(self) -> bool:
+        """More than half the configured workers are live: the pool still
+        has real capacity.  /readyz flips unready below this."""
+        return self.live_workers > self.workers // 2
+
     async def run(self, fn: Callable[..., Any], *args: Any) -> Any:
         """Run ``fn(*args)`` on a pool worker; awaits (and bounds) the job."""
         if self._closed:
             raise PoolClosed(f"worker pool {self._name!r} is closed")
+        if len(self._threads) < self.workers:
+            # lost capacity heals lazily on submission once the respawn
+            # window slides (the len check is the cheap fast path)
+            self._maybe_respawn()
+            if not self._threads:
+                # zero live workers and no budget to respawn: a queued
+                # job would never run and this caller would hang forever
+                from deconv_api_tpu import errors
+
+                raise errors.Unavailable(
+                    f"worker pool {self._name!r} has no live workers "
+                    "(respawn budget exhausted)"
+                )
         if self._sem is None:
             # created lazily so the pool can be constructed off-loop
             self._sem = asyncio.Semaphore(self.max_pending)
@@ -158,6 +335,20 @@ class WorkerPool:
                 self._gauge()
                 self._sem.release()
                 raise PoolClosed(f"worker pool {self._name!r} is closed")
+            if not self._threads:
+                # the last worker died while we awaited the semaphore
+                # and the orphan drain already ran: enqueueing now would
+                # hang this caller forever.  In-lock, so it cannot race
+                # _on_worker_death's thread removal + drain.
+                self._depth -= 1
+                self._gauge()
+                self._sem.release()
+                from deconv_api_tpu import errors
+
+                raise errors.Unavailable(
+                    f"worker pool {self._name!r} has no live workers "
+                    "(respawn budget exhausted)"
+                )
             self._jobs.put((fn, args, loop, fut))
         try:
             return await fut
@@ -179,18 +370,55 @@ class WorkerPool:
         to inline execution once the pool is closed."""
         import concurrent.futures
 
+        if len(self._threads) < self.workers:
+            self._maybe_respawn()
         futs = []
         # under the close lock: a close() racing this enqueue could
         # otherwise land jobs BEHIND the shutdown sentinels, where no
         # worker would ever run them and f.result() would block forever
         with self._close_lock:
-            if self._closed or not items:
+            if self._closed or not items or not self._threads:
+                # closed OR zero live workers (post-storm, budget spent):
+                # inline execution beats enqueueing jobs nobody will run
                 return [fn(item) for item in items]
             for item in items:
                 f: concurrent.futures.Future = concurrent.futures.Future()
                 self._jobs.put((fn, (item,), None, f))
                 futs.append(f)
         return [f.result() for f in futs]
+
+    def map_sync_settle(self, fn: Callable[[Any], Any], items: list) -> list:
+        """``map_sync`` that SETTLES: per-item failures come back as the
+        exception object in that item's slot instead of aborting the
+        whole fan-out.  The batch fetch thread uses this for the fused
+        grid encodes (round 9): one crashed/raising codec worker must
+        cost ONE request a retry, not fail the entire batch it rode."""
+        import concurrent.futures
+
+        if len(self._threads) < self.workers:
+            self._maybe_respawn()
+
+        def inline(item):
+            try:
+                return fn(item)
+            except Exception as e:  # noqa: BLE001 — settled per item
+                return e
+
+        futs: list[concurrent.futures.Future] = []
+        with self._close_lock:
+            if self._closed or not items or not self._threads:
+                return [inline(item) for item in items]
+            for item in items:
+                f: concurrent.futures.Future = concurrent.futures.Future()
+                self._jobs.put((fn, (item,), None, f))
+                futs.append(f)
+        out: list = []
+        for f in futs:
+            try:
+                out.append(f.result())
+            except Exception as e:  # noqa: BLE001 — settled per item
+                out.append(e)
+        return out
 
     @property
     def closed(self) -> bool:

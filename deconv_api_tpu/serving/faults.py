@@ -1,0 +1,246 @@
+"""Fault injection registry: named failure sites, armed on demand (round 9).
+
+Large-scale serving systems treat partial failure as a first-class input —
+TensorFlow Serving's health-checked worker recovery (arXiv:1605.08695) and
+the TPU serving comparison's tail-under-faults methodology (PAPERS.md) both
+assume the failure paths are EXERCISABLE.  Ours were not: a codec worker
+death, a crashed dispatch task, a flaky device — each could only be
+observed by waiting for production to produce it.  This module makes every
+such path a named, armable injection site:
+
+- ``SITES``: the registry of known sites.  Each production call site
+  consults the registry through the module-level ``check(site)`` hook,
+  which is ZERO-COST while disabled — one global load and an ``is None``
+  test, no lock, no dict lookup (pinned by tests/test_faults.py).
+
+- ``FaultSpec`` / ``parse_fault_specs``: the arm grammar, shared by the
+  ``--fault site=spec`` CLI flag, the ``DECONV_FAULTS`` env var, and the
+  ``POST /v1/debug/faults`` one-shot endpoint.  ``spec`` is
+  ``p<prob>``/``<prob>`` (fire with that probability per consultation),
+  or ``n<count>`` (fire on the next <count> consultations, then
+  self-disarm — the "burst" form), optionally ``:<param>`` for
+  parameterized sites (milliseconds for the delay/hang/slow-write sites).
+  Multiple ``site=spec`` pairs join with commas.
+
+- ``FaultRegistry``: lock-protected armed-spec table with a SEEDED
+  ``random.Random`` so probabilistic chaos runs are reproducible, and
+  per-site injection counters published as
+  ``faults_injected_total{site=...}`` through the Metrics registry.
+
+The registry is owned by the service (``DeconvService.faults``) and
+installed into the module hook only when ``fault_injection`` is enabled;
+a default-configured server never pays more than the disabled hook.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.utils import slog
+
+_log = slog.get_logger("deconv.faults")
+
+# Every known injection site; arming an unknown one is a config error
+# (a typo'd site would otherwise arm nothing and the drill would
+# silently measure a healthy server).
+SITES = (
+    "codec.worker_raise",      # codec-pool worker dies mid-task
+    "codec.worker_hang",       # codec-pool worker stalls for :param ms
+    "dispatch.worker_raise",   # batcher dispatch-worker dies mid-task
+    "dispatch.worker_hang",    # batcher dispatch-worker stalls :param ms
+    "batcher.dispatch_raise",  # batcher dispatch-stage task crashes
+    "device.dispatch_error",   # device batch dispatch raises
+    "device.dispatch_delay_ms",  # device batch dispatch stalls :param ms
+    "http.slow_write",         # response write stalls :param ms
+)
+
+
+@dataclass
+class FaultSpec:
+    """One armed site: probability per consultation, optional one-shot
+    remaining count (None = until disarmed), optional site parameter."""
+
+    p: float = 1.0
+    n: int | None = None
+    param: float | None = None
+
+    def __str__(self) -> str:
+        s = f"n{self.n}" if self.n is not None else f"p{self.p:g}"
+        if self.param is not None:
+            s += f":{self.param:g}"
+        return s
+
+
+@dataclass
+class FaultAction:
+    """A fired fault, handed back to the call site (carries the spec's
+    parameter, e.g. the delay in ms)."""
+
+    site: str
+    param: float | None = None
+
+
+def parse_spec(raw: str) -> FaultSpec:
+    """``p0.05`` / ``0.05`` / ``n3`` with an optional ``:<param>``."""
+    head, _, param_s = raw.partition(":")
+    head = head.strip()
+    spec = FaultSpec()
+    try:
+        if head.startswith("n"):
+            spec.n = int(head[1:])
+            if spec.n <= 0:
+                raise ValueError
+        else:
+            spec.p = float(head[1:] if head.startswith("p") else head)
+            if not 0.0 < spec.p <= 1.0:
+                raise ValueError
+        if param_s:
+            spec.param = float(param_s)
+    except ValueError:
+        raise ValueError(
+            f"bad fault spec {raw!r}: want p<0..1], n<count>, or <0..1], "
+            "optionally :<param>"
+        ) from None
+    return spec
+
+
+def parse_fault_specs(raw: str) -> dict[str, FaultSpec]:
+    """``site=spec,site=spec,...`` -> validated {site: FaultSpec}."""
+    out: dict[str, FaultSpec] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, eq, spec = part.partition("=")
+        site = site.strip()
+        if not eq:
+            raise ValueError(f"bad fault arm {part!r}: want site=spec")
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; known: {', '.join(SITES)}"
+            )
+        out[site] = parse_spec(spec.strip())
+    return out
+
+
+class FaultRegistry:
+    """Armed-fault table + deterministic RNG + injection accounting.
+
+    ``check(site)`` is the only hot-path surface: returns a
+    ``FaultAction`` when the site fires (decrementing one-shot counts,
+    self-disarming at zero) and ``None`` otherwise.  All state is
+    lock-protected — sites are consulted from the event loop, codec
+    worker threads, and the dispatch worker thread."""
+
+    def __init__(self, seed: int = 0, metrics=None):
+        self._lock = threading.Lock()
+        self._armed: dict[str, FaultSpec] = {}
+        self._rng = random.Random(seed)
+        self._injected: dict[str, int] = {}
+        self._metrics = metrics
+
+    def arm(self, site: str, spec: FaultSpec | str) -> None:
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; known: {', '.join(SITES)}"
+            )
+        if isinstance(spec, str):
+            spec = parse_spec(spec)
+        with self._lock:
+            self._armed[site] = spec
+        slog.event(_log, "fault_armed", site=site, spec=str(spec))
+        self._publish()
+
+    def arm_string(self, raw: str) -> None:
+        """Arm every ``site=spec`` pair of a CLI/env/endpoint string."""
+        for site, spec in parse_fault_specs(raw).items():
+            self.arm(site, spec)
+
+    def disarm(self, site: str | None = None) -> None:
+        """Disarm one site, or every site (None)."""
+        with self._lock:
+            if site is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(site, None)
+        slog.event(_log, "fault_disarmed", site=site or "all")
+        self._publish()
+
+    def check(self, site: str) -> FaultAction | None:
+        disarmed = False
+        with self._lock:
+            spec = self._armed.get(site)
+            if spec is None:
+                return None
+            if spec.p < 1.0 and self._rng.random() >= spec.p:
+                return None
+            if spec.n is not None:
+                spec.n -= 1
+                if spec.n <= 0:
+                    del self._armed[site]
+                    disarmed = True
+            self._injected[site] = self._injected.get(site, 0) + 1
+        if self._metrics is not None:
+            self._metrics.inc_labeled("faults_injected_total", "site", site)
+        if disarmed:
+            # the armed-count gauge only moves when a one-shot spec
+            # self-disarms; publishing on every fire would pay an extra
+            # lock round-trip per injection on sustained chaos
+            self._publish()
+        return FaultAction(site, spec.param)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "armed": {s: str(spec) for s, spec in self._armed.items()},
+                "injected": dict(self._injected),
+            }
+
+    def _publish(self) -> None:
+        if self._metrics is not None:
+            with self._lock:
+                n = len(self._armed)
+            self._metrics.set_gauge("faults_armed", n)
+
+
+# ------------------------------------------------------- module-level hook
+
+# The zero-cost-when-disabled hook: production call sites do
+# ``faults.check(site)`` unconditionally; with no registry installed that
+# is one module-global load and an ``is None`` branch.  The service
+# installs its registry only when cfg.fault_injection is on.
+_REGISTRY: FaultRegistry | None = None
+
+
+def install(registry: FaultRegistry) -> None:
+    global _REGISTRY
+    _REGISTRY = registry
+
+
+def uninstall(registry: FaultRegistry | None = None) -> None:
+    """Remove the installed registry.  Pass the registry you installed so
+    a service tearing down cannot evict one installed after it."""
+    global _REGISTRY
+    if registry is None or _REGISTRY is registry:
+        _REGISTRY = None
+
+
+def installed() -> FaultRegistry | None:
+    return _REGISTRY
+
+
+def check(site: str) -> FaultAction | None:
+    reg = _REGISTRY
+    if reg is None:
+        return None
+    return reg.check(site)
+
+
+def raise_if_armed(site: str) -> None:
+    """Shared raise-form consultation: the site fires -> FaultInjected."""
+    act = check(site)
+    if act is not None:
+        raise errors.FaultInjected(f"injected fault at {site}")

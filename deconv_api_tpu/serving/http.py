@@ -21,7 +21,8 @@ import time
 from dataclasses import dataclass, field
 from urllib.parse import parse_qsl, unquote, urlsplit
 
-from deconv_api_tpu.serving.trace import request_id_from
+from deconv_api_tpu.serving import faults
+from deconv_api_tpu.serving.trace import deadline_from, request_id_from
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.http")
@@ -62,6 +63,13 @@ class Request:
     # line and flight-recorder trace carries it — the one join key
     # across client logs, server logs, metrics exemplars and traces.
     id: str = ""
+    # Absolute perf_counter deadline parsed from x-deadline-ms (round 9),
+    # anchored at parse time so queue wait counts against the caller's
+    # budget; None = no deadline.  The batcher reaps items whose
+    # deadline lapsed at the queue-pop and pre-dispatch boundaries, and
+    # singleflight waiters time out on their OWN deadline independently
+    # of the flight leader.
+    deadline: float | None = None
     # memoized form() result — the response cache derives its key from
     # the parsed form and the route handler parses the same body again;
     # one parse serves both (round 7).  None = not parsed yet.
@@ -165,6 +173,12 @@ class HttpServer:
         self._body_timeout_s = body_timeout_s
         self._max_connections = max_connections
         self._nconn = 0
+        # Drain-aware keep-alive (round 9): while True, every response on
+        # a live connection carries `connection: close` and the serve
+        # loop stops honoring keep-alive — clients learn the server is
+        # going away from the LAST response they get, not from a TCP
+        # reset mid-pipeline.  Set by the service at drain begin.
+        self.draining = False
 
     def route(self, method: str, path: str):
         def register(fn):
@@ -178,6 +192,7 @@ class HttpServer:
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self, grace_s: float = 5.0) -> None:
+        self.draining = True
         if self._server is not None:
             self._server.close()
             try:
@@ -235,9 +250,17 @@ class HttpServer:
                 req = await self._read_request(reader)
                 if req is None:
                     break
-                keep_alive = req.headers.get("connection", "keep-alive") != "close"
+                keep_alive = (
+                    req.headers.get("connection", "keep-alive") != "close"
+                    and not self.draining
+                )
                 t0 = time.perf_counter()
                 resp = await self._dispatch(req)
+                # draining may have BEGUN while the handler ran: this
+                # response must already tell the client to stop
+                # pipelining into a dying server
+                if self.draining:
+                    keep_alive = False
                 # EVERY response carries the request id — success, 4xx,
                 # shed 503, handler-crash 500 — so a client-side log line
                 # joins server logs and flight-recorder traces on one key
@@ -256,6 +279,12 @@ class HttpServer:
                     id=req.id,
                     ms=round((time.perf_counter() - t0) * 1e3, 1),
                 )
+                act = faults.check("http.slow_write")
+                if act is not None:
+                    # chaos site: a stalled response write (saturated NIC,
+                    # slow proxy) — the client-observed tail grows while
+                    # the handler's own spans stay healthy
+                    await asyncio.sleep((act.param or 50.0) / 1e3)
                 writer.write(resp.encode(keep_alive))
                 await writer.drain()
                 if not keep_alive:
@@ -352,6 +381,7 @@ class HttpServer:
         return Request(
             method.upper(), unquote(parts.path), query, headers, body,
             request_id_from(headers.get("x-request-id")),
+            deadline_from(headers.get("x-deadline-ms")),
         )
 
     async def _read_chunked(self, reader: asyncio.StreamReader) -> bytes:

@@ -75,6 +75,10 @@ class Metrics:
         self._stage: dict[str, _Reservoir] = {}
         self._gauges: dict[str, float] = {}
         self._counters: dict[str, int] = {}
+        # family -> (label name, {label value: count}) — round 9's
+        # per-site fault and per-task restart accounting; one label name
+        # per family, like errors_total{code=...}
+        self._labeled: dict[str, tuple[str, dict[str, int]]] = {}
 
     def observe_request(self, latency_s: float, error_code: str | None = None) -> None:
         with self._lock:
@@ -143,6 +147,24 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0)
 
+    def inc_labeled(
+        self, family: str, label: str, value: str, n: int = 1
+    ) -> None:
+        """Labeled monotonic counters (round 9: the robustness layer's
+        ``faults_injected_total{site=...}`` and
+        ``task_restarts_total{task=...}`` accounting) — one counter
+        family, one sample line per label value, exactly like
+        ``errors_total{code=...}``."""
+        with self._lock:
+            _, series = self._labeled.setdefault(family, (label, {}))
+            series[value] = series.get(value, 0) + n
+
+    def labeled(self, family: str) -> dict[str, int]:
+        """{label value: count} for one labeled-counter family."""
+        with self._lock:
+            _, series = self._labeled.get(family, ("", {}))
+            return dict(series)
+
     def set_gauge(self, name: str, value: float) -> None:
         """Instantaneous pipeline-state gauges (queue depths, inflight
         batches — round 6's three-stage pipeline observability).  Updated
@@ -172,6 +194,10 @@ class Metrics:
                 },
                 "gauges": dict(self._gauges),
                 "counters": dict(self._counters),
+                "labeled": {
+                    fam: (label, dict(series))
+                    for fam, (label, series) in self._labeled.items()
+                },
             }
 
     def prometheus(self) -> str:
@@ -234,6 +260,14 @@ class Metrics:
         for name, n in sorted(s["counters"].items()):
             lines.append(f"# TYPE {p}_{name} counter")
             lines.append(f"{p}_{name} {n}")
+        # labeled counters (round 9): per-site fault injections, per-task
+        # supervisor restarts — one TYPE header per family
+        for fam, (label, series) in sorted(s["labeled"].items()):
+            lines.append(f"# TYPE {p}_{fam} counter")
+            for value, n in sorted(series.items()):
+                lines.append(
+                    f'{p}_{fam}{{{label}="{escape_label(value)}"}} {n}'
+                )
         # pipeline-state gauges (round 6): collect/dispatch queue depths,
         # inflight batches, codec-pool pending jobs; cache resident bytes /
         # entries / hit ratio (round 7)

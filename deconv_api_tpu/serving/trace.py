@@ -85,6 +85,33 @@ def request_id_from(raw: str | None) -> str:
     return new_request_id()
 
 
+# A deadline header longer than a day is a client bug, not a budget;
+# ignoring it (no deadline) beats honoring a nonsense value.
+MAX_DEADLINE_MS = 24 * 3600 * 1000
+
+
+def deadline_from(raw: str | None, now: float | None = None) -> float | None:
+    """Parse an inbound ``x-deadline-ms`` header (round 9 deadline
+    propagation) into an ABSOLUTE ``time.perf_counter`` deadline.
+
+    The header is the caller's remaining budget in milliseconds, anchored
+    at request-parse time so queue wait counts against it.  Malformed or
+    insane values (non-numeric, <= 0, > a day) yield None — no deadline —
+    rather than a 400: the header is advisory backpressure metadata, and
+    rejecting the request over it would fail work the caller still
+    wants.  The per-dispatcher cap against ``request_timeout_s`` is
+    applied downstream (serving/batcher.py), where the timeout lives."""
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        return None
+    if not 0 < ms <= MAX_DEADLINE_MS:
+        return None
+    return (time.perf_counter() if now is None else now) + ms / 1e3
+
+
 class RequestTrace:
     """One request's span-structured lifecycle.
 

@@ -153,9 +153,13 @@ def test_gauge_tracks_depth():
 
     asyncio.run(go())
     names = {n for n, _ in m.values}
-    assert names == {"codec_queue_depth"}
-    assert any(v >= 1 for _, v in m.values)  # saw the job pending
-    assert m.values[-1][1] == 0  # and its completion
+    # depth gauge plus the round-9 live-workers gauge (the /readyz
+    # quorum input, published from construction on)
+    assert names == {"codec_queue_depth", "codec_workers_live"}
+    depth = [v for n, v in m.values if n == "codec_queue_depth"]
+    assert any(v >= 1 for v in depth)  # saw the job pending
+    assert depth[-1] == 0  # and its completion
+    assert [v for n, v in m.values if n == "codec_workers_live"][-1] == 2
     pool.close()
 
 

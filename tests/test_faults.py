@@ -1,0 +1,345 @@
+"""Fault-injection registry + deadline propagation (round 9,
+serving/faults.py): spec grammar, deterministic firing, the
+zero-overhead disabled hook, the guarded debug endpoint, x-deadline-ms
+end to end, and the singleflight waiter's independent deadline.
+Fast-lane by design — clocks are short or injected."""
+
+import asyncio
+import time
+
+import httpx
+import pytest
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.serving import faults
+from deconv_api_tpu.serving.cache import Singleflight
+from deconv_api_tpu.serving.faults import (
+    FaultRegistry,
+    parse_fault_specs,
+    parse_spec,
+)
+from deconv_api_tpu.serving.metrics import Metrics
+from deconv_api_tpu.serving.trace import deadline_from
+from tests.test_serving import ServiceFixture, _data_url
+
+# ------------------------------------------------------------ spec grammar
+
+
+def test_parse_spec_forms():
+    assert parse_spec("p0.05").p == 0.05
+    assert parse_spec("0.25").p == 0.25
+    s = parse_spec("n3")
+    assert s.n == 3 and s.p == 1.0
+    s = parse_spec("p0.5:100")
+    assert s.p == 0.5 and s.param == 100.0
+    assert parse_spec("n2:250").param == 250.0
+    assert str(parse_spec("p0.05")) == "p0.05"
+    assert str(parse_spec("n2:250")) == "n2:250"
+
+
+@pytest.mark.parametrize("bad", ["", "p0", "p1.5", "n0", "n-1", "xyz", "p:5"])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_parse_fault_specs_multi_and_unknown_site():
+    specs = parse_fault_specs(
+        "codec.worker_raise=p0.05,device.dispatch_delay_ms=n2:100"
+    )
+    assert set(specs) == {"codec.worker_raise", "device.dispatch_delay_ms"}
+    assert specs["device.dispatch_delay_ms"].param == 100.0
+    with pytest.raises(ValueError, match="unknown fault site"):
+        parse_fault_specs("codec.worker_rais=p1")
+    with pytest.raises(ValueError, match="site=spec"):
+        parse_fault_specs("codec.worker_raise")
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_one_shot_fires_exactly_n_then_disarms():
+    reg = FaultRegistry()
+    reg.arm("device.dispatch_error", "n3")
+    fired = [reg.check("device.dispatch_error") for _ in range(10)]
+    assert sum(a is not None for a in fired) == 3
+    assert all(a is not None for a in fired[:3])  # p=1: the FIRST three
+    assert reg.snapshot()["armed"] == {}  # self-disarmed at zero
+    assert reg.snapshot()["injected"] == {"device.dispatch_error": 3}
+
+
+def test_probabilistic_firing_deterministic_under_seed():
+    def sequence(seed):
+        reg = FaultRegistry(seed=seed)
+        reg.arm("codec.worker_raise", "p0.5")
+        return [reg.check("codec.worker_raise") is not None for _ in range(64)]
+
+    a, b = sequence(7), sequence(7)
+    assert a == b  # same seed -> same firing sequence (replayable chaos)
+    assert 5 < sum(a) < 59  # and it actually is probabilistic
+
+
+def test_disabled_hook_is_inert():
+    """The zero-cost path: no registry installed -> one global load, no
+    action, no accounting.  A registry with the site DISARMED is also
+    side-effect free."""
+    assert faults.installed() is None
+    assert faults.check("codec.worker_raise") is None
+    m = Metrics()
+    reg = FaultRegistry(metrics=m)
+    faults.install(reg)
+    try:
+        assert faults.check("codec.worker_raise") is None
+        assert m.labeled("faults_injected_total") == {}
+    finally:
+        faults.uninstall(reg)
+    assert faults.installed() is None
+
+
+def test_uninstall_only_evicts_own_registry():
+    a, b = FaultRegistry(), FaultRegistry()
+    faults.install(a)
+    faults.install(b)
+    try:
+        faults.uninstall(a)  # stale owner: must NOT evict b
+        assert faults.installed() is b
+    finally:
+        faults.uninstall(b)
+
+
+def test_injection_counter_labeled_by_site():
+    m = Metrics()
+    reg = FaultRegistry(metrics=m)
+    reg.arm("device.dispatch_error", "n2")
+    reg.arm("http.slow_write", "n1:10")
+    for _ in range(3):
+        reg.check("device.dispatch_error")
+    reg.check("http.slow_write")
+    assert m.labeled("faults_injected_total") == {
+        "device.dispatch_error": 2,
+        "http.slow_write": 1,
+    }
+    text = m.prometheus()
+    assert '# TYPE deconv_faults_injected_total counter' in text
+    assert 'deconv_faults_injected_total{site="device.dispatch_error"} 2' in text
+
+
+def test_registry_rejects_unknown_site():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultRegistry().arm("nope.bad_site", "p1")
+
+
+# -------------------------------------------------------- deadline parsing
+
+
+def test_deadline_from_sane_and_insane():
+    now = 100.0
+    assert deadline_from("250", now=now) == pytest.approx(100.25)
+    assert deadline_from(None) is None
+    assert deadline_from("") is None
+    assert deadline_from("abc") is None
+    assert deadline_from("-5") is None
+    assert deadline_from("0") is None
+    assert deadline_from(str(10**9)) is None  # > a day: client bug, ignored
+
+
+def test_singleflight_waiter_honors_own_deadline():
+    """A coalesced waiter 504s on ITS deadline while the shared flight
+    (and the leader) live on — the flight future is neither cancelled
+    nor resolved by the timed-out waiter."""
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        t0 = time.perf_counter()
+        with pytest.raises(errors.DeadlineExpired):
+            await Singleflight.wait(fut, deadline=t0 + 0.05)
+        assert time.perf_counter() - t0 < 1.0
+        assert not fut.cancelled() and not fut.done()
+        # an already-lapsed deadline fails without awaiting at all
+        with pytest.raises(errors.DeadlineExpired):
+            await Singleflight.wait(fut, deadline=time.perf_counter() - 1)
+        fut.set_result("late")  # flight completes normally for others
+        assert await Singleflight.wait(fut) == "late"
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------- e2e service
+
+
+@pytest.fixture(scope="module")
+def chaos_server():
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        compilation_cache_dir="",
+        fault_injection=True,
+        fault_seed=0,
+    )
+    with ServiceFixture(cfg) as s:
+        yield s
+        # tests arm one-shot (n) faults; anything left is a test bug
+        assert s.service.faults.snapshot()["armed"] == {}
+
+
+def _arm(server, spec: str):
+    r = httpx.post(server.base_url + "/v1/debug/faults", data={"arm": spec})
+    assert r.status_code == 200, r.text
+    return r.json()
+
+
+def test_debug_faults_404_when_disabled():
+    cfg = ServerConfig(
+        image_size=16, max_batch=4, batch_window_ms=1.0,
+        compilation_cache_dir="",
+    )
+    with ServiceFixture(cfg) as s:
+        r = httpx.post(s.base_url + "/v1/debug/faults", data={"arm": "x=p1"})
+        assert r.status_code == 404  # invisible unless fault_injection on
+
+
+def test_debug_faults_arm_snapshot_disarm(chaos_server):
+    snap = _arm(chaos_server, "device.dispatch_delay_ms=p0.5:100")["faults"]
+    assert snap["armed"] == {"device.dispatch_delay_ms": "p0.5:100"}
+    r = httpx.post(
+        chaos_server.base_url + "/v1/debug/faults", data={"disarm": "all"}
+    )
+    assert r.status_code == 200
+    assert r.json()["faults"]["armed"] == {}
+    # bad specs answer 400, not a crashed handler
+    r = httpx.post(
+        chaos_server.base_url + "/v1/debug/faults", data={"arm": "bogus=p1"}
+    )
+    assert r.status_code == 400
+    assert r.json()["error"] == "bad_request"
+
+
+def test_device_dispatch_error_maps_to_fault_injected_500(chaos_server):
+    _arm(chaos_server, "device.dispatch_error=n1")
+    r = httpx.post(
+        chaos_server.base_url + "/",
+        data={"file": _data_url(), "layer": "b2c1"},
+        headers={"cache-control": "no-store"},
+        timeout=30,
+    )
+    assert r.status_code == 500
+    assert r.json()["error"] == "fault_injected"
+    # one-shot: the very next identical request computes fine
+    r = httpx.post(
+        chaos_server.base_url + "/",
+        data={"file": _data_url(), "layer": "b2c1"},
+        headers={"cache-control": "no-store"},
+        timeout=30,
+    )
+    assert r.status_code == 200, r.text
+
+
+def test_http_slow_write_delays_response(chaos_server):
+    # n2: the arm endpoint's OWN response is also a tracked write and
+    # consumes the first shot; the probed GET consumes the second
+    _arm(chaos_server, "http.slow_write=n2:120")
+    t0 = time.perf_counter()
+    r = httpx.get(chaos_server.base_url + "/health-check", timeout=10)
+    dt = time.perf_counter() - t0
+    assert r.status_code == 200
+    assert dt >= 0.1  # the injected write stall is client-visible
+    t0 = time.perf_counter()
+    httpx.get(chaos_server.base_url + "/health-check", timeout=10)
+    assert time.perf_counter() - t0 < 0.1  # one-shot: back to fast
+
+
+def test_deadline_expired_504_end_to_end(chaos_server):
+    """An x-deadline-ms the server cannot possibly meet 504s with the
+    deadline taxonomy code, carries the request id, and bumps the
+    deadline_expired_total counter — without burning the 60 s timeout."""
+    before = chaos_server.service.metrics.counter("deadline_expired_total")
+    t0 = time.perf_counter()
+    r = httpx.post(
+        chaos_server.base_url + "/",
+        data={"file": _data_url(), "layer": "b2c1"},
+        headers={"x-deadline-ms": "0.01", "cache-control": "no-store"},
+        timeout=30,
+    )
+    assert time.perf_counter() - t0 < 5.0
+    assert r.status_code == 504
+    assert r.json()["error"] == "deadline_expired"
+    assert r.headers["x-request-id"]
+    after = chaos_server.service.metrics.counter("deadline_expired_total")
+    assert after > before
+    # a generous deadline serves normally
+    r = httpx.post(
+        chaos_server.base_url + "/",
+        data={"file": _data_url(), "layer": "b2c1"},
+        headers={"x-deadline-ms": "30000", "cache-control": "no-store"},
+        timeout=30,
+    )
+    assert r.status_code == 200, r.text
+
+
+def test_leader_deadline_does_not_poison_coalesced_waiters(chaos_server):
+    """A flight leader whose PERSONAL x-deadline-ms lapses fails with
+    504 deadline_expired; coalesced waiters (who sent no deadline) get a
+    retryable 503 unavailable — never a 504 that is not theirs."""
+    import threading
+
+    _arm(chaos_server, "device.dispatch_delay_ms=n1:500")
+    form = {"file": _data_url(rng_seed=77), "layer": "b2c1"}
+    results = {}
+
+    def leader():
+        results["leader"] = httpx.post(
+            chaos_server.base_url + "/", data=form,
+            headers={"x-deadline-ms": "150"}, timeout=30,
+        )
+
+    def waiter():
+        results["waiter"] = httpx.post(
+            chaos_server.base_url + "/", data=form, timeout=30
+        )
+
+    tl = threading.Thread(target=leader)
+    tl.start()
+    time.sleep(0.1)  # leader owns the flight before the waiter arrives
+    tw = threading.Thread(target=waiter)
+    tw.start()
+    tl.join(20)
+    tw.join(20)
+    lr, wr = results["leader"], results["waiter"]
+    assert lr.status_code == 504 and lr.json()["error"] == "deadline_expired"
+    assert wr.status_code == 503, wr.text
+    assert wr.json()["error"] == "unavailable"
+    assert wr.headers.get("x-cache") == "coalesced"
+
+
+def test_config_reports_fault_state(chaos_server):
+    cfg = httpx.get(chaos_server.base_url + "/v1/config").json()
+    assert cfg["fault_injection_active"] is True
+    assert cfg["breaker_active"] is True
+    assert cfg["breaker_state"] == "closed"
+    assert cfg["draining"] is False
+    assert cfg["codec_workers_live"] >= 1
+    assert "injected" in cfg["faults_state"]
+
+
+def test_live_metrics_exposition_lints_with_fault_series(chaos_server):
+    from tests.test_metrics_exposition import lint_exposition
+
+    _arm(chaos_server, "device.dispatch_error=n1")
+    httpx.post(
+        chaos_server.base_url + "/",
+        data={"file": _data_url(), "layer": "b2c1"},
+        headers={"cache-control": "no-store"},
+        timeout=30,
+    )
+    text = httpx.get(chaos_server.base_url + "/v1/metrics").text
+    families, samples = lint_exposition(text)
+    assert families["deconv_faults_injected_total"] == "counter"
+    assert families["deconv_breaker_state"] == "gauge"
+    assert families["deconv_codec_workers_live"] == "gauge"
+    assert (
+        "deconv_faults_injected_total",
+        'site="device.dispatch_error"',
+    ) in samples

@@ -80,6 +80,13 @@ def _traffic(m: Metrics) -> None:
     m.observe_stage("compute", 0.030)
     m.inc_counter("cache_hits_total", 2)
     m.set_gauge("cache_resident_bytes", 1024)
+    # robustness-layer series (round 9): breaker/pool gauges, deadline
+    # counter, labeled fault-injection and task-restart counters
+    m.inc_counter("deadline_expired_total")
+    m.set_gauge("breaker_state", 2)
+    m.set_gauge("codec_workers_live", 8)
+    m.inc_labeled("faults_injected_total", "site", "codec.worker_raise")
+    m.inc_labeled("task_restarts_total", "task", "dispatch")
 
 
 def test_every_family_typed_once_and_labels_escape():
@@ -93,6 +100,17 @@ def test_every_family_typed_once_and_labels_escape():
     assert families["deconv_errors_total"] == "counter"
     assert families["deconv_stage_seconds"] == "summary"
     assert any(name == "deconv_errors_total" for name, _ in samples)
+    # round-9 robustness series carry TYPE headers and parse
+    assert families["deconv_deadline_expired_total"] == "counter"
+    assert families["deconv_breaker_state"] == "gauge"
+    assert families["deconv_codec_workers_live"] == "gauge"
+    assert families["deconv_faults_injected_total"] == "counter"
+    assert samples[
+        ("deconv_faults_injected_total", 'site="codec.worker_raise"')
+    ] == 1.0
+    assert samples[
+        ("deconv_task_restarts_total", 'task="dispatch"')
+    ] == 1.0
     # the raw quote must not appear unescaped inside any label block
     for line in text.splitlines():
         if "we" in line and "ird" in line:

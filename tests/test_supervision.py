@@ -1,0 +1,482 @@
+"""Self-healing supervision (round 9): codec-pool worker respawn, the
+batcher task supervisor, the device circuit breaker, deadline reaping,
+and the health/readiness surface.  Fast-lane — breaker cooldowns use an
+injected clock, supervisor backoffs start at 50 ms."""
+
+import asyncio
+import threading
+import time
+
+import httpx
+import numpy as np
+import pytest
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.serving import faults
+from deconv_api_tpu.serving.batcher import BatchingDispatcher, CircuitBreaker
+from deconv_api_tpu.serving.codec_pool import WorkerPool
+from deconv_api_tpu.serving.faults import FaultRegistry
+from deconv_api_tpu.serving.metrics import Metrics
+from tests.test_serving import ServiceFixture, _data_url
+
+
+def _img():
+    return np.zeros((2, 2, 3), np.float32)
+
+
+def _wait_until(cond, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+class _Installed:
+    """Arm a registry for one test, guaranteed uninstalled after."""
+
+    def __init__(self, metrics=None):
+        self.registry = FaultRegistry(metrics=metrics)
+
+    def __enter__(self):
+        faults.install(self.registry)
+        return self.registry
+
+    def __exit__(self, *exc):
+        faults.uninstall(self.registry)
+
+
+# ------------------------------------------------------- worker pool healing
+
+
+def test_worker_crash_fails_only_that_task_and_respawns():
+    """The satellite pin: a worker dying MID-TASK fails that task's
+    future (no hung caller), the other tasks complete, and the pool
+    respawns back to full capacity."""
+    m = Metrics()
+    with _Installed(metrics=m) as reg:
+        pool = WorkerPool(2, name="codec", metrics=m)
+        reg.arm("codec.worker_raise", "n1")
+
+        async def go():
+            jobs = [pool.run(lambda i=i: i * 10) for i in range(6)]
+            return await asyncio.gather(*jobs, return_exceptions=True)
+
+        results = asyncio.run(go())
+        crashes = [r for r in results if isinstance(r, errors.FaultInjected)]
+        assert len(crashes) == 1  # exactly the faulted task
+        assert sorted(r for r in results if not isinstance(r, Exception)) == [
+            i * 10 for i in range(6) if results[i] not in crashes
+        ]
+        assert _wait_until(lambda: pool.live_workers == 2)
+        assert m.labeled("worker_deaths_total") == {"codec": 1}
+        assert pool.at_quorum
+        pool.close()
+
+
+def test_respawn_budget_bounds_crash_loops():
+    """Budget exhausted -> capacity degrades (visible via live_workers /
+    at_quorum) instead of respawn churn, and a pool at zero workers
+    fails submissions fast instead of queueing jobs nobody will run."""
+    with _Installed() as reg:
+        pool = WorkerPool(2, respawn_budget=1, respawn_window_s=60.0)
+        reg.arm("codec.worker_raise", "n3")
+
+        async def crash_all():
+            out = []
+            for _ in range(3):
+                try:
+                    await asyncio.wait_for(pool.run(lambda: 1), 5)
+                except errors.FaultInjected:
+                    out.append("crash")
+            return out
+
+        assert asyncio.run(crash_all()) == ["crash"] * 3
+        # 3 deaths, budget 1: one respawned, then capacity shrinks to 0
+        assert _wait_until(lambda: pool.live_workers == 0)
+        assert not pool.at_quorum
+
+        async def rejected():
+            with pytest.raises(errors.Unavailable, match="no live workers"):
+                await pool.run(lambda: 1)
+
+        asyncio.run(rejected())
+        pool.close()
+
+
+def test_capacity_self_restores_after_window_slides():
+    """Respawn budget spent during a storm; once the sliding window
+    passes, the next submission tops the pool back up — the
+    self-restore the chaos drill's recovery phase depends on."""
+    with _Installed() as reg:
+        pool = WorkerPool(2, respawn_budget=2, respawn_window_s=0.2)
+        reg.arm("codec.worker_raise", "n3")
+
+        async def crash_all():
+            for _ in range(3):
+                try:
+                    await asyncio.wait_for(pool.run(lambda: 1), 5)
+                except errors.FaultInjected:
+                    pass
+
+        asyncio.run(crash_all())
+        # 3 deaths vs budget 2: two respawned during the storm, the
+        # third death leaves the pool one short
+        assert _wait_until(lambda: pool.live_workers == 1)
+        time.sleep(0.25)  # the respawn window slides past the storm
+
+        async def healed():
+            return await asyncio.wait_for(pool.run(lambda: "ok"), 5)
+
+        assert asyncio.run(healed()) == "ok"
+        assert pool.live_workers == 2
+        pool.close()
+
+
+def test_map_sync_settle_isolates_per_item_failures():
+    pool = WorkerPool(2)
+
+    def job(i):
+        if i == 2:
+            raise RuntimeError("tile exploded")
+        return i * 10
+
+    out = pool.map_sync_settle(job, [0, 1, 2, 3])
+    assert out[0] == 0 and out[1] == 10 and out[3] == 30
+    assert isinstance(out[2], RuntimeError)  # settled, not raised
+    pool.close()
+    # closed pool: inline fallback settles identically
+    out = pool.map_sync_settle(job, [1, 2])
+    assert out[0] == 10 and isinstance(out[1], RuntimeError)
+
+
+# ------------------------------------------------------------ circuit breaker
+
+
+def test_breaker_lifecycle_closed_open_halfopen_closed():
+    clock = [0.0]
+    m = Metrics()
+    br = CircuitBreaker(3, 10.0, metrics=m, clock=lambda: clock[0])
+    assert br.state == CircuitBreaker.CLOSED
+    br.record_failure()
+    br.record_success()  # success resets the consecutive streak
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # streak broken at 2 < 3
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    allowed, retry = br.allow()
+    assert not allowed and retry > 0
+    # a straggler success while OPEN must not flap it shut
+    br.record_success()
+    assert br.state == CircuitBreaker.OPEN
+    clock[0] = 10.5  # cooldown elapsed: exactly ONE probe admitted
+    ok1, _ = br.allow()
+    ok2, _ = br.allow()
+    assert ok1 and not ok2
+    assert br.state == CircuitBreaker.HALF_OPEN
+    # a probe that never reports back must not wedge the breaker: its
+    # claim expires after a cooldown and another probe is admitted
+    clock[0] = 21.0
+    assert br.allow()[0]
+    assert not br.allow()[0]
+    br.record_success()  # the probe came back
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow() == (True, 0.0)
+    assert m.counter("breaker_open_total") == 1
+
+
+def test_breaker_accepting_heals_readiness_livelock():
+    """accepting() (what /readyz reports) must flip back to True once
+    the cooldown elapses even though state is still OPEN: a readiness-
+    gated LB would otherwise never route the request that runs the
+    recovery probe, deadlocking the breaker open forever."""
+    clock = [0.0]
+    br = CircuitBreaker(1, 5.0, clock=lambda: clock[0])
+    assert br.accepting()
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.accepting()  # cooling: shed elsewhere
+    clock[0] = 5.5
+    # NO traffic has called allow() — state is still OPEN — but the
+    # instance must advertise ready so the probe can arrive
+    assert br.state == CircuitBreaker.OPEN
+    assert br.accepting()
+
+
+def test_breaker_failed_probe_reopens():
+    clock = [0.0]
+    br = CircuitBreaker(1, 5.0, clock=lambda: clock[0])
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    clock[0] = 6.0
+    assert br.allow()[0]  # the probe
+    br.record_failure()  # probe failed: fresh cooldown from NOW
+    assert br.state == CircuitBreaker.OPEN
+    clock[0] = 10.0  # 4s after reopen < 5s cooldown
+    assert not br.allow()[0]
+    clock[0] = 11.5
+    assert br.allow()[0]
+
+
+def test_breaker_gates_dispatcher_submits():
+    """Consecutive device failures open the shared breaker; subsequent
+    submits fail FAST with breaker_open + retry_after instead of
+    queueing onto the dead device, and the half-open probe closes it."""
+    clock = [0.0]
+    br = CircuitBreaker(2, 5.0, clock=lambda: clock[0])
+    healthy = [False]
+
+    def runner(key, images):
+        if not healthy[0]:
+            raise RuntimeError("device wedged")
+        return ["ok"] * len(images)
+
+    async def go():
+        d = BatchingDispatcher(
+            runner, max_batch=1, window_ms=0, pipeline_depth=1,
+            request_timeout_s=5.0, breaker=br,
+        )
+        await d.start()
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="device wedged"):
+                await d.submit(_img(), "k")
+        t0 = time.perf_counter()
+        with pytest.raises(errors.BreakerOpen) as ei:
+            await d.submit(_img(), "k")
+        assert time.perf_counter() - t0 < 1.0  # failed fast, no queueing
+        assert ei.value.retry_after_s > 0
+        healthy[0] = True
+        clock[0] = 6.0  # cooldown over: this submit IS the probe
+        assert await d.submit(_img(), "k") == "ok"
+        assert br.state == CircuitBreaker.CLOSED
+        assert await d.submit(_img(), "k") == "ok"
+        await d.stop()
+
+    asyncio.run(go())
+
+
+# --------------------------------------------------------- task supervision
+
+
+def test_dispatch_task_crash_fails_inflight_fast_and_restarts():
+    """An injected dispatch-stage crash fails the in-flight request
+    immediately (no 60 s 504 wait) and the supervisor restarts the task
+    — the next submit serves normally."""
+    m = Metrics()
+    with _Installed(metrics=m) as reg:
+
+        def dispatch(key, images):
+            return lambda: [f"{key}-ok"] * len(images)
+
+        async def go():
+            d = BatchingDispatcher(
+                lambda k, i: [None], dispatch_runner=dispatch,
+                pipeline_depth=2, max_batch=4, window_ms=0,
+                request_timeout_s=30.0, metrics=m,
+            )
+            await d.start()
+            assert await d.submit(_img(), "warm") == "warm-ok"
+            reg.arm("batcher.dispatch_raise", "n1")
+            t0 = time.perf_counter()
+            with pytest.raises(errors.FaultInjected):
+                await d.submit(_img(), "a")
+            assert time.perf_counter() - t0 < 5.0  # failed fast
+            # supervisor restarted the crashed stage (50 ms backoff)
+            result = await asyncio.wait_for(d.submit(_img(), "b"), 10)
+            assert result == "b-ok"
+            assert d.tasks_alive()
+            await d.stop()
+            assert not d.tasks_alive()
+
+        asyncio.run(go())
+        assert m.labeled("task_restarts_total") == {"dispatch": 1}
+
+
+def test_collect_task_crash_restarts_too():
+    """A crash in the collect loop (simulated by a poisoned runner-key
+    grouping via a broken trace object is contrived — instead poison
+    _drain_nowait) restarts under the same supervisor."""
+    m = Metrics()
+
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, images: ["ok"] * len(images),
+            max_batch=2, window_ms=0, pipeline_depth=1,
+            request_timeout_s=30.0, metrics=m,
+        )
+        await d.start()
+        assert await d.submit(_img(), "warm") == "ok"
+        original = d._drain_nowait
+        calls = []
+
+        def boom(batch):
+            d._drain_nowait = original  # crash exactly once
+            calls.append(1)
+            raise RuntimeError("collect bug")
+
+        d._drain_nowait = boom
+        with pytest.raises(errors.Unavailable, match="collect task crashed"):
+            await d.submit(_img(), "a")
+        assert calls  # the poisoned path actually ran
+        assert await asyncio.wait_for(d.submit(_img(), "b"), 10) == "ok"
+        await d.stop()
+
+    asyncio.run(go())
+    assert m.labeled("task_restarts_total") == {"collect": 1}
+
+
+# ------------------------------------------------------------ deadline reap
+
+
+def test_deadline_reap_never_dispatches_expired_work():
+    """An item whose deadline lapses while queued behind a slow batch is
+    reaped at the queue-pop boundary: its caller gets an immediate 504
+    and the runner NEVER sees its work."""
+    gate = threading.Event()
+    seen = []
+
+    def runner(key, images):
+        seen.append(key)
+        if key == "slow":
+            gate.wait(10)
+        return ["ok"] * len(images)
+
+    m = Metrics()
+
+    async def go():
+        d = BatchingDispatcher(
+            runner, max_batch=1, window_ms=0, pipeline_depth=1,
+            request_timeout_s=30.0, metrics=m,
+        )
+        await d.start()
+        slow = asyncio.create_task(d.submit(_img(), "slow"))
+        await asyncio.sleep(0.15)  # slow batch now occupies the device
+        t0 = time.perf_counter()
+        with pytest.raises(errors.DeadlineExpired):
+            await d.submit(
+                _img(), "doomed", deadline=time.perf_counter() + 0.05
+            )
+        assert time.perf_counter() - t0 < 5.0
+        gate.set()
+        assert await slow == "ok"
+        await asyncio.sleep(0.1)  # let any (wrong) dispatch of doomed run
+        assert "doomed" not in seen  # dead work never reached the device
+        await d.stop()
+
+    asyncio.run(go())
+    assert m.counter("deadline_expired_total") >= 1
+
+
+def test_deadline_already_expired_rejected_at_submit():
+    async def go():
+        d = BatchingDispatcher(
+            lambda k, i: ["ok"], max_batch=1, window_ms=0, pipeline_depth=1
+        )
+        await d.start()
+        t0 = time.perf_counter()
+        with pytest.raises(errors.DeadlineExpired):
+            await d.submit(_img(), "k", deadline=time.perf_counter() - 1.0)
+        assert time.perf_counter() - t0 < 0.5  # immediate, not queued
+        await d.stop()
+
+    asyncio.run(go())
+
+
+# -------------------------------------------------------- health surface
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = ServerConfig(
+        image_size=16, max_batch=4, batch_window_ms=1.0,
+        compilation_cache_dir="",
+    )
+    with ServiceFixture(cfg) as s:
+        yield s
+
+
+def test_healthz_liveness(server):
+    r = httpx.get(server.base_url + "/healthz")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["status"] == "ok"
+    assert body["event_loop_lag_ms"] >= 0
+
+
+def test_readyz_all_checks_green(server):
+    r = httpx.get(server.base_url + "/readyz")
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["ready"] is True
+    assert set(body["checks"]) == {
+        "warmed", "not_draining", "batcher_tasks",
+        "codec_pool_quorum", "breaker_not_open",
+    }
+    assert all(body["checks"].values())
+
+
+def test_readyz_flips_503_when_breaker_opens(server):
+    br = server.service.breaker
+    for _ in range(br.threshold):
+        br.record_failure()
+    try:
+        r = httpx.get(server.base_url + "/readyz")
+        assert r.status_code == 503
+        assert r.json()["checks"]["breaker_not_open"] is False
+        # liveness is unaffected: restarting would not fix an open breaker
+        assert httpx.get(server.base_url + "/healthz").status_code == 200
+    finally:
+        # close it again the legitimate way: cooldown probe + success
+        br._opened_at = -1e9
+        assert br.allow()[0]
+        br.record_success()
+    assert httpx.get(server.base_url + "/readyz").status_code == 200
+
+
+def test_readyz_flips_during_drain_and_keepalive_closes(server):
+    """The drain contract: begin_drain flips /readyz to 503 (LBs stop
+    routing) and live keep-alive responses carry connection: close
+    (clients stop pipelining) — all BEFORE the listener dies."""
+    with httpx.Client(base_url=server.base_url) as client:
+        r = client.get("/healthz")
+        assert r.headers["connection"] == "keep-alive"
+        server.service.begin_drain()
+        try:
+            r = client.get("/readyz")
+            assert r.status_code == 503
+            assert r.json()["checks"]["not_draining"] is False
+            assert r.headers["connection"] == "close"
+            # liveness stays green through a drain
+            assert httpx.get(server.base_url + "/healthz").status_code == 200
+        finally:
+            server.service.draining = False
+            server.service.server.draining = False
+    r = httpx.get(server.base_url + "/readyz")
+    assert r.status_code == 200
+    assert r.headers["connection"] == "keep-alive"
+
+
+def test_readyz_not_ready_before_start():
+    """A constructed-but-unstarted (or unwarmed) service reports every
+    missing gate rather than a blanket false."""
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving.app import DeconvService
+    from tests.test_engine_parity import TINY
+
+    import jax
+
+    cfg = ServerConfig(
+        image_size=16, max_batch=4, compilation_cache_dir="",
+    )
+    svc = DeconvService(
+        cfg, spec=TINY, params=init_params(TINY, jax.random.PRNGKey(0))
+    )
+    checks = svc._readiness_checks()
+    assert checks["warmed"] is False
+    assert checks["batcher_tasks"] is False  # dispatchers not started
+    assert checks["codec_pool_quorum"] is True
+    svc.codec_pool.close()

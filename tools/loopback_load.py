@@ -15,7 +15,23 @@ bench_suite_results.jsonl via tools/run_experiments.py
 
 Usage: python tools/loopback_load.py [--passes N] [--no-donate]
            [--key-dist unique|zipf:<s>|hotset:<k>] [--requests N]
-           [--trace-ring N] [--slow-ms F] [--dump-slow PATH] [depth ...]
+           [--trace-ring N] [--slow-ms F] [--dump-slow PATH]
+           [--chaos site=spec,...] [--pool-decode] [depth ...]
+
+Round 9 added `--chaos site=spec,...`: the faults are armed at server
+startup (serving/faults.py grammar, e.g. `codec.worker_raise=p0.05`),
+payload decode is forced through the codec pool so worker faults are
+actually exercised, and before the FINAL measured pass a forced
+`device.dispatch_error` burst is armed through the live
+`POST /v1/debug/faults` endpoint (opening the circuit breaker) while a
+concurrent poller watches `/readyz` flip.  The row carries the
+error-budget split — success / expected-fault errors (taxonomy codes
+`fault_injected`, `breaker_open`, `unavailable`, `deadline_expired`,
+`overloaded`) / collateral errors — plus the client-observed max
+latency (nothing may wait out the full request timeout), and after
+disarming everything a RECOVERY pass proves throughput and codec-pool
+capacity self-restore (`tools/run_bench_suite.py`'s `chaos` token pins
+recovery within 5% of a same-day no-fault baseline).
 
 Round 8 added the tracing-spine hooks: every request's `x-request-id`
 is captured client-side, `--trace-ring 0` disables the server's trace
@@ -100,6 +116,19 @@ def _key_streams(
     return [stream[p * n : (p + 1) * n] for p in range(passes)]
 
 
+# Taxonomy codes a chaos run EXPECTS: failures the armed faults (and the
+# fail-fast machinery reacting to them) produce by design.  Anything
+# else that is not a 200 is collateral — a robustness bug.
+EXPECTED_FAULT_CODES = frozenset(
+    ("fault_injected", "breaker_open", "unavailable", "deadline_expired",
+     "overloaded")
+)
+
+# The forced device burst of the chaos drill: enough consecutive
+# dispatch errors to open the default-threshold (5) circuit breaker.
+CHAOS_BURST = "device.dispatch_error=n8"
+
+
 def _resp_meta(raw: bytes) -> tuple[str, str]:
     """(x-cache kind, x-request-id) out of a raw HTTP byte blob.  The
     request id is the join key against the server's flight-recorder
@@ -120,6 +149,49 @@ def _resp_meta(raw: bytes) -> tuple[str, str]:
     return kind, rid
 
 
+def _resp_status_code(raw: bytes) -> tuple[int, str | None]:
+    """(HTTP status, taxonomy error code) out of a raw response blob —
+    the chaos error-budget classifier's inputs."""
+    try:
+        status = int(raw.split(b"\r\n", 1)[0].split(b" ")[1])
+    except (IndexError, ValueError):
+        return 0, "unparseable"
+    code = None
+    if status != 200:
+        try:
+            code = json.loads(raw.split(b"\r\n\r\n", 1)[1]).get("error")
+        except (ValueError, IndexError):
+            code = "unparseable"
+    return status, code
+
+
+async def _http(
+    port: int, method: str, path: str, form: dict | None = None
+) -> tuple[int, dict | None]:
+    """One urlencoded request against the loopback server — the chaos
+    driver's control channel (/readyz polls, /v1/debug/faults arms)."""
+    import urllib.parse
+
+    body = urllib.parse.urlencode(form).encode() if form else b""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    head = f"{method} {path} HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+    if body:
+        head += (
+            "Content-Type: application/x-www-form-urlencoded\r\n"
+            f"Content-Length: {len(body)}\r\n"
+        )
+    writer.write(head.encode() + b"\r\n" + body)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    status, _ = _resp_status_code(raw)
+    try:
+        payload = json.loads(raw.split(b"\r\n\r\n", 1)[1])
+    except (ValueError, IndexError):
+        payload = None
+    return status, payload
+
+
 def run_load(
     pipeline_depth: int,
     n_requests: int = 512,
@@ -130,6 +202,8 @@ def run_load(
     trace_ring: int | None = None,
     slow_ms: float | None = None,
     dump_slow: str | None = None,
+    chaos: str | None = None,
+    pool_decode: bool = False,
 ) -> dict:
     import jax
 
@@ -163,6 +237,22 @@ def run_load(
         trace_kw["trace_ring"] = trace_ring
     if slow_ms is not None:
         trace_kw["trace_slow_ms"] = slow_ms
+    if chaos:
+        # Chaos mode (round 9): arm the requested faults at startup and
+        # shorten the breaker cooldown so the recovery phase fits a
+        # bench pass instead of a production-shaped 5 s outage window.
+        trace_kw.update(
+            fault_injection=True,
+            faults=chaos,
+            breaker_cooldown_s=0.75,
+        )
+    if chaos or pool_decode:
+        # Force every decode through the codec pool: inline decode would
+        # dodge the worker faults at loopback payload sizes.  The
+        # standalone flag exists so a no-fault BASELINE can run the same
+        # configuration (the chaos recovery-budget comparison in
+        # tools/run_bench_suite.py must be apples to apples).
+        trace_kw.update(codec_inline_bytes=0)
     cfg = ServerConfig(
         image_size=32,
         max_batch=32,
@@ -201,9 +291,7 @@ def run_load(
         await asyncio.to_thread(service.warmup, "c3")
         sem = asyncio.Semaphore(concurrency)
 
-        async def one(
-            i: int, indices: list[int], samples: list[tuple[float, str, str]]
-        ):
+        async def one(i: int, indices: list[int], samples: list[tuple]):
             body = urllib.parse.urlencode(
                 {"file": uris[indices[i]], "layer": "c3"}
             ).encode()
@@ -222,8 +310,12 @@ def run_load(
                 raw = await reader.read()
                 writer.close()
                 kind, rid = _resp_meta(raw)
-                samples.append((time.perf_counter() - t0, kind, rid))
-                assert b" 200 " in raw.split(b"\r\n", 1)[0], raw[:120]
+                status, code = _resp_status_code(raw)
+                samples.append((time.perf_counter() - t0, kind, rid, status, code))
+                if not chaos:
+                    # a chaos run EXPECTS non-200s (classified below);
+                    # every other mode still hard-fails on one
+                    assert status == 200, raw[:120]
 
         # Best-of-N passes (the bench.py round-6 methodology): one pass is
         # hostage to scheduler/allocator weather; run N, report the max,
@@ -232,15 +324,117 @@ def run_load(
         # later passes run against the warm cache — the steady state a
         # hot-key workload actually serves in; pass 1 carries the
         # cold-fill mixture and stays visible in passes_req_s.
+        async def readyz_poller(statuses: list[int]):
+            while True:
+                s, _ = await _http(port, "GET", "/readyz")
+                statuses.append(s)
+                await asyncio.sleep(0.025)
+
         runs = []
-        for indices in streams:
-            samples: list[tuple[float, str, str]] = []
+        readyz_seen: list[int] = []
+        for p, indices in enumerate(streams):
+            poller = None
+            if chaos and len(streams) > 1 and p == len(streams) - 1:
+                # the forced device burst rides the FINAL chaos pass,
+                # armed through the live debug endpoint (exercising it
+                # end to end); the poller watches /readyz flip while the
+                # breaker holds the degraded window open
+                s, _ = await _http(
+                    port, "POST", "/v1/debug/faults", {"arm": CHAOS_BURST}
+                )
+                assert s == 200, f"fault arm endpoint answered {s}"
+                poller = asyncio.create_task(readyz_poller(readyz_seen))
+            samples: list[tuple] = []
             t0 = time.perf_counter()
             await asyncio.gather(
                 *(one(i, indices, samples) for i in range(n_requests))
             )
             wall = time.perf_counter() - t0
+            if poller is not None:
+                poller.cancel()
+                try:
+                    await poller
+                except asyncio.CancelledError:
+                    pass
             runs.append((wall, samples))
+        chaos_report = None
+        if chaos:
+            # final /readyz sample: the breaker may still be holding the
+            # degraded window open right after the burst pass
+            s, _ = await _http(port, "GET", "/readyz")
+            readyz_seen.append(s)
+            # error-budget split across every chaos pass: a chaos run is
+            # healthy when errors are the EXPECTED fail-fast kinds and
+            # nothing waited out the full request timeout
+            split = {"success": 0, "expected_fault": 0, "collateral": 0}
+            collateral_codes: dict[str, int] = {}
+            max_ms = 0.0
+            for _, ss in runs:
+                for dt, _k, _r, status, code in ss:
+                    max_ms = max(max_ms, dt * 1e3)
+                    if status == 200:
+                        split["success"] += 1
+                    elif code in EXPECTED_FAULT_CODES:
+                        split["expected_fault"] += 1
+                    else:
+                        split["collateral"] += 1
+                        collateral_codes[str(code)] = (
+                            collateral_codes.get(str(code), 0) + 1
+                        )
+            # disarm everything, then drive single probes until the
+            # half-open breaker closes (its recovery path IS the probe)
+            s, _ = await _http(
+                port, "POST", "/v1/debug/faults", {"disarm": "all"}
+            )
+            assert s == 200, f"fault disarm endpoint answered {s}"
+            probe_deadline = time.monotonic() + 15.0
+            recovered = False
+            while time.monotonic() < probe_deadline:
+                probe: list[tuple] = []
+                await one(0, streams[-1], probe)
+                if probe[0][3] == 200:
+                    recovered = True
+                    break
+                await asyncio.sleep(0.25)
+            ready_after, _ = await _http(port, "GET", "/readyz")
+            # recovery passes: with faults disarmed and the breaker
+            # closed, throughput must return to the no-fault envelope
+            # (the 5% budget lives in tools/run_bench_suite.py).  Same
+            # best-of-N methodology as the measurement itself — one
+            # recovery pass per measured pass, best reported, so the
+            # comparison against a best-of-N baseline is symmetric.
+            recovery_walls: list[float] = []
+            rsamples_all: list[list[tuple]] = []
+            for _ in range(max(1, len(streams))):
+                rsamples: list[tuple] = []
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(one(i, streams[-1], rsamples) for i in range(n_requests))
+                )
+                recovery_walls.append(time.perf_counter() - t0)
+                rsamples_all.append(rsamples)
+            rwall = min(recovery_walls)
+            rsamples = [s for ss in rsamples_all for s in ss]
+            chaos_report = {
+                "armed": chaos,
+                "burst": CHAOS_BURST,
+                "split": split,
+                "collateral_codes": collateral_codes,
+                "max_client_ms": round(max_ms, 1),
+                "readyz_degraded_observed": 503 in readyz_seen,
+                "readyz_polls": len(readyz_seen),
+                "probe_recovered": recovered,
+                "readyz_after_recovery": ready_after,
+                "recovery_req_s": round(n_requests / rwall, 1),
+                "recovery_passes_req_s": [
+                    round(n_requests / w, 1) for w in recovery_walls
+                ],
+                "recovery_errors": sum(
+                    1 for s in rsamples if s[3] != 200
+                ),
+                "codec_workers": service.codec_pool.workers,
+                "codec_workers_live": service.codec_pool.live_workers,
+            }
         snap = service.metrics.snapshot()
         dump = None
         if dump_slow:
@@ -264,7 +458,7 @@ def run_load(
             payload.setdefault("counts", {})
             client = {}
             for _, ss in runs:
-                for dt, kind, rid in ss:
+                for dt, kind, rid, *_ in ss:
                     if rid:
                         client[rid] = (dt, kind)
             joined = []
@@ -325,7 +519,7 @@ def run_load(
             # counters across all passes
             kinds: dict[str, int] = {}
             by_kind: dict[str, list[float]] = {}
-            for dt, kind, _rid in samples:
+            for dt, kind, *_ in samples:
                 kinds[kind] = kinds.get(kind, 0) + 1
                 by_kind.setdefault(kind, []).append(dt)
             hits = kinds.get("hit", 0) + kinds.get("hit-negative", 0)
@@ -364,6 +558,9 @@ def run_load(
                     row["cache"][f"{name}_p99_ms"] = round(
                         ks[int(len(ks) * 0.99)] * 1e3, 3
                     )
+        if chaos_report is not None:
+            row["which"] += "_chaos"
+            row["chaos"] = chaos_report
         if not donate:
             row["which"] += "_nodonate"
             row["donate_inputs"] = False
@@ -403,6 +600,8 @@ def main() -> int:
     trace_ring: int | None = None
     slow_ms: float | None = None
     dump_slow: str | None = None
+    chaos: str | None = None
+    pool_decode = False
     depths: list[int] = []
     i = 0
     while i < len(args):
@@ -427,6 +626,12 @@ def main() -> int:
         elif args[i] == "--dump-slow":
             dump_slow = args[i + 1]
             i += 2
+        elif args[i] == "--chaos":
+            chaos = args[i + 1]
+            i += 2
+        elif args[i] == "--pool-decode":
+            pool_decode = True
+            i += 1
         else:
             depths.append(int(args[i]))
             i += 1
@@ -441,11 +646,20 @@ def main() -> int:
         # threshold (100 ms) would leave the slow ring empty and the dump
         # vacuous
         slow_ms = 5.0
+    if chaos:
+        # validate the spec string BEFORE burning a server boot on a typo
+        from deconv_api_tpu.serving.faults import parse_fault_specs
+
+        try:
+            parse_fault_specs(chaos)
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 2
     for d in depths or [2, 1]:
         row = run_load(
             d, n_requests=n_requests, passes=passes, donate=donate,
             key_dist=key_dist, trace_ring=trace_ring, slow_ms=slow_ms,
-            dump_slow=dump_slow,
+            dump_slow=dump_slow, chaos=chaos, pool_decode=pool_decode,
         )
         print(json.dumps(row), flush=True)
     return 0

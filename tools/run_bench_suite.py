@@ -40,6 +40,84 @@ LOOPBACK_CONFIGS = {
 # than this.
 TRACE_OVERHEAD_BUDGET_PCT = 3.0
 
+# Chaos recovery budget (round 9): after a chaos run's faults disarm,
+# loopback throughput must return to within this of a same-day no-fault
+# baseline — capacity that does not self-restore is a supervision bug.
+CHAOS_RECOVERY_BUDGET_PCT = 5.0
+
+
+def run_chaos_guard(timeout_s: float = 900.0) -> dict:
+    """The end-to-end chaos drill (round 9): codec workers dying at
+    p=0.05 plus a forced device.dispatch_error burst mid-run (armed via
+    the live debug endpoint, opening the circuit breaker), then a
+    disarm + recovery pass.  The row fails LOUDLY (`error` field) when
+    the drill sees collateral errors, a request that waited anywhere
+    near the full 60 s timeout, a /readyz that never reflected the
+    degraded window, or recovered throughput more than
+    CHAOS_RECOVERY_BUDGET_PCT below the same-day no-fault baseline."""
+    base = ["--passes", "2", "2"]
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    chaos = run_cmd_json(
+        [sys.executable, loopback, "--chaos", "codec.worker_raise=p0.05",
+         *base],
+        timeout_s, env=env,
+    )
+    # --pool-decode: chaos mode forces decode through the codec pool, so
+    # the no-fault baseline must run the same configuration or the
+    # recovery comparison measures the inline-decode shortcut, not fault
+    # recovery
+    baseline = run_cmd_json(
+        [sys.executable, loopback, "--pool-decode", *base], timeout_s, env=env
+    )
+    row = {"config": "chaos", "which": "loopback_chaos_drill"}
+    if "error" in chaos or "error" in baseline:
+        row["error"] = chaos.get("error") or baseline.get("error")
+        return row
+    rep = chaos.get("chaos", {})
+    base_rs = baseline["requests_per_sec"]
+    rec_rs = rep.get("recovery_req_s", 0.0)
+    delta = (base_rs - rec_rs) / base_rs * 100.0 if base_rs else 0.0
+    row.update(
+        chaos_req_s=chaos["requests_per_sec"],
+        chaos_passes=chaos.get("passes_req_s"),
+        split=rep.get("split"),
+        collateral_codes=rep.get("collateral_codes"),
+        max_client_ms=rep.get("max_client_ms"),
+        readyz_degraded_observed=rep.get("readyz_degraded_observed"),
+        readyz_after_recovery=rep.get("readyz_after_recovery"),
+        recovery_req_s=rec_rs,
+        recovery_errors=rep.get("recovery_errors"),
+        baseline_req_s=base_rs,
+        recovery_delta_pct=round(delta, 2),
+        budget_pct=CHAOS_RECOVERY_BUDGET_PCT,
+        codec_workers=rep.get("codec_workers"),
+        codec_workers_live=rep.get("codec_workers_live"),
+    )
+    problems = []
+    if rep.get("split", {}).get("collateral", 1):
+        problems.append(f"collateral errors: {rep.get('collateral_codes')}")
+    if (rep.get("max_client_ms") or 1e9) > 30_000:
+        problems.append(
+            f"a request waited {rep.get('max_client_ms')} ms (fail-fast broken)"
+        )
+    if not rep.get("readyz_degraded_observed"):
+        problems.append("/readyz never reflected the degraded window")
+    if rep.get("readyz_after_recovery") != 200:
+        problems.append("/readyz not ready after recovery")
+    if rep.get("recovery_errors"):
+        problems.append(f"{rep['recovery_errors']} errors in the recovery pass")
+    if rep.get("codec_workers_live", 0) < rep.get("codec_workers", 1):
+        problems.append("codec pool capacity did not self-restore")
+    if delta > CHAOS_RECOVERY_BUDGET_PCT:
+        problems.append(
+            f"recovered throughput {delta:.1f}% below baseline "
+            f"(> {CHAOS_RECOVERY_BUDGET_PCT:.0f}% budget)"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
+    return row
+
 
 def run_trace_guard(timeout_s: float = 900.0) -> dict:
     """Tracing-on vs tracing-off A/B on the hot cache-hit loopback
@@ -278,6 +356,11 @@ def main() -> int:
             # failure in the artifact past the budget
             result = run_trace_guard()
             result["date"] = date
+        elif tok == "chaos":
+            # chaos drill + recovery guard (round 9): faults on, burst,
+            # disarm, throughput must return within the budget
+            result = run_chaos_guard()
+            result["date"] = date
         elif tok in LOOPBACK_CONFIGS:
             # host-side loopback workload: CPU backend, no tunnel needed
             result = run_loopback(tok)
@@ -288,7 +371,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos'])}",
             }
         else:
             n = int(tok)
