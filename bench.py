@@ -343,7 +343,7 @@ def main_child(force_cpu: bool) -> None:
     from deconv_api_tpu.models.vgg16 import vgg16_init
 
     cfg = ServerConfig.from_env()
-    enable_compilation_cache(cfg)
+    enable_compilation_cache(cfg, bench_default=True)
     dev = jax.devices()[0]
     platform = dev.platform
     on_tpu = platform == "tpu"

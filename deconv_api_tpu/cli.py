@@ -36,7 +36,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "cache_bytes", "cache_ttl_s",
         "trace_ring", "trace_slow_ms", "trace_sample",
         "fault_seed", "breaker_threshold", "breaker_cooldown_s",
-        "drain_grace_s",
+        "drain_grace_s", "lanes", "compile_cache_dir",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -299,6 +299,18 @@ def main(argv: list[str] | None = None) -> int:
         "--drain-grace-s", type=float, default=None, dest="drain_grace_s",
         help="seconds /readyz answers 503 before the listener closes on "
         "SIGTERM (default 0)",
+    )
+    s.add_argument(
+        "--lanes", default=None, dest="lanes", metavar="N|auto|off",
+        help="executor lanes: independent per-chip dispatch streams with "
+        "least-loaded batch scheduling (default auto = one per device "
+        "when no mesh is configured)",
+    )
+    s.add_argument(
+        "--compile-cache-dir", default=None, dest="compile_cache_dir",
+        metavar="DIR",
+        help="persistent XLA compilation cache (default off); warm "
+        "restarts skip the warmup compile tax",
     )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)

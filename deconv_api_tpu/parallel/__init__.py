@@ -23,12 +23,15 @@ from deconv_api_tpu.parallel.mesh import (
     replicated,
 )
 from deconv_api_tpu.parallel.batch import sharded_visualizer
+from deconv_api_tpu.parallel.lanes import lane_placements, resolve_lane_count
 
 __all__ = [
     "batch_sharding",
     "init_distributed",
+    "lane_placements",
     "make_mesh",
     "param_shardings",
     "replicated",
+    "resolve_lane_count",
     "sharded_visualizer",
 ]

@@ -1,0 +1,103 @@
+"""Executor-lane topology: partition visible devices into serving lanes.
+
+ROUND5.md closed the single-chip ledgers with one lever left: spreading
+work across chips.  A **lane** is an independent execution stream with its
+own device (or its own small dp mesh), its own copy of the model params,
+and its own circuit-breaker state.  The serving batcher schedules each
+collected batch onto the least-loaded lane (serving/batcher.py LanePool),
+so batches for different (model, layer, mode) keys — and consecutive
+batches for one key when pipeline_depth allows — execute concurrently on
+different chips instead of serializing through one dispatch stream.
+
+This module owns only the TOPOLOGY: how many lanes a config resolves to,
+and which devices each lane gets.  Two shapes compose:
+
+- ``serve_lanes`` == device count (the ``auto`` default on a multi-chip
+  host): one whole device per lane — the many-small-mixed-key-batches
+  regime the zipf loopback row measures.
+- ``serve_lanes`` < device count: each lane gets an equal contiguous
+  slice of devices as its own ``dp`` mesh, so big-batch keys still shard
+  data-parallel WITHIN a lane while independent keys spread ACROSS lanes.
+
+``mesh_shape`` (the whole-pool GSPMD mesh) and lanes are mutually
+exclusive: a configured mesh keeps the single-stream dp-sharded path.
+"""
+
+from __future__ import annotations
+
+
+def resolve_lane_count(
+    serve_lanes: str | int,
+    n_devices: int,
+    mesh_active: bool = False,
+) -> int:
+    """How many executor lanes a config resolves to.
+
+    ``auto`` (the default): one lane per visible device when no mesh is
+    configured — multi-chip hosts scale out without a flag, single-chip
+    hosts keep the exact single-stream path.  An explicit count must
+    divide the device count evenly (equal lanes are what makes the
+    least-loaded signal comparable across lanes); ``0``/``1``/``off``
+    force the single-stream path.
+    """
+    if mesh_active:
+        # the whole-pool dp mesh owns every device; lanes would double-
+        # subscribe chips.  An explicit lane request on top is a config
+        # error the caller surfaces, not a silent fallback.
+        if str(serve_lanes) not in ("auto", "0", "1", "off"):
+            raise ValueError(
+                "serve_lanes and mesh_shape are mutually exclusive: the "
+                "mesh already spans every device"
+            )
+        return 1
+    raw = str(serve_lanes).strip().lower()
+    if raw in ("auto", ""):
+        return max(1, n_devices)
+    if raw == "off":
+        return 1
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"serve_lanes must be 'auto', 'off' or an integer, got "
+            f"{serve_lanes!r}"
+        ) from None
+    if n <= 1:
+        return 1
+    if n > n_devices:
+        raise ValueError(
+            f"serve_lanes={n} needs {n} devices, have {n_devices}"
+        )
+    if n_devices % n != 0:
+        raise ValueError(
+            f"serve_lanes={n} must divide the device count ({n_devices}) "
+            "evenly — unequal lanes would skew the least-loaded signal"
+        )
+    return n
+
+
+def lane_placements(n_lanes: int, devices=None) -> list:
+    """The device placement for each lane: a single Device when lanes map
+    1:1 onto chips, or a ``dp`` Mesh over an equal contiguous slice when
+    each lane spans several (lanes then compose with dp-sharding: the
+    batcher spreads keys across lanes, GSPMD spreads each lane's batch
+    across its slice).  Contiguous slices keep a lane's collectives on
+    neighbouring chips (ICI locality on real TPU topologies)."""
+    import jax
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n_lanes <= 0:
+        raise ValueError(f"need at least one lane, got {n_lanes}")
+    if len(devices) % n_lanes != 0:
+        raise ValueError(
+            f"{n_lanes} lanes cannot evenly split {len(devices)} devices"
+        )
+    per = len(devices) // n_lanes
+    if per == 1:
+        return devices[:n_lanes]
+    from deconv_api_tpu.parallel.mesh import make_mesh
+
+    return [
+        make_mesh((per,), axis_names=("dp",), devices=devices[i * per : (i + 1) * per])
+        for i in range(n_lanes)
+    ]

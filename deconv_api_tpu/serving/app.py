@@ -121,6 +121,34 @@ class DeconvService:
             )
             self.bundle.mesh = self.mesh
         self.metrics = Metrics()
+        # Executor lanes (round 10, parallel/lanes.py + batcher.LanePool):
+        # when no whole-pool mesh is configured, the visible devices
+        # partition into independent lanes — params replicated per lane
+        # once, each collected batch scheduled onto the least-loaded lane
+        # — so mixed-key traffic executes concurrently across chips
+        # instead of serializing through one dispatch stream.  'auto'
+        # resolves to one lane per device; a single-device host keeps
+        # the exact single-stream path.
+        import jax as _jax
+
+        from deconv_api_tpu.parallel.lanes import (
+            lane_placements,
+            resolve_lane_count,
+        )
+        from deconv_api_tpu.serving.batcher import LanePool
+
+        self.lane_count = resolve_lane_count(
+            self.cfg.serve_lanes, _jax.device_count(), self.mesh is not None
+        )
+        self._lane_dp = 1
+        if self.lane_count > 1:
+            placements = lane_placements(self.lane_count)
+            self.bundle.set_lanes(placements)
+            self._lane_dp = _jax.device_count() // self.lane_count
+        # warmup() records its wall time here; /v1/config reports it so
+        # the compile-cache A/B (cold vs warm restart) is observable on
+        # a live server
+        self.warmup_wall_s: float | None = None
         self.ready = False
         # Drain state (round 9): set at shutdown begin, BEFORE the
         # listener closes — /readyz flips 503 so load balancers stop
@@ -140,19 +168,31 @@ class DeconvService:
             if self.cfg.faults:
                 self.faults.arm_string(self.cfg.faults)
             faults_mod.install(self.faults)
-        # Device circuit breaker (round 9): ONE breaker shared by all
-        # three dispatchers — they sit on the same device, so its
-        # failures are correlated.  N consecutive batch failures open
-        # it; open = fail-fast 503 breaker_open with a cooldown-derived
-        # Retry-After; a single half-open probe closes it again.
+        # Device circuit breakers (round 9, per-LANE since round 10):
+        # ONE lane pool shared by all three dispatchers — they sit on
+        # the same chips, so per-chip failures are correlated across
+        # streams.  N consecutive batch failures open that lane's
+        # breaker; the scheduler then routes around it and the pool
+        # serves from the survivors (degraded, not dead).  Only when
+        # EVERY lane is open-and-cooling do submits fail fast with 503
+        # breaker_open + a cooldown-derived Retry-After; each lane
+        # recovers through its own single half-open probe.  The pool —
+        # not the breakers — publishes the breaker_state gauge and
+        # breaker_open_total counter, aggregated across lanes.
+        self.lane_pool = LanePool(
+            self.lane_count,
+            breaker_factory=lambda: (
+                CircuitBreaker(
+                    self.cfg.breaker_threshold, self.cfg.breaker_cooldown_s
+                )
+                if self.cfg.breaker_threshold > 0
+                else None
+            ),
+            metrics=self.metrics,
+        )
+        # back-compat handle: THE breaker when there is a single stream
         self.breaker = (
-            CircuitBreaker(
-                self.cfg.breaker_threshold,
-                self.cfg.breaker_cooldown_s,
-                metrics=self.metrics,
-            )
-            if self.cfg.breaker_threshold > 0
-            else None
+            self.lane_pool.lanes[0].breaker if self.lane_count == 1 else None
         )
         # Host I/O pipeline (round 6): decode and encode run on a bounded
         # pool of persistent codec workers (no per-call thread spawn; the
@@ -188,7 +228,7 @@ class DeconvService:
             shed_factor=self.cfg.shed_factor,
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
-            breaker=self.breaker,
+            lane_pool=self.lane_pool,
         )
         # Dreams run for seconds-to-minutes; a separate dispatcher keeps them
         # from head-of-line blocking the deconv queue (the device interleaves
@@ -204,7 +244,7 @@ class DeconvService:
             shed_factor=self.cfg.shed_factor,
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
-            breaker=self.breaker,
+            lane_pool=self.lane_pool,
         )
         # Sweeps (~13x a single-layer request, large first-use compile) get
         # the dream treatment: own dispatcher so they never head-of-line
@@ -220,7 +260,7 @@ class DeconvService:
             shed_factor=self.cfg.shed_factor,
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
-            breaker=self.breaker,
+            lane_pool=self.lane_pool,
         )
         # Content-addressed response cache + singleflight (round 7,
         # serving/cache.py): every compute response is a pure function of
@@ -344,7 +384,7 @@ class DeconvService:
         finally:
             self._profile_lock.release()
 
-    def _run_batch(self, key, images: list[np.ndarray]):
+    def _run_batch(self, key, images: list[np.ndarray], lane: int = 0):
         """Execute one request group as a single device dispatch and block
         for its results.
 
@@ -352,12 +392,14 @@ class DeconvService:
         are padded to a power-of-two bucket so XLA compiles at most
         log2(max_batch)+1 batch shapes per key; dream groups run as ONE
         batched multi-octave ascent (see _dispatch_dream), bucket-padded
-        the same way.
+        the same way.  ``lane`` is the executor lane the scheduler picked
+        (round 10): the dispatch reads that lane's param replica and runs
+        on its chip.
         """
         with self._profile_scope():
-            return self._dispatch_inner(key, images)()
+            return self._dispatch_inner(key, images, lane)()
 
-    def _dispatch_batch(self, key, images: list[np.ndarray]):
+    def _dispatch_batch(self, key, images: list[np.ndarray], lane: int = 0):
         """Pipelined form: dispatch the device program WITHOUT blocking and
         return a thunk that materialises the per-request results (one
         device_get).  The dispatcher calls the thunk in a separate fetch
@@ -370,23 +412,25 @@ class DeconvService:
         to the blocking path INSIDE the trace scope, so captures keep
         covering device execution, not just the dispatch."""
         if self._profile_remaining > 0:
-            res = self._run_batch(key, images)
+            res = self._run_batch(key, images, lane)
             return lambda: res
-        return self._dispatch_inner(key, images)
+        return self._dispatch_inner(key, images, lane)
 
-    def _dispatch_inner(self, key, images: list[np.ndarray]):
+    def _dispatch_inner(self, key, images: list[np.ndarray], lane: int = 0):
         import jax.numpy as jnp
 
         # device chaos sites (round 9): a delayed or failing dispatch —
         # the batcher's breaker sees the failure exactly like a real
         # wedged backend.  Runs on the dispatch worker thread, so the
-        # delay never blocks the event loop.
+        # delay never blocks the event loop.  dispatch_error passes the
+        # consulting LANE (round 10): a spec armed with :<lane> bursts
+        # one chip and leaves the rest of the pool untouched.
         act = faults_mod.check("device.dispatch_delay_ms")
         if act is not None:
             time.sleep((act.param or 100.0) / 1e3)
-        faults_mod.raise_if_armed("device.dispatch_error")
+        faults_mod.raise_if_armed("device.dispatch_error", where=lane)
         if key[0] == "__dream__":
-            return self._dispatch_dream(key, images)
+            return self._dispatch_dream(key, images, lane)
         # 4-tuple: single-layer (the default); 5-tuple adds sweep=True
         layer_name, mode, top_k, post, *rest = key
         sweep = bool(rest[0]) if rest else False
@@ -397,7 +441,7 @@ class DeconvService:
         fn = self.bundle.batched_visualizer(
             layer_name, mode, top_k, self.cfg.bug_compat,
             self.cfg.backward_dtype or None, post, sweep,
-            donate=self.cfg.donate_inputs,
+            donate=self.cfg.donate_inputs, lane=lane,
         )
         bucket = self._bucket_for(len(images))
         # Assemble the padded batch into a reusable input-ring buffer
@@ -415,7 +459,10 @@ class DeconvService:
         fwd_dtype = (
             jnp.bfloat16 if self.cfg.dtype == "bfloat16" else jnp.float32
         )
-        out_all = fn(self.bundle.params, jnp.asarray(batch, dtype=fwd_dtype))
+        out_all = fn(
+            self.bundle.lane_params(lane),
+            self._stage_batch(batch, fwd_dtype, lane),
+        )
         n = len(images)
 
         def materialise():
@@ -497,7 +544,28 @@ class DeconvService:
 
         return materialise
 
-    def _dispatch_dream(self, key, images: list[np.ndarray]):
+    def _stage_batch(self, batch: np.ndarray, dtype, lane: int):
+        """Host staging buffer -> the device array one dispatch consumes.
+        Without lanes: the default-device jnp.asarray the program always
+        used.  With lanes: cast on the host (ml_dtypes covers bfloat16)
+        and commit to the lane's chip in ONE transfer — committed inputs
+        are what pins the jitted program's execution to that lane; a
+        mesh-slice lane hands the host array straight to its sharded jit
+        (in_shardings places it over the lane's dp axis)."""
+        import jax
+        import jax.numpy as jnp
+
+        pl = self.bundle.lane_placement(lane)
+        if pl is None:
+            return jnp.asarray(batch, dtype=dtype)
+        host = np.asarray(batch, dtype=dtype)
+        from jax.sharding import Mesh
+
+        if isinstance(pl, Mesh):
+            return host
+        return jax.device_put(host, pl)
+
+    def _dispatch_dream(self, key, images: list[np.ndarray], lane: int = 0):
         from deconv_api_tpu.engine import deepdream_batch
 
         _, layers, steps, octaves, lr = key
@@ -514,17 +582,33 @@ class DeconvService:
         batch = self.input_ring.assemble(
             [np.asarray(img) for img in images], bucket
         )
+        # lane placement (round 10): the octave programs follow their
+        # committed inputs — a device lane pins the whole ascent to its
+        # chip, a mesh-slice lane runs it dp-sharded over the slice.
+        lane_pl = self.bundle.lane_placement(lane)
+        lane_mesh = None
+        if lane_pl is not None:
+            from jax.sharding import Mesh
+
+            if isinstance(lane_pl, Mesh):
+                lane_mesh = lane_pl
+        mesh = self.mesh if self.mesh is not None else lane_mesh
+        staged = batch
+        if lane_pl is not None and lane_mesh is None:
+            import jax
+
+            staged = jax.device_put(batch, lane_pl)
         out, losses = deepdream_batch(
             fwd,
-            self.bundle.params,
-            batch,
+            self.bundle.lane_params(lane),
+            staged,
             layers=layers,
             steps_per_octave=steps,
             num_octaves=octaves,
             lr=lr,
             min_size=self.bundle.min_dream_size,
-            mesh=self.mesh,
-            donate=self.cfg.donate_inputs and self.mesh is None,
+            mesh=mesh,
+            donate=self.cfg.donate_inputs and mesh is None,
         )
         n = len(images)
 
@@ -540,11 +624,16 @@ class DeconvService:
         return materialise
 
     def _round_to_dp(self, bucket: int) -> int:
-        """Round a bucket up to a multiple of the mesh's dp axis so every
-        dispatch shards evenly — one rule for deconv and dream paths."""
-        if self.mesh is None:
+        """Round a bucket up to a multiple of the dp axis so every
+        dispatch shards evenly — one rule for deconv and dream paths.
+        The axis is the whole-pool mesh's, or (round 10) a mesh-slice
+        lane's; lanes are equal-sized, so one rule covers every lane."""
+        if self.mesh is not None:
+            dp = self.mesh.shape["dp"]
+        elif self._lane_dp > 1:
+            dp = self._lane_dp
+        else:
             return bucket
-        dp = self.mesh.shape["dp"]
         return max(dp, -(-bucket // dp) * dp)
 
     def _bucket_for(self, n: int) -> int:
@@ -558,8 +647,16 @@ class DeconvService:
         Warms EVERY batch bucket for both route defaults — with only the
         batch-1 bucket warm, the first concurrent burst pays a fresh XLA
         compile per new bucket shape at request time (directly visible in
-        config-5 p99).  `warmup_all_buckets=False` restores the fast
-        single-bucket warmup (tests, dev loops)."""
+        config-5 p99) — and does it ON EVERY LANE (round 10): each lane
+        holds its own executables pinned to its own param replica, so a
+        cold lane would otherwise pay its first-use compile inside the
+        first request the scheduler lands on it.  The recorded wall time
+        (warmup_wall_s, surfaced in /v1/config) is the number the
+        persistent compile cache attacks: warm restarts skip the
+        per-bucket-per-lane compile tax entirely.
+        `warmup_all_buckets=False` restores the fast single-bucket warmup
+        (tests, dev loops)."""
+        t_start = time.perf_counter()
         names = self.bundle.layer_names
         layer = layer_name
         if layer is None or layer not in names:
@@ -576,48 +673,55 @@ class DeconvService:
             sizes = [self._bucket_for(1)]
         # both route defaults, so /ready implies neither pays a first-hit
         # compile: POST / uses (stitch_k, grid), /v1/deconv (top_k, tiles)
-        for size in sizes:
-            self._run_batch(
-                (layer, self.cfg.visualize_mode, self.cfg.stitch_k, "grid"),
-                [img] * size,
-            )
-            self._run_batch(
-                (layer, self.cfg.visualize_mode, self.cfg.top_k, "tiles"),
-                [img] * size,
-            )
-        if self.cfg.warmup_sweep:
-            # the sweep program is ~15x a single-layer request; compiling
-            # it here keeps the first sweep request out of its own
-            # sweep_timeout_s window
-            self._run_batch(
-                (layer, self.cfg.visualize_mode, self.cfg.top_k,
-                 "tiles", True),
-                [img] * self._bucket_for(1),
-            )
-        if self.cfg.warmup_dream and self.bundle.dream_layers:
-            # the whole-dream program (r5: one executable per octave
-            # ladder) is the route's largest compile; warm the DEFAULT
-            # request shape (the shared _DREAM_DEFAULTS the route uses)
-            # so first dreams serve inside their window — every dream
-            # bucket under warmup_all_buckets, else just the first
-            if self.cfg.warmup_all_buckets:
-                dream_sizes = sorted(
-                    {
-                        self._round_to_dp(pad_bucket(n, self.cfg.dream_max_batch))
-                        for n in range(1, self.cfg.dream_max_batch + 1)
-                    }
-                )
-            else:
-                dream_sizes = [self._round_to_dp(pad_bucket(1, self.cfg.dream_max_batch))]
-            for size in dream_sizes:
+        for lane in range(self.lane_count):
+            for size in sizes:
                 self._run_batch(
-                    (
-                        "__dream__", self.bundle.dream_layers,
-                        _DREAM_DEFAULTS["steps"], _DREAM_DEFAULTS["octaves"],
-                        _DREAM_DEFAULTS["lr"],
-                    ),
-                    [img] * size,
+                    (layer, self.cfg.visualize_mode, self.cfg.stitch_k, "grid"),
+                    [img] * size, lane=lane,
                 )
+                self._run_batch(
+                    (layer, self.cfg.visualize_mode, self.cfg.top_k, "tiles"),
+                    [img] * size, lane=lane,
+                )
+            if self.cfg.warmup_sweep:
+                # the sweep program is ~15x a single-layer request;
+                # compiling it here keeps the first sweep request out of
+                # its own sweep_timeout_s window
+                self._run_batch(
+                    (layer, self.cfg.visualize_mode, self.cfg.top_k,
+                     "tiles", True),
+                    [img] * self._bucket_for(1), lane=lane,
+                )
+            if self.cfg.warmup_dream and self.bundle.dream_layers:
+                # the whole-dream program (r5: one executable per octave
+                # ladder) is the route's largest compile; warm the DEFAULT
+                # request shape (the shared _DREAM_DEFAULTS the route uses)
+                # so first dreams serve inside their window — every dream
+                # bucket under warmup_all_buckets, else just the first
+                if self.cfg.warmup_all_buckets:
+                    dream_sizes = sorted(
+                        {
+                            self._round_to_dp(pad_bucket(n, self.cfg.dream_max_batch))
+                            for n in range(1, self.cfg.dream_max_batch + 1)
+                        }
+                    )
+                else:
+                    dream_sizes = [self._round_to_dp(pad_bucket(1, self.cfg.dream_max_batch))]
+                for size in dream_sizes:
+                    self._run_batch(
+                        (
+                            "__dream__", self.bundle.dream_layers,
+                            _DREAM_DEFAULTS["steps"], _DREAM_DEFAULTS["octaves"],
+                            _DREAM_DEFAULTS["lr"],
+                        ),
+                        [img] * size, lane=lane,
+                    )
+        # ACCUMULATED across calls: drivers that warm several layers
+        # (loopback --heavy warms one per request-nameable layer) must
+        # report the process's total compile tax, not the last slice
+        self.warmup_wall_s = round(
+            (self.warmup_wall_s or 0.0) + time.perf_counter() - t_start, 3
+        )
         self.ready = True
 
     # ----------------------------------------------------------- pipeline
@@ -979,22 +1083,29 @@ class DeconvService:
             # codec pool above half capacity (worker deaths outran the
             # respawn budget otherwise)
             "codec_pool_quorum": self.codec_pool.at_quorum,
-            # device breaker: open-and-cooling means every dispatch
-            # fails fast.  accepting() (not raw state) so an instance
-            # whose cooldown elapsed reports ready — the LB must route
-            # the one request that runs the recovery probe, or an
-            # open breaker and a readiness-gated LB deadlock each other
-            "breaker_not_open": (
-                self.breaker is None or self.breaker.accepting()
-            ),
+            # device breakers: READY while ANY lane accepts (or would
+            # run its recovery probe) — one sick chip degrades the pool,
+            # it must not pull the whole instance from rotation.
+            # accepting() (not raw state) so a lane whose cooldown
+            # elapsed counts — the LB must route the one request that
+            # runs the recovery probe, or an open breaker and a
+            # readiness-gated LB deadlock each other.
+            "breaker_not_open": self.lane_pool.accepting(),
         }
 
     async def _readyz(self, _req: Request) -> Response:
         checks = self._readiness_checks()
         ok = all(checks.values())
-        return Response.json(
-            {"ready": ok, "checks": checks}, status=200 if ok else 503
-        )
+        body = {"ready": ok, "checks": checks}
+        if self.lane_count > 1:
+            # degraded-not-dead visibility (round 10): a ready pool with
+            # open lanes says so, instead of hiding the sick chip behind
+            # a green readiness bit
+            body["lanes"] = {
+                "total": self.lane_pool.size,
+                "accepting": self.lane_pool.accepting_count(),
+            }
+        return Response.json(body, status=200 if ok else 503)
 
     async def _debug_faults(self, req: Request) -> Response:
         """POST /v1/debug/faults — one-shot runtime arm/disarm (only
@@ -1052,9 +1163,15 @@ class DeconvService:
         if self.recorder is not None:
             cfg["trace_counts"] = self.recorder.counts()
         # robustness layer (round 9): live breaker / fault / drain state
-        cfg["breaker_active"] = self.breaker is not None
-        if self.breaker is not None:
-            cfg["breaker_state"] = self.breaker.state_name
+        cfg["breaker_active"] = self.cfg.breaker_threshold > 0
+        if cfg["breaker_active"]:
+            cfg["breaker_state"] = self.lane_pool.state_name()
+        # executor lanes (round 10): live per-lane occupancy + breaker
+        # state, and the warmup wall the compile cache attacks
+        cfg["serve_lanes_active"] = self.lane_count
+        if self.lane_count > 1:
+            cfg["lanes"] = self.lane_pool.snapshot()
+        cfg["warmup_wall_s"] = self.warmup_wall_s
         cfg["fault_injection_active"] = self.faults is not None
         if self.faults is not None:
             cfg["faults_state"] = self.faults.snapshot()
@@ -1425,6 +1542,7 @@ async def serve_forever(cfg: ServerConfig) -> None:
         host=service.cfg.host, port=port, model=service.cfg.model or "injected",
         pipeline_depth=service.cfg.pipeline_depth,
         mesh=list(service.cfg.mesh_shape) or None,
+        lanes=service.lane_count,
     )
     print(f"deconv_api_tpu serving on {service.cfg.host}:{port}", flush=True)
     await asyncio.to_thread(service.warmup)
@@ -1519,6 +1637,17 @@ def main(argv: list[str] | None = None) -> None:
         help="seconds between /readyz flipping 503 and the listener "
         "closing on SIGTERM",
     )
+    p.add_argument(
+        "--lanes", default=None, metavar="N|auto|off",
+        help="executor lanes: independent per-chip dispatch streams with "
+        "least-loaded batch scheduling (auto = one per visible device "
+        "when no mesh is configured; N must divide the device count)",
+    )
+    p.add_argument(
+        "--compile-cache-dir", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory (default off): "
+        "warm restarts skip the per-bucket-per-lane warmup compile tax",
+    )
     args = p.parse_args(argv)
     overrides = {}
     if args.cache_bytes is not None:
@@ -1544,6 +1673,10 @@ def main(argv: list[str] | None = None) -> None:
         overrides["breaker_cooldown_s"] = args.breaker_cooldown_s
     if args.drain_grace_s is not None:
         overrides["drain_grace_s"] = args.drain_grace_s
+    if args.lanes is not None:
+        overrides["serve_lanes"] = args.lanes
+    if args.compile_cache_dir is not None:
+        overrides["compilation_cache_dir"] = args.compile_cache_dir
     if args.host is not None:
         overrides["host"] = args.host
     if args.port is not None:

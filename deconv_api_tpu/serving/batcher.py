@@ -49,11 +49,23 @@ to one submit — the leader's finished response is published to the
 coalesced waiters when its batch completes.  What this file contributes is
 the shed path's actionable backoff: the 503's Retry-After derives from
 `_estimated_drain_s`, the same live estimate that triggered the shed.
+
+Round 10 broke the single-stream assumption itself: on a multi-chip host
+the dispatch stage schedules each collected batch onto the LEAST-LOADED
+**executor lane** (LanePool/ExecutorLane below — one device or one small
+dp mesh per lane, params replicated per lane by the service), so batches
+for different keys, and consecutive batches for one key when
+pipeline_depth allows, execute concurrently on different chips.  Each
+lane carries its own dispatch worker, its own fetch-permit budget
+(pipeline_depth becomes per-lane), and its own circuit breaker — one
+sick chip opens ONE lane's breaker and the pool degrades to the
+survivors instead of failing fast everywhere.
 """
 
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import threading
 import time
@@ -136,6 +148,18 @@ class CircuitBreaker:
                 return True
             return self._clock() >= self._opened_at + self.cooldown_s
 
+    def admit_hint(self) -> tuple[bool, float]:
+        """(would a request arriving now be admitted?, retry-after when
+        not) — WITHOUT claiming the half-open probe.  The lane pool asks
+        this at submit time (fail fast only when every lane is open and
+        cooling); the probe itself is claimed by ``allow()`` at dispatch
+        time, on the lane the scheduler actually picked."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return True, 0.0
+            remaining = self._opened_at + self.cooldown_s - self._clock()
+            return remaining <= 0, max(remaining, 1.0)
+
     def allow(self) -> tuple[bool, float]:
         """(admit this request?, retry-after seconds when not)."""
         with self._lock:
@@ -205,6 +229,282 @@ class CircuitBreaker:
     def _publish(self) -> None:
         if self._metrics is not None:
             self._metrics.set_gauge("breaker_state", self._state)
+
+
+# EWMA smoothing for a lane's observed batch cost, and the seed cost a
+# lane with no history pretends to have: with no observation every idle
+# lane ties at load 0 and the pick's least-pick tiebreak round-robins,
+# which is exactly what warms every lane.
+_EWMA_ALPHA = 0.2
+_EWMA_SEED_S = 1e-3
+
+
+class ExecutorLane:
+    """One executor lane's shared state: the load signal (in-flight depth
+    + EWMA batch cost) and the lane's own circuit breaker.
+
+    The lane is SHARED by every dispatcher that can schedule onto its
+    chip (deconv/dream/sweep sit on the same devices, so their load and
+    failures are correlated per chip); the per-dispatcher pieces — the
+    lane's dispatch worker thread and fetch-permit budget — live on the
+    dispatcher.  Lock-protected: outcomes are recorded from the event
+    loop and from fetch completions racing on it."""
+
+    def __init__(self, index: int, breaker: CircuitBreaker | None = None):
+        self.index = index
+        self.breaker = breaker
+        self._lock = threading.Lock()
+        self.inflight = 0  # dispatched-but-unfinished groups on this chip
+        self.ewma_s = 0.0  # smoothed dispatch->done wall per batch
+        self.batches = 0  # executed batches (the occupancy ledger)
+        self.picks = 0  # scheduler picks (ties round-robin on this)
+
+    def load(self) -> float:
+        """Estimated pending seconds on this lane — the least-loaded
+        scheduling signal: queued depth times what a batch has been
+        costing here lately."""
+        with self._lock:
+            return self.inflight * (self.ewma_s or _EWMA_SEED_S)
+
+    def note_dispatched(self) -> None:
+        with self._lock:
+            self.inflight += 1
+            self.picks += 1
+
+    def note_done(self, wall_s: float, ok: bool = True) -> None:
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+            self.batches += 1
+            if not ok:
+                # a failure's wall says nothing about the lane's true
+                # batch cost — fast-failing dispatches would collapse
+                # the EWMA and make the SICK lane look cheapest, so the
+                # scheduler would chase it (its breaker only saves the
+                # pool once failures are consecutive)
+                return
+            self.ewma_s = (
+                wall_s
+                if self.ewma_s == 0.0
+                else (1 - _EWMA_ALPHA) * self.ewma_s + _EWMA_ALPHA * wall_s
+            )
+
+    def note_cancelled(self) -> None:
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+
+
+class LanePool:
+    """The set of executor lanes one service schedules over, shared by
+    all of its dispatchers.  Owns the least-loaded pick, the pool-level
+    admission answer (fail fast only when EVERY lane is open and
+    cooling), and the per-lane metrics: ``lane_inflight{lane=}`` /
+    ``lane_breaker_state{lane=}`` gauges, ``lane_batches_total{lane=}``
+    counters, and a ``lane_imbalance`` gauge (max/mean of per-lane
+    executed batches — 1.0 is a perfectly balanced pool).
+
+    A single-lane pool is the exact pre-lane serving path: one stream,
+    one (optional) breaker, no placement decisions."""
+
+    def __init__(
+        self,
+        n: int = 1,
+        *,
+        breaker_factory: Callable[[], CircuitBreaker | None] | None = None,
+        breakers: list[CircuitBreaker | None] | None = None,
+        metrics=None,
+    ):
+        if breakers is None:
+            breakers = [
+                breaker_factory() if breaker_factory is not None else None
+                for _ in range(n)
+            ]
+        if len(breakers) != n:
+            raise ValueError(f"{n} lanes need {n} breakers, got {len(breakers)}")
+        self.lanes = [ExecutorLane(i, breakers[i]) for i in range(n)]
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        for lane in self.lanes:
+            self._publish_lane(lane)
+        self._publish_pool()
+
+    @property
+    def size(self) -> int:
+        return len(self.lanes)
+
+    def admit(self) -> tuple[bool, float]:
+        """Pool-level fail-fast answer for submit(): admit while ANY lane
+        would take the request (or run its recovery probe); when every
+        lane is open and cooling, reject with the soonest lane's
+        retry-after — the pool is only as dead as its healthiest lane."""
+        retry = 0.0
+        for lane in self.lanes:
+            if lane.breaker is None:
+                return True, 0.0
+            ok, lane_retry = lane.breaker.admit_hint()
+            if ok:
+                return True, 0.0
+            retry = lane_retry if retry == 0.0 else min(retry, lane_retry)
+        return False, max(retry, 1.0)
+
+    def pick(self) -> tuple[ExecutorLane | None, float]:
+        """Least-loaded lane whose breaker admits the dispatch (claiming
+        the half-open probe when that is what admission means).  Ties
+        break on fewest picks — an idle pool round-robins, which warms
+        every lane — then index.  (None, retry_after) when no lane
+        admits: the group fails fast instead of burning its timeout."""
+        order = sorted(
+            self.lanes, key=lambda l: (l.load(), l.inflight, l.picks, l.index)
+        )
+        retry = 0.0
+        for lane in order:
+            if lane.breaker is None:
+                return lane, 0.0
+            ok, lane_retry = lane.breaker.allow()
+            if ok:
+                # allow() may have claimed the half-open probe
+                # (OPEN -> HALF_OPEN); refresh the lane's state gauge
+                self._publish_lane(lane)
+                return lane, 0.0
+            retry = lane_retry if retry == 0.0 else min(retry, lane_retry)
+        return None, max(retry, 1.0)
+
+    def record_dispatched(self, lane: ExecutorLane) -> None:
+        lane.note_dispatched()
+        self._publish_lane(lane)
+
+    def record_done(
+        self, lane: ExecutorLane, ok: bool, wall_s: float, n: int = 0
+    ) -> None:
+        """One executed group's outcome: lane load signal, lane breaker,
+        and the per-lane metric series (``n`` = member requests, for the
+        lane-occupancy ledger the loopback row reports)."""
+        lane.note_done(wall_s, ok)
+        if lane.breaker is not None:
+            pre = lane.breaker.state
+            if ok:
+                lane.breaker.record_success()
+            else:
+                lane.breaker.record_failure()
+            # count EVERY open transition — including a failed probe's
+            # HALF_OPEN -> OPEN reopen, which a sampled edge detector
+            # would miss because allow() went half-open in between
+            if (
+                self._metrics is not None
+                and pre != CircuitBreaker.OPEN
+                and lane.breaker.state == CircuitBreaker.OPEN
+            ):
+                self._metrics.inc_counter("breaker_open_total")
+        if self._metrics is not None:
+            self._metrics.inc_labeled(
+                "lane_batches_total", "lane", str(lane.index)
+            )
+            if n:
+                self._metrics.inc_labeled(
+                    "lane_requests_total", "lane", str(lane.index), n
+                )
+        self._publish_lane(lane)
+        self._publish_pool()
+
+    def record_cancelled(self, lane: ExecutorLane) -> None:
+        """A dispatched group whose outcome is unknowable (shutdown
+        cancelled the await): release the lane's load signal without
+        recording a breaker outcome — a drain is not a device failure."""
+        lane.note_cancelled()
+        self._publish_lane(lane)
+
+    def accepting_count(self) -> int:
+        return sum(
+            1
+            for lane in self.lanes
+            if lane.breaker is None or lane.breaker.accepting()
+        )
+
+    def accepting(self) -> bool:
+        """Would the pool admit a request arriving now? (the /readyz
+        gate: degraded-but-serving is READY; only a pool with every
+        lane open-and-cooling should be pulled from rotation)."""
+        return self.accepting_count() > 0
+
+    def state_name(self) -> str:
+        """Aggregate breaker state for /v1/config: a single lane reports
+        its breaker verbatim (the pre-lane contract); a pool reports
+        closed / degraded (some lanes open) / open (none accepting)."""
+        if not any(lane.breaker is not None for lane in self.lanes):
+            return "closed"
+        if self.size == 1:
+            return self.lanes[0].breaker.state_name
+        states = [
+            lane.breaker.state for lane in self.lanes if lane.breaker is not None
+        ]
+        if all(s == CircuitBreaker.CLOSED for s in states):
+            return "closed"
+        return "degraded" if self.accepting() else "open"
+
+    def snapshot(self) -> dict:
+        """Per-lane occupancy for /v1/config and the loopback row."""
+        return {
+            "lanes": self.size,
+            "accepting": self.accepting_count(),
+            "per_lane": [
+                {
+                    "lane": lane.index,
+                    "inflight": lane.inflight,
+                    "batches": lane.batches,
+                    "ewma_ms": round(lane.ewma_s * 1e3, 3),
+                    "breaker": (
+                        lane.breaker.state_name
+                        if lane.breaker is not None
+                        else "none"
+                    ),
+                }
+                for lane in self.lanes
+            ],
+        }
+
+    def _publish_lane(self, lane: ExecutorLane) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.set_labeled_gauge(
+            "lane_inflight", "lane", str(lane.index), lane.inflight
+        )
+        if lane.breaker is not None:
+            self._metrics.set_labeled_gauge(
+                "lane_breaker_state", "lane", str(lane.index),
+                lane.breaker.state,
+            )
+
+    def _publish_pool(self) -> None:
+        if self._metrics is None:
+            return
+        with self._lock:
+            counts = [lane.batches for lane in self.lanes]
+            total = sum(counts)
+            imbalance = (
+                max(counts) * len(counts) / total if total > 0 else 1.0
+            )
+            self._metrics.set_gauge("lane_imbalance", round(imbalance, 4))
+            self._metrics.set_gauge("lanes_accepting", self.accepting_count())
+            # pool-aggregate breaker surface: the worst lane's state
+            # (open transitions are counted in record_done, where they
+            # happen — the pre-lane breaker_state/breaker_open_total
+            # series live on)
+            worst = 0
+            for lane in self.lanes:
+                if lane.breaker is not None:
+                    worst = max(worst, lane.breaker.state)
+            self._metrics.set_gauge("breaker_state", worst)
+
+
+def _accepts_lane(fn) -> bool:
+    """Does a runner take the scheduler's ``lane`` keyword?  Probed once
+    at dispatcher construction so legacy 2-arg runners (tests, embedders)
+    keep working unchanged on a single-lane pool."""
+    if fn is None:
+        return False
+    try:
+        return "lane" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # C callables etc.
+        return False
 
 
 def _to_daemon_thread(fn: Callable[[], Any]) -> asyncio.Future:
@@ -279,12 +579,21 @@ class BatchingDispatcher:
         | None = None,
         pipeline_depth: int = 2,
         breaker: CircuitBreaker | None = None,
+        lane_pool: LanePool | None = None,
     ):
         self._runner = runner
-        # Shared across the dispatchers on one device (they fail
-        # together); outcomes recorded per executed group, admission
-        # gated in submit().
-        self._breaker = breaker
+        # Executor lanes (round 10): the service passes ONE pool shared
+        # by all its dispatchers (their load and failures are correlated
+        # per chip); a bare ``breaker=`` builds the exact pre-lane
+        # single-stream pool around it.  Admission is gated pool-wide in
+        # submit() (fail fast only when every lane is open and cooling);
+        # the per-lane breaker claim and outcome recording happen at
+        # dispatch, on the lane the scheduler picked.
+        self._pool = (
+            lane_pool
+            if lane_pool is not None
+            else LanePool(1, breakers=[breaker])
+        )
         self._max_batch = max_batch
         self._window_s = window_ms / 1e3
         self._timeout_s = request_timeout_s
@@ -303,7 +612,13 @@ class BatchingDispatcher:
         # semaphore; depth<=1 or dispatch_runner=None restores the fully
         # serial dispatch->fetch->resolve loop.
         self._dispatch_runner = dispatch_runner if pipeline_depth > 1 else None
-        self._fetch_sem = asyncio.Semaphore(max(1, pipeline_depth))
+        # pipeline_depth is PER LANE (round 10): each lane may hold that
+        # many dispatched-but-unfetched batches, so a deep pipeline on
+        # one chip never starves the others of dispatches.
+        self._fetch_sems = [
+            asyncio.Semaphore(max(1, pipeline_depth))
+            for _ in range(self._pool.size)
+        ]
         self._fetch_tasks: set[asyncio.Task] = set()
         self._last_done: float | None = None  # cadence observation anchor
         self._stopping = False
@@ -313,17 +628,19 @@ class BatchingDispatcher:
         # collect loop, the submit queue grows, and the shed estimator
         # sees the depth.
         self._dispatch_q: asyncio.Queue[list[WorkItem]] = asyncio.Queue(
-            maxsize=max(1, pipeline_depth)
+            maxsize=max(1, pipeline_depth) * self._pool.size
         )
         self._dispatch_task: asyncio.Task | None = None
         self._staged = 0  # items handed to the dispatch stage, not yet dispatched
-        # One PERSISTENT dispatch worker thread (vs a fresh daemon thread
-        # per batch): device dispatch is a short async enqueue, so thread
-        # spawn + first-schedule latency dominated it.  Per-dispatcher, so
-        # one stream's first-use compile (an unwarmed sweep program) can
-        # never stall another's dispatches.  Fetches keep thread-per-call
-        # — a wedged device_get must only ever wedge its own thread.
-        self._dispatch_worker = None
+        # One PERSISTENT dispatch worker thread PER LANE (vs a fresh
+        # daemon thread per batch): device dispatch is a short async
+        # enqueue, so thread spawn + first-schedule latency dominated it.
+        # Per-dispatcher AND per-lane, so one stream's first-use compile
+        # (an unwarmed sweep program, or a cold lane's first executable)
+        # can never stall another lane's dispatches.  Fetches keep
+        # thread-per-call — a wedged device_get must only ever wedge its
+        # own thread.
+        self._dispatch_workers: list | None = None
 
     async def start(self) -> None:
         if self._task is None:
@@ -332,10 +649,16 @@ class BatchingDispatcher:
                 self._supervised("collect", self._run), name="batch-dispatcher"
             )
             if self._dispatch_runner is not None:
-                if self._dispatch_worker is None:
+                if self._dispatch_workers is None:
                     from deconv_api_tpu.serving.codec_pool import WorkerPool
 
-                    self._dispatch_worker = WorkerPool(1, name="dispatch")
+                    # all lanes share the "dispatch" fault-site name, so
+                    # dispatch.worker_raise/_hang drills hit whichever
+                    # lane the scheduler picks
+                    self._dispatch_workers = [
+                        WorkerPool(1, name="dispatch")
+                        for _ in range(self._pool.size)
+                    ]
                 self._dispatch_task = asyncio.create_task(
                     self._supervised("dispatch", self._dispatch_stage),
                     name="batch-dispatch-stage",
@@ -402,9 +725,10 @@ class BatchingDispatcher:
             except asyncio.CancelledError:
                 pass
             self._dispatch_task = None
-        if self._dispatch_worker is not None:
-            self._dispatch_worker.close()
-            self._dispatch_worker = None  # start() builds a fresh one
+        if self._dispatch_workers is not None:
+            for w in self._dispatch_workers:
+                w.close()
+            self._dispatch_workers = None  # start() builds fresh ones
         # Batches still staged in the handoff queue were never dispatched:
         # fail them now or they hang to a full request-timeout 504.
         while not self._dispatch_q.empty():
@@ -480,18 +804,19 @@ class BatchingDispatcher:
         if self._stopping:
             raise errors.Unavailable("server shutting down")
         tr = trace_mod.current_trace()
-        if self._breaker is not None:
-            allowed, retry_s = self._breaker.allow()
-            if not allowed:
-                # fail fast: with the breaker open every dispatch is
-                # overwhelmingly likely to fail — queueing this request
-                # would only burn its timeout against a dead device
-                if tr is not None:
-                    tr.annotate(breaker="open")
-                raise errors.BreakerOpen(
-                    "device circuit breaker is open; failing fast",
-                    retry_after_s=retry_s,
-                )
+        allowed, retry_s = self._pool.admit()
+        if not allowed:
+            # fail fast: every lane's breaker is open and cooling, so
+            # every dispatch is overwhelmingly likely to fail — queueing
+            # this request would only burn its timeout against dead
+            # devices.  One sick lane never trips this: admit() answers
+            # yes while any lane would serve (degraded, not dead).
+            if tr is not None:
+                tr.annotate(breaker="open")
+            raise errors.BreakerOpen(
+                "device circuit breaker is open on every lane; failing fast",
+                retry_after_s=retry_s,
+            )
         now = time.perf_counter()
         if deadline is not None:
             # the caller's x-deadline-ms budget, capped by the server's
@@ -733,14 +1058,29 @@ class BatchingDispatcher:
                         item.future.set_exception(exc)
                 raise
 
-    def _record_outcome(self, ok: bool) -> None:
-        """One executed group's device outcome into the shared breaker
-        (dispatch raise, fetch raise, or clean completion)."""
-        if self._breaker is not None:
-            if ok:
-                self._breaker.record_success()
-            else:
-                self._breaker.record_failure()
+    def _call_runner(self, key, images, lane: ExecutorLane):
+        """Serial-mode runner invocation, lane keyword only for runners
+        that take it (legacy 2-arg runners ride lane 0 unchanged).
+        Lane-awareness is probed per call, not cached: tests and
+        embedders swap the runner attributes at runtime, and the probe
+        is microseconds against a batch's milliseconds."""
+        fn = self._runner
+        if _accepts_lane(fn):
+            return fn(key, images, lane=lane.index)
+        return fn(key, images)
+
+    def _call_dispatch(self, key, images, lane: ExecutorLane):
+        """Pipelined dispatch invocation; runs on the lane's dispatch
+        worker thread.  Same per-call lane probe as _call_runner."""
+        fn = self._dispatch_runner
+        if _accepts_lane(fn):
+            return fn(key, images, lane=lane.index)
+        return fn(key, images)
+
+    def _fail_group(self, items: list[WorkItem], exc: BaseException) -> None:
+        for it in items:
+            if not it.future.done():
+                it.future.set_exception(exc)
 
     async def _execute(self, batch: list[WorkItem]) -> None:
         groups: dict[Any, list[WorkItem]] = {}
@@ -760,15 +1100,35 @@ class BatchingDispatcher:
         try:
             for key, items in groups.items():
                 images = [it.image for it in items]
+                lane, retry_s = self._pool.pick()
+                if lane is None:
+                    # the pool's breakers all opened while this batch
+                    # sat collected: fail the group fast, like submit()
+                    # would have
+                    self._inflight -= 1
+                    pending_groups = pending_groups[1:]
+                    self._fail_group(
+                        items,
+                        errors.BreakerOpen(
+                            "device circuit breaker is open on every lane; "
+                            "failing fast",
+                            retry_after_s=retry_s,
+                        ),
+                    )
+                    continue
+                self._pool.record_dispatched(lane)
                 t0 = time.perf_counter()
                 try:
                     results = await _to_daemon_thread(
-                        lambda key=key, images=images: self._runner(key, images)
+                        lambda key=key, images=images, lane=lane: (
+                            self._call_runner(key, images, lane)
+                        )
                     )
                 except asyncio.CancelledError:
                     # stop() cancelled the dispatcher mid-batch: these items
                     # are already out of the queue, so the stop() drain loop
                     # cannot fail them — do it here or they 504 (r4 review)
+                    self._pool.record_cancelled(lane)
                     for grp in pending_groups:
                         for it in grp:
                             if not it.future.done():
@@ -777,16 +1137,18 @@ class BatchingDispatcher:
                                 )
                     raise
                 except Exception as e:  # noqa: BLE001 — propagate to callers
-                    self._record_outcome(False)
-                    for it in items:
-                        if not it.future.done():
-                            it.future.set_exception(e)
+                    self._pool.record_done(
+                        lane, False, time.perf_counter() - t0, len(items)
+                    )
+                    self._fail_group(items, e)
                     continue
                 finally:
                     self._inflight -= 1
                     pending_groups = pending_groups[1:]
-                self._record_outcome(True)
-                self._resolve(items, results, t0)
+                self._pool.record_done(
+                    lane, True, time.perf_counter() - t0, len(items)
+                )
+                self._resolve(items, results, t0, lane=lane)
         finally:
             self._inflight = 0  # cancellation mid-drain must not leak count
 
@@ -808,27 +1170,49 @@ class BatchingDispatcher:
         try:
             for key, items in group_list:
                 images = [it.image for it in items]
-                await self._fetch_sem.acquire()
-                t0 = time.perf_counter()
-                try:
-                    thunk = await self._dispatch_worker.run(
-                        self._dispatch_runner, key, images
-                    )
-                except asyncio.CancelledError:
-                    self._fetch_sem.release()  # held permit must not leak
-                    raise
-                except Exception as e:  # noqa: BLE001 — propagate to callers
-                    self._fetch_sem.release()
+                # Least-loaded lane selection (round 10): each group goes
+                # to the lane with the smallest pending-seconds estimate
+                # whose breaker admits it.  With one lane this degenerates
+                # to the pre-lane single stream.
+                lane, retry_s = self._pool.pick()
+                if lane is None:
                     self._inflight -= 1
                     handed_off += 1
-                    self._record_outcome(False)
-                    for it in items:
-                        if not it.future.done():
-                            it.future.set_exception(e)
+                    self._fail_group(
+                        items,
+                        errors.BreakerOpen(
+                            "device circuit breaker is open on every lane; "
+                            "failing fast",
+                            retry_after_s=retry_s,
+                        ),
+                    )
+                    continue
+                # the LANE's fetch permit: a deep pipeline on one chip
+                # blocks only further dispatches to that chip
+                sem = self._fetch_sems[lane.index]
+                await sem.acquire()
+                self._pool.record_dispatched(lane)
+                t0 = time.perf_counter()
+                try:
+                    thunk = await self._dispatch_workers[lane.index].run(
+                        self._call_dispatch, key, images, lane
+                    )
+                except asyncio.CancelledError:
+                    sem.release()  # held permit must not leak
+                    self._pool.record_cancelled(lane)
+                    raise
+                except Exception as e:  # noqa: BLE001 — propagate to callers
+                    sem.release()
+                    self._inflight -= 1
+                    handed_off += 1
+                    self._pool.record_done(
+                        lane, False, time.perf_counter() - t0, len(items)
+                    )
+                    self._fail_group(items, e)
                     continue
                 handed_off += 1
                 task = asyncio.create_task(
-                    self._finish(items, thunk, t0, time.perf_counter()),
+                    self._finish(items, thunk, t0, time.perf_counter(), lane),
                     name="batch-fetch",
                 )
                 self._fetch_tasks.add(task)
@@ -852,6 +1236,7 @@ class BatchingDispatcher:
         thunk,
         t0: float,
         dispatched_at: float | None = None,
+        lane: ExecutorLane | None = None,
     ) -> None:
         try:
             results = await _to_daemon_thread(thunk)
@@ -859,6 +1244,8 @@ class BatchingDispatcher:
             # stop()'s bounded grace cancels wedged fetches; their results
             # are unreachable (to_thread discards the worker's return on
             # cancel) so the futures must fail NOW, not 504 later
+            if lane is not None:
+                self._pool.record_cancelled(lane)
             for it in items:
                 if not it.future.done():
                     it.future.set_exception(
@@ -866,16 +1253,21 @@ class BatchingDispatcher:
                     )
             raise
         except Exception as e:  # noqa: BLE001 — propagate to callers
-            self._record_outcome(False)
-            for it in items:
-                if not it.future.done():
-                    it.future.set_exception(e)
+            if lane is not None:
+                self._pool.record_done(
+                    lane, False, time.perf_counter() - t0, len(items)
+                )
+            self._fail_group(items, e)
             return
         finally:
             self._inflight -= 1
-            self._fetch_sem.release()
-        self._record_outcome(True)
-        self._resolve(items, results, t0, dispatched_at)
+            if lane is not None:
+                self._fetch_sems[lane.index].release()
+        if lane is not None:
+            self._pool.record_done(
+                lane, True, time.perf_counter() - t0, len(items)
+            )
+        self._resolve(items, results, t0, dispatched_at, lane)
 
     def _resolve(
         self,
@@ -883,6 +1275,7 @@ class BatchingDispatcher:
         results: list[Any],
         t0: float,
         dispatched_at: float | None = None,
+        lane: ExecutorLane | None = None,
     ) -> None:
         """Shared epilogue for both execution modes: metrics + futures.
         Cadence (interval between completions while more work is in
@@ -890,12 +1283,15 @@ class BatchingDispatcher:
         Round 8: each member request's trace gets its queue-wait and
         dispatch/fetch spans here, stamped with the batch id that
         observe_batch just recorded — the join key between a single
-        request's timeline and the batch-level metrics."""
+        request's timeline and the batch-level metrics.  Round 10: the
+        spans and the batch_done line carry the executing LANE, so a
+        slow trace says which chip ran it."""
         now = time.perf_counter()
+        lane_ix = lane.index if lane is not None else 0
         slog.event(
             _log, "batch_done", level=10,  # DEBUG: per-request http_request
             # lines already cover the serving story at INFO
-            key=str(items[0].key), size=len(items),
+            key=str(items[0].key), size=len(items), lane=lane_ix,
             ms=round((now - t0) * 1e3, 1), inflight=self._inflight,
         )
         bid = None
@@ -920,17 +1316,21 @@ class BatchingDispatcher:
                 self._last_done = None
         for it in items:
             if it.trace is not None:
-                it.trace.annotate(batch_id=bid, batch_size=len(items))
+                it.trace.annotate(batch_id=bid, batch_size=len(items), lane=lane_ix)
                 it.trace.add_span("queue_wait", it.enqueued_at, t0 - it.enqueued_at)
                 if dispatched_at is not None:
                     it.trace.add_span(
-                        "dispatch", t0, dispatched_at - t0, batch_id=bid
+                        "dispatch", t0, dispatched_at - t0, batch_id=bid,
+                        lane=lane_ix,
                     )
                     it.trace.add_span(
-                        "fetch", dispatched_at, now - dispatched_at, batch_id=bid
+                        "fetch", dispatched_at, now - dispatched_at,
+                        batch_id=bid, lane=lane_ix,
                     )
                 else:
-                    it.trace.add_span("device", t0, now - t0, batch_id=bid)
+                    it.trace.add_span(
+                        "device", t0, now - t0, batch_id=bid, lane=lane_ix
+                    )
         for it, res in zip(items, results):
             if not it.future.done():
                 it.future.set_result(res)

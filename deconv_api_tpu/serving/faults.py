@@ -52,7 +52,7 @@ SITES = (
     "dispatch.worker_raise",   # batcher dispatch-worker dies mid-task
     "dispatch.worker_hang",    # batcher dispatch-worker stalls :param ms
     "batcher.dispatch_raise",  # batcher dispatch-stage task crashes
-    "device.dispatch_error",   # device batch dispatch raises
+    "device.dispatch_error",   # device batch dispatch raises (:param = lane)
     "device.dispatch_delay_ms",  # device batch dispatch stalls :param ms
     "http.slow_write",         # response write stalls :param ms
 )
@@ -169,11 +169,23 @@ class FaultRegistry:
         slog.event(_log, "fault_disarmed", site=site or "all")
         self._publish()
 
-    def check(self, site: str) -> FaultAction | None:
+    def check(self, site: str, where: int | None = None) -> FaultAction | None:
+        """``where`` is the call site's locality (round 10: the executor
+        LANE consulting a device site).  A spec armed with a ``:<param>``
+        on a lane-targetable site fires only when the param matches —
+        ``device.dispatch_error=n8:1`` bursts lane 1 and leaves the rest
+        of the pool untouched; non-matching consultations don't consume
+        one-shot counts."""
         disarmed = False
         with self._lock:
             spec = self._armed.get(site)
             if spec is None:
+                return None
+            if (
+                where is not None
+                and spec.param is not None
+                and int(spec.param) != where
+            ):
                 return None
             if spec.p < 1.0 and self._rng.random() >= spec.p:
                 return None
@@ -232,15 +244,15 @@ def installed() -> FaultRegistry | None:
     return _REGISTRY
 
 
-def check(site: str) -> FaultAction | None:
+def check(site: str, where: int | None = None) -> FaultAction | None:
     reg = _REGISTRY
     if reg is None:
         return None
-    return reg.check(site)
+    return reg.check(site, where)
 
 
-def raise_if_armed(site: str) -> None:
+def raise_if_armed(site: str, where: int | None = None) -> None:
     """Shared raise-form consultation: the site fires -> FaultInjected."""
-    act = check(site)
+    act = check(site, where)
     if act is not None:
         raise errors.FaultInjected(f"injected fault at {site}")

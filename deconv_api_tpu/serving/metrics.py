@@ -79,6 +79,10 @@ class Metrics:
         # per-site fault and per-task restart accounting; one label name
         # per family, like errors_total{code=...}
         self._labeled: dict[str, tuple[str, dict[str, int]]] = {}
+        # family -> (label name, {label value: gauge}) — round 10's
+        # per-lane pipeline state (lane_inflight{lane=},
+        # lane_breaker_state{lane=}); same shape as labeled counters
+        self._labeled_gauges: dict[str, tuple[str, dict[str, float]]] = {}
 
     def observe_request(self, latency_s: float, error_code: str | None = None) -> None:
         with self._lock:
@@ -165,6 +169,22 @@ class Metrics:
             _, series = self._labeled.get(family, ("", {}))
             return dict(series)
 
+    def set_labeled_gauge(
+        self, family: str, label: str, value: str, v: float
+    ) -> None:
+        """Labeled instantaneous gauges (round 10: the lane pool's
+        ``lane_inflight{lane=...}`` and ``lane_breaker_state{lane=...}``)
+        — one gauge family, one sample line per label value."""
+        with self._lock:
+            _, series = self._labeled_gauges.setdefault(family, (label, {}))
+            series[value] = float(v)
+
+    def labeled_gauge(self, family: str) -> dict[str, float]:
+        """{label value: gauge} for one labeled-gauge family."""
+        with self._lock:
+            _, series = self._labeled_gauges.get(family, ("", {}))
+            return dict(series)
+
     def set_gauge(self, name: str, value: float) -> None:
         """Instantaneous pipeline-state gauges (queue depths, inflight
         batches — round 6's three-stage pipeline observability).  Updated
@@ -197,6 +217,10 @@ class Metrics:
                 "labeled": {
                     fam: (label, dict(series))
                     for fam, (label, series) in self._labeled.items()
+                },
+                "labeled_gauges": {
+                    fam: (label, dict(series))
+                    for fam, (label, series) in self._labeled_gauges.items()
                 },
             }
 
@@ -267,6 +291,14 @@ class Metrics:
             for value, n in sorted(series.items()):
                 lines.append(
                     f'{p}_{fam}{{{label}="{escape_label(value)}"}} {n}'
+                )
+        # labeled gauges (round 10): per-lane in-flight depth and breaker
+        # state — one TYPE header per family, one line per lane
+        for fam, (label, series) in sorted(s["labeled_gauges"].items()):
+            lines.append(f"# TYPE {p}_{fam} gauge")
+            for value, v in sorted(series.items()):
+                lines.append(
+                    f'{p}_{fam}{{{label}="{escape_label(value)}"}} {v:g}'
                 )
         # pipeline-state gauges (round 6): collect/dispatch queue depths,
         # inflight batches, codec-pool pending jobs; cache resident bytes /

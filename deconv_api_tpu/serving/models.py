@@ -36,6 +36,40 @@ class ModelBundle:
     # cfg.mesh_shape is configured; visualizers then run dp-sharded
     _vis_cache: dict = dataclasses.field(default_factory=dict)
     _dream_cache: dict = dataclasses.field(default_factory=dict)
+    # Executor lanes (round 10): one placement (Device, or a small dp
+    # Mesh) and one param replica per lane, set once by set_lanes().
+    # Empty = single-stream serving with the original params.
+    _lane_placements: list = dataclasses.field(default_factory=list)
+    _lane_params: list = dataclasses.field(default_factory=list)
+
+    def set_lanes(self, placements: list) -> None:
+        """Replicate the params onto every lane ONCE at startup — each
+        lane's dispatches then read their chip-local copy, so no
+        cross-chip param traffic ever rides the serving hot path.  A
+        placement is a single Device (lane == chip) or a Mesh (lane ==
+        dp slice; params replicated across the slice)."""
+        import jax
+        from jax.sharding import Mesh
+
+        from deconv_api_tpu.parallel.mesh import replicated
+
+        self._lane_placements = list(placements)
+        self._lane_params = [
+            jax.device_put(
+                self.params,
+                replicated(pl) if isinstance(pl, Mesh) else pl,
+            )
+            for pl in self._lane_placements
+        ]
+
+    def lane_params(self, lane: int = 0):
+        """The params replica a lane's dispatch must read (the original
+        params when lanes are not configured)."""
+        return self._lane_params[lane] if self._lane_params else self.params
+
+    def lane_placement(self, lane: int = 0):
+        """Device or Mesh backing one lane; None without lanes."""
+        return self._lane_placements[lane] if self._lane_placements else None
 
     def sweep_layers(self, layer: str) -> tuple[str, ...]:
         """The projectable layers at/below `layer` in forward order,
@@ -129,6 +163,7 @@ class ModelBundle:
         post: str | None = None,
         sweep: bool = False,
         donate: bool = False,
+        lane: int = 0,
     ):
         """fn(params, batch) -> {layer: {..., indices, sums, valid}} —
         jitted once per static configuration and cached.  ``bug_compat``
@@ -162,17 +197,32 @@ class ModelBundle:
         families: outputs may reuse the input's memory, so the dispatcher
         must pass freshly staged batches (it does — the input ring,
         serving/codec_pool.py).  Inactive under a mesh
-        (shard_batched_fn owns that jit boundary)."""
+        (shard_batched_fn owns that jit boundary).
+
+        ``lane`` selects the executor lane's program (round 10): the
+        cache is keyed per lane so each chip holds its own executable
+        pinned to its own param replica — a multi-device-sweeping cache
+        key lookup can never route lane 1's batch through lane 0's
+        compiled program.  Lanes backed by a Mesh slice run dp-sharded
+        over it, exactly like the whole-pool mesh path."""
+        lane_pl = self.lane_placement(lane)
+        lane_mesh = None
+        if lane_pl is not None:
+            from jax.sharding import Mesh
+
+            if isinstance(lane_pl, Mesh):
+                lane_mesh = lane_pl
+        mesh = self.mesh if self.mesh is not None else lane_mesh
         if self.spec is None:
             backward_dtype = None
-        if self.mesh is not None:
+        if mesh is not None:
             donate = False  # sharded jit boundary; donation not threaded
         if donate:
             from deconv_api_tpu.engine.deconv import allow_unusable_donation
 
             allow_unusable_donation()
         key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep,
-               donate)
+               donate, lane)
         if key not in self._vis_cache:
             if self.spec is not None:
                 # On a dp mesh the merged-sweep batch chunking must stay
@@ -184,7 +234,7 @@ class ModelBundle:
                     self.spec, layer, top_k, mode, bug_compat,
                     sweep=sweep, batched=True,
                     backward_dtype=backward_dtype or None,
-                    sweep_chunk=0 if self.mesh is not None else None,
+                    sweep_chunk=0 if mesh is not None else None,
                 )
             else:
                 sweep_names = self.sweep_layers(layer) if sweep else None
@@ -201,10 +251,10 @@ class ModelBundle:
                     raw = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
 
             fn = raw if post is None else _fuse_post(raw, post)
-            if self.mesh is not None:
+            if mesh is not None:
                 from deconv_api_tpu.parallel.batch import shard_batched_fn
 
-                fn = shard_batched_fn(fn, self.mesh)
+                fn = shard_batched_fn(fn, mesh)
             else:
                 fn = jax.jit(fn, donate_argnums=(1,) if donate else ())
             self._vis_cache[key] = fn

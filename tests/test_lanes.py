@@ -1,0 +1,397 @@
+"""Executor-lane tests (round 10): lane topology resolution, the
+least-loaded scheduler (injectable load signal), per-lane breaker
+isolation (one sick chip degrades the pool, never kills it), byte-exact
+response parity between lanes=1 and lanes=4 serving, lane-aware warmup,
+and the lane-targeted fault form.  Fast-lane: the only device work is
+the tiny spec on virtual CPU devices."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import threading
+import time
+
+import httpx
+import numpy as np
+import pytest
+
+import jax
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.models.spec import init_params
+from deconv_api_tpu.parallel.lanes import lane_placements, resolve_lane_count
+from deconv_api_tpu.serving.app import DeconvService
+from deconv_api_tpu.serving.batcher import (
+    BatchingDispatcher,
+    CircuitBreaker,
+    LanePool,
+)
+from deconv_api_tpu.serving.faults import FaultRegistry
+from deconv_api_tpu.serving.metrics import Metrics
+from tests.test_engine_parity import TINY
+from tests.test_serving import ServiceFixture, _data_url
+
+
+# ---------------------------------------------------------------- topology
+
+
+def test_resolve_lane_count_forms():
+    assert resolve_lane_count("auto", 8) == 8
+    assert resolve_lane_count("auto", 1) == 1
+    assert resolve_lane_count("off", 8) == 1
+    assert resolve_lane_count("0", 8) == 1
+    assert resolve_lane_count("1", 8) == 1
+    assert resolve_lane_count("4", 8) == 4
+    assert resolve_lane_count(8, 8) == 8
+    # a whole-pool mesh owns every device: auto degrades to one stream,
+    # an explicit lane request on top is a config error
+    assert resolve_lane_count("auto", 8, mesh_active=True) == 1
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        resolve_lane_count("4", 8, mesh_active=True)
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        resolve_lane_count("16", 8)
+    with pytest.raises(ValueError, match="divide the device count"):
+        resolve_lane_count("3", 8)
+    with pytest.raises(ValueError, match="must be 'auto'"):
+        resolve_lane_count("many", 8)
+
+
+def test_lane_placements_whole_devices_and_mesh_slices():
+    devs = jax.devices()
+    whole = lane_placements(8, devs)
+    assert whole == list(devs)
+    sliced = lane_placements(2, devs)
+    assert len(sliced) == 2
+    from jax.sharding import Mesh
+
+    for i, m in enumerate(sliced):
+        assert isinstance(m, Mesh)
+        assert m.shape["dp"] == 4
+        # contiguous, non-overlapping slices
+        assert set(m.devices.flat) == set(devs[i * 4 : (i + 1) * 4])
+    with pytest.raises(ValueError, match="evenly split"):
+        lane_placements(3, devs)
+
+
+# ------------------------------------------------- least-loaded scheduling
+
+
+def test_pick_prefers_smallest_pending_seconds():
+    """The load signal is inflight x EWMA cost, injectable by setting
+    those fields directly: a lane with 2 cheap batches in flight beats a
+    lane with 1 expensive one."""
+    pool = LanePool(3)
+    pool.lanes[0].inflight, pool.lanes[0].ewma_s = 2, 0.010  # 20 ms pending
+    pool.lanes[1].inflight, pool.lanes[1].ewma_s = 1, 0.100  # 100 ms pending
+    pool.lanes[2].inflight, pool.lanes[2].ewma_s = 1, 0.005  # 5 ms pending
+    lane, retry = pool.pick()
+    assert (lane.index, retry) == (2, 0.0)
+    pool.lanes[2].inflight = 30  # now the most loaded
+    assert pool.pick()[0].index == 0
+
+
+def test_idle_pool_round_robins_on_ties():
+    """All lanes idle -> load ties at 0 -> fewest-picks tiebreak walks
+    every lane, which is exactly what warms a cold pool."""
+    pool = LanePool(4)
+    picked = []
+    for _ in range(8):
+        lane, _ = pool.pick()
+        picked.append(lane.index)
+        pool.record_dispatched(lane)
+        pool.record_done(lane, True, 0.01, 1)
+    assert sorted(picked[:4]) == [0, 1, 2, 3]
+    assert sorted(picked[4:]) == [0, 1, 2, 3]
+
+
+def test_ewma_tracks_observed_cost():
+    pool = LanePool(1)
+    lane = pool.lanes[0]
+    pool.record_dispatched(lane)
+    pool.record_done(lane, True, 0.1, 1)
+    assert lane.ewma_s == pytest.approx(0.1)
+    pool.record_dispatched(lane)
+    pool.record_done(lane, True, 0.2, 1)
+    assert 0.1 < lane.ewma_s < 0.2  # smoothed, not last-sample
+
+
+def test_pick_skips_open_lane_and_runs_probe_after_cooldown():
+    clock = [0.0]
+    pool = LanePool(
+        2,
+        breaker_factory=lambda: CircuitBreaker(
+            1, 5.0, clock=lambda: clock[0]
+        ),
+    )
+    lane0 = pool.lanes[0]
+    pool.record_dispatched(lane0)
+    pool.record_done(lane0, False, 0.01, 1)  # threshold 1: lane 0 opens
+    assert lane0.breaker.state == CircuitBreaker.OPEN
+    # the pool still admits (lane 1 is healthy) and never picks lane 0
+    assert pool.admit() == (True, 0.0)
+    for _ in range(4):
+        lane, _ = pool.pick()
+        assert lane.index == 1
+        pool.record_dispatched(lane)
+        pool.record_done(lane, True, 0.01, 1)
+    assert pool.accepting_count() == 1
+    assert pool.state_name() == "degraded"
+    # cooldown over: lane 0 is idle (load 0) so it sorts first and the
+    # pick claims its half-open probe; success closes it
+    clock[0] = 6.0
+    lane, _ = pool.pick()
+    assert lane.index == 0
+    assert lane0.breaker.state == CircuitBreaker.HALF_OPEN
+    pool.record_dispatched(lane)
+    pool.record_done(lane, True, 0.01, 1)
+    assert lane0.breaker.state == CircuitBreaker.CLOSED
+    assert pool.state_name() == "closed"
+
+
+def test_admit_fails_fast_only_when_every_lane_cooling():
+    clock = [0.0]
+    pool = LanePool(
+        2,
+        breaker_factory=lambda: CircuitBreaker(
+            1, 5.0, clock=lambda: clock[0]
+        ),
+    )
+    for lane in pool.lanes:
+        pool.record_dispatched(lane)
+        pool.record_done(lane, False, 0.01, 1)
+    ok, retry = pool.admit()
+    assert not ok and retry > 0
+    assert pool.state_name() == "open"
+    lane, retry = pool.pick()
+    assert lane is None and retry > 0
+    clock[0] = 6.0  # cooldowns over: admit again (a probe can run)
+    assert pool.admit() == (True, 0.0)
+
+
+# ------------------------------------------------ breaker isolation (e2e)
+
+
+def test_one_sick_lane_never_fails_healthy_lane_requests():
+    """A runner that fails ONLY on lane 0 costs exactly the requests
+    scheduled there before its breaker opens (threshold 1 -> one); every
+    later submit serves from the surviving lane, and the pool never
+    fail-fasts a healthy request with BreakerOpen."""
+    clock = [0.0]
+    pool = LanePool(
+        2,
+        breaker_factory=lambda: CircuitBreaker(
+            1, 1000.0, clock=lambda: clock[0]
+        ),
+    )
+    served_on = []
+
+    def runner(key, images, lane=0):
+        if lane == 0:
+            raise RuntimeError("chip 0 is wedged")
+        served_on.append(lane)
+        return ["ok"] * len(images)
+
+    async def go():
+        d = BatchingDispatcher(
+            runner, max_batch=1, window_ms=0, pipeline_depth=1,
+            request_timeout_s=5.0, lane_pool=pool,
+        )
+        await d.start()
+        failures = 0
+        for i in range(10):
+            try:
+                assert await d.submit(_img(), f"k{i}") == "ok"
+            except RuntimeError:
+                failures += 1
+            except errors.BreakerOpen:
+                raise AssertionError(
+                    "pool fail-fasted while a healthy lane was serving"
+                )
+        # exactly the one pre-open pick of lane 0 failed
+        assert failures == 1
+        assert served_on and set(served_on) == {1}
+        assert pool.accepting_count() == 1
+        await d.stop()
+
+    asyncio.run(go())
+
+
+def _img():
+    return np.zeros((4, 4, 3), np.float32)
+
+
+# ------------------------------------------------------- lane-aware faults
+
+
+def test_lane_targeted_fault_spares_other_lanes_and_counts():
+    reg = FaultRegistry()
+    reg.arm("device.dispatch_error", "n2:1")
+    # a mismatching lane's consultation neither fires nor consumes
+    for _ in range(5):
+        assert reg.check("device.dispatch_error", where=0) is None
+    assert reg.check("device.dispatch_error", where=1) is not None
+    assert reg.check("device.dispatch_error", where=1) is not None
+    # n2 exhausted -> self-disarmed
+    assert reg.check("device.dispatch_error", where=1) is None
+    assert reg.snapshot()["injected"] == {"device.dispatch_error": 2}
+    # an untargeted spec still fires for any lane
+    reg.arm("device.dispatch_error", "n1")
+    assert reg.check("device.dispatch_error", where=3) is not None
+
+
+# ----------------------------------------------------- end-to-end serving
+
+
+def _boot_service(serve_lanes: str) -> ServiceFixture:
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        compilation_cache_dir="",
+        serve_lanes=serve_lanes,
+    )
+    return ServiceFixture(
+        cfg, service=DeconvService(cfg, spec=TINY, params=params)
+    )
+
+
+LAYERS = ("b1c1", "b1c2", "b2c1")
+
+
+def test_lane_parity_byte_identical_responses():
+    """THE parity pin: the same requests through a lanes=1 and a lanes=4
+    server produce byte-identical payloads — lane replication and
+    placement change WHERE a batch runs, never its bytes.  Sequential
+    requests round-robin the idle pool, so all four lanes actually
+    execute; a concurrent mixed-key burst then re-checks parity under
+    real multi-lane scheduling."""
+    with _boot_service("off") as ref, _boot_service("4") as laned:
+        assert laned.service.lane_count == 4
+        assert ref.service.lane_count == 1
+        reqs = [
+            (layer, _data_url(seed))
+            for layer in LAYERS
+            for seed in range(4)
+        ]
+
+        def fetch(base_url, layer, uri):
+            r = httpx.post(
+                base_url + "/",
+                data={"file": uri, "layer": layer},
+                headers={"cache-control": "no-store"},
+                timeout=60,
+            )
+            assert r.status_code == 200, r.text
+            return r.content
+
+        expect = {
+            (layer, uri): fetch(ref.base_url, layer, uri)
+            for layer, uri in reqs
+        }
+        for layer, uri in reqs:
+            assert fetch(laned.base_url, layer, uri) == expect[(layer, uri)]
+        # every lane executed at least one batch during the sweep
+        batches = laned.service.metrics.labeled("lane_batches_total")
+        assert set(batches) == {"0", "1", "2", "3"}, batches
+        # concurrent burst: mixed keys land on different lanes at once
+        results: dict = {}
+
+        def one(i):
+            layer, uri = reqs[i % len(reqs)]
+            results[i] = (layer, uri, fetch(laned.base_url, layer, uri))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 12
+        for layer, uri, body in results.values():
+            assert body == expect[(layer, uri)]
+
+
+def test_lane_warmup_compiles_every_lane_and_reports_wall():
+    with _boot_service("2") as s:
+        svc = s.service
+        assert svc.lane_count == 2
+        assert len(svc.bundle._lane_params) == 2
+        svc.cfg = svc.cfg  # warmup below uses the live config
+        svc.warmup("b2c1")
+        # per-lane visualizer cache entries (lane is the key's tail)
+        lanes_warmed = {k[-1] for k in svc.bundle._vis_cache}
+        assert lanes_warmed == {0, 1}
+        assert svc.warmup_wall_s is not None and svc.warmup_wall_s > 0
+        r = httpx.get(s.base_url + "/v1/config")
+        cfg = r.json()
+        assert cfg["serve_lanes_active"] == 2
+        assert cfg["warmup_wall_s"] == svc.warmup_wall_s
+        assert cfg["lanes"]["lanes"] == 2
+        assert len(cfg["lanes"]["per_lane"]) == 2
+        assert cfg["breaker_state"] == "closed"
+        r = httpx.get(s.base_url + "/readyz")
+        assert r.status_code == 200
+        assert r.json()["lanes"] == {"total": 2, "accepting": 2}
+
+
+def test_mesh_slice_lanes_compose_with_dp_sharding():
+    """serve_lanes=2 on 8 devices: each lane is a 4-device dp mesh, and
+    batches round up to the lane's dp multiple so every dispatch shards
+    evenly."""
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=8,
+        compilation_cache_dir="",
+        serve_lanes="2",
+        donate_inputs=False,
+    )
+    svc = DeconvService(cfg, spec=TINY, params=params)
+    assert svc.lane_count == 2 and svc._lane_dp == 4
+    assert svc._bucket_for(1) == 4  # rounded up to the lane's dp axis
+    from jax.sharding import Mesh
+
+    assert isinstance(svc.bundle.lane_placement(0), Mesh)
+    # one whole dispatch through each mesh-slice lane, identical bytes
+    img = svc.bundle.preprocess(
+        np.zeros((16, 16, 3), np.float32)
+    )
+    a = svc._run_batch(("b2c1", "all", 4, "grid"), [img], lane=0)[0]
+    b = svc._run_batch(("b2c1", "all", 4, "grid"), [img], lane=1)[0]
+    assert np.array_equal(np.asarray(a["grid"]), np.asarray(b["grid"]))
+
+
+def test_single_device_auto_resolves_single_stream():
+    """The pre-lane contract: serve_lanes left at auto on a single-chip
+    host (mesh_shape set here to force it) keeps one lane and the
+    original params object — no replication, no placement."""
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    cfg = ServerConfig(
+        image_size=16, compilation_cache_dir="", mesh_shape=(2,)
+    )
+    svc = DeconvService(cfg, spec=TINY, params=params)
+    assert svc.lane_count == 1
+    assert svc.bundle.lane_params(0) is svc.bundle.params
+    assert svc.breaker is svc.lane_pool.lanes[0].breaker
+
+
+def test_cadence_omitted_not_zero_in_loopback_row():
+    """The satellite fix: a metrics snapshot that never observed a
+    cadence reports 0.0, and the loopback row must OMIT the field
+    rather than publish a misleading 0.0 ms."""
+    m = Metrics()
+    m.observe_batch(size=1, compute_s=0.01, queue_s=0.0)
+    snap = m.snapshot()
+    assert snap["batch_cadence_p50_s"] == 0.0
+    # mirror of tools/loopback_load.py's row construction
+    server_row = {}
+    if snap["batch_cadence_p50_s"] > 0:
+        server_row["batch_cadence_p50_ms"] = round(
+            snap["batch_cadence_p50_s"] * 1e3, 2
+        )
+    assert "batch_cadence_p50_ms" not in server_row
+    m.observe_cadence(0.02)
+    snap = m.snapshot()
+    assert snap["batch_cadence_p50_s"] > 0

@@ -87,6 +87,13 @@ def _traffic(m: Metrics) -> None:
     m.set_gauge("codec_workers_live", 8)
     m.inc_labeled("faults_injected_total", "site", "codec.worker_raise")
     m.inc_labeled("task_restarts_total", "task", "dispatch")
+    # executor-lane series (round 10): per-lane labeled counters and
+    # gauges plus the pool-level imbalance gauge
+    m.inc_labeled("lane_batches_total", "lane", "0")
+    m.inc_labeled("lane_requests_total", "lane", "0", 4)
+    m.set_labeled_gauge("lane_inflight", "lane", "0", 1)
+    m.set_labeled_gauge("lane_breaker_state", "lane", "0", 0)
+    m.set_gauge("lane_imbalance", 1.0)
 
 
 def test_every_family_typed_once_and_labels_escape():
@@ -111,6 +118,15 @@ def test_every_family_typed_once_and_labels_escape():
     assert samples[
         ("deconv_task_restarts_total", 'task="dispatch"')
     ] == 1.0
+    # round-10 lane series carry TYPE headers and parse, with labeled
+    # GAUGES typed gauge (not counter)
+    assert families["deconv_lane_batches_total"] == "counter"
+    assert families["deconv_lane_requests_total"] == "counter"
+    assert families["deconv_lane_inflight"] == "gauge"
+    assert families["deconv_lane_breaker_state"] == "gauge"
+    assert families["deconv_lane_imbalance"] == "gauge"
+    assert samples[("deconv_lane_requests_total", 'lane="0"')] == 4.0
+    assert samples[("deconv_lane_inflight", 'lane="0"')] == 1.0
     # the raw quote must not appear unescaped inside any label block
     for line in text.splitlines():
         if "we" in line and "ird" in line:

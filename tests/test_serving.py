@@ -925,8 +925,10 @@ def test_warmup_sweep_precompiles_sweep_program():
     with ServiceFixture(cfg) as s:
         s.service.warmup()
         # the sweep executable is in the bundle's visualizer cache now
+        # (key: layer, mode, top_k, bug_compat, backward_dtype, post,
+        # sweep, donate, lane — sweep is index 6)
         sweep_keys = [
-            k for k in s.service.bundle._vis_cache if k[-1] is True
+            k for k in s.service.bundle._vis_cache if k[6] is True
         ]
         assert sweep_keys, "warmup did not compile a sweep program"
         warmed_layer = sweep_keys[0][0]

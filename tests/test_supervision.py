@@ -420,20 +420,35 @@ def test_readyz_all_checks_green(server):
 
 
 def test_readyz_flips_503_when_breaker_opens(server):
-    br = server.service.breaker
-    for _ in range(br.threshold):
-        br.record_failure()
+    """Per-lane breakers (round 10): one open lane leaves the pool READY
+    (degraded-not-dead — the scheduler routes around the sick chip);
+    only a pool with EVERY lane open-and-cooling flips /readyz 503."""
+    pool = server.service.lane_pool
+    breakers = [lane.breaker for lane in pool.lanes]
+    assert len(breakers) > 1  # the 8-device test env resolves auto lanes
     try:
+        for _ in range(breakers[0].threshold):
+            breakers[0].record_failure()
+        r = httpx.get(server.base_url + "/readyz")
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["checks"]["breaker_not_open"] is True
+        # the degraded window is VISIBLE, not hidden behind the green bit
+        assert body["lanes"]["accepting"] == len(breakers) - 1
+        for br in breakers[1:]:
+            for _ in range(br.threshold):
+                br.record_failure()
         r = httpx.get(server.base_url + "/readyz")
         assert r.status_code == 503
         assert r.json()["checks"]["breaker_not_open"] is False
         # liveness is unaffected: restarting would not fix an open breaker
         assert httpx.get(server.base_url + "/healthz").status_code == 200
     finally:
-        # close it again the legitimate way: cooldown probe + success
-        br._opened_at = -1e9
-        assert br.allow()[0]
-        br.record_success()
+        # close them again the legitimate way: cooldown probe + success
+        for br in breakers:
+            br._opened_at = -1e9
+            assert br.allow()[0]
+            br.record_success()
     assert httpx.get(server.base_url + "/readyz").status_code == 200
 
 

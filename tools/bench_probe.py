@@ -109,7 +109,7 @@ def main() -> None:
     from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
 
     cfg = ServerConfig.from_env()
-    enable_compilation_cache(cfg)
+    enable_compilation_cache(cfg, bench_default=True)
     dev = jax.devices()[0]
     print(f"device: {dev}", flush=True)
 

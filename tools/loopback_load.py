@@ -16,7 +16,33 @@ bench_suite_results.jsonl via tools/run_experiments.py
 Usage: python tools/loopback_load.py [--passes N] [--no-donate]
            [--key-dist unique|zipf:<s>|hotset:<k>] [--requests N]
            [--trace-ring N] [--slow-ms F] [--dump-slow PATH]
-           [--chaos site=spec,...] [--pool-decode] [depth ...]
+           [--chaos site=spec,...] [--pool-decode] [--lanes N]
+           [--compile-cache-dir DIR] [--heavy] [depth ...]
+
+Round 10 added `--lanes N`: the process forces N virtual CPU devices
+(XLA_FLAGS --xla_force_host_platform_device_count, set before jax
+initialises) and the server runs N executor lanes — per-chip dispatch
+streams with least-loaded batch scheduling (serving/batcher.py
+LanePool).  The row gains a `lanes` block: requests/batches executed
+per lane and the imbalance ratio (max/mean — 1.0 is perfectly
+balanced).  `tools/run_bench_suite.py`'s `lanes` token records the
+lanes=4 vs lanes=1 zipf A/B this was built for.  Under `--chaos` with
+lanes, the forced device burst becomes LANE-TARGETED
+(`device.dispatch_error=n8:0` — only lane 0's dispatches fail): the
+drill then pins that requests scheduled on healthy lanes never fail
+(the collateral count) and that the pool recovers to full lane quorum
+after disarm.
+
+`--heavy` swaps the tiny spec for a compute-heavy one (64px, six convs
+of 48..128 filters) and spreads requests across SIX layers — i.e. six
+distinct compiled programs sharing the batcher, the recorded zipf pathology
+(batch_size_p50 collapse, per-key groups serializing).  The default
+tiny spec measures the HOST pipeline (device time negligible, the
+~1 ms/request loopback floor); `--heavy` measures the DISPATCH path,
+which is what lanes parallelize — a lanes A/B on the tiny spec can
+only show host-floor noise.  Pair it with DECONV_CACHE_BYTES=0 so
+every request actually dispatches (steady-state zipf traffic with the
+response cache on is ~95% hits, i.e. host-bound again).
 
 Round 9 added `--chaos site=spec,...`: the faults are armed at server
 startup (serving/faults.py grammar, e.g. `codec.worker_raise=p0.05`),
@@ -126,7 +152,10 @@ EXPECTED_FAULT_CODES = frozenset(
 
 # The forced device burst of the chaos drill: enough consecutive
 # dispatch errors to open the default-threshold (5) circuit breaker.
+# With lanes the burst is TARGETED at lane 0 (`:0`): only that lane's
+# dispatches fail, so healthy lanes must keep serving cleanly.
 CHAOS_BURST = "device.dispatch_error=n8"
+CHAOS_BURST_LANE0 = "device.dispatch_error=n8:0"
 
 
 def _resp_meta(raw: bytes) -> tuple[str, str]:
@@ -204,10 +233,23 @@ def run_load(
     dump_slow: str | None = None,
     chaos: str | None = None,
     pool_decode: bool = False,
+    lanes: int | None = None,
+    compile_cache_dir: str = "",
+    heavy: bool = False,
 ) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if lanes and lanes > 1 and jax.device_count() != lanes:
+        # EXACTLY one device per lane, or the row's label lies: an
+        # inherited XLA_FLAGS forcing a different device count would
+        # silently turn the A/B into mesh-slice lanes
+        raise RuntimeError(
+            f"--lanes {lanes} needs exactly {lanes} devices but jax sees "
+            f"{jax.device_count()} — unset any inherited "
+            "xla_force_host_platform_device_count (main() sets it only "
+            "when absent)"
+        )
     import numpy as np
     from PIL import Image
 
@@ -217,19 +259,45 @@ def run_load(
 
     # VGG-shaped but tiny: 32x32, three convs + two pools — compiles in
     # seconds on CPU, runs in microseconds, leaving codec+dispatcher as
-    # the measured quantity.
-    spec = ModelSpec(
-        name="loopback_tiny",
-        input_shape=(32, 32, 3),
-        layers=(
-            Layer("input_1", "input"),
-            Layer("c1", "conv", activation="relu", filters=16),
-            Layer("p1", "pool"),
-            Layer("c2", "conv", activation="relu", filters=32),
-            Layer("p2", "pool"),
-            Layer("c3", "conv", activation="relu", filters=32),
-        ),
-    )
+    # the measured quantity.  --heavy widens it to ~65 ms per batch-8
+    # execution (measured), so the DEVICE dispatch path dominates and a
+    # lanes A/B measures scheduling, not the host floor.
+    if heavy:
+        spec = ModelSpec(
+            name="loopback_heavy",
+            input_shape=(64, 64, 3),
+            layers=(
+                Layer("input_1", "input"),
+                Layer("c1", "conv", activation="relu", filters=48),
+                Layer("c2", "conv", activation="relu", filters=64),
+                Layer("p1", "pool"),
+                Layer("c3", "conv", activation="relu", filters=96),
+                Layer("c4", "conv", activation="relu", filters=96),
+                Layer("p2", "pool"),
+                Layer("c5", "conv", activation="relu", filters=128),
+                Layer("c6", "conv", activation="relu", filters=128),
+            ),
+        )
+        # requests spread across SIX layers = six distinct compiled
+        # programs contending for dispatch (the zipf mixed-key
+        # pathology: a drain window splits into per-key groups that a
+        # single stream serializes)
+        layer_pool = ("c1", "c2", "c3", "c4", "c5", "c6")
+    else:
+        spec = ModelSpec(
+            name="loopback_tiny",
+            input_shape=(32, 32, 3),
+            layers=(
+                Layer("input_1", "input"),
+                Layer("c1", "conv", activation="relu", filters=16),
+                Layer("p1", "pool"),
+                Layer("c2", "conv", activation="relu", filters=32),
+                Layer("p2", "pool"),
+                Layer("c3", "conv", activation="relu", filters=32),
+            ),
+        )
+        layer_pool = ("c3",)
+    size = spec.input_shape[0]
     params = init_params(spec, jax.random.PRNGKey(0))
     cache_on = key_dist is not None
     trace_kw = {}
@@ -254,28 +322,43 @@ def run_load(
         # tools/run_bench_suite.py must be apples to apples).
         trace_kw.update(codec_inline_bytes=0)
     cfg = ServerConfig(
-        image_size=32,
+        image_size=size,
         max_batch=32,
         batch_window_ms=5.0,
         pipeline_depth=pipeline_depth,
         warmup_all_buckets=True,
-        compilation_cache_dir="",
+        # default off (hermetic rows); the bench suite's compile-cache
+        # token passes a shared temp dir for its cold/warm warmup A/B
+        compilation_cache_dir=compile_cache_dir,
         platform="cpu",
         donate_inputs=donate,
+        # explicit lane count ('off' without --lanes): rows must stay
+        # comparable run-to-run regardless of inherited XLA_FLAGS
+        serve_lanes=str(lanes) if lanes else "off",
         # legacy mode reuses 8 images; the cache would serve them and the
         # row would stop measuring the decode->dispatch->encode machinery
         cache_bytes=cfg_cache_bytes() if cache_on else 0,
-        singleflight=cache_on,
+        # DECONV_SINGLEFLIGHT=0 opts a key-dist run out of coalescing
+        # (the lanes A/B wants every request to DISPATCH: coalesced
+        # duplicates add host work but no device work, hiding the
+        # dispatch-path scaling under test)
+        singleflight=cache_on and ServerConfig.from_env().singleflight,
         **trace_kw,
     )
     service = DeconvService(cfg, spec=spec, params=params)
+    if compile_cache_dir:
+        # the loopback specs' per-program compiles sit under the server's
+        # 0.5 s persistence threshold; cache everything here so the
+        # cold/warm A/B measures the MECHANISM (real TPU serving compiles
+        # all clear that bar on their own)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     rng = np.random.default_rng(0)
     streams = _key_streams(key_dist, n_requests, max(1, passes), rng)
     uris: dict[int, str] = {}
     for idx in sorted({i for stream in streams for i in stream}):
         img = Image.fromarray(
-            np.random.default_rng(idx).integers(0, 255, (32, 32, 3), np.uint8),
+            np.random.default_rng(idx).integers(0, 255, (size, size, 3), np.uint8),
             "RGB",
         )
         buf = io.BytesIO()
@@ -288,12 +371,20 @@ def run_load(
         import urllib.parse
 
         port = await service.start(host="127.0.0.1", port=0)
-        await asyncio.to_thread(service.warmup, "c3")
+        for ln in layer_pool:
+            # every layer a request can name must be warm on every lane,
+            # or the measurement pays request-time compiles
+            await asyncio.to_thread(service.warmup, ln)
         sem = asyncio.Semaphore(concurrency)
 
         async def one(i: int, indices: list[int], samples: list[tuple]):
             body = urllib.parse.urlencode(
-                {"file": uris[indices[i]], "layer": "c3"}
+                {
+                    "file": uris[indices[i]],
+                    # heavy mode: the image key also picks the layer, so
+                    # the batcher sees per-layer groups contending
+                    "layer": layer_pool[indices[i] % len(layer_pool)],
+                }
             ).encode()
             async with sem:
                 t0 = time.perf_counter()
@@ -324,23 +415,29 @@ def run_load(
         # later passes run against the warm cache — the steady state a
         # hot-key workload actually serves in; pass 1 carries the
         # cold-fill mixture and stays visible in passes_req_s.
-        async def readyz_poller(statuses: list[int]):
+        burst = CHAOS_BURST_LANE0 if (lanes and lanes > 1) else CHAOS_BURST
+
+        async def readyz_poller(statuses: list[tuple]):
             while True:
-                s, _ = await _http(port, "GET", "/readyz")
-                statuses.append(s)
+                s, payload = await _http(port, "GET", "/readyz")
+                accepting = None
+                if isinstance(payload, dict) and "lanes" in payload:
+                    accepting = payload["lanes"].get("accepting")
+                statuses.append((s, accepting))
                 await asyncio.sleep(0.025)
 
         runs = []
-        readyz_seen: list[int] = []
+        readyz_seen: list[tuple] = []
         for p, indices in enumerate(streams):
             poller = None
             if chaos and len(streams) > 1 and p == len(streams) - 1:
                 # the forced device burst rides the FINAL chaos pass,
                 # armed through the live debug endpoint (exercising it
-                # end to end); the poller watches /readyz flip while the
+                # end to end); the poller watches /readyz flip — or,
+                # with lanes, the accepting-lane count dip — while the
                 # breaker holds the degraded window open
                 s, _ = await _http(
-                    port, "POST", "/v1/debug/faults", {"arm": CHAOS_BURST}
+                    port, "POST", "/v1/debug/faults", {"arm": burst}
                 )
                 assert s == 200, f"fault arm endpoint answered {s}"
                 poller = asyncio.create_task(readyz_poller(readyz_seen))
@@ -361,8 +458,10 @@ def run_load(
         if chaos:
             # final /readyz sample: the breaker may still be holding the
             # degraded window open right after the burst pass
-            s, _ = await _http(port, "GET", "/readyz")
-            readyz_seen.append(s)
+            s, payload = await _http(port, "GET", "/readyz")
+            readyz_seen.append(
+                (s, (payload or {}).get("lanes", {}).get("accepting"))
+            )
             # error-budget split across every chaos pass: a chaos run is
             # healthy when errors are the EXPECTED fail-fast kinds and
             # nothing waited out the full request timeout
@@ -415,13 +514,23 @@ def run_load(
                 rsamples_all.append(rsamples)
             rwall = min(recovery_walls)
             rsamples = [s for ss in rsamples_all for s in ss]
+            # The degraded window's observable: single-stream = /readyz
+            # flipping 503 (every dispatch fails fast); lanes = the
+            # accepting-lane count dipping below the pool size while
+            # /readyz correctly STAYS 200 (degraded, not dead).
+            degraded_observed = any(s == 503 for s, _ in readyz_seen) or (
+                bool(lanes and lanes > 1)
+                and any(
+                    acc is not None and acc < lanes for _, acc in readyz_seen
+                )
+            )
             chaos_report = {
                 "armed": chaos,
-                "burst": CHAOS_BURST,
+                "burst": burst,
                 "split": split,
                 "collateral_codes": collateral_codes,
                 "max_client_ms": round(max_ms, 1),
-                "readyz_degraded_observed": 503 in readyz_seen,
+                "readyz_degraded_observed": degraded_observed,
                 "readyz_polls": len(readyz_seen),
                 "probe_recovered": recovered,
                 "readyz_after_recovery": ready_after,
@@ -435,6 +544,13 @@ def run_load(
                 "codec_workers": service.codec_pool.workers,
                 "codec_workers_live": service.codec_pool.live_workers,
             }
+            if lanes and lanes > 1:
+                # full lane quorum after recovery: the burst lane's
+                # breaker must have closed through its half-open probe
+                chaos_report["lanes_total"] = service.lane_pool.size
+                chaos_report["lanes_accepting_after_recovery"] = (
+                    service.lane_pool.accepting_count()
+                )
         snap = service.metrics.snapshot()
         dump = None
         if dump_slow:
@@ -500,12 +616,12 @@ def run_load(
             "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
             "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 2),
             "per_request_overhead_ms": round(wall / n_requests * 1e3, 3),
+            # every compile the serving path needs, end to end — the
+            # number the persistent compile cache attacks on restart
+            "warmup_wall_s": service.warmup_wall_s,
             "server": {
                 "batches_total": snap["batches_total"],
                 "batch_size_p50": round(snap["batch_size_p50"], 1),
-                "batch_cadence_p50_ms": round(
-                    snap["batch_cadence_p50_s"] * 1e3, 2
-                ),
                 "queue_wait_p50_ms": round(snap["queue_wait_p50_s"] * 1e3, 2),
                 "stages_p50_ms": {
                     k: round(v["p50_s"] * 1e3, 2)
@@ -514,6 +630,34 @@ def run_load(
                 "gauges": snap["gauges"],
             },
         }
+        # cadence needs >= 2 completions under sustained load to exist;
+        # a run that never observed one OMITS the field — the old 0.0
+        # read as "zero ms between batches", a lie (r10 satellite fix)
+        if snap["batch_cadence_p50_s"] > 0:
+            row["server"]["batch_cadence_p50_ms"] = round(
+                snap["batch_cadence_p50_s"] * 1e3, 2
+            )
+        if lanes:
+            req_by_lane = snap["labeled"].get(
+                "lane_requests_total", ("lane", {})
+            )[1]
+            batch_by_lane = snap["labeled"].get(
+                "lane_batches_total", ("lane", {})
+            )[1]
+            vals = [req_by_lane.get(str(i), 0) for i in range(lanes)]
+            mean = sum(vals) / max(1, len(vals))
+            row["lanes"] = {
+                "count": service.lane_pool.size,
+                "requests_per_lane": vals,
+                "batches_per_lane": [
+                    batch_by_lane.get(str(i), 0) for i in range(lanes)
+                ],
+                "imbalance_ratio": (
+                    round(max(vals) / mean, 3) if mean > 0 else None
+                ),
+                "accepting": service.lane_pool.accepting_count(),
+                "imbalance_gauge": snap["gauges"].get("lane_imbalance"),
+            }
         if cache_on:
             # hit/miss/coalesced split, client side (best pass) + server
             # counters across all passes
@@ -558,6 +702,13 @@ def run_load(
                     row["cache"][f"{name}_p99_ms"] = round(
                         ks[int(len(ks) * 0.99)] * 1e3, 3
                     )
+        if heavy:
+            row["which"] += "_heavy"
+            row["heavy"] = True
+        if lanes:
+            # after the cache block's which rename, so every mode's row
+            # carries the lane count in its token
+            row["which"] += f"_lanes{lanes}"
         if chaos_report is not None:
             row["which"] += "_chaos"
             row["chaos"] = chaos_report
@@ -602,6 +753,10 @@ def main() -> int:
     dump_slow: str | None = None
     chaos: str | None = None
     pool_decode = False
+    lanes: int | None = None
+    compile_cache_dir = ""
+    heavy = False
+    concurrency = 64
     depths: list[int] = []
     i = 0
     while i < len(args):
@@ -632,9 +787,32 @@ def main() -> int:
         elif args[i] == "--pool-decode":
             pool_decode = True
             i += 1
+        elif args[i] == "--lanes":
+            lanes = int(args[i + 1])
+            i += 2
+        elif args[i] == "--compile-cache-dir":
+            compile_cache_dir = args[i + 1]
+            i += 2
+        elif args[i] == "--heavy":
+            heavy = True
+            i += 1
+        elif args[i] == "--concurrency":
+            concurrency = int(args[i + 1])
+            i += 2
         else:
             depths.append(int(args[i]))
             i += 1
+    if lanes is not None and lanes < 1:
+        print("--lanes needs a count >= 1", file=sys.stderr)
+        return 2
+    if lanes and lanes > 1:
+        # must land before jax initialises its backends (run_load's
+        # first jax import): N virtual CPU devices = N one-chip lanes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={lanes}"
+            ).strip()
     if dump_slow and trace_ring == 0:
         print(
             "--dump-slow needs the trace spine; drop --trace-ring 0",
@@ -660,6 +838,8 @@ def main() -> int:
             d, n_requests=n_requests, passes=passes, donate=donate,
             key_dist=key_dist, trace_ring=trace_ring, slow_ms=slow_ms,
             dump_slow=dump_slow, chaos=chaos, pool_decode=pool_decode,
+            lanes=lanes, compile_cache_dir=compile_cache_dir, heavy=heavy,
+            concurrency=concurrency,
         )
         print(json.dumps(row), flush=True)
     return 0

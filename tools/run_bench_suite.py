@@ -45,8 +45,14 @@ TRACE_OVERHEAD_BUDGET_PCT = 3.0
 # baseline — capacity that does not self-restore is a supervision bug.
 CHAOS_RECOVERY_BUDGET_PCT = 5.0
 
+# Executor-lane A/B budget (round 10): zipf mixed-key loopback
+# throughput with lanes=4 must beat the same-day lanes=1 baseline by at
+# least this factor — anything less means the lane scheduler is not
+# actually spreading the key mix across chips.
+LANES_SPEEDUP_BUDGET = 1.4
 
-def run_chaos_guard(timeout_s: float = 900.0) -> dict:
+
+def run_chaos_guard(timeout_s: float = 900.0, lanes: int | None = None) -> dict:
     """The end-to-end chaos drill (round 9): codec workers dying at
     p=0.05 plus a forced device.dispatch_error burst mid-run (armed via
     the live debug endpoint, opening the circuit breaker), then a
@@ -54,8 +60,17 @@ def run_chaos_guard(timeout_s: float = 900.0) -> dict:
     the drill sees collateral errors, a request that waited anywhere
     near the full 60 s timeout, a /readyz that never reflected the
     degraded window, or recovered throughput more than
-    CHAOS_RECOVERY_BUDGET_PCT below the same-day no-fault baseline."""
+    CHAOS_RECOVERY_BUDGET_PCT below the same-day no-fault baseline.
+
+    ``lanes`` (round 10, the `chaos-lanes` token) runs the drill on a
+    multi-lane pool: the device burst becomes LANE-TARGETED (only lane
+    0's dispatches fail), so the collateral count now also pins that
+    requests scheduled on healthy lanes never fail, and the row
+    additionally fails loudly if the pool does not recover to FULL lane
+    quorum after disarm."""
     base = ["--passes", "2", "2"]
+    if lanes:
+        base = ["--lanes", str(lanes), *base]
     loopback = os.path.join(REPO, "tools", "loopback_load.py")
     env = {"JAX_PLATFORMS": "cpu"}
     chaos = run_cmd_json(
@@ -70,7 +85,14 @@ def run_chaos_guard(timeout_s: float = 900.0) -> dict:
     baseline = run_cmd_json(
         [sys.executable, loopback, "--pool-decode", *base], timeout_s, env=env
     )
-    row = {"config": "chaos", "which": "loopback_chaos_drill"}
+    row = {
+        "config": "chaos-lanes" if lanes else "chaos",
+        "which": (
+            f"loopback_chaos_drill_lanes{lanes}"
+            if lanes
+            else "loopback_chaos_drill"
+        ),
+    }
     if "error" in chaos or "error" in baseline:
         row["error"] = chaos.get("error") or baseline.get("error")
         return row
@@ -94,6 +116,15 @@ def run_chaos_guard(timeout_s: float = 900.0) -> dict:
         codec_workers=rep.get("codec_workers"),
         codec_workers_live=rep.get("codec_workers_live"),
     )
+    if lanes:
+        row.update(
+            burst=rep.get("burst"),
+            lanes_total=rep.get("lanes_total"),
+            lanes_accepting_after_recovery=rep.get(
+                "lanes_accepting_after_recovery"
+            ),
+            lane_occupancy=chaos.get("lanes"),
+        )
     problems = []
     if rep.get("split", {}).get("collateral", 1):
         problems.append(f"collateral errors: {rep.get('collateral_codes')}")
@@ -109,6 +140,11 @@ def run_chaos_guard(timeout_s: float = 900.0) -> dict:
         problems.append(f"{rep['recovery_errors']} errors in the recovery pass")
     if rep.get("codec_workers_live", 0) < rep.get("codec_workers", 1):
         problems.append("codec pool capacity did not self-restore")
+    if lanes and rep.get("lanes_accepting_after_recovery", 0) < lanes:
+        problems.append(
+            f"pool recovered to {rep.get('lanes_accepting_after_recovery')}"
+            f"/{lanes} lanes (full quorum required)"
+        )
     if delta > CHAOS_RECOVERY_BUDGET_PCT:
         problems.append(
             f"recovered throughput {delta:.1f}% below baseline "
@@ -116,6 +152,100 @@ def run_chaos_guard(timeout_s: float = 900.0) -> dict:
         )
     if problems:
         row["error"] = "; ".join(problems)
+    return row
+
+
+def run_lanes_guard(timeout_s: float = 1800.0) -> dict:
+    """Executor-lane A/B (round 10): the zipf mixed-key DISPATCH
+    workload — `--heavy` (six distinct compiled programs contending,
+    device-bound batches: the recorded pathology whose batch_size_p50
+    collapsed and whose per-key groups serialized on one stream) with
+    the response cache OFF so every request actually dispatches — run
+    with lanes=4 vs lanes=1 on a 4-virtual-device CPU mesh.  The tiny
+    host-path spec cannot carry this A/B: its requests bound on the
+    ~1 ms/request loopback HTTP floor, which lanes do not touch.  The
+    row records both rates, the speedup, and the lanes=4 occupancy
+    split; speedup under LANES_SPEEDUP_BUDGET gets a loud `error`
+    field.  (Byte-identical response parity between lanes=1 and
+    lanes=4 is pinned separately by tests/test_lanes.py.)
+
+    Singleflight is also off (DECONV_SINGLEFLIGHT=0): coalesced zipf
+    duplicates add host work but no device work, and the A/B measures
+    the device dispatch path.  Concurrency 192 keeps the single-stream
+    side saturated (its queue, not the client pool, must be the
+    bottleneck being fixed)."""
+    base = [
+        "--heavy", "--key-dist", "zipf:1.1", "--passes", "3",
+        "--requests", "768", "--concurrency", "192", "2",
+    ]
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "DECONV_CACHE_BYTES": "0",
+        "DECONV_SINGLEFLIGHT": "0",
+    }
+    on = run_cmd_json(
+        [sys.executable, loopback, "--lanes", "4", *base], timeout_s, env=env
+    )
+    off = run_cmd_json(
+        [sys.executable, loopback, "--lanes", "1", *base], timeout_s, env=env
+    )
+    row = {"config": "lanes", "which": "loopback_lanes_ab_zipf"}
+    if "error" in on or "error" in off:
+        row["error"] = on.get("error") or off.get("error")
+        return row
+    on_rs, off_rs = on["requests_per_sec"], off["requests_per_sec"]
+    speedup = on_rs / off_rs if off_rs else 0.0
+    row.update(
+        lanes4_req_s=on_rs,
+        lanes1_req_s=off_rs,
+        lanes4_passes=on.get("passes_req_s"),
+        lanes1_passes=off.get("passes_req_s"),
+        lanes4_batch_size_p50=on.get("server", {}).get("batch_size_p50"),
+        lanes1_batch_size_p50=off.get("server", {}).get("batch_size_p50"),
+        lanes4_p50_ms=on.get("p50_ms"),
+        lanes1_p50_ms=off.get("p50_ms"),
+        lane_occupancy=on.get("lanes"),
+        speedup=round(speedup, 3),
+        budget=LANES_SPEEDUP_BUDGET,
+    )
+    if speedup < LANES_SPEEDUP_BUDGET:
+        row["error"] = (
+            f"lanes=4 speedup {speedup:.2f}x under the "
+            f"{LANES_SPEEDUP_BUDGET:.1f}x budget on the zipf workload"
+        )
+    return row
+
+
+def run_compile_cache_guard(timeout_s: float = 900.0) -> dict:
+    """Cold vs warm startup A/B (round 10 satellite): the same loopback
+    boot twice against one persistent XLA compile-cache dir — run 1
+    pays every warmup compile (cold), run 2 loads them from the cache
+    (warm).  The row records both warmup walls and the speedup; no
+    budget, it is a recorded comparison (the tax varies by backend)."""
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="deconv-compile-cache-ab-")
+    base = [
+        "--requests", "64", "--passes", "1",
+        "--compile-cache-dir", cache_dir, "2",
+    ]
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    cold = run_cmd_json([sys.executable, loopback, *base], timeout_s, env=env)
+    warm = run_cmd_json([sys.executable, loopback, *base], timeout_s, env=env)
+    row = {"config": "compile-cache", "which": "loopback_compile_cache_cold_warm"}
+    if "error" in cold or "error" in warm:
+        row["error"] = cold.get("error") or warm.get("error")
+        return row
+    cold_s, warm_s = cold.get("warmup_wall_s"), warm.get("warmup_wall_s")
+    row.update(
+        cold_warmup_s=cold_s,
+        warm_warmup_s=warm_s,
+        warmup_speedup=(
+            round(cold_s / warm_s, 2) if cold_s and warm_s else None
+        ),
+    )
     return row
 
 
@@ -224,7 +354,7 @@ def run_one(n: int, timeout_s: float, env: dict | None = None) -> dict:
     code = (
         "import json, sys\n"
         "from deconv_api_tpu.config import ServerConfig, enable_compilation_cache\n"
-        "enable_compilation_cache(ServerConfig.from_env())\n"
+        "enable_compilation_cache(ServerConfig.from_env(), bench_default=True)\n"
         "from deconv_api_tpu.bench.suite import run_config\n"
         f"print(json.dumps(run_config({n})), flush=True)\n"
     )
@@ -361,6 +491,22 @@ def main() -> int:
             # disarm, throughput must return within the budget
             result = run_chaos_guard()
             result["date"] = date
+        elif tok == "chaos-lanes":
+            # lane-targeted chaos drill (round 10): one lane's burst must
+            # cost zero collateral on healthy lanes, pool back to full
+            # quorum within the recovery budget
+            result = run_chaos_guard(lanes=4)
+            result["date"] = date
+        elif tok == "lanes":
+            # executor-lane A/B (round 10): zipf lanes=4 vs lanes=1,
+            # loud error under the speedup budget
+            result = run_lanes_guard()
+            result["date"] = date
+        elif tok == "compile-cache":
+            # persistent-compile-cache A/B (round 10): cold vs warm
+            # warmup wall against one cache dir
+            result = run_compile_cache_guard()
+            result["date"] = date
         elif tok in LOOPBACK_CONFIGS:
             # host-side loopback workload: CPU backend, no tunnel needed
             result = run_loopback(tok)
@@ -371,7 +517,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache'])}",
             }
         else:
             n = int(tok)

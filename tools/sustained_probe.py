@@ -45,7 +45,7 @@ def main() -> None:
     from deconv_api_tpu.engine.deconv import get_forward_only
     from deconv_api_tpu.models.vgg16 import vgg16_init
 
-    enable_compilation_cache(ServerConfig.from_env())
+    enable_compilation_cache(ServerConfig.from_env(), bench_default=True)
     print(f"device: {jax.devices()[0]}", flush=True)
 
     spec, params = vgg16_init()

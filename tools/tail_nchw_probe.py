@@ -41,7 +41,7 @@ def main() -> None:
     from deconv_api_tpu.engine import get_visualizer
     from deconv_api_tpu.models.vgg16 import vgg16_init
 
-    enable_compilation_cache(ServerConfig.from_env())
+    enable_compilation_cache(ServerConfig.from_env(), bench_default=True)
     print(f"device: {jax.devices()[0]}", file=sys.stderr, flush=True)
 
     spec, params = vgg16_init()
