@@ -37,6 +37,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "trace_ring", "trace_slow_ms", "trace_sample",
         "fault_seed", "breaker_threshold", "breaker_cooldown_s",
         "drain_grace_s", "lanes", "compile_cache_dir",
+        "jobs_dir", "jobs_workers", "jobs_queue_depth",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -311,6 +312,21 @@ def main(argv: list[str] | None = None) -> int:
         metavar="DIR",
         help="persistent XLA compilation cache (default off); warm "
         "restarts skip the warmup compile tax",
+    )
+    s.add_argument(
+        "--jobs-dir", default=None, dest="jobs_dir", metavar="DIR",
+        help="enable the durable async job subsystem (POST /v1/jobs): "
+        "write-ahead journal + checkpoint spill files live here "
+        "(default off)",
+    )
+    s.add_argument(
+        "--jobs-workers", type=int, default=None, dest="jobs_workers",
+        help="concurrent job runner tasks (default 2)",
+    )
+    s.add_argument(
+        "--jobs-queue-depth", type=int, default=None, dest="jobs_queue_depth",
+        help="queued-or-running jobs admitted before submits 429 "
+        "(default 64)",
     )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)

@@ -153,6 +153,31 @@ class ServerConfig:
     # and stop routing before connections start dying.  0 = immediate
     # (tests, dev loops); set to ~2x the LB probe interval in k8s.
     drain_grace_s: float = 0.0
+    # --- durable async jobs (round 11: serving/jobs.py) ---
+    # Directory for the job subsystem's write-ahead journal
+    # (journal.jsonl) and checkpoint/result spill files.  Empty =
+    # DISABLED: no /v1/jobs routes, no runner tasks, zero cost on the
+    # synchronous path.  Heavy dream configs and layer sweeps run for
+    # seconds on-chip — hostile to synchronous HTTP, x-deadline-ms and
+    # LB idle timeouts; POST /v1/jobs + SSE progress is the durable
+    # alternative (crash-safe: execution checkpoints at octave/layer
+    # boundaries and resumes from the journal after a crash or restart).
+    jobs_dir: str = ""
+    # Queued-or-running jobs the subsystem will hold; a full queue 429s
+    # new submissions with a Retry-After derived from the EWMA job cost.
+    jobs_queue_depth: int = 64
+    # Concurrent job runner tasks (each job's device work still rides
+    # the shared dispatchers/LanePool — this bounds how many jobs make
+    # progress at once, not device parallelism).
+    jobs_workers: int = 2
+    # Completed/failed/cancelled job records (and their result payloads)
+    # survive this long across boots before compaction drops them —
+    # the idempotent-resubmit and late-GET window.
+    jobs_retention_s: float = 3600.0
+    # Runner-crash resume budget per job: a job that crashes (not a
+    # deterministic taxonomy failure) re-queues and resumes from its
+    # last checkpoint at most this many times before failing for good.
+    jobs_max_attempts: int = 3
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

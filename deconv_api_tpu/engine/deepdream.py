@@ -169,6 +169,34 @@ def make_octave_runner(
     return lambda params, x, base: fn(params, x, base, steps, lr)
 
 
+def octave_shapes(
+    h: int,
+    w: int,
+    num_octaves: int,
+    octave_scale: float = 1.4,
+    min_size: int = 75,
+) -> tuple[tuple[int, int], ...]:
+    """The octave ladder — smallest scale first, full resolution last.
+
+    Octaves whose smaller edge would fall under ``min_size`` (the trunk's
+    minimum input) are dropped; an image too small for any scaled octave
+    gets a one-rung ladder at its own resolution.  ONE definition shared
+    by ``deepdream_batch`` (the fused whole-dream program) and the
+    serving job runner (round 11), whose checkpointed octave-by-octave
+    execution must walk exactly this ladder — a drifted ladder would
+    break resume-from-checkpoint parity."""
+    shapes: list[tuple[int, int]] = []
+    for i in range(num_octaves):
+        s = octave_scale ** (num_octaves - 1 - i)
+        oh, ow = int(round(h / s)), int(round(w / s))
+        if min(oh, ow) < min_size:
+            continue
+        shapes.append((oh, ow))
+    if not shapes:
+        shapes = [(h, w)]
+    return tuple(shapes)
+
+
 def _resize(x: jnp.ndarray, hw: tuple[int, int]) -> jnp.ndarray:
     return jax.image.resize(
         x, (x.shape[0], hw[0], hw[1], x.shape[-1]), method="bilinear"
@@ -288,15 +316,9 @@ def deepdream_batch(
     """
     base = images.astype(jnp.float32)
     h, w = base.shape[1:3]
-    shapes: list[tuple[int, int]] = []
-    for i in range(num_octaves):
-        s = octave_scale ** (num_octaves - 1 - i)
-        oh, ow = int(round(h / s)), int(round(w / s))
-        if min(oh, ow) < min_size:
-            continue
-        shapes.append((oh, ow))
-    if not shapes:
-        shapes = [(h, w)]
+    shapes = octave_shapes(
+        h, w, num_octaves, octave_scale=octave_scale, min_size=min_size
+    )
 
     # The WHOLE pyramid — every octave's resize + detail reinjection +
     # ascent loop — is one jitted program: a dream is ONE device dispatch

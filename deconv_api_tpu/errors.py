@@ -139,6 +139,28 @@ class BreakerOpen(DeconvError):
         self.retry_after_s = retry_after_s
 
 
+class JobQueueFull(DeconvError):
+    """The async job queue is at capacity (round 11): admitting more
+    submissions would only let them rot past their deadlines, so the
+    submit 429s with a ``Retry-After`` derived from the queue depth and
+    the EWMA job cost (the PR 5 lane cost signal)."""
+
+    status = 429
+    code = "job_queue_full"
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class JobNotFound(DeconvError):
+    """No such job id: never submitted, or compacted out after its
+    retention window (round 11 job subsystem)."""
+
+    status = 404
+    code = "job_not_found"
+
+
 class FaultInjected(DeconvError):
     """An armed fault-injection site fired (serving/faults.py).  Its own
     taxonomy code so a chaos run's error budget can split EXPECTED

@@ -357,6 +357,30 @@ class DeconvService:
                 self._cache_wrap("/v1/dream", self._dream_v1, self.dream_metrics),
             )
         )
+        # Durable async jobs (round 11, serving/jobs.py): heavy dreams
+        # and sweeps as 202-accepted, journal-backed, checkpoint-resumed
+        # work — POST /v1/jobs, GET/DELETE /v1/jobs/{id}, SSE progress
+        # at /v1/jobs/{id}/events.  Enabled ONLY with a jobs_dir (the
+        # journal and checkpoint spills need a home); a default server
+        # carries no routes and no runner tasks — zero sync-path cost.
+        self.jobs = None
+        if self.cfg.jobs_dir:
+            from deconv_api_tpu.serving.jobs import JobManager
+
+            self.jobs = JobManager(
+                self.cfg.jobs_dir,
+                self._execute_job,
+                metrics=self.metrics,
+                lane_pool=self.lane_pool,
+                queue_depth=self.cfg.jobs_queue_depth,
+                workers=self.cfg.jobs_workers,
+                retention_s=self.cfg.jobs_retention_s,
+                max_attempts=self.cfg.jobs_max_attempts,
+            )
+            self.server.route("POST", "/v1/jobs")(self._jobs_submit)
+            self.server.route("GET", "/v1/jobs")(self._jobs_collection)
+            self.server.route_prefix("GET", "/v1/jobs/")(self._jobs_entity)
+            self.server.route_prefix("DELETE", "/v1/jobs/")(self._jobs_delete)
 
     # ---------------------------------------------------------- device side
 
@@ -431,6 +455,8 @@ class DeconvService:
         faults_mod.raise_if_armed("device.dispatch_error", where=lane)
         if key[0] == "__dream__":
             return self._dispatch_dream(key, images, lane)
+        if key[0] == "__dream_octave__":
+            return self._dispatch_dream_octave(key, images, lane)
         # 4-tuple: single-layer (the default); 5-tuple adds sweep=True
         layer_name, mode, top_k, post, *rest = key
         sweep = bool(rest[0]) if rest else False
@@ -620,6 +646,64 @@ class DeconvService:
                 return [{"image": o[i], "loss": float(ls[i])} for i in range(n)]
             finally:
                 self.input_ring.release(batch)
+
+        return materialise
+
+    def _dispatch_dream_octave(self, key, images: list, lane: int = 0):
+        """ONE checkpointable dream octave as a single device dispatch
+        (round 11 job runner).  ``images`` entries are ``(x, base)``
+        pairs — the evolving dream at the previous octave's resolution
+        and the full-resolution original whose lost detail the pyramid
+        step re-injects.  The per-octave program is the library's
+        ``make_octave_runner`` fused form, walking exactly the
+        ``octave_shapes`` ladder the whole-dream program uses, so the
+        checkpointed walk cannot drift from the fused one.  Keyed by
+        (layers, steps, lr, ladder, octave index): concurrent jobs at
+        the same octave of the same config batch into one dispatch."""
+        import jax
+        import numpy as np_mod
+
+        from deconv_api_tpu.engine.deepdream import make_octave_runner
+
+        _, layers, steps, lr, shapes, i = key
+        fwd = self.bundle.dream_forward(layers)
+        out_hw = shapes[i]
+        prev_hw = shapes[i - 1] if i > 0 else None
+        lane_pl = self.bundle.lane_placement(lane)
+        lane_mesh = None
+        if lane_pl is not None:
+            from jax.sharding import Mesh
+
+            if isinstance(lane_pl, Mesh):
+                lane_mesh = lane_pl
+        mesh = self.mesh if self.mesh is not None else lane_mesh
+        n = len(images)
+        bucket = self._round_to_dp(pad_bucket(n, self.cfg.dream_max_batch))
+        xs = np_mod.stack(
+            [np_mod.asarray(x, np_mod.float32) for x, _ in images]
+        )
+        bases = np_mod.stack(
+            [np_mod.asarray(b, np_mod.float32) for _, b in images]
+        )
+        if bucket > n:
+            xs = np_mod.concatenate(
+                [xs, np_mod.zeros((bucket - n, *xs.shape[1:]), xs.dtype)]
+            )
+            bases = np_mod.concatenate(
+                [bases,
+                 np_mod.zeros((bucket - n, *bases.shape[1:]), bases.dtype)]
+            )
+        fn = make_octave_runner(
+            fwd, layers, steps, lr, mesh=mesh, out_hw=out_hw, prev_hw=prev_hw
+        )
+        if lane_pl is not None and lane_mesh is None:
+            xs = jax.device_put(xs, lane_pl)
+            bases = jax.device_put(bases, lane_pl)
+        out, losses = fn(self.bundle.lane_params(lane), xs, bases)
+
+        def materialise():
+            o, ls = jax.device_get((out, losses))  # one host transfer
+            return [{"image": o[j], "loss": float(ls[j])} for j in range(n)]
 
         return materialise
 
@@ -1105,6 +1189,15 @@ class DeconvService:
                 "total": self.lane_pool.size,
                 "accepting": self.lane_pool.accepting_count(),
             }
+        if self.jobs is not None:
+            # operators (and the drain runbook) read the park/queue
+            # picture straight off the readiness probe
+            c = self.jobs.counts()
+            body["jobs"] = {
+                "running": c["running"],
+                "parked": c["parked"],
+                "queued": c["queued"],
+            }
         return Response.json(body, status=200 if ok else 503)
 
     async def _debug_faults(self, req: Request) -> Response:
@@ -1149,7 +1242,10 @@ class DeconvService:
         import dataclasses
 
         cfg = dataclasses.asdict(self.cfg)
-        for key in ("weights_path", "compilation_cache_dir", "profile_dir"):
+        for key in (
+            "weights_path", "compilation_cache_dir", "profile_dir",
+            "jobs_dir",
+        ):
             cfg[key] = bool(cfg[key])
         cfg["mesh_active"] = self.mesh is not None
         cfg["model_active"] = self.bundle.name
@@ -1172,6 +1268,16 @@ class DeconvService:
         if self.lane_count > 1:
             cfg["lanes"] = self.lane_pool.snapshot()
         cfg["warmup_wall_s"] = self.warmup_wall_s
+        # durable async jobs (round 11): live queue/park/retention state
+        cfg["jobs_active"] = self.jobs is not None
+        if self.jobs is not None:
+            cfg["jobs"] = {
+                **self.jobs.counts(),
+                "queue_depth": self.jobs.queue_depth,
+                "workers": self.jobs.workers,
+                "reclaimed_on_boot": self.jobs.reclaimed,
+                "torn_records_on_boot": self.jobs.torn_records,
+            }
         cfg["fault_injection_active"] = self.faults is not None
         if self.faults is not None:
             cfg["faults_state"] = self.faults.snapshot()
@@ -1314,17 +1420,26 @@ class DeconvService:
         # FastAPI JSON-encodes the returned string (reference app/main.py:78).
         return Response.json(data_url)
 
+    def _deconv_params(self, form: dict[str, str]) -> tuple[str, int]:
+        """Validate a deconv/sweep request's (mode, top_k) — the ONE
+        rule set shared by /v1/deconv and POST /v1/jobs (round 11), for
+        the same no-drift reason as ``_dream_params``."""
+        mode = form.get("mode", self.cfg.visualize_mode)
+        if mode not in ("all", "max"):
+            raise errors.IllegalMode(
+                f"mode must be 'all' or 'max', got {mode!r}"
+            )
+        top_k = int(form.get("top_k", self.cfg.top_k))
+        if not 1 <= top_k <= 64:
+            raise errors.BadRequest("top_k must be in [1, 64]")
+        return mode, top_k
+
     async def _deconv_v1(self, req: Request) -> Response:
         """POST /v1/deconv — JSON API over the same engine, exposing knobs."""
         t0 = time.perf_counter()
         try:
             form = _parse_form(req)
-            mode = form.get("mode", self.cfg.visualize_mode)
-            if mode not in ("all", "max"):
-                raise errors.IllegalMode(f"mode must be 'all' or 'max', got {mode!r}")
-            top_k = int(form.get("top_k", self.cfg.top_k))
-            if not 1 <= top_k <= 64:
-                raise errors.BadRequest("top_k must be in [1, 64]")
+            mode, top_k = self._deconv_params(form)
             sweep = form.get("sweep", "").lower() in ("1", "true", "yes", "on")
             if sweep:
                 # every layer from the requested one down — the reference's
@@ -1365,6 +1480,34 @@ class DeconvService:
             {"layer": form["layer"], "mode": mode, **payload}
         )
 
+    def _dream_params(
+        self, form: dict[str, str]
+    ) -> tuple[tuple[str, ...], int, int, float]:
+        """Validate a dream request's knobs — the ONE rule set shared by
+        the synchronous /v1/dream route and POST /v1/jobs dream
+        submission (round 11), so the async tier can never accept a
+        config the sync tier would reject."""
+        layers = tuple(
+            s for s in form.get("layers", "").split(",") if s
+        ) or self.bundle.dream_layers
+        if not layers:
+            raise errors.BadRequest(
+                f"model {self.bundle.name!r} has no default dream layers; "
+                "pass 'layers' explicitly"
+            )
+        steps = int(form.get("steps", _DREAM_DEFAULTS["steps"]))
+        octaves = int(form.get("octaves", _DREAM_DEFAULTS["octaves"]))
+        lr = float(form.get("lr", _DREAM_DEFAULTS["lr"]))
+        if not 1 <= steps <= 100 or not 1 <= octaves <= 16:
+            raise errors.BadRequest("steps must be in [1,100], octaves in [1,16]")
+        if steps * octaves > 500:
+            raise errors.BadRequest(
+                "steps x octaves must be <= 500 (total ascent steps)"
+            )
+        if not (0.0 < lr <= 1.0):  # also rejects NaN
+            raise errors.BadRequest("lr must be a finite value in (0, 1]")
+        return layers, steps, octaves, lr
+
     async def _dream_v1(self, req: Request) -> Response:
         """POST /v1/dream — multi-octave DeepDream (BASELINE config 3).
 
@@ -1380,25 +1523,7 @@ class DeconvService:
             file_uri = form.get("file")
             if not file_uri:
                 raise errors.BadRequest("form field 'file' is required")
-            layers = tuple(
-                s for s in form.get("layers", "").split(",") if s
-            ) or self.bundle.dream_layers
-            if not layers:
-                raise errors.BadRequest(
-                    f"model {self.bundle.name!r} has no default dream layers; "
-                    "pass 'layers' explicitly"
-                )
-            steps = int(form.get("steps", _DREAM_DEFAULTS["steps"]))
-            octaves = int(form.get("octaves", _DREAM_DEFAULTS["octaves"]))
-            lr = float(form.get("lr", _DREAM_DEFAULTS["lr"]))
-            if not 1 <= steps <= 100 or not 1 <= octaves <= 16:
-                raise errors.BadRequest("steps must be in [1,100], octaves in [1,16]")
-            if steps * octaves > 500:
-                raise errors.BadRequest(
-                    "steps x octaves must be <= 500 (total ascent steps)"
-                )
-            if not (0.0 < lr <= 1.0):  # also rejects NaN
-                raise errors.BadRequest("lr must be a finite value in (0, 1]")
+            layers, steps, octaves, lr = self._dream_params(form)
             def decode():
                 try:
                     img = codec.decode_data_url(file_uri)
@@ -1458,6 +1583,413 @@ class DeconvService:
             "images": images,
         }
 
+    # ------------------------------------------------------- async jobs
+
+    def _job_deadline_pc(self, job) -> float | None:
+        """A job's wall-clock completion deadline (survives restarts) as
+        the perf_counter deadline the batcher's reap boundaries use."""
+        if job.deadline_ts is None:
+            return None
+        return time.perf_counter() + (job.deadline_ts - time.time())
+
+    async def _job_dispatch(self, job, dispatcher, payload, key):
+        """One device stage of a job through a shared dispatcher,
+        cancellable: the submit rides its own task, and DELETE cancels
+        that task — the batcher's reap boundary then drops the dead item
+        before dispatch, so the device never runs a cancelled octave."""
+        # activate the job's per-attempt trace around the submit ONLY:
+        # activate/deactivate must pair within one generator drive (an
+        # async generator's finalizer runs in a different context, where
+        # a cross-drive token reset raises)
+        tr = job._trace
+        token = trace_mod.activate(tr) if tr is not None else None
+        try:
+            fut = asyncio.ensure_future(
+                dispatcher.submit(
+                    payload, key, deadline=self._job_deadline_pc(job)
+                )
+            )
+            job._inflight = fut
+            try:
+                return await fut
+            finally:
+                job._inflight = None
+                if not fut.done():
+                    # the AWAIT was interrupted (worker teardown): the
+                    # submit task must not keep the item live in the queue
+                    fut.cancel()
+        finally:
+            if token is not None:
+                trace_mod.deactivate(token)
+
+    async def _execute_job(self, job, ckpts, load):
+        """The executor the JobManager drives (round 11): dispatch by
+        job kind, with a per-attempt trace recorded to the flight
+        recorder so job stages appear in /v1/debug/requests like any
+        synchronous request's spans."""
+        tr = None
+        if self.recorder is not None:
+            tr = RequestTrace(f"{job.id}-a{job.attempts}", f"job:{job.kind}")
+            job._trace = tr
+        try:
+            if job.kind == "dream":
+                gen = self._job_dream(job, ckpts, load)
+            elif job.kind == "sweep":
+                gen = self._job_sweep(job, ckpts, load)
+            else:
+                gen = self._job_deconv(job, ckpts, load)
+            async for step in gen:
+                yield step
+        except GeneratorExit:
+            # the manager stops iterating early: after consuming the
+            # Result (success), OR when a checkpoint-boundary park/
+            # cancel returned out of its loop — label the attempt by
+            # what actually happened to the job, not a blanket 200
+            if tr is not None:
+                done = job.state == "done"
+                tr.finish(
+                    status=200 if done else 503,
+                    error=None if done else job.state,
+                )
+                self.recorder.record(tr)
+                tr = None
+            raise
+        except BaseException as e:
+            if tr is not None:
+                tr.finish(status=500, error=type(e).__name__)
+                self.recorder.record(tr)
+                tr = None
+            raise
+        else:
+            # NORMAL exhaustion means the executor ended WITHOUT a
+            # Result (the manager's no_result failure path) — a
+            # successful attempt always ends via GeneratorExit when the
+            # manager stops consuming after the Result
+            if tr is not None:
+                tr.finish(status=500, error="no_result")
+                self.recorder.record(tr)
+                tr = None
+        finally:
+            job._trace = None
+
+    @staticmethod
+    def _job_input(ckpts, load):
+        """The decoded input image out of a job's checkpoint chain (it
+        is spilled at submit time, so resume never re-decodes)."""
+        for rec in ckpts:
+            if rec.get("stage") == "input":
+                arrs = load(rec)
+                if arrs is not None and "input" in arrs:
+                    return arrs["input"]
+        # DETERMINISTIC failure, not Unavailable: a missing/corrupt
+        # input spill cannot heal, so retrying would only burn the
+        # attempt budget and mislabel the job as a runner crash
+        raise errors.DeconvError(
+            "job input checkpoint missing or corrupt in the spill dir"
+        )
+
+    async def _job_dream(self, job, ckpts, load):
+        """Checkpointed octave-by-octave dream: resume picks up AFTER
+        the last durable octave, and because each octave round-trips the
+        exact float32 host array that the checkpoint spilled, a resumed
+        run's final payload is byte-identical to an uninterrupted one
+        (pinned by tests/test_jobs.py and the bench `jobs` drill)."""
+        from deconv_api_tpu.engine.deepdream import octave_shapes
+        from deconv_api_tpu.serving.jobs import Checkpoint, Result
+
+        p = job.params
+        layers = tuple(
+            s for s in p.get("layers", "").split(",") if s
+        ) or self.bundle.dream_layers
+        steps = int(p.get("steps", _DREAM_DEFAULTS["steps"]))
+        octaves = int(p.get("octaves", _DREAM_DEFAULTS["octaves"]))
+        lr = float(p.get("lr", _DREAM_DEFAULTS["lr"]))
+        base = self._job_input(ckpts, load)
+        h, w = base.shape[:2]
+        shapes = octave_shapes(
+            h, w, octaves, min_size=self.bundle.min_dream_size
+        )
+        start, x, loss = 0, base, None
+        last_rec = None
+        for rec in ckpts:
+            if rec.get("stage") == "octave":
+                last_rec = rec
+        if last_rec is not None and int(last_rec.get("index", -1)) < len(shapes):
+            arrs = load(last_rec)
+            if arrs is not None and "x" in arrs:
+                start = int(last_rec["index"]) + 1
+                x = arrs["x"]
+                loss = (last_rec.get("meta") or {}).get("loss")
+        for i in range(start, len(shapes)):
+            faults_mod.raise_if_armed("jobs.runner_crash")
+            try:
+                res = await self._job_dispatch(
+                    job,
+                    self.dream_dispatcher,
+                    (np.asarray(x), np.asarray(base)),
+                    ("__dream_octave__", layers, steps, lr, shapes, i),
+                )
+            except KeyError as e:
+                # unknown dream activation surfaces at trace time — a
+                # deterministic failure, never a crash-retry
+                raise errors.UnknownLayer(str(e)) from e
+            x = np.asarray(res["image"])
+            loss = res["loss"]
+            yield Checkpoint(
+                stage="octave", index=i, total=len(shapes),
+                arrays={"x": x},
+                meta={"loss": loss, "hw": list(shapes[i])},
+            )
+        data_url = await self.codec_pool.run(
+            lambda: codec.encode_data_url(
+                self.bundle.unpreprocess(np.asarray(x))
+            )
+        )
+        body = json.dumps(
+            {
+                "layers": list(layers),
+                "loss": (
+                    loss
+                    if loss is not None and np.isfinite(loss)
+                    else None
+                ),
+                "image": data_url,
+            }
+        ).encode()
+        yield Result(200, "application/json", body)
+
+    async def _job_sweep(self, job, ckpts, load):
+        """Checkpointed layer-by-layer sweep: each swept layer is one
+        single-layer dispatch on the sweep dispatcher, its ENCODED
+        payload checkpointed as JSON — resume re-projects only the
+        layers with no durable checkpoint."""
+        from deconv_api_tpu.serving.jobs import Checkpoint, Result
+
+        p = job.params
+        layer = p["layer"]
+        mode = p.get("mode", self.cfg.visualize_mode)
+        top_k = int(p.get("top_k", self.cfg.top_k))
+        x = self._job_input(ckpts, load)
+        done: dict[str, dict] = {}
+        for rec in ckpts:
+            if rec.get("stage") == "layer":
+                payload = load(rec)
+                if payload is not None and "name" in payload:
+                    done[payload["name"]] = payload["entry"]
+        names = self.bundle.sweep_layers(layer)
+        for i, name in enumerate(names):
+            if name in done:
+                continue
+            faults_mod.raise_if_armed("jobs.runner_crash")
+            result = await self._job_dispatch(
+                job, self.sweep_dispatcher, np.asarray(x),
+                (name, mode, top_k, "tiles"),
+            )
+            entry = await self._encode_tiles_pooled(result)
+            done[name] = entry
+            yield Checkpoint(
+                stage="layer", index=i, total=len(names),
+                data={"name": name, "entry": entry},
+                meta={"layer": name},
+            )
+        body = json.dumps(
+            {
+                "layer": layer, "mode": mode, "sweep": True,
+                # assembled in ladder order regardless of which layers a
+                # resume re-ran, so resumed output is byte-identical
+                "layers": {name: done[name] for name in names},
+            }
+        ).encode()
+        yield Result(200, "application/json", body)
+
+    async def _job_deconv(self, job, ckpts, load):
+        """Single-layer deconv as a job: one dispatch, no intermediate
+        checkpoints (the input spill already makes the submit durable)."""
+        from deconv_api_tpu.serving.jobs import Result
+
+        p = job.params
+        layer = p["layer"]
+        mode = p.get("mode", self.cfg.visualize_mode)
+        top_k = int(p.get("top_k", self.cfg.top_k))
+        x = self._job_input(ckpts, load)
+        faults_mod.raise_if_armed("jobs.runner_crash")
+        result = await self._job_dispatch(
+            job, self.dispatcher, np.asarray(x), (layer, mode, top_k, "tiles")
+        )
+        payload = await self._encode_tiles_pooled(result)
+        body = json.dumps({"layer": layer, "mode": mode, **payload}).encode()
+        yield Result(200, "application/json", body)
+
+    async def _jobs_submit(self, req: Request) -> Response:
+        """POST /v1/jobs — 202 + job id.  Validation and the image
+        decode happen NOW (a bad request 4xxs at submit, and the decoded
+        input rides the spill dir so resume never re-decodes); the
+        device work happens on the runner.  Retry-safe: an
+        ``x-idempotency-key`` header (default: the PR 2 canonical body
+        digest) dedups duplicate submits onto the live or completed
+        job."""
+        try:
+            if not self.ready:
+                raise errors.ModelNotReady(
+                    "model executables are still compiling; poll /ready"
+                )
+            form = _parse_form(req)
+            kind = form.get("type", "dream")
+            if kind not in ("deconv", "dream", "sweep"):
+                raise errors.BadRequest(
+                    f"type must be deconv, dream or sweep, got {kind!r}"
+                )
+            file_uri = form.get("file")
+            if not file_uri:
+                raise errors.BadRequest("form field 'file' is required")
+            if kind == "dream":
+                layers, steps, octaves, lr = self._dream_params(form)
+                params = {
+                    "layers": ",".join(layers), "steps": str(steps),
+                    "octaves": str(octaves), "lr": repr(lr),
+                }
+            else:
+                layer = form.get("layer")
+                if not layer:
+                    raise errors.BadRequest("form field 'layer' is required")
+                try:
+                    self.bundle.check_layer(layer)
+                except ValueError as e:
+                    raise errors.UnknownLayer(str(e)) from None
+                mode, top_k = self._deconv_params(form)
+                params = {"layer": layer, "mode": mode, "top_k": str(top_k)}
+            idem = req.headers.get("x-idempotency-key", "")
+            if idem and not trace_mod.RID_RE.match(idem):
+                raise errors.BadRequest(
+                    "x-idempotency-key must match [A-Za-z0-9._-]{1,64}"
+                )
+            if not idem:
+                idem = canonical_digest(
+                    f"{self._cache_prefix}|jobs",
+                    req.headers.get("content-type", ""),
+                    req.body,
+                    req=req,
+                )
+            # dedup and capacity BEFORE the decode: a retried submit and
+            # an at-capacity 429 both answer without burning a
+            # codec-pool slot on an image nobody will use
+            existing = self.jobs.lookup(idem)
+            if existing is None:
+                self.jobs.ensure_capacity()
+                with stage(self.metrics, "decode"):
+                    x = await self.codec_pool.run(
+                        self._decode_preprocess, file_uri
+                    )
+                deadline_ts = None
+                if req.deadline is not None:
+                    # x-deadline-ms on submit is a JOB-COMPLETION
+                    # deadline: anchored to wall clock so it survives a
+                    # restart
+                    deadline_ts = time.time() + max(
+                        0.0, req.deadline - time.perf_counter()
+                    )
+                # the input spill (the submit's one large fsync'd
+                # write) runs off-loop; submit just records the ref
+                spilled = await asyncio.to_thread(
+                    self.jobs.spill_input,
+                    {"input": np.asarray(x, np.float32)},
+                )
+                job, deduped = self.jobs.submit(
+                    kind, params, idem,
+                    input_spilled=spilled,
+                    deadline_ts=deadline_ts,
+                )
+            else:
+                job, deduped = existing, True
+        except errors.DeconvError as e:
+            return _error_response(e, req.id)
+        except ValueError as e:
+            return _error_response(errors.BadRequest(str(e)), req.id)
+        doc = self.jobs.describe(job)
+        doc["deduped"] = deduped
+        resp = Response.json(doc, status=202)
+        resp.headers["location"] = f"/v1/jobs/{job.id}"
+        return resp
+
+    async def _jobs_collection(self, req: Request) -> Response:
+        """GET /v1/jobs — every known job (newest last) + counts."""
+        return Response.json(
+            {
+                "jobs": self.jobs.jobs_snapshot(),
+                "counts": self.jobs.counts(),
+                "queue_depth": self.jobs.queue_depth,
+            }
+        )
+
+    async def _jobs_entity(self, req: Request) -> Response:
+        """GET /v1/jobs/{id}[/result|/events] — status document, final
+        payload, or the SSE progress stream (``Last-Event-ID`` replays
+        missed events from the journal-backed history)."""
+        parts = [p for p in req.path[len("/v1/jobs/"):].split("/") if p]
+        if not parts:
+            return await self._jobs_collection(req)
+        try:
+            job = self.jobs.get(parts[0])
+        except errors.DeconvError as e:
+            return _error_response(e, req.id)
+        if len(parts) == 1:
+            return Response.json(self.jobs.describe(job))
+        if parts[1] == "result":
+            if job.state != "done" or job.result is None:
+                return _error_response(
+                    errors.BadRequest(
+                        f"job {job.id} is {job.state!r}; no result yet"
+                    ),
+                    req.id,
+                )
+            body = self.jobs.result_body(job)
+            if body is None:
+                return _error_response(
+                    errors.DeconvError("job result spill unreadable"), req.id
+                )
+            return Response(
+                status=job.result["status"],
+                body=body,
+                headers={
+                    "content-type": job.result["content_type"],
+                    "x-job-id": job.id,
+                },
+            )
+        if parts[1] == "events":
+            last = -1
+            raw = req.headers.get("last-event-id") or req.query.get(
+                "last_event_id"
+            )
+            if raw:
+                try:
+                    last = int(raw)
+                except ValueError:
+                    return _error_response(
+                        errors.BadRequest("Last-Event-ID must be an int"),
+                        req.id,
+                    )
+            return Response(
+                status=200,
+                headers={"content-type": "text/event-stream"},
+                stream=self.jobs.event_stream(job, last),
+            )
+        return _error_response(
+            errors.BadRequest(f"unknown job subresource {parts[1]!r}"),
+            req.id,
+        )
+
+    async def _jobs_delete(self, req: Request) -> Response:
+        """DELETE /v1/jobs/{id} — cancel.  Idempotent: a terminal job
+        answers its current state; a running job's in-flight octave is
+        reaped before it can dispatch (the device never runs dead
+        octaves)."""
+        job_id = req.path[len("/v1/jobs/"):].strip("/")
+        try:
+            job = self.jobs.cancel(job_id)
+        except errors.DeconvError as e:
+            return _error_response(e, req.id)
+        return Response.json(self.jobs.describe(job))
+
     # ---------------------------------------------------------- lifecycle
 
     async def start(self, host: str | None = None, port: int | None = None) -> int:
@@ -1473,6 +2005,10 @@ class DeconvService:
         await self.dispatcher.start()
         await self.dream_dispatcher.start()
         await self.sweep_dispatcher.start()
+        if self.jobs is not None:
+            # runner tasks need the dispatchers (each job stage rides
+            # them); boot already re-queued reclaimed jobs
+            self.jobs.start()
         bind_host = host if host is not None else self.cfg.host
         bound_port = await self.server.start(
             bind_host, self.cfg.port if port is None else port
@@ -1491,9 +2027,18 @@ class DeconvService:
         earlier to give LB probes a window (cfg.drain_grace_s)."""
         self.draining = True
         self.server.draining = True
+        if self.jobs is not None:
+            # queued jobs park NOW (journaled, reclaimed on the next
+            # boot); running jobs park at their next checkpoint boundary
+            self.jobs.begin_drain()
 
     async def stop(self, grace_s: float = 10.0) -> None:
         self.begin_drain()
+        if self.jobs is not None:
+            # BEFORE the dispatchers die: a runner parking mid-octave
+            # journals from its cancellation handler, and any in-flight
+            # octave item is dropped at the reap boundary
+            await self.jobs.stop()
         await self.server.stop()
         # One SHARED grace deadline across the three dispatchers: they sit
         # on the same device, so a wedge is correlated — sequential
@@ -1648,6 +2193,21 @@ def main(argv: list[str] | None = None) -> None:
         help="persistent XLA compilation cache directory (default off): "
         "warm restarts skip the per-bucket-per-lane warmup compile tax",
     )
+    p.add_argument(
+        "--jobs-dir", default=None, metavar="DIR",
+        help="enable the durable async job subsystem (POST /v1/jobs): "
+        "write-ahead journal + checkpoint spill files live here "
+        "(default off)",
+    )
+    p.add_argument(
+        "--jobs-workers", type=int, default=None,
+        help="concurrent job runner tasks (default 2)",
+    )
+    p.add_argument(
+        "--jobs-queue-depth", type=int, default=None,
+        help="queued-or-running jobs admitted before submits 429 "
+        "(default 64)",
+    )
     args = p.parse_args(argv)
     overrides = {}
     if args.cache_bytes is not None:
@@ -1677,6 +2237,12 @@ def main(argv: list[str] | None = None) -> None:
         overrides["serve_lanes"] = args.lanes
     if args.compile_cache_dir is not None:
         overrides["compilation_cache_dir"] = args.compile_cache_dir
+    if args.jobs_dir is not None:
+        overrides["jobs_dir"] = args.jobs_dir
+    if args.jobs_workers is not None:
+        overrides["jobs_workers"] = args.jobs_workers
+    if args.jobs_queue_depth is not None:
+        overrides["jobs_queue_depth"] = args.jobs_queue_depth
     if args.host is not None:
         overrides["host"] = args.host
     if args.port is not None:

@@ -886,27 +886,34 @@ class BatchingDispatcher:
             )
 
     def _reap_expired(self, batch: list[WorkItem]) -> list[WorkItem]:
-        """Drop items whose deadline already passed: immediate 504 for
-        their callers, and the device NEVER sees dead work.  Called at
-        the queue-pop boundary (collect) and again pre-dispatch — a
-        deadline can lapse while a batch sits in the handoff queue."""
+        """Drop items nobody can receive results for: expired deadlines
+        (immediate 504) and already-done futures — the submit side timed
+        out, or (round 11) the caller CANCELLED, e.g. a cancelled job's
+        in-flight octave.  Either way the device NEVER sees dead work.
+        Called at the queue-pop boundary (collect) and again
+        pre-dispatch — a deadline can lapse (and a cancel can land)
+        while a batch sits in the handoff queue."""
         now = time.perf_counter()
         live: list[WorkItem] = []
         for it in batch:
-            if it.deadline is not None and now >= it.deadline:
+            if it.future.done():
                 # a done future means the submit side already timed out
-                # (wait_for cancels it) and COUNTED this expiry — drop
-                # the item without double-counting or double-spanning
-                if not it.future.done():
-                    self._count_deadline(
-                        it.trace, it.enqueued_at, now - it.enqueued_at
+                # (wait_for cancels it) and COUNTED any expiry, or the
+                # caller cancelled — drop the item without
+                # double-counting or double-spanning; its result is
+                # undeliverable, so dispatching it would only burn
+                # device time
+                continue
+            if it.deadline is not None and now >= it.deadline:
+                self._count_deadline(
+                    it.trace, it.enqueued_at, now - it.enqueued_at
+                )
+                it.future.set_exception(
+                    errors.DeadlineExpired(
+                        "deadline expired while queued; request reaped "
+                        "before dispatch"
                     )
-                    it.future.set_exception(
-                        errors.DeadlineExpired(
-                            "deadline expired while queued; request reaped "
-                            "before dispatch"
-                        )
-                    )
+                )
             else:
                 live.append(it)
         return live

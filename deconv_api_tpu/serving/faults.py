@@ -55,6 +55,8 @@ SITES = (
     "device.dispatch_error",   # device batch dispatch raises (:param = lane)
     "device.dispatch_delay_ms",  # device batch dispatch stalls :param ms
     "http.slow_write",         # response write stalls :param ms
+    "jobs.runner_crash",       # job runner dies at a checkpoint boundary
+    "jobs.journal_write_error",  # job journal append raises (disk fault)
 )
 
 

@@ -42,9 +42,11 @@ CORS_HEADERS = {
 }
 
 _STATUS_TEXT = {
-    200: "OK", 204: "No Content", 400: "Bad Request", 404: "Not Found",
+    200: "OK", 202: "Accepted", 204: "No Content", 400: "Bad Request",
+    404: "Not Found",
     405: "Method Not Allowed", 408: "Request Timeout", 411: "Length Required",
     413: "Payload Too Large", 422: "Unprocessable Entity",
+    429: "Too Many Requests",
     500: "Internal Server Error", 501: "Not Implemented",
     503: "Service Unavailable", 504: "Gateway Timeout",
 }
@@ -126,6 +128,12 @@ class Response:
     status: int = 200
     body: bytes = b""
     headers: dict[str, str] = field(default_factory=dict)
+    # Progressive delivery (round 11, the jobs SSE surface): an async
+    # iterator of byte chunks.  When set, the serve loop writes the head
+    # (no content-length, ``connection: close``) and then streams chunks
+    # as the iterator yields them — body-until-close framing, which is
+    # what EventSource clients expect.  ``body`` is ignored.
+    stream: object | None = field(default=None, repr=False, compare=False)
 
     @classmethod
     def json(cls, obj, status: int = 200) -> "Response":
@@ -168,6 +176,10 @@ class HttpServer:
         max_connections: int = 256,
     ):
         self._routes: dict[tuple[str, str], callable] = {}
+        # prefix-matched routes (round 11: /v1/jobs/{id}[/...]): checked
+        # after the exact table, longest prefix wins; the handler reads
+        # the id out of req.path itself
+        self._prefix_routes: list[tuple[str, str, callable]] = []
         self._server: asyncio.AbstractServer | None = None
         self._idle_timeout_s = idle_timeout_s
         self._body_timeout_s = body_timeout_s
@@ -183,6 +195,19 @@ class HttpServer:
     def route(self, method: str, path: str):
         def register(fn):
             self._routes[(method.upper(), path)] = fn
+            return fn
+
+        return register
+
+    def route_prefix(self, method: str, prefix: str):
+        """Register a handler for every path under ``prefix`` (round 11:
+        the per-job routes).  Exact routes win; among prefixes the
+        longest match wins."""
+
+        def register(fn):
+            self._prefix_routes.append((method.upper(), prefix, fn))
+            # longest prefix first, so /v1/jobs/ beats /v1/ if both exist
+            self._prefix_routes.sort(key=lambda r: -len(r[1]))
             return fn
 
         return register
@@ -265,6 +290,13 @@ class HttpServer:
                 # shed 503, handler-crash 500 — so a client-side log line
                 # joins server logs and flight-recorder traces on one key
                 resp.headers.setdefault("x-request-id", req.id)
+                if resp.stream is not None:
+                    # progressive delivery (round 11, the jobs SSE
+                    # surface): head now, chunks as they come, close at
+                    # the end — body-until-close framing on a
+                    # ``connection: close`` response
+                    await self._write_stream(writer, req, resp, t0)
+                    break
                 # 500 = handler crash -> ERROR.  503/504 are DESIGNED
                 # backpressure (shedding, timeouts) — WARNING, or they
                 # would flood error alerting exactly at peak load.
@@ -322,6 +354,50 @@ class HttpServer:
                 await writer.wait_closed()
             except ConnectionResetError:
                 pass
+
+    async def _write_stream(
+        self,
+        writer: asyncio.StreamWriter,
+        req: Request,
+        resp: Response,
+        t0: float,
+    ) -> None:
+        """Write a streaming response: head without content-length, then
+        every chunk the iterator yields.  The access log line lands when
+        the stream ENDS (its ms is the stream's whole lifetime).  A
+        client that disconnects mid-stream surfaces as ConnectionReset
+        in the caller's handler; the generator is always closed so its
+        finally blocks (subscription cleanup) run."""
+        headers = {
+            **CORS_HEADERS,
+            "connection": "close",
+            "cache-control": "no-cache",
+            **resp.headers,
+        }
+        head = (
+            f"HTTP/1.1 {resp.status} "
+            f"{_STATUS_TEXT.get(resp.status, 'Unknown')}\r\n"
+        )
+        head += "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+        try:
+            writer.write(head.encode() + b"\r\n")
+            await writer.drain()
+            async for chunk in resp.stream:
+                writer.write(chunk)
+                await writer.drain()
+        finally:
+            aclose = getattr(resp.stream, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:  # noqa: BLE001 — cleanup must not mask
+                    pass
+            slog.event(
+                _log, "http_request", level=logging.INFO,
+                method=req.method, path=req.path, status=resp.status,
+                id=req.id, stream=True,
+                ms=round((time.perf_counter() - t0) * 1e3, 1),
+            )
 
     async def _read_request(self, reader: asyncio.StreamReader) -> Request | None:
         try:
@@ -417,7 +493,15 @@ class HttpServer:
             return Response(204)
         handler = self._routes.get((req.method, req.path))
         if handler is None:
-            if any(p == req.path for (_, p) in self._routes):
+            for method, prefix, fn in self._prefix_routes:
+                if method == req.method and req.path.startswith(prefix):
+                    handler = fn
+                    break
+        if handler is None:
+            if any(p == req.path for (_, p) in self._routes) or any(
+                req.path.startswith(prefix)
+                for (_, prefix, _fn) in self._prefix_routes
+            ):
                 return Response.json({"error": "method not allowed"}, 405)
             return Response.json({"error": f"no route for {req.path}"}, 404)
         try:

@@ -1,0 +1,712 @@
+"""Durable async job subsystem tests (round 11, serving/jobs.py).
+
+Fast lane: CPU, a tiny conv-only spec (32px, so the dream octave ladder
+has three rungs — resume/cancel tests need real checkpoint boundaries).
+
+Covers the journal (torn-tail replay, boot compaction, retention),
+retry-safe submission (idempotent resubmit onto live and completed
+jobs, 429 + Retry-After at capacity), checkpointed execution (runner
+crash resumes from the last checkpoint with BYTE-IDENTICAL output,
+cancellation mid-octave never runs another octave), SSE progress
+(Last-Event-ID reconnect replay), drain parking + boot re-claim, and
+the jobs exposition lint."""
+
+import asyncio
+import base64
+import io
+import json
+import time
+
+import httpx
+import numpy as np
+import pytest
+
+import jax
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+from deconv_api_tpu.serving.app import DeconvService
+from deconv_api_tpu.serving.jobs import (
+    Checkpoint,
+    JobJournal,
+    JobManager,
+    Result,
+    SpillStore,
+)
+from tests.test_metrics_exposition import lint_exposition
+from tests.test_serving import ServiceFixture
+
+# Conv-only (no flatten/dense head), 32px: dreams work at any octave
+# resolution and octave_shapes(32, 32, 3, min_size=16) is a 3-rung
+# ladder — enough boundaries to crash, cancel and park between.
+JOBS_SPEC = ModelSpec(
+    name="jobs_tiny",
+    input_shape=(32, 32, 3),
+    layers=(
+        Layer("input_1", "input"),
+        Layer("c1", "conv", activation="relu", filters=8),
+        Layer("p1", "pool"),
+        Layer("c2", "conv", activation="relu", filters=8),
+    ),
+)
+
+DREAM_FORM = {"type": "dream", "layers": "c2", "steps": "2", "octaves": "3"}
+
+
+def _data_url(seed=0, size=32):
+    from PIL import Image
+
+    img = Image.fromarray(
+        np.random.default_rng(seed).integers(0, 255, (size, size, 3), np.uint8),
+        "RGB",
+    )
+    buf = io.BytesIO()
+    img.save(buf, "JPEG")
+    return "data:image/jpeg;base64," + base64.b64encode(buf.getvalue()).decode()
+
+
+def _make_service(jobs_dir, **cfg_kw):
+    cfg = ServerConfig(
+        image_size=32,
+        max_batch=4,
+        batch_window_ms=1.0,
+        compilation_cache_dir="",
+        cache_bytes=0,
+        jobs_dir=str(jobs_dir),
+        fault_injection=True,
+        **cfg_kw,
+    )
+    params = init_params(JOBS_SPEC, jax.random.PRNGKey(0))
+    return ServiceFixture(
+        cfg, service=DeconvService(cfg, spec=JOBS_SPEC, params=params)
+    )
+
+
+@pytest.fixture(scope="module")
+def jobs_server(tmp_path_factory):
+    with _make_service(tmp_path_factory.mktemp("jobs")) as s:
+        yield s
+
+
+def _wait_terminal(server, job_id, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        doc = httpx.get(server.base_url + f"/v1/jobs/{job_id}").json()
+        if doc["state"] in ("done", "failed", "cancelled", "parked"):
+            return doc
+        time.sleep(0.03)
+    raise AssertionError(f"job {job_id} never reached a terminal state: {doc}")
+
+
+def _arm(server, spec):
+    r = httpx.post(server.base_url + "/v1/debug/faults", data={"arm": spec})
+    assert r.status_code == 200, r.text
+
+
+def _disarm(server):
+    r = httpx.post(
+        server.base_url + "/v1/debug/faults", data={"disarm": "all"}
+    )
+    assert r.status_code == 200, r.text
+
+
+def _sse_events(text):
+    events = []
+    for block in text.split("\n\n"):
+        ev = {}
+        for line in block.splitlines():
+            if line.startswith("id: "):
+                ev["id"] = int(line[4:])
+            elif line.startswith("event: "):
+                ev["event"] = line[7:]
+            elif line.startswith("data: "):
+                ev["data"] = json.loads(line[6:])
+        if "event" in ev:
+            events.append(ev)
+    return events
+
+
+# ----------------------------------------------------------- journal unit
+
+
+def test_journal_replay_tolerates_torn_tail(tmp_path):
+    j = JobJournal(str(tmp_path / "journal.jsonl"))
+    j.append({"rec": "submitted", "job": "a", "seq": 0})
+    j.append({"rec": "state", "job": "a", "state": "running", "seq": 1})
+    # a crash mid-append leaves a torn, undecodable final line
+    with open(j.path, "ab") as f:
+        f.write(b'{"rec":"checkpoint","job":"a","se')
+    recs, torn = JobJournal.replay(j.path)
+    assert torn == 1
+    assert [r["rec"] for r in recs] == ["submitted", "state"]
+
+
+def test_journal_rewrite_is_atomic_replacement(tmp_path):
+    j = JobJournal(str(tmp_path / "journal.jsonl"))
+    for i in range(5):
+        j.append({"rec": "state", "job": "a", "seq": i})
+    j.rewrite([{"rec": "submitted", "job": "a", "seq": 0}])
+    recs, torn = JobJournal.replay(j.path)
+    assert torn == 0
+    assert recs == [{"rec": "submitted", "job": "a", "seq": 0}]
+    # the handle reopens for appends after a rewrite
+    j.append({"rec": "state", "job": "a", "state": "queued", "seq": 1})
+    recs, _ = JobJournal.replay(j.path)
+    assert len(recs) == 2
+
+
+def test_spill_digest_mismatch_reads_as_absent(tmp_path):
+    s = SpillStore(str(tmp_path))
+    fname, digest = s.put_arrays("job-x", 1, {"x": np.arange(8.0)})
+    assert s.load_arrays(fname, digest)["x"].shape == (8,)
+    import os
+
+    with open(os.path.join(str(tmp_path), fname), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    assert s.load_arrays(fname, digest) is None
+
+
+# ----------------------------------------------------- manager unit tests
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _wait(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(0.01)
+    return False
+
+
+def test_manager_queue_full_429_with_retry_after(tmp_path):
+    async def exec_(job, ckpts, load):
+        yield Result(200, "application/json", b"{}")
+
+    async def drive():
+        m = JobManager(str(tmp_path), exec_, queue_depth=2, workers=1)
+        m.submit("dream", {}, "idem-a")
+        m.submit("dream", {}, "idem-b")
+        with pytest.raises(errors.JobQueueFull) as ei:
+            m.submit("dream", {}, "idem-c")
+        assert ei.value.status == 429
+        assert ei.value.retry_after_s >= 1.0
+        # dedup onto an existing job is NOT an admission: it must
+        # succeed even at capacity (retry-safe resubmission)
+        job, deduped = m.submit("dream", {}, "idem-a")
+        assert deduped
+
+    _run(drive())
+
+
+def test_manager_reaps_expired_job_before_device(tmp_path):
+    calls = []
+
+    async def exec_(job, ckpts, load):
+        calls.append(job.id)
+        yield Result(200, "application/json", b"{}")
+
+    async def drive():
+        m = JobManager(str(tmp_path), exec_, workers=1)
+        job, _ = m.submit(
+            "dream", {}, "idem-dead", deadline_ts=time.time() - 5.0
+        )
+        m.start()
+        assert await _wait(lambda: job.state == "failed")
+        assert job.error == "deadline_expired"
+        assert calls == []  # the executor (and so the device) never ran
+        await m.stop()
+
+    _run(drive())
+
+
+def test_manager_crash_resumes_from_checkpoint(tmp_path):
+    attempts = []
+
+    async def exec_(job, ckpts, load):
+        attempts.append(len(ckpts))
+        have = {r["index"] for r in ckpts if r["stage"] == "step"}
+        if 0 not in have:
+            yield Checkpoint(stage="step", index=0, total=2, data={"v": 1})
+            raise RuntimeError("boom")  # crash AFTER the durable edge
+        yield Checkpoint(stage="step", index=1, total=2, data={"v": 2})
+        yield Result(200, "application/json", b'{"ok":true}')
+
+    async def drive():
+        m = JobManager(str(tmp_path), exec_, workers=1)
+        job, _ = m.submit("dream", {}, "idem-crash")
+        m.start()
+        assert await _wait(lambda: job.state == "done", 10.0)
+        assert job.attempts == 2 and job.resumed
+        steps = [r for r in job.checkpoints if r["stage"] == "step"]
+        assert [r["index"] for r in steps] == [0, 1]
+        assert m.result_body(job) == b'{"ok":true}'
+        await m.stop()
+
+    _run(drive())
+
+
+def test_manager_crash_budget_exhausts_to_failed(tmp_path):
+    async def exec_(job, ckpts, load):
+        raise RuntimeError("always boom")
+        yield  # pragma: no cover — makes this an async generator
+
+    async def drive():
+        m = JobManager(str(tmp_path), exec_, workers=1, max_attempts=2)
+        job, _ = m.submit("dream", {}, "idem-doom")
+        m.start()
+        assert await _wait(lambda: job.state == "failed", 10.0)
+        assert job.attempts == 2 and job.error == "runner_crash"
+        await m.stop()
+
+    _run(drive())
+
+
+def test_manager_idempotent_resubmit_live_and_completed(tmp_path):
+    release = asyncio.Event()
+
+    async def exec_(job, ckpts, load):
+        await release.wait()
+        yield Result(200, "application/json", b'{"done":1}')
+
+    async def drive():
+        m = JobManager(str(tmp_path), exec_, workers=1)
+        job, deduped = m.submit("dream", {"k": "v"}, "idem-1")
+        assert not deduped
+        m.start()
+        assert await _wait(lambda: job.state == "running")
+        # dedup onto the LIVE job
+        again, deduped = m.submit("dream", {"k": "v"}, "idem-1")
+        assert deduped and again.id == job.id
+        release.set()
+        assert await _wait(lambda: job.state == "done")
+        # dedup onto the COMPLETED job
+        again, deduped = m.submit("dream", {"k": "v"}, "idem-1")
+        assert deduped and again.id == job.id
+        await m.stop()
+
+    _run(drive())
+
+
+def test_manager_boot_reclaims_parked_and_compacts(tmp_path):
+    async def exec_(job, ckpts, load):
+        yield Checkpoint(stage="step", index=0, total=1, data={"v": 1})
+        yield Result(200, "application/json", b'{"ok":1}')
+
+    async def phase1():
+        m = JobManager(str(tmp_path), exec_, workers=1)
+        job, _ = m.submit("dream", {}, "idem-park")
+        # drain before the runners ever start: the queued job parks
+        m.begin_drain()
+        assert job.state == "parked"
+
+    async def phase2():
+        m = JobManager(str(tmp_path), exec_, workers=1)
+        # boot re-claimed the parked job (pinned)
+        assert m.reclaimed == 1
+        job = m.get(m._idem["idem-park"])
+        assert job.state == "queued" and job.resumed
+        m.start()
+        assert await _wait(lambda: job.state == "done", 10.0)
+        await m.stop()
+
+    _run(phase1())
+    _run(phase2())
+    # third boot: the job is terminal — compaction collapses its
+    # checkpoint chain to submitted + final state
+    async def phase3():
+        m = JobManager(str(tmp_path), exec_, workers=1)
+        job = m.get(m._idem["idem-park"])
+        assert job.state == "done"
+        assert m.result_body(job) == b'{"ok":1}'
+
+    _run(phase3())
+    recs, torn = JobJournal.replay(str(tmp_path / "journal.jsonl"))
+    assert torn == 0
+    assert [r["rec"] for r in recs] == ["submitted", "state"]
+
+
+def test_manager_retention_drops_old_terminal_jobs(tmp_path):
+    async def exec_(job, ckpts, load):
+        yield Result(200, "application/json", b"{}")
+
+    now = [1000.0]
+
+    async def phase1():
+        m = JobManager(
+            str(tmp_path), exec_, workers=1, clock=lambda: now[0]
+        )
+        job, _ = m.submit("dream", {}, "idem-old")
+        m.start()
+        assert await _wait(lambda: job.state == "done")
+        await m.stop()
+
+    _run(phase1())
+    now[0] = 1000.0 + 7200.0  # past the default 3600 s retention
+
+    async def phase2():
+        m = JobManager(
+            str(tmp_path), exec_, workers=1, clock=lambda: now[0]
+        )
+        assert m.counts()["done"] == 0
+        with pytest.raises(errors.JobNotFound):
+            m.get("anything")
+        # the idempotency slot is free again: a resubmit is a NEW job
+        job, deduped = m.submit("dream", {}, "idem-old")
+        assert not deduped
+
+    _run(phase2())
+
+
+def test_manager_runtime_eviction_and_spill_hygiene(tmp_path):
+    """A LONG-RUNNING server must not grow without bound: intermediate
+    checkpoint spills die when the result lands, and terminal jobs past
+    retention evict (records, idem slot, result spill) at submit time —
+    not only at the next boot."""
+    import os
+
+    async def exec_(job, ckpts, load):
+        yield Checkpoint(
+            stage="step", index=0, total=1, arrays={"x": np.arange(4.0)}
+        )
+        yield Result(200, "application/json", b"{}")
+
+    now = [1000.0]
+
+    async def drive():
+        m = JobManager(
+            str(tmp_path), exec_, workers=1, clock=lambda: now[0]
+        )
+        job, _ = m.submit(
+            "dream", {}, "idem-evict",
+            input_arrays={"input": np.arange(4.0)},
+        )
+        m.start()
+        assert await _wait(lambda: job.state == "done")
+        assert m.result_body(job) == b"{}"
+        spill_dir = str(tmp_path / "spill")
+        files = os.listdir(spill_dir)
+        # result retained, intermediate checkpoint spills already gone
+        assert any("result" in f for f in files)
+        assert not any(f.endswith(".npz") for f in files)
+        now[0] += 7200.0  # past the default 3600 s retention
+        m.submit("dream", {}, "idem-other")
+        assert job.id not in m._jobs
+        assert not any("result" in f for f in os.listdir(spill_dir))
+        # the idempotency slot is free again
+        j2, deduped = m.submit("dream", {}, "idem-evict")
+        assert not deduped and j2.id != job.id
+        await m.stop()
+
+    _run(drive())
+
+
+# --------------------------------------------------------------- e2e HTTP
+
+
+def test_job_dream_e2e_done_result_and_checkpoints(jobs_server):
+    form = dict(DREAM_FORM, file=_data_url(1))
+    r = httpx.post(jobs_server.base_url + "/v1/jobs", data=form, timeout=60)
+    assert r.status_code == 202, r.text
+    doc = r.json()
+    assert doc["state"] == "queued" and not doc["deduped"]
+    assert r.headers["location"] == f"/v1/jobs/{doc['id']}"
+    final = _wait_terminal(jobs_server, doc["id"])
+    assert final["state"] == "done", final
+    # input checkpoint + one per octave-ladder rung (32px, min 16 → 3)
+    assert final["checkpoints"] == 4
+    assert final["last_checkpoint"] == {"stage": "octave", "index": 2, "total": 3}
+    res = httpx.get(jobs_server.base_url + f"/v1/jobs/{doc['id']}/result")
+    assert res.status_code == 200
+    payload = res.json()
+    assert payload["layers"] == ["c2"]
+    assert payload["image"].startswith("data:image/")
+    assert res.headers["x-job-id"] == doc["id"]
+
+
+def test_job_submit_validation(jobs_server):
+    url = jobs_server.base_url + "/v1/jobs"
+    r = httpx.post(url, data={"type": "dream", "layers": "c2"})
+    assert r.status_code == 400  # no file
+    r = httpx.post(url, data={"type": "nope", "file": _data_url()})
+    assert r.status_code == 400 and r.json()["error"] == "bad_request"
+    r = httpx.post(url, data={"type": "deconv", "file": _data_url()})
+    assert r.status_code == 400  # no layer
+    r = httpx.post(
+        url, data={"type": "deconv", "file": _data_url(), "layer": "nope"}
+    )
+    assert r.status_code == 422 and r.json()["error"] == "unknown_layer"
+    r = httpx.post(
+        url,
+        data=dict(DREAM_FORM, file=_data_url()),
+        headers={"x-idempotency-key": "has spaces!"},
+    )
+    assert r.status_code == 400
+    r = httpx.get(jobs_server.base_url + "/v1/jobs/job-nonexistent")
+    assert r.status_code == 404 and r.json()["error"] == "job_not_found"
+
+
+def test_job_idempotent_resubmit_e2e(jobs_server):
+    form = dict(DREAM_FORM, file=_data_url(2))
+    r1 = httpx.post(jobs_server.base_url + "/v1/jobs", data=form, timeout=60)
+    assert r1.status_code == 202
+    # identical body → same canonical digest → same job, while live
+    r2 = httpx.post(jobs_server.base_url + "/v1/jobs", data=form, timeout=60)
+    assert r2.status_code == 202
+    assert r2.json()["id"] == r1.json()["id"] and r2.json()["deduped"]
+    final = _wait_terminal(jobs_server, r1.json()["id"])
+    assert final["state"] == "done"
+    # ... and onto the completed job
+    r3 = httpx.post(jobs_server.base_url + "/v1/jobs", data=form, timeout=60)
+    assert r3.json()["id"] == r1.json()["id"] and r3.json()["deduped"]
+    # an explicit x-idempotency-key overrides the body digest
+    r4 = httpx.post(
+        jobs_server.base_url + "/v1/jobs", data=form,
+        headers={"x-idempotency-key": "fresh-key-1"}, timeout=60,
+    )
+    assert r4.json()["id"] != r1.json()["id"] and not r4.json()["deduped"]
+    _wait_terminal(jobs_server, r4.json()["id"])
+
+
+def test_job_runner_crash_resume_byte_parity(jobs_server):
+    """THE resume contract: a job that crashes mid-dream and resumes
+    from its checkpoint produces a byte-identical final payload to an
+    uninterrupted run of the same request."""
+    form = dict(DREAM_FORM, file=_data_url(3))
+    r1 = httpx.post(
+        jobs_server.base_url + "/v1/jobs", data=form,
+        headers={"x-idempotency-key": "parity-ref"}, timeout=60,
+    )
+    ref = _wait_terminal(jobs_server, r1.json()["id"])
+    assert ref["state"] == "done" and ref["attempts"] == 1
+    body_ref = httpx.get(
+        jobs_server.base_url + f"/v1/jobs/{r1.json()['id']}/result"
+    ).content
+    # slow the octaves, and arm the crash only AFTER an octave
+    # checkpoint provably exists — a crash armed up-front fires at the
+    # FIRST boundary consult, before any octave checkpoint, and the
+    # "resume" would be a full restart that proves nothing about
+    # resume-from-checkpoint
+    _arm(jobs_server, "device.dispatch_delay_ms=p1:200")
+    try:
+        r2 = httpx.post(
+            jobs_server.base_url + "/v1/jobs", data=form,
+            headers={"x-idempotency-key": "parity-crash"}, timeout=60,
+        )
+        jid = r2.json()["id"]
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            doc = httpx.get(jobs_server.base_url + f"/v1/jobs/{jid}").json()
+            if doc["checkpoints"] >= 2:  # input + octave 0 durable
+                break
+            time.sleep(0.02)
+        assert doc["checkpoints"] >= 2, doc
+        _arm(jobs_server, "jobs.runner_crash=n1")
+        crashed = _wait_terminal(jobs_server, jid)
+    finally:
+        _disarm(jobs_server)
+    assert crashed["state"] == "done", crashed
+    assert crashed["attempts"] == 2 and crashed["resumed"]
+    # a genuine mid-dream resume records NO duplicate octave: input +
+    # exactly one checkpoint per ladder rung (a restart-from-scratch
+    # would re-record octave 0 → 5)
+    assert crashed["checkpoints"] == 4, crashed
+    events = _sse_events(
+        httpx.get(
+            jobs_server.base_url + f"/v1/jobs/{jid}/events", timeout=30
+        ).text
+    )
+    octave_idx = [
+        e["data"]["index"]
+        for e in events
+        if e["event"] == "checkpoint" and e["data"].get("stage") == "octave"
+    ]
+    assert octave_idx == [0, 1, 2]
+    assert "queued" in [e["event"] for e in events]  # the resume edge
+    body_crash = httpx.get(
+        jobs_server.base_url + f"/v1/jobs/{jid}/result"
+    ).content
+    assert body_crash == body_ref  # byte-identical
+
+
+def test_job_cancel_mid_octave(jobs_server):
+    """DELETE on a running job cancels between (or inside) octaves: the
+    device never runs the remaining octaves, and the job lands in
+    ``cancelled`` with fewer checkpoints than the ladder."""
+    _arm(jobs_server, "device.dispatch_delay_ms=p1:250")
+    try:
+        r = httpx.post(
+            jobs_server.base_url + "/v1/jobs",
+            data=dict(DREAM_FORM, file=_data_url(4)),
+            headers={"x-idempotency-key": "cancel-1"}, timeout=60,
+        )
+        assert r.status_code == 202
+        jid = r.json()["id"]
+        # wait for the first octave checkpoint (input ckpt + octave 0)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            doc = httpx.get(jobs_server.base_url + f"/v1/jobs/{jid}").json()
+            if doc["checkpoints"] >= 2:
+                break
+            time.sleep(0.02)
+        assert doc["checkpoints"] >= 2, doc
+        d = httpx.delete(jobs_server.base_url + f"/v1/jobs/{jid}")
+        assert d.status_code == 200
+        final = _wait_terminal(jobs_server, jid)
+    finally:
+        _disarm(jobs_server)
+    assert final["state"] == "cancelled", final
+    assert final["checkpoints"] < 4  # never reached the full ladder
+    res = httpx.get(jobs_server.base_url + f"/v1/jobs/{jid}/result")
+    assert res.status_code == 400  # no result for a cancelled job
+    # cancel is idempotent on a terminal job
+    d2 = httpx.delete(jobs_server.base_url + f"/v1/jobs/{jid}")
+    assert d2.status_code == 200 and d2.json()["state"] == "cancelled"
+
+
+def test_job_sse_stream_and_last_event_id_reconnect(jobs_server):
+    form = dict(DREAM_FORM, file=_data_url(5))
+    r = httpx.post(jobs_server.base_url + "/v1/jobs", data=form, timeout=60)
+    jid = r.json()["id"]
+    _wait_terminal(jobs_server, jid)
+    s = httpx.get(
+        jobs_server.base_url + f"/v1/jobs/{jid}/events", timeout=30
+    )
+    assert s.status_code == 200
+    assert s.headers["content-type"] == "text/event-stream"
+    events = _sse_events(s.text)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "submitted" and kinds[-1] == "done"
+    assert kinds.count("checkpoint") == 4
+    ids = [e["id"] for e in events]
+    assert ids == sorted(ids)  # monotone per-job event ids
+    # reconnect mid-stream: Last-Event-ID replays ONLY what was missed
+    cut = ids[len(ids) // 2]
+    s2 = httpx.get(
+        jobs_server.base_url + f"/v1/jobs/{jid}/events",
+        headers={"Last-Event-ID": str(cut)}, timeout=30,
+    )
+    events2 = _sse_events(s2.text)
+    assert [e["id"] for e in events2] == [i for i in ids if i > cut]
+    assert events2[-1]["event"] == "done"
+    # a fully caught-up reconnect replays nothing and closes cleanly
+    s3 = httpx.get(
+        jobs_server.base_url + f"/v1/jobs/{jid}/events",
+        headers={"Last-Event-ID": str(ids[-1])}, timeout=30,
+    )
+    assert _sse_events(s3.text) == []
+
+
+def test_jobs_list_readyz_config_and_exposition(jobs_server):
+    r = httpx.get(jobs_server.base_url + "/v1/jobs")
+    assert r.status_code == 200
+    listing = r.json()
+    assert listing["jobs"] and "counts" in listing
+    rz = httpx.get(jobs_server.base_url + "/readyz")
+    assert "jobs" in rz.json()
+    assert set(rz.json()["jobs"]) == {"running", "parked", "queued"}
+    cfg = httpx.get(jobs_server.base_url + "/v1/config").json()
+    assert cfg["jobs_active"] is True
+    assert cfg["jobs_dir"] is True  # masked to a boolean, never the path
+    assert cfg["jobs"]["queue_depth"] == 64
+    # exposition lint: the jobs series are TYPEd and well-formed
+    text = httpx.get(jobs_server.base_url + "/v1/metrics").text
+    types, samples = lint_exposition(text)
+    assert types["deconv_jobs_active"] == "gauge"
+    assert types["deconv_jobs_checkpoints_total"] == "counter"
+    assert types["deconv_jobs_state_total"] == "counter"
+    assert any(
+        name == "deconv_jobs_checkpoints_total"
+        and 'job_state="running"' in labels
+        for (name, labels) in samples
+    )
+
+
+def test_jobs_routes_absent_when_disabled():
+    cfg = ServerConfig(
+        image_size=16, compilation_cache_dir="", jobs_dir=""
+    )
+    from tests.test_engine_parity import TINY
+
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    svc = DeconvService(cfg, spec=TINY, params=params)
+    assert svc.jobs is None
+    assert ("POST", "/v1/jobs") not in svc.server._routes
+    assert not svc.server._prefix_routes
+
+
+def test_job_queue_full_429_e2e(tmp_path_factory):
+    with _make_service(
+        tmp_path_factory.mktemp("jobs429"),
+        jobs_queue_depth=1, jobs_workers=1,
+    ) as s:
+        _arm(s, "device.dispatch_delay_ms=p1:400")
+        try:
+            r1 = httpx.post(
+                s.base_url + "/v1/jobs",
+                data=dict(DREAM_FORM, file=_data_url(10)), timeout=60,
+            )
+            assert r1.status_code == 202
+            r2 = httpx.post(
+                s.base_url + "/v1/jobs",
+                data=dict(DREAM_FORM, file=_data_url(11)), timeout=60,
+            )
+            assert r2.status_code == 429, r2.text
+            assert r2.json()["error"] == "job_queue_full"
+            assert int(r2.headers["retry-after"]) >= 1
+        finally:
+            _disarm(s)
+        _wait_terminal(s, r1.json()["id"])
+
+
+def test_job_parked_on_drain_reclaimed_on_restart(tmp_path_factory):
+    """The graceful-drain satellite pin: a running job parks (with its
+    checkpoints journaled) instead of being abandoned, and a RESTARTED
+    process re-claims it and runs it to completion."""
+    jobs_dir = tmp_path_factory.mktemp("jobs-restart")
+    form = dict(DREAM_FORM, file=_data_url(20))
+    with _make_service(jobs_dir, jobs_workers=1) as s:
+        _arm(s, "device.dispatch_delay_ms=p1:400")
+        r = httpx.post(s.base_url + "/v1/jobs", data=form, timeout=60)
+        assert r.status_code == 202
+        jid = r.json()["id"]
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            doc = httpx.get(s.base_url + f"/v1/jobs/{jid}").json()
+            if doc["checkpoints"] >= 2:
+                break
+            time.sleep(0.02)
+        assert doc["checkpoints"] >= 2, doc
+        # fixture exit = begin_drain + stop: the running job parks
+    with _make_service(jobs_dir, jobs_workers=1) as s2:
+        assert s2.service.jobs.reclaimed == 1
+        final = _wait_terminal(s2, jid)
+        assert final["state"] == "done", final
+        assert final["resumed"]
+        res = httpx.get(s2.base_url + f"/v1/jobs/{jid}/result")
+        assert res.status_code == 200
+        assert res.json()["image"].startswith("data:image/")
+
+
+def test_job_sweep_e2e_layer_checkpoints(jobs_server):
+    r = httpx.post(
+        jobs_server.base_url + "/v1/jobs",
+        data={"type": "sweep", "file": _data_url(6), "layer": "c2",
+              "top_k": "2"},
+        timeout=60,
+    )
+    assert r.status_code == 202, r.text
+    final = _wait_terminal(jobs_server, r.json()["id"])
+    assert final["state"] == "done", final
+    payload = httpx.get(
+        jobs_server.base_url + f"/v1/jobs/{r.json()['id']}/result"
+    ).json()
+    assert payload["sweep"] is True
+    assert list(payload["layers"])  # one entry per swept layer
+    # layer checkpoints: one per swept layer, plus the input spill
+    assert final["checkpoints"] == 1 + len(payload["layers"])

@@ -17,7 +17,17 @@ Usage: python tools/loopback_load.py [--passes N] [--no-donate]
            [--key-dist unique|zipf:<s>|hotset:<k>] [--requests N]
            [--trace-ring N] [--slow-ms F] [--dump-slow PATH]
            [--chaos site=spec,...] [--pool-decode] [--lanes N]
-           [--compile-cache-dir DIR] [--heavy] [depth ...]
+           [--compile-cache-dir DIR] [--heavy] [--jobs]
+           [--jobs-dir DIR] [depth ...]
+
+Round 11 added `--jobs`: the durable-jobs chaos drill (run_jobs_drill)
+— submit hundreds of dream jobs to POST /v1/jobs while
+`jobs.runner_crash` kills the runner at checkpoint boundaries, and
+assert zero lost jobs plus checkpoint-resumed byte parity against an
+uninterrupted reference job.  `--jobs-dir DIR` (without `--jobs`)
+enables the job subsystem on a normal measurement run — the
+sync-path-overhead A/B that `tools/run_bench_suite.py`'s `jobs` token
+pins to a 3% budget.
 
 Round 10 added `--lanes N`: the process forces N virtual CPU devices
 (XLA_FLAGS --xla_force_host_platform_device_count, set before jax
@@ -221,6 +231,257 @@ async def _http(
     return status, payload
 
 
+def run_jobs_drill(
+    n_jobs: int = 256,
+    concurrency: int = 32,
+    crash_p: float = 0.05,
+    timeout_s: float = 600.0,
+) -> dict:
+    """The round-11 jobs chaos drill: submit ``n_jobs`` dream jobs while
+    ``jobs.runner_crash`` kills the runner at checkpoint boundaries with
+    probability ``crash_p``, and assert the durable-jobs contract:
+
+    - ZERO lost jobs: every accepted submit reaches a terminal state;
+    - zero failed jobs: every crash resumes from its last checkpoint
+      (the attempt budget is sized so a crash storm cannot exhaust it);
+    - checkpoint-resumed BYTE PARITY: a dedicated job crashed once
+      mid-dream produces a final payload byte-identical to an
+      uninterrupted run of the same request.
+
+    The sync-path overhead companion (the 3% budget) lives in
+    tools/run_bench_suite.py's `jobs` token: the hot cached workload
+    with the subsystem enabled (--jobs-dir) vs disabled."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+    from deconv_api_tpu.serving.app import DeconvService
+
+    # conv-only (dreams need no dense head), 32px: the octave ladder has
+    # three rungs, so every job has real checkpoint boundaries to crash
+    # and resume between
+    spec = ModelSpec(
+        name="loopback_jobs",
+        input_shape=(32, 32, 3),
+        layers=(
+            Layer("input_1", "input"),
+            Layer("c1", "conv", activation="relu", filters=8),
+            Layer("p1", "pool"),
+            Layer("c2", "conv", activation="relu", filters=8),
+        ),
+    )
+    params = init_params(spec, jax.random.PRNGKey(0))
+    jobs_dir = tempfile.mkdtemp(prefix="deconv-jobs-drill-")
+    cfg = ServerConfig(
+        image_size=32,
+        max_batch=16,
+        batch_window_ms=3.0,
+        platform="cpu",
+        compilation_cache_dir="",
+        cache_bytes=0,
+        warmup_all_buckets=False,
+        jobs_dir=jobs_dir,
+        jobs_queue_depth=n_jobs + 8,
+        jobs_workers=4,
+        # a p-crash storm may hit one job several times; the budget must
+        # out-last it or the drill measures the budget, not durability
+        jobs_max_attempts=8,
+        fault_injection=True,
+    )
+    service = DeconvService(cfg, spec=spec, params=params)
+
+    def uri_for(idx: int) -> str:
+        img = Image.fromarray(
+            np.random.default_rng(idx).integers(0, 255, (32, 32, 3), np.uint8),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        return (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+
+    dream = {"type": "dream", "layers": "c2", "steps": "2", "octaves": "3"}
+
+    async def drive():
+        port = await service.start(host="127.0.0.1", port=0)
+        # the drill only exercises the jobs path, whose octave programs
+        # compile on first use inside the (async) jobs themselves — the
+        # synchronous warmup would only compile deconv programs it
+        # never dispatches
+        service.ready = True
+
+        async def raw_get(path: str) -> bytes:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                "Connection: close\r\n\r\n".encode()
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            return raw.split(b"\r\n\r\n", 1)[1]
+
+        async def submit(idx: int, idem: str | None = None):
+            form = dict(dream, file=uri_for(idx))
+            # idempotency key via a form-independent header is not
+            # expressible through _http; fold it into the body instead
+            # (a distinct field changes the canonical digest)
+            if idem:
+                form["drill_key"] = idem
+            return await _http(port, "POST", "/v1/jobs", form)
+
+        async def wait_state(job_id: str, states=("done", "failed", "cancelled")):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                s, doc = await _http(port, "GET", f"/v1/jobs/{job_id}")
+                if s == 200 and doc["state"] in states:
+                    return doc
+                await asyncio.sleep(0.05)
+            return doc if s == 200 else None
+
+        # --- byte-parity pair: uninterrupted vs crash-once-resumed ---
+        s, ref = await submit(0, "parity-ref")
+        assert s == 202, ref
+        ref_doc = await wait_state(ref["id"])
+        assert ref_doc and ref_doc["state"] == "done", ref_doc
+        ref_body = await raw_get(f"/v1/jobs/{ref['id']}/result")
+        # slow the octaves and arm the crash only AFTER an octave
+        # checkpoint provably exists: a crash armed up-front fires at
+        # the FIRST boundary consult — before any octave checkpoint —
+        # and the "resume" would be a full restart proving nothing
+        # about resume-from-checkpoint
+        s, _ = await _http(
+            port, "POST", "/v1/debug/faults",
+            {"arm": "device.dispatch_delay_ms=p1:150"},
+        )
+        assert s == 200
+        s, crash = await submit(0, "parity-crash")
+        assert s == 202, crash
+        ckpt_seen = 0
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            s, doc = await _http(port, "GET", f"/v1/jobs/{crash['id']}")
+            ckpt_seen = doc.get("checkpoints", 0) if s == 200 else 0
+            if ckpt_seen >= 2:  # input + octave 0 durable
+                break
+            await asyncio.sleep(0.02)
+        s, _ = await _http(
+            port, "POST", "/v1/debug/faults",
+            {"arm": "jobs.runner_crash=n1"},
+        )
+        assert s == 200
+        crash_doc = await wait_state(crash["id"])
+        crash_body = await raw_get(f"/v1/jobs/{crash['id']}/result")
+        s, _ = await _http(
+            port, "POST", "/v1/debug/faults", {"disarm": "all"}
+        )
+        parity_ok = (
+            ckpt_seen >= 2  # the crash landed MID-dream, not pre-octave
+            and crash_doc is not None
+            and crash_doc["state"] == "done"
+            and crash_doc["attempts"] == 2
+            # no duplicate octave recorded: input + one per ladder rung
+            and crash_doc["checkpoints"] == 4
+            and crash_body == ref_body
+        )
+
+        # --- the fleet, under a probabilistic crash storm ---
+        s, _ = await _http(
+            port, "POST", "/v1/debug/faults",
+            {"arm": f"jobs.runner_crash=p{crash_p:g}"},
+        )
+        assert s == 200
+        sem = asyncio.Semaphore(concurrency)
+        accepted: list[str] = []
+        rejected = 0
+        t0 = time.perf_counter()
+
+        async def one(i: int):
+            nonlocal rejected
+            async with sem:
+                s, doc = await submit(i + 1)
+                if s == 202:
+                    accepted.append(doc["id"])
+                else:
+                    rejected += 1
+
+        await asyncio.gather(*(one(i) for i in range(n_jobs)))
+        submit_wall = time.perf_counter() - t0
+        # poll the collection until every accepted job is terminal
+        deadline = time.monotonic() + timeout_s
+        counts = {}
+        while time.monotonic() < deadline:
+            s, listing = await _http(port, "GET", "/v1/jobs")
+            states = {
+                j["id"]: j["state"] for j in listing.get("jobs", [])
+            }
+            live = [
+                jid
+                for jid in accepted
+                if states.get(jid) not in ("done", "failed", "cancelled")
+            ]
+            counts = listing.get("counts", {})
+            if not live:
+                break
+            await asyncio.sleep(0.1)
+        wall = time.perf_counter() - t0
+        await _http(port, "POST", "/v1/debug/faults", {"disarm": "all"})
+        s, listing = await _http(port, "GET", "/v1/jobs")
+        by_id = {j["id"]: j for j in listing.get("jobs", [])}
+        lost = sum(
+            1
+            for jid in accepted
+            if jid not in by_id
+            or by_id[jid]["state"] not in ("done", "failed", "cancelled")
+        )
+        failed = sum(
+            1 for jid in accepted if by_id.get(jid, {}).get("state") == "failed"
+        )
+        done = sum(
+            1 for jid in accepted if by_id.get(jid, {}).get("state") == "done"
+        )
+        resumed = sum(
+            1 for jid in accepted if by_id.get(jid, {}).get("resumed")
+        )
+        snap = service.metrics.snapshot()
+        crashes = snap["counters"].get("jobs_runner_crashes_total", 0)
+        ckpts = sum(
+            snap["labeled"].get("jobs_checkpoints_total", ("", {}))[1].values()
+        )
+        await service.stop()
+        row = {
+            "which": "loopback_jobs_drill",
+            "platform": "cpu-loopback",
+            "jobs_submitted": n_jobs,
+            "jobs_accepted": len(accepted),
+            "jobs_rejected": rejected,
+            "jobs_done": done,
+            "jobs_failed": failed,
+            "jobs_lost": lost,
+            "jobs_resumed": resumed,
+            "runner_crashes": crashes,
+            "checkpoints_total": ckpts,
+            "crash_p": crash_p,
+            "parity_ok": bool(parity_ok),
+            "parity_attempts": crash_doc["attempts"] if crash_doc else None,
+            "submit_wall_s": round(submit_wall, 3),
+            "wall_s": round(wall, 3),
+            "jobs_per_sec": round(len(accepted) / wall, 1) if wall else 0.0,
+            "final_counts": counts,
+        }
+        return row
+
+    return asyncio.run(drive())
+
+
 def run_load(
     pipeline_depth: int,
     n_requests: int = 512,
@@ -236,6 +497,7 @@ def run_load(
     lanes: int | None = None,
     compile_cache_dir: str = "",
     heavy: bool = False,
+    jobs_dir: str = "",
 ) -> dict:
     import jax
 
@@ -335,6 +597,11 @@ def run_load(
         # explicit lane count ('off' without --lanes): rows must stay
         # comparable run-to-run regardless of inherited XLA_FLAGS
         serve_lanes=str(lanes) if lanes else "off",
+        # sync-path overhead A/B (round 11): the jobs subsystem enabled
+        # but idle — its routes and runner tasks must cost the hot
+        # synchronous path nothing (the 3% budget in run_bench_suite's
+        # `jobs` token)
+        jobs_dir=jobs_dir,
         # legacy mode reuses 8 images; the cache would serve them and the
         # row would stop measuring the decode->dispatch->encode machinery
         cache_bytes=cfg_cache_bytes() if cache_on else 0,
@@ -705,6 +972,9 @@ def run_load(
         if heavy:
             row["which"] += "_heavy"
             row["heavy"] = True
+        if jobs_dir:
+            row["which"] += "_jobs"
+            row["jobs_subsystem"] = True
         if lanes:
             # after the cache block's which rename, so every mode's row
             # carries the lane count in its token
@@ -747,7 +1017,7 @@ def main() -> int:
     passes = 1
     donate = True
     key_dist: str | None = None
-    n_requests = 512
+    n_requests: int | None = None  # default: 512 load / 256 jobs drill
     trace_ring: int | None = None
     slow_ms: float | None = None
     dump_slow: str | None = None
@@ -756,6 +1026,8 @@ def main() -> int:
     lanes: int | None = None
     compile_cache_dir = ""
     heavy = False
+    jobs_mode = False
+    jobs_dir = ""
     concurrency = 64
     depths: list[int] = []
     i = 0
@@ -796,6 +1068,12 @@ def main() -> int:
         elif args[i] == "--heavy":
             heavy = True
             i += 1
+        elif args[i] == "--jobs":
+            jobs_mode = True
+            i += 1
+        elif args[i] == "--jobs-dir":
+            jobs_dir = args[i + 1]
+            i += 2
         elif args[i] == "--concurrency":
             concurrency = int(args[i + 1])
             i += 2
@@ -833,13 +1111,22 @@ def main() -> int:
         except ValueError as e:
             print(e, file=sys.stderr)
             return 2
+    if jobs_mode:
+        # the durable-jobs chaos drill (round 11): depths are irrelevant
+        # — jobs ride the dispatchers whatever the depth
+        row = run_jobs_drill(
+            n_jobs=n_requests or 256,
+            concurrency=min(concurrency, 32),
+        )
+        print(json.dumps(row), flush=True)
+        return 0
     for d in depths or [2, 1]:
         row = run_load(
-            d, n_requests=n_requests, passes=passes, donate=donate,
+            d, n_requests=n_requests or 512, passes=passes, donate=donate,
             key_dist=key_dist, trace_ring=trace_ring, slow_ms=slow_ms,
             dump_slow=dump_slow, chaos=chaos, pool_decode=pool_decode,
             lanes=lanes, compile_cache_dir=compile_cache_dir, heavy=heavy,
-            concurrency=concurrency,
+            concurrency=concurrency, jobs_dir=jobs_dir,
         )
         print(json.dumps(row), flush=True)
     return 0

@@ -45,6 +45,12 @@ TRACE_OVERHEAD_BUDGET_PCT = 3.0
 # baseline — capacity that does not self-restore is a supervision bug.
 CHAOS_RECOVERY_BUDGET_PCT = 5.0
 
+# Durable-jobs sync-path budget (round 11): with the job subsystem
+# enabled but idle (--jobs-dir), hot cached synchronous throughput must
+# stay within this of the jobs-disabled baseline — the async tier may
+# not tax the sync tier.
+JOBS_SYNC_OVERHEAD_BUDGET_PCT = 3.0
+
 # Executor-lane A/B budget (round 10): zipf mixed-key loopback
 # throughput with lanes=4 must beat the same-day lanes=1 baseline by at
 # least this factor — anything less means the lane scheduler is not
@@ -214,6 +220,85 @@ def run_lanes_guard(timeout_s: float = 1800.0) -> dict:
             f"lanes=4 speedup {speedup:.2f}x under the "
             f"{LANES_SPEEDUP_BUDGET:.1f}x budget on the zipf workload"
         )
+    return row
+
+
+def run_jobs_guard(timeout_s: float = 1800.0) -> dict:
+    """Durable-jobs drill + sync-overhead guard (round 11).
+
+    Part 1 — the chaos drill (tools/loopback_load.py --jobs): ≥256
+    dream jobs submitted while ``jobs.runner_crash`` kills the runner
+    at checkpoint boundaries (p=0.05), plus a dedicated parity pair.
+    The row fails LOUDLY when any job is lost or failed, when no job
+    actually exercised the resume path, or when the crashed-and-resumed
+    job's payload is not byte-identical to the uninterrupted run.
+
+    Part 2 — the sync-path A/B: the hot cached loopback workload with
+    the job subsystem enabled-but-idle vs disabled; overhead past
+    JOBS_SYNC_OVERHEAD_BUDGET_PCT fails the row."""
+    import tempfile
+
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--jobs", "--requests", "256"],
+        timeout_s, env=env,
+    )
+    jobs_dir = tempfile.mkdtemp(prefix="deconv-jobs-sync-ab-")
+    base = ["--key-dist", "hotset:8", "--passes", "3", "2"]
+    on = run_cmd_json(
+        [sys.executable, loopback, "--jobs-dir", jobs_dir, *base],
+        timeout_s, env=env,
+    )
+    off = run_cmd_json([sys.executable, loopback, *base], timeout_s, env=env)
+    row = {"config": "jobs", "which": "loopback_jobs_drill"}
+    if "error" in drill or "error" in on or "error" in off:
+        row["error"] = (
+            drill.get("error") or on.get("error") or off.get("error")
+        )
+        return row
+    on_rs, off_rs = on["requests_per_sec"], off["requests_per_sec"]
+    overhead = (off_rs - on_rs) / off_rs * 100.0 if off_rs else 0.0
+    row.update(
+        jobs_submitted=drill.get("jobs_submitted"),
+        jobs_accepted=drill.get("jobs_accepted"),
+        jobs_done=drill.get("jobs_done"),
+        jobs_failed=drill.get("jobs_failed"),
+        jobs_lost=drill.get("jobs_lost"),
+        jobs_resumed=drill.get("jobs_resumed"),
+        runner_crashes=drill.get("runner_crashes"),
+        checkpoints_total=drill.get("checkpoints_total"),
+        parity_ok=drill.get("parity_ok"),
+        jobs_per_sec=drill.get("jobs_per_sec"),
+        drill_wall_s=drill.get("wall_s"),
+        sync_jobs_on_req_s=on_rs,
+        sync_jobs_off_req_s=off_rs,
+        sync_overhead_pct=round(overhead, 2),
+        sync_budget_pct=JOBS_SYNC_OVERHEAD_BUDGET_PCT,
+    )
+    problems = []
+    if drill.get("jobs_accepted") != drill.get("jobs_submitted"):
+        problems.append(
+            f"only {drill.get('jobs_accepted')}/{drill.get('jobs_submitted')}"
+            " submits accepted"
+        )
+    if drill.get("jobs_lost", 1):
+        problems.append(f"{drill.get('jobs_lost')} jobs LOST")
+    if drill.get("jobs_failed", 1):
+        problems.append(f"{drill.get('jobs_failed')} jobs failed")
+    if not drill.get("jobs_resumed"):
+        problems.append(
+            "no job exercised the crash-resume path (drill vacuous)"
+        )
+    if not drill.get("parity_ok"):
+        problems.append("resumed job NOT byte-identical to uninterrupted run")
+    if overhead > JOBS_SYNC_OVERHEAD_BUDGET_PCT:
+        problems.append(
+            f"sync-path overhead {overhead:.1f}% with jobs enabled "
+            f"(> {JOBS_SYNC_OVERHEAD_BUDGET_PCT:.0f}% budget)"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
     return row
 
 
@@ -502,6 +587,12 @@ def main() -> int:
             # loud error under the speedup budget
             result = run_lanes_guard()
             result["date"] = date
+        elif tok == "jobs":
+            # durable-jobs drill (round 11): runner killed mid-dream,
+            # zero lost jobs + checkpoint-resume byte parity + the
+            # sync-path 3% overhead budget
+            result = run_jobs_guard()
+            result["date"] = date
         elif tok == "compile-cache":
             # persistent-compile-cache A/B (round 10): cold vs warm
             # warmup wall against one cache dir
@@ -517,7 +608,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs'])}",
             }
         else:
             n = int(tok)
