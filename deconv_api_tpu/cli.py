@@ -36,7 +36,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "cache_bytes", "cache_ttl_s",
         "trace_ring", "trace_slow_ms", "trace_sample",
         "fault_seed", "breaker_threshold", "breaker_cooldown_s",
-        "drain_grace_s", "lanes", "compile_cache_dir",
+        "drain_grace_s", "lanes", "lowc_kpack", "compile_cache_dir",
         "jobs_dir", "jobs_workers", "jobs_queue_depth",
     ):
         val = getattr(args, flag, None)
@@ -306,6 +306,13 @@ def main(argv: list[str] | None = None) -> int:
         help="executor lanes: independent per-chip dispatch streams with "
         "least-loaded batch scheduling (default auto = one per device "
         "when no mesh is configured)",
+    )
+    s.add_argument(
+        "--lowc-kpack", default=None, dest="lowc_kpack",
+        metavar="off|auto|forced|CHAN",
+        help="pack the K projections into the channel dim for the "
+        "low-channel backward tail (sequential models; default off — "
+        "see docs/OPERATIONS.md 'Low-channel layout packing')",
     )
     s.add_argument(
         "--compile-cache-dir", default=None, dest="compile_cache_dir",

@@ -198,6 +198,19 @@ class ServerConfig:
     # chain's bf16 rounding is invisible after deprocess quantisation
     # (measured ~168dB PSNR vs fp32 on VGG16) at ~1.4x the throughput.
     backward_dtype: str = "bfloat16"  # '' | 'float32' | 'bfloat16'
+    # Low-channel backward-tail packing (round 12, engine/deconv.py):
+    # fold the K top-filter projections into the channel dim for the
+    # C<=threshold tail of the backward walk, so the high-resolution
+    # low-channel convs (VGG block1, C=64 — the profiled 24%-MXU
+    # pathology) run full-lane grouped convs with a group-broadcast
+    # switch unpool.  'off' (default) | 'auto' (pack the C<=64 tail when
+    # top_k > 1) | 'forced' (whole certified C<=128 tail) | an explicit
+    # channel threshold.  Sequential-spec engines only; DAG models and
+    # dreams normalise it out (their backward is a vjp/true gradient —
+    # no per-K chain to re-lay out).  Output bytes are pinned identical
+    # on/off (tests/test_kpack.py); the knob still folds into the
+    # response-cache key prefix, same rule as DECONV_FWD_LOWC_BF16.
+    lowc_kpack: str = "off"  # 'off' | 'auto' | 'forced' | '<channels>'
     # Persistent XLA compilation cache (first compile on TPU is
     # expensive: warmup re-pays a multi-second per-bucket compile tax on
     # EVERY restart without it).  Round 10: default OFF for the server —

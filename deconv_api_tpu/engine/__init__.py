@@ -10,6 +10,7 @@ predict() round-trips (reference: app/deepdream.py:383-476).
 from deconv_api_tpu.engine.autodeconv import autodeconv_visualizer
 from deconv_api_tpu.engine.deconv import (
     get_visualizer,
+    resolve_kpack_chan,
     visualize,
     visualize_all_layers,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "deepdream_batch",
     "get_visualizer",
     "make_octave_runner",
+    "resolve_kpack_chan",
     "visualize",
     "visualize_all_layers",
 ]

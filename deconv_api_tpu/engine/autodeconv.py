@@ -42,6 +42,7 @@ def autodeconv_visualizer(
     mode: str = "all",
     sweep_layers: tuple[str, ...] | None = None,
     donate: bool = False,
+    lowc_kpack: str = "off",
 ):
     """Build a jitted ``fn(params, image) -> {images, indices, sums, valid}``.
 
@@ -62,7 +63,21 @@ def autodeconv_visualizer(
     invalidated).  Numerically inert — the serving layer's donation
     happens at its own outer jit (serving/models.py), so this flag only
     matters for direct library use.
+
+    ``lowc_kpack`` is the engine's channel-packing policy knob
+    (engine/deconv.py:resolve_kpack_chan), accepted here so a globally
+    configured policy traces through every engine uniformly — it is
+    VALIDATED but INERT on this walk: the backward projection is a
+    `jax.vjp` over the model's own forward (conv VJPs are the
+    flipped/transposed kernels XLA derives), so there is no separate
+    per-K chain whose layout could be re-packed; the K projections
+    already batch through one vmapped cotangent pass.  The program (and
+    its bytes) is identical for every policy value — pinned by
+    tests/test_kpack.py.
     """
+    from deconv_api_tpu.engine.deconv import resolve_kpack_chan
+
+    resolve_kpack_chan(lowc_kpack, top_k)  # validate the vocabulary only
     if mode not in ("all", "max"):
         raise ValueError(f"illegal visualize mode {mode!r}; expected 'all' or 'max'")
     if donate:

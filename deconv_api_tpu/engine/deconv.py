@@ -112,11 +112,11 @@ def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool,
                 dimension_numbers=("NCHW", "HWIO", "NCHW"),
             )
         elif groups > 1:
-            fk = ops.flip_kernel(params[l.name]["w"]).astype(x.dtype)
-            y = lax.conv_general_dilated(
-                x, jnp.concatenate([fk] * groups, axis=3), (1, 1), "SAME",
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                feature_group_count=groups,
+            # ONE grouped conv over the packed channel dim (ops/conv.py):
+            # the flipped kernel tiles per group, per-group reduction
+            # order matches the vmapped path exactly.
+            y = ops.conv2d_input_backward_grouped(
+                x, params[l.name]["w"].astype(x.dtype), groups
             )
         else:
             w = params[l.name]["w"].astype(x.dtype)
@@ -133,9 +133,10 @@ def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool,
         idx, out_hw = switches[e.name]
         if layout == "nchw":
             return _unpool_nchw(x, idx, l.pool_size, out_hw)
-        if groups > 1:
-            idx = jnp.tile(idx, (1, 1, 1, groups))
-        return ops.unpool_with_argmax(x, idx, l.pool_size, out_hw)
+        # groups > 1: the switch index is K-invariant, so the grouped
+        # unpool BROADCASTS it across the packed groups (ops/pool.py)
+        # instead of materialising a K-tiled index.
+        return ops.unpool_with_argmax(x, idx, l.pool_size, out_hw, groups=groups)
     if layout == "nchw":  # pragma: no cover — excluded by certification
         raise AssertionError(f"{l.kind} inside NCHW tail")
     if l.kind == "flatten":
@@ -173,10 +174,9 @@ def _down_chain(entries, params, ups, switches, x, start, stop_after,
                     x, sw_idx, e.layer.pool_size, out_hw, fuse_relu=True
                 )
             else:
-                if groups > 1:
-                    sw_idx = jnp.tile(sw_idx, (1, 1, 1, groups))
                 x = ops.unpool_with_argmax(
-                    x, sw_idx, e.layer.pool_size, out_hw, fuse_relu=True
+                    x, sw_idx, e.layer.pool_size, out_hw, fuse_relu=True,
+                    groups=groups,
                 )
             j -= 2
             continue
@@ -224,6 +224,65 @@ def _pack_boundary(entries, ups, i, max_chan: int) -> int:
             jb = j
             break
     return jb
+
+
+# lowc_kpack policy constants (round 12).  AUTO packs only where the
+# channel-minor dim under-fills the 128 vector lanes by 2x or more (VGG
+# block1, C=64 — the profiled 24%-MXU pathology); FORCED packs the whole
+# certified C<=128 tail (block2 included), the A/B-experimentation mode.
+KPACK_AUTO_CHAN = 64
+KPACK_FORCED_CHAN = 128
+
+
+def resolve_kpack_chan(policy, top_k: int = 8) -> int:
+    """Resolve the ``lowc_kpack`` policy knob to a kpack channel threshold
+    — the ONE place the off|auto|forced vocabulary (config.py) becomes an
+    engine ``kpack_chan`` value, shared by get_visualizer's env fallback,
+    the serving layer and the probes so the mapping can never drift.
+
+    - ``off`` (also '', '0', 'false', 'no'): disabled — the vmapped path.
+    - ``auto``: pack the C <= 64 tail, and only when there is more than
+      one projection to pack (top_k == 1 has no lane fill to gain, so
+      auto stays off rather than paying the pack/unpack boundary).
+    - ``forced``: pack the whole certified C <= 128 tail unconditionally.
+    - an integer (or digit string): explicit channel threshold.
+    """
+    if isinstance(policy, bool):  # guard: bool is an int subclass
+        raise ValueError(f"illegal lowc_kpack policy {policy!r}")
+    if isinstance(policy, int):
+        return policy
+    p = str(policy).strip().lower()
+    if p in ("", "0", "off", "false", "no"):
+        return 0
+    if p == "auto":
+        return KPACK_AUTO_CHAN if top_k > 1 else 0
+    if p == "forced":
+        return KPACK_FORCED_CHAN
+    if p.isdigit():
+        return int(p)
+    raise ValueError(
+        f"illegal lowc_kpack policy {policy!r}; expected "
+        "'off', 'auto', 'forced' or a channel threshold"
+    )
+
+
+def pack_k(xk):
+    """(K, B, H, W, C) -> (B, H, W, K*C): fold the K leading projections
+    into a group(K)-major packed channel dim — projection k occupies
+    channels [k*C, (k+1)*C), matching XLA's grouped-conv channel-block
+    order (ops.conv2d_input_backward_grouped) and the grouped unpool's
+    reshape (ops.unpool_with_argmax groups=)."""
+    k, b, h, w, c = xk.shape
+    return jnp.transpose(xk, (1, 2, 3, 0, 4)).reshape(b, h, w, k * c)
+
+
+def unpack_k(x, k: int):
+    """(B, H, W, K*C) -> (K, B, H, W, C): pack_k's exact inverse (pure
+    layout — transpose + reshape, no arithmetic), pinned round-trip by
+    tests/test_kpack.py."""
+    b, h, w, ck = x.shape
+    c = ck // k
+    return jnp.transpose(x.reshape(b, h, w, k, c), (3, 0, 1, 2, 4))
 
 
 def _fwd_lowc_default() -> int:
@@ -330,15 +389,24 @@ def _visualize_entry(
     whose signal has <= kpack_chan channels, for VGG16 the whole block1
     path at C=64) runs ONCE with the K projections packed into the
     channel dimension — K x C fills the 128 vector lanes that the
-    per-projection layout leaves half-empty — using grouped convolutions
-    (`feature_group_count=K`, the flipped kernel tiled per group) and a
-    channel-tiled switch unpool.  Bit-exact in fp32 (CPU test); measured
-    END-TO-END SLOWER on a v5e-1 (280 vs 368 img/s at batch 32, and
-    +6.6 GB of XLA temps — OOM at batch 64) even though the isolated
-    block1 tail is 2.5x faster (tools/kpack_probe.py): the boundary
-    transposes and the grouped-conv lowering cost more than the lane
-    packing saves.  Default OFF; kept as the measurement harness for
-    revisiting on future toolchains (same policy as ops/pallas_pool.py)."""
+    per-projection layout leaves half-empty — as ONE grouped convolution
+    per conv entry (ops.conv2d_input_backward_grouped: feature_group_count
+    = K, flipped kernel tiled per group) and a group-BROADCAST switch
+    unpool (ops.unpool_with_argmax groups=K: the K-invariant index rides
+    the one-hot broadcast; no tiled index or mask ever materialises).
+    Bit-exact vs the vmapped path in fp32 (tests/test_kpack.py pins it
+    for deconv, sweep, and the C ∈ {3, 64, 128} op shapes).
+
+    History: the r3 PROTOTYPE of this layout (inline tiled-index unpool
+    + eager boundary transposes) measured end-to-end slower on a v5e-1
+    (280 vs 368 img/s at batch 32, +6.6 GB XLA temps) despite the
+    isolated block1 tail running 2.5x faster — recorded in BASELINE.md's
+    slack ledger.  Round 12 re-engineered the tail into the dedicated
+    grouped ops above and promoted the knob to config
+    (``lowc_kpack`` off|auto|forced, resolve_kpack_chan); the default
+    stays OFF until the re-engineered form records a TPU win
+    (tools/kpack_probe.py is the standing A/B harness, the `kpack`
+    bench-suite token its regression guard)."""
     output = ups[i]
     top_idx, top_sums, valid = _select_top(output, top_k)
 
@@ -370,16 +438,16 @@ def _visualize_entry(
     def packed_tail(xk):
         """Run entries[jb..0] once with K packed into channels.
 
-        xk: (K, 1, h, w, c) -> (K, 1, H0, W0, C0)."""
-        kk, one, h, w, c = xk.shape
-        x = jnp.transpose(xk, (1, 2, 3, 0, 4)).reshape(one, h, w, kk * c)
+        xk: (K, 1, h, w, c) -> (K, 1, H0, W0, C0).  The boundary is the
+        shared pack_k/unpack_k pair (pure layout, round-trip pinned by
+        tests/test_kpack.py); everything between is the one _down_chain
+        walker with groups=K."""
+        kk = xk.shape[0]
         x = _down_chain(
-            entries, params, ups, switches, x, jb, -1, bug_compat, groups=kk
+            entries, params, ups, switches, pack_k(xk), jb, -1, bug_compat,
+            groups=kk,
         )
-        c0 = x.shape[-1] // kk
-        return jnp.transpose(
-            x.reshape(one, x.shape[1], x.shape[2], kk, c0), (3, 0, 1, 2, 4)
-        )
+        return unpack_k(x, kk)
 
     if jb >= 0:
         upper = jax.vmap(lambda t: backproject(t, jb))(top_idx)  # (K, 1, h, w, c)
@@ -511,9 +579,11 @@ def get_visualizer(
     projection chain in that dtype: filter selection and switches stay
     exact, trading a little projection precision for MXU throughput.
     ``kpack_chan`` sets the channel threshold below which the backward
-    tail runs K-packed into the channel dim (see ``_visualize_entry`` —
-    measured slower end-to-end, so the default is OFF); ``None`` reads
-    ``DECONV_KPACK_CHAN`` (default 0 = disabled).  ``sweep_merged``
+    tail runs K-packed into the channel dim (see ``_visualize_entry``;
+    the serving config surfaces it as the ``lowc_kpack`` off|auto|forced
+    policy via ``resolve_kpack_chan``); ``None`` reads the legacy
+    ``DECONV_KPACK_CHAN`` threshold if set, else resolves
+    ``DECONV_LOWC_KPACK`` (default off).  ``sweep_merged``
     selects the merged cross-layer sweep (``_sweep_merged``); ``None``
     reads ``DECONV_SWEEP_MERGED`` (default 0 = OFF — measured slower
     than the separate sweep under honest sync, 2026-07-31); a nonzero
@@ -530,7 +600,16 @@ def get_visualizer(
     import os
 
     if kpack_chan is None:
-        kpack_chan = int(os.environ.get("DECONV_KPACK_CHAN", "0"))
+        # DECONV_KPACK_CHAN (legacy r3 knob) keeps its explicit-threshold
+        # meaning when set; otherwise the config-surface policy vocabulary
+        # DECONV_LOWC_KPACK (off|auto|forced|<chan>) resolves here.
+        env = os.environ.get("DECONV_KPACK_CHAN")
+        if env is not None:
+            kpack_chan = int(env)
+        else:
+            kpack_chan = resolve_kpack_chan(
+                os.environ.get("DECONV_LOWC_KPACK", "off"), top_k
+            )
     if nchw_chan is None:
         # NCHW low-channel tail (VERDICT r3 item 4): channel threshold
         # below which the backward tail runs channels-major, dodging the

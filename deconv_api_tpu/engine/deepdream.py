@@ -313,6 +313,16 @@ def deepdream_batch(
     (InceptionV3/ResNet50) are, their heads being global-avg-pooled;
     sequential specs must be truncated below their flatten/dense head
     (`spec.truncated(deepest_layer)`) before wrapping with `spec_forward`.
+
+    The engine's low-channel layout knobs (``lowc_kpack`` / the NCHW
+    tail, engine/deconv.py) do NOT reach these programs by design: a
+    dream's backward is a TRUE gradient over the batch-major ascent loop
+    — there is no per-projection K axis to fold into channels — so a
+    globally configured packing policy leaves every dream program (fused
+    whole-dream and the per-octave checkpointed form alike)
+    byte-identical.  The serving layer normalises the knob out of its
+    dream dispatch keys accordingly (serving/models.py), and
+    tests/test_kpack.py pins the byte-parity end to end.
     """
     base = images.astype(jnp.float32)
     h, w = base.shape[1:3]

@@ -40,6 +40,14 @@ class Rules:
 
 INFERENCE_RULES = Rules(relu=ops.relu, relu6=ops.relu6)
 DECONV_RULES = Rules(relu=ops.deconv_relu, relu6=ops.deconv_relu6)
+# Note on the engine's low-channel packing knob (``lowc_kpack``,
+# engine/deconv.py): models built from these blocks project via jax.vjp
+# of their forward, so their backward convs are whatever VJP rules XLA
+# derives for ops.conv2d — including the grouped/depthwise forms below,
+# whose VJP is already a per-group flipped-kernel conv.  There is no
+# hand-walked per-K backward chain here to re-lay out, so the packing
+# policy is validated-but-inert for DAG models (see
+# autodeconv_visualizer); the sequential engine owns the packed tail.
 
 
 def maxpool(

@@ -17,7 +17,9 @@ from deconv_api_tpu.ops.activations import (
 from deconv_api_tpu.ops.conv import (
     conv2d,
     conv2d_input_backward,
+    conv2d_input_backward_grouped,
     flip_kernel,
+    tile_kernel_groups,
 )
 from deconv_api_tpu.ops.linear import (
     dense,
@@ -37,6 +39,7 @@ __all__ = [
     "apply_activation",
     "conv2d",
     "conv2d_input_backward",
+    "conv2d_input_backward_grouped",
     "deconv_relu",
     "deconv_relu6",
     "dense",
@@ -50,6 +53,7 @@ __all__ = [
     "relu",
     "relu6",
     "softmax",
+    "tile_kernel_groups",
     "unflatten",
     "unpool_with_switches",
 ]

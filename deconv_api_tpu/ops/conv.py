@@ -62,6 +62,59 @@ def flip_kernel(w: jnp.ndarray) -> jnp.ndarray:
     return jnp.transpose(w, (0, 1, 3, 2))[::-1, ::-1, :, :]
 
 
+def tile_kernel_groups(w: jnp.ndarray, groups: int) -> jnp.ndarray:
+    """Tile an HWIO kernel ``groups`` times along its output-channel axis —
+    the kernel form of a `feature_group_count=groups` conv in which every
+    group applies the SAME weights.
+
+    XLA's grouped-conv semantics: input channels split into `groups`
+    contiguous blocks; output block g uses kernel slice
+    ``w[..., g*cout_per_group:(g+1)*cout_per_group]`` with input block g.
+    Tiling the one kernel therefore makes each packed group an independent
+    copy of the same convolution — the channel-packed ("kpack") layout of
+    the low-C backward tail (engine/deconv.py)."""
+    if groups <= 1:
+        return w
+    return jnp.concatenate([w] * groups, axis=3)
+
+
+def conv2d_input_backward_grouped(
+    y: jnp.ndarray,
+    w: jnp.ndarray,
+    groups: int,
+) -> jnp.ndarray:
+    """Deconvnet backward projection of ``groups`` independent signals
+    packed into the channel dim: ``y`` is (B, H, W, Cout*groups) with
+    group-major channel order (signal g occupies channels
+    ``[g*Cout, (g+1)*Cout)``), ``w`` the UNFLIPPED forward HWIO kernel
+    shared by every group; returns (B, H, W, Cin*groups).
+
+    One grouped `lax.conv_general_dilated` call instead of `groups`
+    vmapped convs: on TPU the packed channel-minor dim (Cout*groups wide)
+    fills the 128 vector lanes that a low-C per-group layout leaves
+    underfilled.  Per-group reduction order is identical to the separate
+    convs (groups do not mix), so the result is bit-equal to the vmapped
+    path (tests/test_kpack.py pins C ∈ {3, 64, 128}).
+
+    Only the stride-1 SAME odd-kernel case exists here — the engine's
+    `_pack_boundary` certification admits nothing else into a packed
+    tail; asserting keeps a future caller from silently getting the
+    wrong transpose for a strided conv."""
+    kh, kw = w.shape[0], w.shape[1]
+    assert kh % 2 == 1 and kw % 2 == 1, (
+        "grouped backward projection is only defined for odd SAME "
+        "stride-1 kernels (the kpack certification)"
+    )
+    return lax.conv_general_dilated(
+        y,
+        tile_kernel_groups(flip_kernel(w), groups),
+        window_strides=(1, 1),
+        padding="SAME",
+        dimension_numbers=DIMENSION_NUMBERS,
+        feature_group_count=groups,
+    )
+
+
 def conv2d_input_backward(
     y: jnp.ndarray,
     w: jnp.ndarray,

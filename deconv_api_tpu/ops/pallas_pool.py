@@ -1,4 +1,13 @@
-"""Pallas TPU kernels for the deconvnet's switch pool/unpool hot path.
+"""EXPERIMENTAL Pallas TPU kernels for the switch pool/unpool hot path.
+
+Status (round 12): explicitly gated as a measured-negative experiment.
+The engine's supported low-channel story is the channel-packed backward
+tail (`lowc_kpack`, engine/deconv.py: grouped convs + the group-broadcast
+unpool in ops/pool.py); these kernels remain importable and tested behind
+`pallas_enabled()` (DECONV_PALLAS opt-in, TPU only) purely as the
+measurement harness for re-probing the custom-call trade-off on future
+toolchains — enabling them logs a one-time experimental warning.
+
 
 The reference's hot loop #1 is an interpreted 4-deep Python loop recording
 max-pool switches (app/deepdream.py:152-188, SURVEY §3.2); the XLA rewrite
@@ -179,18 +188,39 @@ def unpool_argmax_pallas(
     )(y, idx)
 
 
+_EXPERIMENTAL_WARNED = False
+
+
 def pallas_enabled(op: str = "") -> bool:
     """Pallas dispatch policy, TPU only and opt-in (see module docstring for
     the measurements behind the default).  DECONV_PALLAS: '0' (default,
-    off), '1' (all ops), or a comma list of op names ('pool', 'unpool')."""
+    off), '1' (all ops), or a comma list of op names ('pool', 'unpool').
+
+    Enabling logs a ONE-TIME experimental warning: both recorded TPU
+    measurements (r2, r3-pipelined) had XLA beating these kernels end to
+    end, and the packed low-C tail (lowc_kpack) superseded them as the
+    supported attack on the same slack — an operator flipping this on in
+    production should be doing it on purpose, with a stopwatch."""
     val = os.environ.get("DECONV_PALLAS", "0").lower()
     if val in ("0", "false", "off", ""):
         return False
     if jax.default_backend() != "tpu":
         return False
-    if val in ("1", "true", "on", "all"):
-        return True
-    return op in val.split(",")
+    enabled = (
+        True if val in ("1", "true", "on", "all") else op in val.split(",")
+    )
+    global _EXPERIMENTAL_WARNED
+    if enabled and not _EXPERIMENTAL_WARNED:
+        _EXPERIMENTAL_WARNED = True
+        import warnings
+
+        warnings.warn(
+            "DECONV_PALLAS is EXPERIMENTAL and measured slower end-to-end "
+            "than the XLA lowering (ops/pallas_pool.py docstring); the "
+            "supported low-channel path is lowc_kpack",
+            stacklevel=2,
+        )
+    return enabled
 
 
 # --- vmap composition -------------------------------------------------------

@@ -69,6 +69,7 @@ def unpool_with_argmax(
     pool_size: Sequence[int] = (2, 2),
     out_hw: tuple[int, int] | None = None,
     fuse_relu: bool = False,
+    groups: int = 1,
 ) -> jnp.ndarray:
     """Scatter each pooled value to its window's argmax position — the
     reference's `np.kron(input, ones(tile)) * switch`
@@ -82,10 +83,19 @@ def unpool_with_argmax(
     uses it for the unpool+ReLU pair of the down chain; semantics hold on
     every dispatch path (the pallas kernel folds it in; XLA fuses the
     equivalent `relu(y)` below).
+
+    ``groups > 1`` is the channel-packed ("kpack") form: ``y`` carries
+    `groups` independent signals packed group-major into its channel dim
+    (C_y = groups * C_idx) while ``idx`` stays at its forward-recorded
+    width — the switch index is group-invariant, so the one-hot mask
+    BROADCASTS across the group axis instead of ever materialising a
+    group-tiled index or mask.  Bit-equal to tiling the index (the same
+    multiplications happen; no reductions are involved), pinned by
+    tests/test_kpack.py.
     """
     ph, pw = int(pool_size[0]), int(pool_size[1])
     b, ho, wo, c = y.shape
-    if out_hw is None or out_hw == (ho * ph, wo * pw):
+    if groups <= 1 and (out_hw is None or out_hw == (ho * ph, wo * pw)):
         from deconv_api_tpu.ops import pallas_pool
 
         if pallas_pool.pallas_enabled("unpool"):
@@ -95,7 +105,21 @@ def unpool_with_argmax(
         # values, zeros elsewhere
         y = jnp.maximum(y, 0.0).astype(y.dtype)
     mask = _argmax_mask(idx, (ph, pw))
-    up = y[:, :, None, :, None, :] * mask.astype(y.dtype)
+    if groups > 1:
+        cg = c // groups
+        assert cg * groups == c and idx.shape[-1] == cg, (
+            f"packed unpool: {c} channels not {groups} groups of the "
+            f"{idx.shape[-1]}-channel switch index"
+        )
+        # (B, Ho, 1, Wo, 1, G, Cg) * (B, Ho, ph, Wo, pw, 1, Cg): the group
+        # axis rides the broadcast, the index expands once.
+        yg = y.reshape(b, ho, wo, groups, cg)
+        up = (
+            yg[:, :, None, :, None, :, :]
+            * mask[:, :, :, :, :, None, :].astype(y.dtype)
+        )
+    else:
+        up = y[:, :, None, :, None, :] * mask.astype(y.dtype)
     up = up.reshape(b, ho * ph, wo * pw, c)
     if out_hw is not None and out_hw != (ho * ph, wo * pw):
         up = jnp.pad(

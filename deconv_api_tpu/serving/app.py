@@ -68,6 +68,12 @@ class DeconvService:
         self.cfg = cfg or ServerConfig.from_env()
         apply_platform(self.cfg)
         enable_compilation_cache(self.cfg)
+        # Fail a mistyped packing policy at BOOT, not at the first
+        # dispatch (resolve_kpack_chan owns the off|auto|forced|<chan>
+        # vocabulary; the same call validates per-visualizer later).
+        from deconv_api_tpu.engine.deconv import resolve_kpack_chan
+
+        resolve_kpack_chan(self.cfg.lowc_kpack, self.cfg.top_k)
         if spec is not None:
             # injected sequential model (tests, embedding)
             self.bundle = spec_bundle(spec, params)
@@ -309,6 +315,11 @@ class DeconvService:
                 self.cfg.strict_compat,
                 self.cfg.dtype,
                 self.cfg.backward_dtype,
+                # backward-tail packing policy (round 12): pinned
+                # bit-inert (tests/test_kpack.py), but config changes
+                # invalidate every key by rule — same treatment as
+                # DECONV_FWD_LOWC_BF16 below.
+                self.cfg.lowc_kpack,
                 self.cfg.weights_path,
                 # engine env knob that changes output bytes (BASELINE r4c)
                 os.environ.get("DECONV_FWD_LOWC_BF16", "0"),
@@ -468,6 +479,7 @@ class DeconvService:
             layer_name, mode, top_k, self.cfg.bug_compat,
             self.cfg.backward_dtype or None, post, sweep,
             donate=self.cfg.donate_inputs, lane=lane,
+            lowc_kpack=self.cfg.lowc_kpack,
         )
         bucket = self._bucket_for(len(images))
         # Assemble the padded batch into a reusable input-ring buffer
@@ -1249,6 +1261,21 @@ class DeconvService:
             cfg[key] = bool(cfg[key])
         cfg["mesh_active"] = self.mesh is not None
         cfg["model_active"] = self.bundle.name
+        # Low-channel backward-tail packing (round 12): the channel
+        # threshold the POLICY resolves to — 0 when the policy is off OR
+        # the active model is a DAG backbone (the vjp walk has no packed
+        # layout; serving/models.py normalises it out).  Resolved WITHOUT
+        # a k: each dispatched program re-resolves with its own request k
+        # (grid route: stitch_k; /v1/deconv: the request's top_k), and
+        # 'auto' additionally disengages for k == 1 requests — a
+        # per-program value would misreport any mixed-k traffic.
+        from deconv_api_tpu.engine.deconv import resolve_kpack_chan
+
+        cfg["lowc_kpack_chan"] = (
+            resolve_kpack_chan(self.cfg.lowc_kpack)
+            if self.bundle.spec is not None
+            else 0
+        )
         # live response-cache state (round 7): operators confirm the cache
         # is on and how full it is without scraping /metrics
         cfg["cache_active"] = self.cache is not None
@@ -2189,6 +2216,13 @@ def main(argv: list[str] | None = None) -> None:
         "when no mesh is configured; N must divide the device count)",
     )
     p.add_argument(
+        "--lowc-kpack", default=None, metavar="off|auto|forced|CHAN",
+        help="pack the K projections into the channel dim for the "
+        "low-channel backward tail (sequential models): auto = C<=64, "
+        "forced = the whole certified C<=128 tail, or an explicit "
+        "channel threshold (default off)",
+    )
+    p.add_argument(
         "--compile-cache-dir", default=None, metavar="DIR",
         help="persistent XLA compilation cache directory (default off): "
         "warm restarts skip the per-bucket-per-lane warmup compile tax",
@@ -2235,6 +2269,8 @@ def main(argv: list[str] | None = None) -> None:
         overrides["drain_grace_s"] = args.drain_grace_s
     if args.lanes is not None:
         overrides["serve_lanes"] = args.lanes
+    if args.lowc_kpack is not None:
+        overrides["lowc_kpack"] = args.lowc_kpack
     if args.compile_cache_dir is not None:
         overrides["compilation_cache_dir"] = args.compile_cache_dir
     if args.jobs_dir is not None:

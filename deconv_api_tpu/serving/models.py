@@ -164,6 +164,7 @@ class ModelBundle:
         sweep: bool = False,
         donate: bool = False,
         lane: int = 0,
+        lowc_kpack: str = "off",
     ):
         """fn(params, batch) -> {layer: {..., indices, sums, valid}} —
         jitted once per static configuration and cached.  ``bug_compat``
@@ -204,7 +205,15 @@ class ModelBundle:
         pinned to its own param replica — a multi-device-sweeping cache
         key lookup can never route lane 1's batch through lane 0's
         compiled program.  Lanes backed by a Mesh slice run dp-sharded
-        over it, exactly like the whole-pool mesh path."""
+        over it, exactly like the whole-pool mesh path.
+
+        ``lowc_kpack`` (round 12) is the low-channel backward-tail
+        packing policy (config.py; engine/deconv.py:resolve_kpack_chan).
+        Sequential specs thread it into the engine as a kpack channel
+        threshold; DAG models normalise it to "off" BEFORE the cache key
+        (same rule as backward_dtype — their vjp walk has no packed
+        layout, so distinct policy values must not compile duplicate
+        identical executables)."""
         lane_pl = self.lane_placement(lane)
         lane_mesh = None
         if lane_pl is not None:
@@ -213,16 +222,24 @@ class ModelBundle:
             if isinstance(lane_pl, Mesh):
                 lane_mesh = lane_pl
         mesh = self.mesh if self.mesh is not None else lane_mesh
+        from deconv_api_tpu.engine.deconv import resolve_kpack_chan
+
+        # Resolve (and thereby validate) the policy for every model
+        # family; only sequential specs key their cache on the result.
+        kpack_chan = resolve_kpack_chan(lowc_kpack, top_k)
         if self.spec is None:
             backward_dtype = None
+            kpack_chan = 0
         if mesh is not None:
             donate = False  # sharded jit boundary; donation not threaded
         if donate:
             from deconv_api_tpu.engine.deconv import allow_unusable_donation
 
             allow_unusable_donation()
+        # lane stays the key's TAIL — test_lanes and the warmup loop read
+        # k[-1] as the lane a cached program is pinned to
         key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep,
-               donate, lane)
+               donate, kpack_chan, lane)
         if key not in self._vis_cache:
             if self.spec is not None:
                 # On a dp mesh the merged-sweep batch chunking must stay
@@ -234,6 +251,7 @@ class ModelBundle:
                     self.spec, layer, top_k, mode, bug_compat,
                     sweep=sweep, batched=True,
                     backward_dtype=backward_dtype or None,
+                    kpack_chan=kpack_chan,
                     sweep_chunk=0 if mesh is not None else None,
                 )
             else:

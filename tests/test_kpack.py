@@ -1,0 +1,356 @@
+"""Channel-packed low-C backward tail (round 12): the lowc_kpack subsystem.
+
+Fast-lane (tier-1) coverage of the packed layout at CPU-sized shapes, so
+layout drift is caught without a TPU: pack/unpack round-trip, grouped-conv
+bit-parity against the per-K convs at C ∈ {3, 64, 128}, the group-broadcast
+switch unpool on odd batch/extent shapes, the off|auto|forced knob resolving
+through `/v1/config`, and end-to-end serving byte-parity with the knob on vs
+off (deconv, sweep, dream — cache bypassed).  Real-backbone (VGG16/VGG19)
+parity is the slow-marked class at the bottom; headline-shape A/B *timing*
+lives in tools/kpack_probe.py (the `kpack` bench-suite token).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.engine.deconv import (
+    KPACK_AUTO_CHAN,
+    KPACK_FORCED_CHAN,
+    get_visualizer,
+    pack_k,
+    resolve_kpack_chan,
+    unpack_k,
+)
+from deconv_api_tpu.models.spec import init_params
+from tests.test_engine_parity import TINY
+
+
+# ---------------------------------------------------------------- helpers
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_params(TINY, jax.random.PRNGKey(42))
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), dtype
+    )
+
+
+# ---------------------------------------------------- pack/unpack boundary
+
+
+class TestPackBoundary:
+    def test_round_trip_is_identity(self):
+        xk = _rand((3, 2, 4, 5, 6))
+        packed = pack_k(xk)
+        assert packed.shape == (2, 4, 5, 3 * 6)
+        assert jnp.array_equal(unpack_k(packed, 3), xk)
+
+    def test_group_major_channel_order(self):
+        """Projection k must occupy channels [k*C, (k+1)*C) — XLA's
+        grouped-conv channel-block order; a drifted pack order would make
+        every grouped conv silently mix projections."""
+        xk = _rand((4, 1, 2, 2, 3), seed=1)
+        packed = np.asarray(pack_k(xk))
+        for k in range(4):
+            np.testing.assert_array_equal(
+                packed[..., k * 3 : (k + 1) * 3], np.asarray(xk[k])
+            )
+
+
+# ------------------------------------------------------------ grouped ops
+
+
+class TestGroupedOps:
+    @pytest.mark.parametrize("c", [3, 64, 128])
+    def test_grouped_conv_bit_parity(self, c):
+        """ONE grouped flipped-conv over the packed channel dim must be
+        bit-equal to the per-K convs it replaces (groups do not mix, and
+        per-group contraction order is unchanged)."""
+        cin, k, b, h, w = 5, 4, 2, 6, 6
+        y = _rand((k, b, h, w, c), seed=c)
+        kern = _rand((3, 3, cin, c), seed=c + 1)
+        got = unpack_k(
+            ops.conv2d_input_backward_grouped(pack_k(y), kern, k), k
+        )
+        want = jnp.stack(
+            [ops.conv2d_input_backward(y[i], kern) for i in range(k)]
+        )
+        assert got.shape == want.shape == (k, b, h, w, cin)
+        assert jnp.array_equal(got, want)
+
+    def test_tile_kernel_groups_identity_at_one(self):
+        kern = _rand((3, 3, 2, 4))
+        assert ops.tile_kernel_groups(kern, 1) is kern
+
+    @pytest.mark.parametrize("fuse_relu", [False, True])
+    @pytest.mark.parametrize(
+        "b,out_hw",
+        [(2, None), (3, (7, 11)), (5, (6, 10))],  # odd batch + odd extents
+    )
+    def test_grouped_unpool_matches_tiled_index(self, b, out_hw, fuse_relu):
+        """The group-broadcast unpool (K-invariant switch index riding the
+        one-hot broadcast) must be bit-equal to materialising a K-tiled
+        index — including on odd batch sizes and odd padded extents (the
+        serving bucket shapes)."""
+        g, c, ho, wo = 4, 3, 3, 5
+        y = _rand((b, ho, wo, g * c), seed=b)
+        idx = jnp.asarray(
+            np.random.default_rng(b).integers(0, 4, (b, ho, wo, c)), jnp.int8
+        )
+        got = ops.unpool_with_argmax(
+            y, idx, (2, 2), out_hw, fuse_relu=fuse_relu, groups=g
+        )
+        want = ops.unpool_with_argmax(
+            y, jnp.tile(idx, (1, 1, 1, g)), (2, 2), out_hw,
+            fuse_relu=fuse_relu,
+        )
+        assert jnp.array_equal(got, want)
+
+    def test_grouped_unpool_rejects_channel_mismatch(self):
+        y = _rand((1, 2, 2, 7))  # 7 not divisible into 2 groups of 3
+        idx = jnp.zeros((1, 2, 2, 3), jnp.int8)
+        with pytest.raises(AssertionError, match="packed unpool"):
+            ops.unpool_with_argmax(y, idx, (2, 2), groups=2)
+
+
+# ------------------------------------------------------- policy resolution
+
+
+class TestResolveKpackChan:
+    @pytest.mark.parametrize(
+        "policy,want",
+        [
+            ("off", 0), ("", 0), ("0", 0), ("false", 0), ("no", 0),
+            ("OFF", 0), ("auto", KPACK_AUTO_CHAN),
+            ("forced", KPACK_FORCED_CHAN), ("96", 96), (32, 32), (0, 0),
+        ],
+    )
+    def test_vocabulary(self, policy, want):
+        assert resolve_kpack_chan(policy, top_k=8) == want
+
+    def test_auto_needs_multiple_projections(self):
+        # top_k == 1 has no lane fill to gain; auto stays off rather than
+        # paying the pack/unpack boundary for nothing
+        assert resolve_kpack_chan("auto", top_k=1) == 0
+        assert resolve_kpack_chan("auto", top_k=2) == KPACK_AUTO_CHAN
+
+    @pytest.mark.parametrize("policy", ["bogus", "-8", "3.5", True])
+    def test_rejects_garbage(self, policy):
+        with pytest.raises(ValueError, match="lowc_kpack"):
+            resolve_kpack_chan(policy, top_k=8)
+
+
+# ----------------------------------------------------- engine env plumbing
+
+
+class TestEngineEnvKnob:
+    def _lowered_text(self, params, batch, **kw):
+        fn = get_visualizer(TINY, "b2c1", 4, "all", True, batched=True, **kw)
+        return fn.lower(params, batch).as_text()
+
+    def test_lowc_kpack_env_builds_packed_program(
+        self, tiny_params, monkeypatch
+    ):
+        """DECONV_LOWC_KPACK=forced must actually change the compiled
+        program (grouped convs with feature_group_count == top_k appear),
+        and the legacy DECONV_KPACK_CHAN threshold must keep precedence.
+        Env vars resolve OUTSIDE the visualizer cache, so monkeypatching
+        between calls takes effect."""
+        batch = _rand((2, 16, 16, 3), seed=7)
+        monkeypatch.delenv("DECONV_KPACK_CHAN", raising=False)
+        monkeypatch.setenv("DECONV_LOWC_KPACK", "forced")
+        assert "feature_group_count = 4" in self._lowered_text(
+            tiny_params, batch
+        )
+        # legacy explicit threshold wins over the policy vocabulary
+        monkeypatch.setenv("DECONV_KPACK_CHAN", "0")
+        assert "feature_group_count = 4" not in self._lowered_text(
+            tiny_params, batch
+        )
+        monkeypatch.delenv("DECONV_KPACK_CHAN")
+        monkeypatch.setenv("DECONV_LOWC_KPACK", "off")
+        assert "feature_group_count = 4" not in self._lowered_text(
+            tiny_params, batch
+        )
+
+    def test_env_packed_output_bit_equal(self, tiny_params, monkeypatch):
+        batch = _rand((2, 16, 16, 3), seed=8)
+        monkeypatch.delenv("DECONV_KPACK_CHAN", raising=False)
+        monkeypatch.setenv("DECONV_LOWC_KPACK", "off")
+        base = get_visualizer(TINY, "b2c1", 4, "all", True, batched=True)(
+            tiny_params, batch
+        )["b2c1"]
+        monkeypatch.setenv("DECONV_LOWC_KPACK", "forced")
+        pack = get_visualizer(TINY, "b2c1", 4, "all", True, batched=True)(
+            tiny_params, batch
+        )["b2c1"]
+        assert jnp.array_equal(base["images"], pack["images"])
+        assert jnp.array_equal(base["indices"], pack["indices"])
+
+
+# ------------------------------------------------------- DAG normalisation
+
+
+class TestDagInert:
+    def test_autodeconv_validates_but_ignores(self, tiny_params):
+        """The vjp walk has no per-K chain to re-lay out: the policy is
+        accepted (and validated) but the projection is identical."""
+        from deconv_api_tpu.engine import autodeconv_visualizer
+        from deconv_api_tpu.models.apply import spec_forward
+
+        img = _rand((16, 16, 3), seed=9)
+        base = autodeconv_visualizer(
+            spec_forward(TINY), "b2c1", top_k=4, lowc_kpack="off"
+        )(tiny_params, img)
+        pack = autodeconv_visualizer(
+            spec_forward(TINY), "b2c1", top_k=4, lowc_kpack="forced"
+        )(tiny_params, img)
+        assert jnp.array_equal(base["images"], pack["images"])
+        with pytest.raises(ValueError, match="lowc_kpack"):
+            autodeconv_visualizer(
+                spec_forward(TINY), "b2c1", top_k=4, lowc_kpack="bogus"
+            )
+
+    def test_bundle_normalises_policy_out_of_cache_key(self, tiny_params):
+        """A DAG bundle must hand back the SAME cached program for every
+        policy value — distinct values compiling duplicate identical
+        executables would double warmup and HBM for nothing."""
+        from deconv_api_tpu.models.apply import spec_forward
+        from deconv_api_tpu.serving.models import ModelBundle
+
+        bundle = ModelBundle(
+            name="tiny_dag",
+            params=tiny_params,
+            image_size=16,
+            preprocess=lambda x: x,
+            layer_names=("b1c1", "b1c2", "b2c1"),
+            dream_layers=(),
+            forward_fn=spec_forward(TINY),
+        )
+        off = bundle.batched_visualizer("b2c1", "all", 4, lowc_kpack="off")
+        forced = bundle.batched_visualizer(
+            "b2c1", "all", 4, lowc_kpack="forced"
+        )
+        assert off is forced
+
+
+# --------------------------------------------------------- serving (e2e)
+
+
+def _service(lowc_kpack: str):
+    from deconv_api_tpu.config import ServerConfig
+    from tests.test_serving import ServiceFixture
+
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        compilation_cache_dir="",
+        lowc_kpack=lowc_kpack,
+    )
+    return ServiceFixture(cfg)
+
+
+class TestServingKnob:
+    @pytest.mark.parametrize(
+        "policy,want_chan",
+        [("off", 0), ("auto", KPACK_AUTO_CHAN), ("forced", KPACK_FORCED_CHAN)],
+    )
+    def test_config_reports_resolved_threshold(self, policy, want_chan):
+        import httpx
+
+        with _service(policy) as s:
+            cfg = httpx.get(s.base_url + "/v1/config").json()
+            assert cfg["lowc_kpack"] == policy
+            assert cfg["lowc_kpack_chan"] == want_chan
+
+    def test_boot_rejects_bad_policy(self):
+        from deconv_api_tpu.config import ServerConfig
+        from deconv_api_tpu.serving.app import DeconvService
+
+        params = init_params(TINY, jax.random.PRNGKey(3))
+        with pytest.raises(ValueError, match="lowc_kpack"):
+            DeconvService(
+                ServerConfig(
+                    image_size=16, lowc_kpack="bogus",
+                    compilation_cache_dir="",
+                ),
+                spec=TINY, params=params,
+            )
+
+    def test_e2e_byte_parity_packed_vs_vmapped(self):
+        """The serving contract behind the knob: the SAME request bytes
+        come back with lowc_kpack forced vs off — deconv, sweep and dream
+        alike — with the response cache bypassed so the device program
+        actually runs on both sides."""
+        import httpx
+
+        from tests.test_serving import _data_url
+
+        headers = {"Cache-Control": "no-cache, no-store"}
+        requests = [
+            ("/v1/deconv", {"file": _data_url(5), "layer": "b2c1"}),
+            (
+                "/v1/deconv",
+                {"file": _data_url(5), "layer": "b2c1", "sweep": "1"},
+            ),
+            (
+                "/v1/dream",
+                {
+                    "file": _data_url(5), "layers": "b2c1", "steps": "2",
+                    "octaves": "2", "lr": "0.05",
+                },
+            ),
+        ]
+        bodies: dict[str, list[bytes]] = {"off": [], "forced": []}
+        for policy in ("off", "forced"):
+            with _service(policy) as s:
+                for path, form in requests:
+                    r = httpx.post(
+                        s.base_url + path, data=form, headers=headers,
+                        timeout=120,
+                    )
+                    assert r.status_code == 200, r.text
+                    assert r.headers["x-cache"] == "bypass"
+                    bodies[policy].append(r.content)
+        for (path, form), off, forced in zip(
+            requests, bodies["off"], bodies["forced"]
+        ):
+            assert off == forced, f"{path} {form.get('sweep', '')} drifted"
+
+
+# ------------------------------------------------- real backbones (slow)
+
+
+@pytest.mark.slow
+class TestRealBackbones:
+    """VGG16/VGG19 packed-vs-vmapped bit parity at real channel widths
+    (C=64/128 tails at 224²) — the shapes tools/kpack_probe.py times.
+    ResNet50's pin is cheap (the DAG path normalises the knob out) so it
+    rides the fast-lane TestDagInert instead."""
+
+    @pytest.mark.parametrize("family", ["vgg16", "vgg19"])
+    def test_packed_tail_bit_parity(self, family):
+        if family == "vgg16":
+            from deconv_api_tpu.models.vgg16 import vgg16_init as init
+        else:
+            from deconv_api_tpu.models.vgg19 import vgg19_init as init
+        spec, params = init()
+        batch = _rand((1, 224, 224, 3), seed=11) * 30.0
+        layer = "block3_conv1"  # packed boundary covers the C<=128 tail
+        base = get_visualizer(
+            spec, layer, 8, "all", True, batched=True, kpack_chan=0
+        )(params, batch)[layer]
+        pack = get_visualizer(
+            spec, layer, 8, "all", True, batched=True,
+            kpack_chan=KPACK_FORCED_CHAN,
+        )(params, batch)[layer]
+        assert jnp.array_equal(base["indices"], pack["indices"])
+        assert jnp.array_equal(base["images"], pack["images"])

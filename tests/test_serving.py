@@ -926,7 +926,7 @@ def test_warmup_sweep_precompiles_sweep_program():
         s.service.warmup()
         # the sweep executable is in the bundle's visualizer cache now
         # (key: layer, mode, top_k, bug_compat, backward_dtype, post,
-        # sweep, donate, lane — sweep is index 6)
+        # sweep, donate, kpack_chan, lane — sweep is index 6)
         sweep_keys = [
             k for k in s.service.bundle._vis_cache if k[6] is True
         ]

@@ -1,25 +1,33 @@
-"""Prototype: pack the K=8 projections into the channel dim for the
-high-resolution backward tail (block1), where C=64 wastes half the
-128-wide vector lanes (XLA pads the channel-minor dim to 128, doubling
-both HBM bytes and MXU time).
+"""Regression probe for the channel-packed low-C backward tail (round 12).
 
-Current engine layout (vmap over K): block1 backward tensors are
-(B*K, 224, 224, 64) — lanes half-empty.
-Packed layout: (B, 224, 224, 64*K=512) — lanes full; the per-K convs
-become ONE grouped conv (feature_group_count=K) with the flipped kernel
-tiled K times; the unpool switch index broadcasts across K groups.
+Promoted from the r3 prototype (which timed a hand-rolled block1 chain in
+isolation — the "tail 2.5x faster" figure in BASELINE.md's slack ledger):
+the probe now A/Bs the REAL engine program at headline shapes.  It builds
+the `get_visualizer` headline config (fp32 forward + bf16 backward) twice
+— `lowc_kpack` packed vs the default vmapped path — and:
 
-This probe times the block1 backward chain both ways at headline shapes
-and checks bit-equality, to decide whether to wire the layout switch
-into the engine at the block2->block1 boundary.
+1. asserts BIT-EQUALITY of the two paths on the exact-fp32 program
+   (indices and images; exits nonzero on drift — the layout-correctness
+   contract, also pinned CPU-sized in tests/test_kpack.py),
+2. verifies the packed program actually ENGAGED (grouped convs with
+   `feature_group_count == top_k` present in the lowering — a probe that
+   silently times two identical programs would record a vacuous 1.0x),
+3. times both at the headline shape under stream-fused sync (the bench.py
+   methodology: dispatch every iter, fetch one trailing checksum),
+4. emits ONE JSON row for bench_suite_results.jsonl — the `kpack` token
+   in tools/run_bench_suite.py wraps it and adds the loud `error` field
+   when the packed path regresses.
 
-Chain (from the unpool1 input down, bf16):
-  unpool 112->224 (C=64, switches) -> relu -> conv1_2-bwd (64->64 @224^2)
-  -> relu -> conv1_1-bwd (64->3) -> fp32 out
+Defaults are backend-aware: TPU probes the full batch-32 headline shape;
+CPU shrinks batch/iters so the probe stays a CI-sized layout guard.
+
+Usage: python tools/kpack_probe.py [--batch N] [--iters N]
+       [--layer block5_conv1] [--kpack auto|forced|CHAN] [--model vgg16]
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -27,106 +35,143 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
 
-B, K = 32, 8
-H = W = 112  # pre-unpool spatial
+def _build(spec, layer: str, top_k: int, kpack_chan: int,
+           backward_dtype: str | None):
+    from deconv_api_tpu.engine import get_visualizer
 
-
-def main() -> None:
-    from deconv_api_tpu import ops
-    from deconv_api_tpu.models.vgg16 import vgg16_init
-    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
-
-    enable_compilation_cache(ServerConfig.from_env(), bench_default=True)
-    print(f"device: {jax.devices()[0]}", flush=True)
-
-    spec, params = vgg16_init()
-    w12 = params["block1_conv2"]["w"]  # (3,3,64,64) HWIO
-    w11 = params["block1_conv1"]["w"]  # (3,3,3,64)
-
-    key = jax.random.PRNGKey(0)
-    y = jax.random.normal(key, (B, K, H, W, 64)).astype(jnp.bfloat16)
-    # compact int8 switches for the 2x2 pool over a 224x224x64 input
-    idx = jax.random.randint(jax.random.PRNGKey(1), (B, 1, H, W, 64), 0, 4).astype(
-        jnp.int8
+    return get_visualizer(
+        spec, layer, top_k, "all", True, batched=True,
+        backward_dtype=backward_dtype, kpack_chan=kpack_chan,
     )
 
-    from deconv_api_tpu.ops.conv import flip_kernel
 
-    f12 = flip_kernel(w12).astype(jnp.bfloat16)  # (3,3,64,64)
-    f11 = flip_kernel(w11).astype(jnp.bfloat16)  # (3,3,64,3)
+def _timed_stream(step, batches) -> float:
+    """Seconds/batch, stream-fused sync (bench/suite.py methodology):
+    dispatch every iteration, fetch one trailing checksum inside the
+    timer, validate the rest after it stops."""
+    sums = [step(b) for b in batches]  # warm
+    for s in sums:
+        float(s)
+    t0 = time.perf_counter()
+    sums = [step(b) for b in batches]
+    last = float(sums[-1])
+    dt = time.perf_counter() - t0
+    vals = [float(s) for s in sums[:-1]] + [last]
+    assert all(v == v for v in vals)
+    return dt / len(batches)
 
-    def chain_vmapk(y, idx):
-        """Current form: K in the batch dim via vmap (over a singleton)."""
 
-        def one(yk):  # (B_like=1? no — per-k slice) (B,H,W,64)
-            x = ops.unpool_with_argmax(yk, idx[:, 0], (2, 2), (224, 224), fuse_relu=True)
-            x = jax.lax.conv_general_dilated(
-                x, f12, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
-            )
-            x = jax.nn.relu(x)
-            x = jax.lax.conv_general_dilated(
-                x, f11, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
-            )
-            return x.astype(jnp.float32)
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=None,
+                    help="default: 32 on TPU, 4 on CPU")
+    ap.add_argument("--iters", type=int, default=None,
+                    help="default: 10 on TPU, 6 on CPU (a CPU batch-2 "
+                    "3-iter run measured ±15%% run-to-run; the larger "
+                    "sample repeats to within 0.1%%)")
+    ap.add_argument("--layer", default="block5_conv1")
+    ap.add_argument("--model", default="vgg16", choices=("vgg16", "vgg19"))
+    ap.add_argument("--top-k", type=int, default=8)
+    ap.add_argument("--kpack", default="auto",
+                    help="packing policy under test: auto (C<=64 tail, the "
+                    "profiled block1 pathology), forced (C<=128), or an "
+                    "explicit channel threshold")
+    args = ap.parse_args()
 
-        return jax.vmap(one, in_axes=1, out_axes=1)(y)
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.engine.deconv import resolve_kpack_chan
 
-    def chain_packed(y, idx):
-        """K packed into channels: (B,H,W,64K), grouped convs."""
-        yp = jnp.transpose(y, (0, 2, 3, 1, 4)).reshape(B, H, W, K * 64)
-        idxp = jnp.tile(idx[:, 0], (1, 1, 1, K))
-        x = ops.unpool_with_argmax(yp, idxp, (2, 2), (224, 224), fuse_relu=True)
-        # grouped conv: each K-group convolves with the same flipped kernel
-        f12g = jnp.concatenate([f12] * K, axis=3)  # (3,3,64,64K), groups=K
-        x = jax.lax.conv_general_dilated(
-            x, f12g, (1, 1), "SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=K,
-        )
-        x = jax.nn.relu(x)
-        f11g = jnp.concatenate([f11] * K, axis=3)  # (3,3,64,3K)
-        x = jax.lax.conv_general_dilated(
-            x, f11g, (1, 1), "SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=K,
-        )  # (B,224,224,3K)
-        x = x.reshape(B, 224, 224, K, 3).transpose(0, 3, 1, 2, 4)
-        return x.astype(jnp.float32)
+    enable_compilation_cache(ServerConfig.from_env(), bench_default=True)
 
+    import jax
+    import jax.numpy as jnp
+
+    from deconv_api_tpu.bench.suite import tree_checksum
+
+    backend = jax.default_backend()
+    batch = args.batch if args.batch is not None else (32 if backend == "tpu" else 4)
+    iters = args.iters if args.iters is not None else (10 if backend == "tpu" else 6)
+    if args.top_k < 2:
+        # a 1-projection "packed" program is an ordinary conv chain (and
+        # its lowering contains feature_group_count = 1 like every plain
+        # conv, making the engagement check below vacuous) — there is
+        # nothing to A/B
+        print(json.dumps({"error": "--top-k must be >= 2 for a packed A/B"}))
+        return 2
+    kpack_chan = resolve_kpack_chan(args.kpack, args.top_k)
+    if kpack_chan <= 0:
+        print(json.dumps({"error": f"--kpack {args.kpack} resolves to off"}))
+        return 2
+    print(f"device: {jax.devices()[0]} batch={batch} iters={iters} "
+          f"kpack_chan={kpack_chan}", file=sys.stderr, flush=True)
+
+    if args.model == "vgg16":
+        from deconv_api_tpu.models.vgg16 import vgg16_init as init
+    else:
+        from deconv_api_tpu.models.vgg19 import vgg19_init as init
+    spec, params = init()
+
+    # --- correctness: exact-fp32 bit parity + engagement check ----------
+    probe_batch = jax.random.normal(
+        jax.random.PRNGKey(0), (min(batch, 2), 224, 224, 3)
+    ) * 30.0
+    exact_v = _build(spec, args.layer, args.top_k, 0, None)
+    exact_p = _build(spec, args.layer, args.top_k, kpack_chan, None)
+    engaged = (
+        f"feature_group_count = {args.top_k}"
+        in exact_p.lower(params, probe_batch).as_text()
+    )
+    a = exact_v(params, probe_batch)[args.layer]
+    b = exact_p(params, probe_batch)[args.layer]
+    bitwise = bool(
+        jnp.array_equal(a["images"], b["images"])
+        and jnp.array_equal(a["indices"], b["indices"])
+    )
+
+    # --- serving-config variant: bf16 backward numeric delta ------------
+    mixed_v = _build(spec, args.layer, args.top_k, 0, "bfloat16")
+    mixed_p = _build(spec, args.layer, args.top_k, kpack_chan, "bfloat16")
+    ma = mixed_v(params, probe_batch)[args.layer]["images"].astype(jnp.float32)
+    mb = mixed_p(params, probe_batch)[args.layer]["images"].astype(jnp.float32)
+    bf16_diff = float(jnp.abs(ma - mb).max())
+
+    # --- throughput A/B at the headline shape (stream-fused sync) -------
     # distinct inputs per iteration: defeats any content-addressed result
     # caching in the relay (same rule as bench.py's timed loop)
-    ys = [
-        jax.random.normal(jax.random.PRNGKey(10 + i), (B, K, H, W, 64)).astype(
-            jnp.bfloat16
-        )
-        for i in range(10)
+    batches = [
+        jax.random.normal(jax.random.PRNGKey(10 + i), (batch, 224, 224, 3))
+        * 30.0
+        for i in range(iters)
     ]
+    step_v = jax.jit(lambda p, x: tree_checksum(mixed_v(p, x)))
+    step_p = jax.jit(lambda p, x: tree_checksum(mixed_p(p, x)))
+    vmapped_s = _timed_stream(lambda x: step_v(params, x), batches)
+    packed_s = _timed_stream(lambda x: step_p(params, x), batches)
 
-    def timed(fn, iters=10):
-        cs = jax.jit(lambda y, i: jnp.sum(fn(y, i).astype(jnp.float32)))
-        float(cs(ys[0], idx))
-        t0 = time.perf_counter()
-        vals = [cs(ys[i], idx) for i in range(iters)]
-        _ = float(vals[-1])
-        ms = (time.perf_counter() - t0) / iters * 1e3
-        assert all(float(v) == float(v) for v in vals[:-1])
-        return ms
-
-    a = jax.jit(chain_vmapk)(y, idx)
-    b = jax.jit(chain_packed)(y, idx)
-    # a is (B,K,224,224,3)? vmap out_axes=1 with per-k (B,224,224,3) -> (B,K,...)
-    diff = float(jnp.abs(a - b).max())
-
-    out = {
-        "vmapk_ms": round(timed(chain_vmapk), 2),
-        "packed_ms": round(timed(chain_packed), 2),
-        "max_abs_diff": diff,
+    row = {
+        "which": "kpack_ab_headline",
+        "backend": backend,
+        "model": args.model,
+        "layer": args.layer,
+        "batch": batch,
+        "iters": iters,
+        "top_k": args.top_k,
+        "kpack_policy": args.kpack,
+        "kpack_chan": kpack_chan,
+        "packed_engaged": engaged,
+        "bitwise_equal_fp32": bitwise,
+        "max_abs_diff_bf16": bf16_diff,
+        "vmapped_ms_per_batch": round(vmapped_s * 1e3, 2),
+        "packed_ms_per_batch": round(packed_s * 1e3, 2),
+        "vmapped_img_s": round(batch / vmapped_s, 2),
+        "packed_img_s": round(batch / packed_s, 2),
+        "speedup": round(vmapped_s / packed_s, 3),
     }
-    print(json.dumps(out), flush=True)
+    print(json.dumps(row), flush=True)
+    # bit-inequality is a correctness failure, not a perf datum
+    return 0 if bitwise and engaged else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

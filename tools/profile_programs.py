@@ -33,7 +33,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def build_headline():
+def build_headline(batch_size: int = 64):
     import jax
 
     from deconv_api_tpu.engine import get_visualizer
@@ -44,7 +44,33 @@ def build_headline():
         spec, "block5_conv1", 8, "all", True,
         batched=True, backward_dtype="bfloat16",
     )
-    batch = jax.random.normal(jax.random.PRNGKey(0), (64, 224, 224, 3))
+    batch = jax.random.normal(
+        jax.random.PRNGKey(0), (batch_size, 224, 224, 3)
+    )
+    return fn, (params, batch)
+
+
+def build_headline_kpack(batch_size: int = 64):
+    """The headline program with the channel-packed low-C backward tail
+    (round 12, lowc_kpack=auto ≙ kpack_chan=64): same shape as
+    build_headline, but the block1 backward walk runs as grouped convs +
+    group-broadcast unpool.  Captured so the op ledger can attribute the
+    packed tail's MXU/HBM behaviour next to the vmapped fusion.93 row."""
+    import jax
+
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.engine.deconv import KPACK_AUTO_CHAN
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    spec, params = vgg16_init()
+    fn = get_visualizer(
+        spec, "block5_conv1", 8, "all", True,
+        batched=True, backward_dtype="bfloat16",
+        kpack_chan=KPACK_AUTO_CHAN,
+    )
+    batch = jax.random.normal(
+        jax.random.PRNGKey(0), (batch_size, 224, 224, 3)
+    )
     return fn, (params, batch)
 
 
@@ -98,6 +124,7 @@ def build_dream():
 
 PROGRAMS = {
     "headline": build_headline,
+    "headline_kpack": build_headline_kpack,
     "sweep": build_sweep,
     "dream": build_dream,
 }
@@ -227,6 +254,10 @@ def main() -> int:
     ap.add_argument("--out", default=os.path.join(REPO, "profiles"))
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--programs", default="headline,sweep")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="override the headline programs' batch size "
+                    "(CPU-sized structural captures; the committed TPU "
+                    "ledgers use the default 64)")
     ap.add_argument("--parse-only", default=None, metavar="DIR")
     args = ap.parse_args()
 
@@ -234,19 +265,32 @@ def main() -> int:
         print(json.dumps(parse_trace(args.parse_only)), flush=True)
         return 0
 
+    import functools
+
+    import jax
+
     for name in args.programs.split(","):
+        build = PROGRAMS[name]
+        if args.batch is not None and name.startswith("headline"):
+            build = functools.partial(build, batch_size=args.batch)
         trace_dir, per_iter = capture(
-            name, PROGRAMS[name], args.out, args.iters
+            name, build, args.out, args.iters
         )
         summary = parse_trace(trace_dir)
         summary.update(
             {
                 "which": f"profile_{name}",
+                # the backend the capture ran on: the committed ledgers
+                # are TPU evidence and a CPU re-run must never be
+                # mistaken for them (round 12)
+                "backend": jax.default_backend(),
                 "iters": args.iters,
                 "wall_ms_per_iter": round(per_iter * 1e3, 1),
                 "trace_dir": trace_dir,
             }
         )
+        if args.batch is not None and name.startswith("headline"):
+            summary["batch"] = args.batch
         print(json.dumps(summary), flush=True)
     return 0
 

@@ -32,12 +32,37 @@ PEAK_BF16 = 197e12  # FLOP/s
 HBM_BW = 819e9  # B/s
 RIDGE = PEAK_BF16 / HBM_BW  # FLOP/byte needed to be MXU-bound (~240)
 
+# Lane-padding model (round 12, the --kpack comparison).  XLA pads a
+# channel-minor dim to the 128-wide vector-lane tile, so a C=64 tensor
+# costs 2x its ideal HBM bytes and MXU occupancy.  The waste factor is
+# CAPPED at 2x: for very narrow channels XLA falls back to batch-minor
+# layouts instead of eating unbounded padding (observed in profiles/ —
+# fusion.93's C=64 output is laid out batch-minor at 512 wide), and the
+# measured block1/2 per-segment slowdown is 2.3-2.4x their ideal
+# roofline (BASELINE.md layer-sweep localisation), consistent with a
+# ~2x layout factor on top of residual inefficiency.
+LANE = 128
 
-def _conv_segs(l, in_shape, out, batch, nsig):
+
+def _lane_factor(c: int) -> float:
+    pad = -(-c // LANE) * LANE
+    return min(pad / c, 2.0)
+
+
+def _conv_segs(l, in_shape, out, batch, nsig, lane: str = "ideal",
+               kpack_chan: int = 0):
     """Forward + backward accounting for one conv layer, with `nsig`
     projection signals crossing it downward (headline: top_k; sweep:
     top_k x vis-layers-above).  ONE formula set for both rooflines so the
-    modeling assumptions cannot drift between them."""
+    modeling assumptions cannot drift between them.
+
+    ``lane`` selects the layout model for the BACKWARD segment (the
+    forward stays ideal — measured at/near its per-segment roofline):
+    'ideal' = no padding waste (the r2 model, the 81.7% figure);
+    'vmapped' = channel-minor lane padding at the per-projection widths;
+    'packed' = the kpack layout: signals at or under ``kpack_chan``
+    channels carry nsig x C packed channels (engine/deconv.py), so their
+    lane factor is computed at the packed width."""
     oh, ow, cout = out
     kh, kw = l.kernel_size
     cin = in_shape[-1]
@@ -50,26 +75,54 @@ def _conv_segs(l, in_shape, out, batch, nsig):
     bbytes = nsig * batch * (
         in_shape[0] * in_shape[1] * cin + oh * ow * cout
     ) * 2 + kh * kw * cin * cout * 2
-    bwd = (f"bwd {l.name} x{nsig}", flops * nsig, bbytes)
+    bflops = flops * nsig
+    if lane != "ideal":
+        packed = lane == "packed" and cout <= kpack_chan
+        win, wout = (cin * nsig, cout * nsig) if packed else (cin, cout)
+        f = max(_lane_factor(win), _lane_factor(wout))
+        bflops *= f
+        bbytes *= f
+    tag = " [packed]" if lane == "packed" and cout <= kpack_chan else ""
+    bwd = (f"bwd {l.name} x{nsig}{tag}", bflops, bbytes)
     return fwd, bwd
 
 
-def _pool_segs(l, in_shape, out, batch, nsig):
+def _pool_segs(l, in_shape, out, batch, nsig, lane: str = "ideal",
+               kpack_chan: int = 0):
     """Forward switch-pool + backward unpool accounting; the int8 switch
     read is counted once per crossing signal in BOTH rooflines (the
     separate sweep re-reads it per segment; merged reads it once per
-    signal batch — per-signal is the consistent, conservative choice)."""
+    signal batch — per-signal is the consistent, conservative choice).
+
+    Under the 'packed' lane model a tail pool's unpool runs
+    group-broadcast (ops/pool.py groups=): full-lane bf16 traffic at the
+    packed width AND the int8 switch index read ONCE per batch instead
+    of once per signal — packing the K-invariant switch is free."""
     h, w, c = in_shape
     oh, ow, _ = out
     fbytes = batch * (h * w * c * 4 + oh * ow * c * 4 + oh * ow * c)
     fwd = (f"fwd {l.name} (switch pool)", 0.0, fbytes)
-    bbytes = nsig * batch * (oh * ow * c * 2 + oh * ow * c + h * w * c * 2)
-    bwd = (f"bwd {l.name} (unpool+relu) x{nsig}", 0.0, bbytes)
+    sig_bytes = nsig * batch * (oh * ow * c * 2 + h * w * c * 2)
+    idx_bytes = nsig * batch * oh * ow * c
+    tag = ""
+    if lane != "ideal":
+        packed = lane == "packed" and c <= kpack_chan
+        f = _lane_factor(c * nsig) if packed else _lane_factor(c)
+        sig_bytes *= f
+        if packed:
+            idx_bytes = batch * oh * ow * c  # broadcast: one read per batch
+            tag = " [packed]"
+    bwd = (f"bwd {l.name} (unpool+relu) x{nsig}{tag}", 0.0,
+           sig_bytes + idx_bytes)
     return fwd, bwd
 
 
-def segments(batch: int, top_k: int, layer: str = "block5_conv1"):
-    """Yield (name, flops, bytes) per program segment (headline config)."""
+def segments(batch: int, top_k: int, layer: str = "block5_conv1",
+             lane: str = "ideal", kpack_chan: int = 0):
+    """Yield (name, flops, bytes) per program segment (headline config).
+    ``lane``/``kpack_chan`` select the layout model for the backward
+    segments (see _conv_segs); the default reproduces the r2 ideal-layout
+    roofline exactly."""
     from deconv_api_tpu.models.spec import layer_output_shapes
     from deconv_api_tpu.models.vgg16 import VGG16_SPEC
 
@@ -80,9 +133,13 @@ def segments(batch: int, top_k: int, layer: str = "block5_conv1"):
     for l in spec.layers:
         out = shapes[l.name]
         if l.kind == "conv":
-            segs.extend(_conv_segs(l, in_shape, out, batch, top_k))
+            segs.extend(
+                _conv_segs(l, in_shape, out, batch, top_k, lane, kpack_chan)
+            )
         elif l.kind == "pool":
-            segs.extend(_pool_segs(l, in_shape, out, batch, top_k))
+            segs.extend(
+                _pool_segs(l, in_shape, out, batch, top_k, lane, kpack_chan)
+            )
         in_shape = out
     # selection (sums + top_k): one read of the target activation
     oh, ow, c = shapes[layer]
@@ -141,6 +198,10 @@ def sweep_segments(batch: int, top_k: int, layer: str = "block5_conv1"):
     return segs
 
 
+def _roof_time(segs) -> float:
+    return sum(max(f / PEAK_BF16, b / HBM_BW) for _, f, b in segs)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=64)
@@ -148,10 +209,16 @@ def main() -> int:
     ap.add_argument("--sweep", action="store_true",
                     help="model the all-layers sweep (BASELINE config 2) "
                     "instead of the single-layer headline")
+    ap.add_argument("--kpack", type=int, default=0, metavar="CHAN",
+                    help="also model the 128-lane channel-padding waste of "
+                    "the backward tail, vmapped vs kpack-packed at this "
+                    "channel threshold (engine lowc_kpack; headline only)")
     ap.add_argument("--measured-ms", type=float, default=None,
                     help="measured ms/batch to compare against the ceiling")
     args = ap.parse_args()
 
+    if args.kpack and args.sweep:
+        ap.error("--kpack models the headline program only")
     segs = (
         sweep_segments(args.batch, args.top_k)
         if args.sweep
@@ -185,6 +252,26 @@ def main() -> int:
         print(f"measured           : {args.measured_ms:7.2f} ms/batch "
               f"-> {100 * mxu_time / meas:.1f}% MFU "
               f"({100 * t_roof / meas:.0f}% of roofline)")
+    if args.kpack:
+        # Lane-padded comparison (round 12): the SAME program mix with the
+        # 128-lane channel-padding waste modeled on the backward segments,
+        # vmapped layout vs the kpack-packed layout.  Ceilings are quoted
+        # against the TRUE algorithmic FLOP count (mxu_time above), so
+        # occupancy waste shows up as a lower ceiling, not more "work".
+        t_v = _roof_time(
+            segments(args.batch, args.top_k, lane="vmapped")
+        )
+        t_p = _roof_time(
+            segments(args.batch, args.top_k, lane="packed",
+                     kpack_chan=args.kpack)
+        )
+        print(f"\nlane-padded model (128-wide lanes, waste capped 2x):")
+        print(f"vmapped layout     : {t_v * 1e3:7.2f} ms/batch "
+              f"-> ceiling {100 * mxu_time / t_v:.1f}% MFU")
+        print(f"packed (C<={args.kpack:3d})    : {t_p * 1e3:7.2f} ms/batch "
+              f"-> ceiling {100 * mxu_time / t_p:.1f}% MFU "
+              f"({100 * (t_v - t_p) / t_v:.1f}% throughput headroom over "
+              "vmapped)")
     return 0
 
 

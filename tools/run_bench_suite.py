@@ -57,6 +57,13 @@ JOBS_SYNC_OVERHEAD_BUDGET_PCT = 3.0
 # actually spreading the key mix across chips.
 LANES_SPEEDUP_BUDGET = 1.4
 
+# Channel-packed backward-tail budget (round 12): the packed path must
+# not run SLOWER than the vmapped path it would replace — a recorded
+# regression (like the r3 prototype's 280-vs-368 img/s) keeps the
+# default off and gets a loud error field; a recorded win is the
+# evidence for flipping lowc_kpack=auto on.
+KPACK_SPEEDUP_BUDGET = 1.0
+
 
 def run_chaos_guard(timeout_s: float = 900.0, lanes: int | None = None) -> dict:
     """The end-to-end chaos drill (round 9): codec workers dying at
@@ -302,6 +309,46 @@ def run_jobs_guard(timeout_s: float = 1800.0) -> dict:
     return row
 
 
+def run_kpack_guard(timeout_s: float = 3600.0) -> dict:
+    """Channel-packed low-C backward tail A/B (round 12): run
+    tools/kpack_probe.py — the real headline program, lowc_kpack packed
+    vs vmapped, bit-equality asserted in the child — and record the row.
+    Fails LOUDLY (`error` field) when the child errored (bit-inequality
+    exits nonzero there), when the packed program did not actually
+    engage (a vacuous identical-programs A/B), or when packed throughput
+    falls below KPACK_SPEEDUP_BUDGET of vmapped.  The probe picks
+    TPU-or-CPU-sized shapes from the attached backend; the row records
+    which backend produced it."""
+    probe = run_cmd_json(
+        [sys.executable, os.path.join(REPO, "tools", "kpack_probe.py")],
+        timeout_s,
+        # the probe exits nonzero on bit-inequality/non-engagement but
+        # still prints its row — keep it so the guard can say WHICH
+        # contract broke instead of recording an opaque rc=1
+        json_on_error=True,
+    )
+    row = {"config": "kpack", **probe}
+    row.setdefault("which", "kpack_ab_headline")
+    if "error" in probe:
+        return row
+    row["budget"] = KPACK_SPEEDUP_BUDGET
+    problems = []
+    if not probe.get("bitwise_equal_fp32"):
+        problems.append("packed path NOT bit-equal to vmapped (fp32)")
+    if not probe.get("packed_engaged"):
+        problems.append("packed program never engaged (A/B vacuous)")
+    if probe.get("speedup", 0.0) < KPACK_SPEEDUP_BUDGET:
+        problems.append(
+            f"packed path regressed: {probe.get('speedup')}x vs the "
+            f"{KPACK_SPEEDUP_BUDGET:.1f}x floor "
+            f"({probe.get('packed_img_s')} vs {probe.get('vmapped_img_s')} "
+            "img/s)"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
+    return row
+
+
 def run_compile_cache_guard(timeout_s: float = 900.0) -> dict:
     """Cold vs warm startup A/B (round 10 satellite): the same loopback
     boot twice against one persistent XLA compile-cache dir — run 1
@@ -392,14 +439,22 @@ def run_loopback(token: str, timeout_s: float = 900.0) -> dict:
 
 
 def run_cmd_json(
-    cmd: list[str], timeout_s: float, env: dict | None = None
+    cmd: list[str], timeout_s: float, env: dict | None = None,
+    json_on_error: bool = False,
 ) -> dict:
     """Run a child under a hard timeout; return its last stdout JSON line.
 
     Failures return an {"error": ...} row instead of raising — timeout,
     nonzero rc (with a stderr tail), or no JSON on stdout.  Shared by the
     bench suite and the tunnel watcher so error classification lives in
-    exactly one place."""
+    exactly one place.
+
+    ``json_on_error`` keeps the child's JSON row even on a nonzero exit
+    (tagged with ``child_rc``): probes like tools/kpack_probe.py signal a
+    correctness failure through their exit status while still printing
+    the measurement row, and the guard needs the ROW to classify the
+    failure — without this the row would be thrown away in favour of an
+    opaque rc=1."""
     full_env = None
     if env:
         full_env = dict(os.environ)
@@ -418,21 +473,32 @@ def run_cmd_json(
         return {"error": f"timeout after {timeout_s:.0f}s"}
     wall = time.monotonic() - t0
     sys.stderr.write(proc.stderr.decode(errors="replace")[-4000:])
+
+    def last_json_line() -> dict | None:
+        for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        return None
+
     if proc.returncode != 0:
+        out = last_json_line() if json_on_error else None
+        if out is not None:
+            out["child_rc"] = proc.returncode
+            out["wall_s_total"] = round(wall, 1)
+            return out
         return {
             "error": f"rc={proc.returncode}",
             "stderr_tail": proc.stderr.decode(errors="replace")[-800:],
         }
-    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                out = json.loads(line)
-                out["wall_s_total"] = round(wall, 1)
-                return out
-            except json.JSONDecodeError:
-                continue
-    return {"error": "no JSON output"}
+    out = last_json_line()
+    if out is None:
+        return {"error": "no JSON output"}
+    out["wall_s_total"] = round(wall, 1)
+    return out
 
 
 def run_one(n: int, timeout_s: float, env: dict | None = None) -> dict:
@@ -593,6 +659,12 @@ def main() -> int:
             # sync-path 3% overhead budget
             result = run_jobs_guard()
             result["date"] = date
+        elif tok == "kpack":
+            # channel-packed backward tail A/B (round 12): bit-equality
+            # asserted in the probe, loud error on regression or a
+            # never-engaged packed program
+            result = run_kpack_guard()
+            result["date"] = date
         elif tok == "compile-cache":
             # persistent-compile-cache A/B (round 10): cold vs warm
             # warmup wall against one cache dir
@@ -608,7 +680,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack'])}",
             }
         else:
             n = int(tok)

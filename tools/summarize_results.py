@@ -24,6 +24,18 @@ def tag_of(row: dict) -> str:
 
 
 def headline_of(row: dict) -> str:
+    if "packed_img_s" in row and "vmapped_img_s" in row:
+        # kpack A/B rows (round 12): show both sides + the speedup next
+        # to the headline trajectory, and keep the error visible — a
+        # regressed packed path is the row's whole point
+        line = (
+            f"packed={row['packed_img_s']} vs vmapped={row['vmapped_img_s']}"
+            f" img/s (x{row.get('speedup')}, {row.get('backend', '?')}"
+            f" b{row.get('batch', '?')})"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     for key in (
         "img_per_sec", "images_per_sec", "requests_per_sec", "value",
         "ms_per_batch", "dreams_per_min",
