@@ -38,6 +38,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "fault_seed", "breaker_threshold", "breaker_cooldown_s",
         "drain_grace_s", "lanes", "lowc_kpack", "compile_cache_dir",
         "jobs_dir", "jobs_workers", "jobs_queue_depth",
+        "tenants", "qos_default_class",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -46,6 +47,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         argv += ["--fault", spec]
     if getattr(args, "no_singleflight", False):
         argv += ["--no-singleflight"]
+    if getattr(args, "qos", False):
+        argv += ["--qos"]
     serve_main(argv)
     return 0
 
@@ -334,6 +337,21 @@ def main(argv: list[str] | None = None) -> int:
         "--jobs-queue-depth", type=int, default=None, dest="jobs_queue_depth",
         help="queued-or-running jobs admitted before submits 429 "
         "(default 64)",
+    )
+    s.add_argument(
+        "--qos", action="store_true", default=None,
+        help="enable multi-tenant QoS: tenant identity, priority "
+        "classes, device-time budgets, DRR fair queues (default off)",
+    )
+    s.add_argument(
+        "--tenants", default=None, metavar="JSON|PATH",
+        help="tenant policy spec, inline JSON or a JSON file "
+        "(implies --qos; see docs/API.md)",
+    )
+    s.add_argument(
+        "--qos-default-class", default=None, dest="qos_default_class",
+        metavar="interactive|standard|bulk",
+        help="priority class for tenants with no explicit class",
     )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)

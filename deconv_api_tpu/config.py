@@ -178,6 +178,33 @@ class ServerConfig:
     # deterministic taxonomy failure) re-queues and resumes from its
     # last checkpoint at most this many times before failing for good.
     jobs_max_attempts: int = 3
+    # --- multi-tenant QoS (round 13: serving/qos.py) ---
+    # Master switch: tenant identity (x-api-key / x-tenant header),
+    # priority classes, per-tenant token-bucket device-time budgets and
+    # in-flight caps, and deficit-round-robin fair queues in every
+    # dispatcher (one abusive tenant degrades only itself).  OFF by
+    # default: the batcher keeps its plain FIFO and the routes skip the
+    # admission wrap entirely — the qos-off hot path is byte-identical
+    # to the pre-QoS server (pinned by tests/test_qos.py; the `qos`
+    # bench token pins a <=3% overhead budget for qos ON).
+    qos: bool = False
+    # Tenant policy spec: inline JSON ('{...}') or a path to a JSON
+    # file — {"name": {"class": "bulk", "rate_ms": 50, "burst_ms": 200,
+    # "max_inflight": 32, "max_jobs": 4}}.  "*" is the template for
+    # tenants not named; anonymous traffic maps to the (unmetered by
+    # default) 'default' tenant.  Empty = fair queues only, no quotas.
+    tenants: str = ""
+    # Priority class for tenants with no explicit class (and for the
+    # default tenant): 'interactive' | 'standard' | 'bulk'.
+    qos_default_class: str = "standard"
+    # DRR quantum weights per class, 'class=weight,...' (defaults
+    # interactive=8,standard=4,bulk=1); a backlogged interactive queue
+    # serves weight/1 items per rotation versus a bulk queue's.
+    qos_weights: str = ""
+    # Fixed device-ms a response-cache HIT debits from the tenant's
+    # bucket (the real cost is ~0.08 ms of host time): hits are metered
+    # traffic, not free laundering of a hot key.
+    qos_hit_cost_ms: float = 0.05
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

@@ -88,6 +88,11 @@ def to_payload(e: DeconvError, request_id: str | None = None) -> dict:
     plus the request id (round 8 tracing spine) so a client-side error
     log joins server logs and `/v1/debug/requests` traces on one key."""
     payload = {"error": e.code, "detail": e.message}
+    tenant = getattr(e, "tenant", None)
+    if tenant:
+        # quota errors name WHOSE budget was hit (round 13 multi-tenant
+        # QoS): a client library multiplexing keys needs the split
+        payload["tenant"] = tenant
     if request_id:
         payload["request_id"] = request_id
     return payload
@@ -159,6 +164,43 @@ class JobNotFound(DeconvError):
 
     status = 404
     code = "job_not_found"
+
+
+class TenantOverQuota(DeconvError):
+    """A tenant exhausted one of its QoS budgets (round 13,
+    serving/qos.py): the device-time token bucket, the in-flight cap,
+    or the async-job queue-depth budget.  429 with a ``Retry-After``
+    derived from the bucket's actual refill rate — actionable backoff,
+    not a magic constant — and the tenant name in the payload so a
+    multi-tenant client library can tell WHOSE budget it hit."""
+
+    status = 429
+    code = "tenant_over_quota"
+
+    def __init__(
+        self,
+        message: str,
+        retry_after_s: float | None = None,
+        tenant: str | None = None,
+    ):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
+
+
+def retry_after_value(retry_after_s: float | None) -> str | None:
+    """The ONE formatter for ``Retry-After`` headers (round 13
+    satellite): integer seconds, never below 1 (RFC 9110 delta-seconds —
+    a fractional or zero value is either invalid or an instant-retry
+    invitation).  Every site that emits the header — ``Overloaded``
+    sheds, ``BreakerOpen`` fail-fasts, ``JobQueueFull``/
+    ``TenantOverQuota`` 429s — formats through here, so the contract
+    cannot drift per call site."""
+    if not retry_after_s or retry_after_s <= 0:
+        return None
+    import math
+
+    return str(max(1, math.ceil(retry_after_s)))
 
 
 class FaultInjected(DeconvError):

@@ -214,6 +214,23 @@ class DeconvService:
             metrics=self.metrics,
         )
         self.input_ring = HostBufferRing(self.cfg.input_ring_depth)
+        # Multi-tenant QoS (round 13, serving/qos.py): tenant identity,
+        # priority classes, token-bucket device-time budgets, and DRR
+        # fair queues in every dispatcher.  Built at BOOT so a typo'd
+        # tenants spec / weights string fails the process, not the first
+        # request; None (the default) keeps the exact pre-QoS path —
+        # plain FIFOs, no admission wrap, zero added cost.
+        self.qos = None
+        if self.cfg.qos:
+            from deconv_api_tpu.serving.qos import QosPolicy
+
+            self.qos = QosPolicy(
+                self.cfg.tenants,
+                default_class=self.cfg.qos_default_class,
+                weights=self.cfg.qos_weights,
+                hit_cost_ms=self.cfg.qos_hit_cost_ms,
+                metrics=self.metrics,
+            )
         # jax.profiler surface (SURVEY §5 tracing row): with profile_dir
         # set, the first DECONV_PROFILE_BATCHES device batches are captured
         # as TensorBoard-loadable traces.  One trace at a time (jax
@@ -235,6 +252,7 @@ class DeconvService:
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
             lane_pool=self.lane_pool,
+            qos=self.qos,
         )
         # Dreams run for seconds-to-minutes; a separate dispatcher keeps them
         # from head-of-line blocking the deconv queue (the device interleaves
@@ -251,6 +269,7 @@ class DeconvService:
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
             lane_pool=self.lane_pool,
+            qos=self.qos,
         )
         # Sweeps (~13x a single-layer request, large first-use compile) get
         # the dream treatment: own dispatcher so they never head-of-line
@@ -267,6 +286,7 @@ class DeconvService:
             dispatch_runner=self._dispatch_batch,
             pipeline_depth=self.cfg.pipeline_depth,
             lane_pool=self.lane_pool,
+            qos=self.qos,
         )
         # Content-addressed response cache + singleflight (round 7,
         # serving/cache.py): every compute response is a pure function of
@@ -351,21 +371,40 @@ class DeconvService:
         # compute routes: trace wrap OUTSIDE the cache wrap, so the span
         # timeline covers the cache lookup / coalesce wait as well as
         # the full decode→dispatch→encode miss path
+        # compute routes: trace wrap OUTSIDE the QoS admission wrap
+        # (a quota 429 must still produce a tenant-annotated error
+        # trace), admission OUTSIDE the cache wrap (identity and budget
+        # run before any decode, and a cache hit refunds the
+        # provisional device debit down to the fixed hit cost)
         self.server.route("POST", "/")(
             self._trace_wrap(
-                "/", self._cache_wrap("/", self._deconv_compat, self.metrics)
+                "/",
+                self._qos_wrap(
+                    self._cache_wrap("/", self._deconv_compat, self.metrics),
+                    self.metrics,
+                ),
             )
         )
         self.server.route("POST", "/v1/deconv")(
             self._trace_wrap(
                 "/v1/deconv",
-                self._cache_wrap("/v1/deconv", self._deconv_v1, self.metrics),
+                self._qos_wrap(
+                    self._cache_wrap(
+                        "/v1/deconv", self._deconv_v1, self.metrics
+                    ),
+                    self.metrics,
+                ),
             )
         )
         self.server.route("POST", "/v1/dream")(
             self._trace_wrap(
                 "/v1/dream",
-                self._cache_wrap("/v1/dream", self._dream_v1, self.dream_metrics),
+                self._qos_wrap(
+                    self._cache_wrap(
+                        "/v1/dream", self._dream_v1, self.dream_metrics
+                    ),
+                    self.dream_metrics,
+                ),
             )
         )
         # Durable async jobs (round 11, serving/jobs.py): heavy dreams
@@ -840,6 +879,8 @@ class DeconvService:
         post: str,
         sweep: bool = False,
         deadline: float | None = None,
+        tenant: str = "",
+        tclass: str = "",
     ):
         if not self.ready:
             # Pre-warmup requests would silently pay a full XLA compile
@@ -873,12 +914,57 @@ class DeconvService:
         if sweep:
             with stage(self.sweep_metrics, "compute"):
                 return await self.sweep_dispatcher.submit(
-                    x, (layer, mode, top_k, post, True), deadline=deadline
+                    x, (layer, mode, top_k, post, True), deadline=deadline,
+                    tenant=tenant, tclass=tclass,
                 )
         with stage(self.metrics, "compute"):
             return await self.dispatcher.submit(
-                x, (layer, mode, top_k, post), deadline=deadline
+                x, (layer, mode, top_k, post), deadline=deadline,
+                tenant=tenant, tclass=tclass,
             )
+
+    # ----------------------------------------------------- QoS admission
+
+    def _qos_wrap(self, handler, metrics: Metrics):
+        """Tenant admission in front of a compute route (round 13,
+        serving/qos.py): resolve identity from x-api-key / x-tenant,
+        enforce the in-flight cap and the device-time token bucket
+        (429 ``tenant_over_quota`` + Retry-After from the bucket's
+        refill), stamp the tenant onto the request (access log), the
+        trace (debug surface), and the grant (cache refund hook), and
+        release the in-flight slot on every exit.  Admission crashes
+        fail OPEN inside ``QosPolicy.admit`` — the request proceeds as
+        the default tenant.  Inert (identity function) while qos is
+        off."""
+        if self.qos is None:
+            return handler
+        qos = self.qos
+
+        async def admitted(req: Request) -> Response:
+            t0 = time.perf_counter()
+            tr = trace_mod.current_trace()
+            try:
+                grant = qos.admit(req.headers)
+            except errors.TenantOverQuota as e:
+                # stamp identity on the REJECTED request too: the 429s
+                # are exactly the lines an operator greps tenant= for
+                # (docs/API.md contract; the jobs route already does)
+                req.tenant = e.tenant or ""
+                metrics.observe_request(time.perf_counter() - t0, e.code)
+                if tr is not None:
+                    tr.annotate(tenant=e.tenant, quota=True)
+                return _error_response(e, req.id)
+            req.tenant = grant.tenant
+            req.tclass = grant.tclass
+            req._qos_grant = grant
+            if tr is not None:
+                tr.annotate(tenant=grant.tenant, tclass=grant.tclass)
+            try:
+                return await handler(req)
+            finally:
+                qos.release(grant)
+
+        return admitted
 
     # ----------------------------------------------------- tracing spine
 
@@ -955,6 +1041,9 @@ class DeconvService:
             slow=truthy(req.query.get("slow", "")),
             error=truthy(req.query.get("error", "")),
             trace_id=req.query.get("id") or None,
+            # round 13: "which tenant is slow" straight off the flight
+            # recorder — filters on the admission wrap's annotation
+            tenant=req.query.get("tenant") or None,
             limit=max(1, min(limit, 10 * max(1, self.cfg.trace_ring))),
         )
         return Response.json(
@@ -1003,7 +1092,14 @@ class DeconvService:
                 prefix, req.headers.get("content-type", ""), req.body, req=req
             )
             if self.cache is not None and not bypass:
-                entry = self.cache.lookup(key)
+                charge = None
+                if self.qos is not None and req._qos_grant is not None:
+                    # hit refund (round 13): the provisional device
+                    # debit never runs on the device — refund it down
+                    # to the fixed hit cost at the cache boundary
+                    grant = req._qos_grant
+                    charge = lambda: self.qos.charge_hit(grant)  # noqa: E731
+                entry = self.cache.lookup(key, charge=charge)
                 dt = time.perf_counter() - t0
                 if entry is not None:
                     self.metrics.observe_stage("cache_hit", dt)
@@ -1057,6 +1153,14 @@ class DeconvService:
                                 time.perf_counter() - t_wait,
                                 leader=getattr(fut, "leader_trace_id", None),
                             )
+                        # a coalesced waiter never runs device work (the
+                        # leader's item is charged by the batcher):
+                        # refund its provisional debit down to the fixed
+                        # hit cost, same as a cache hit — otherwise N
+                        # identical concurrent requests debit N×est while
+                        # the same N sent sequentially debit hit_cost
+                        if self.qos is not None and req._qos_grant is not None:
+                            self.qos.charge_hit(req._qos_grant)
                     code = (
                         errors.code_from_body(resp.body)
                         if resp.status >= 400
@@ -1210,6 +1314,10 @@ class DeconvService:
                 "parked": c["parked"],
                 "queued": c["queued"],
             }
+        if self.qos is not None:
+            # round 13: tenant occupancy on the probe — a fleet
+            # dashboard reads "who is in flight" without /v1/config
+            body["qos"] = self.qos.counts()
         return Response.json(body, status=200 if ok else 503)
 
     async def _debug_faults(self, req: Request) -> Response:
@@ -1304,6 +1412,21 @@ class DeconvService:
                 "workers": self.jobs.workers,
                 "reclaimed_on_boot": self.jobs.reclaimed,
                 "torn_records_on_boot": self.jobs.torn_records,
+            }
+        # multi-tenant QoS (round 13): live per-tenant occupancy —
+        # class, in-flight, device-ms ledger, bucket level — plus the
+        # fairness reading the noisy-neighbor runbook starts from
+        cfg["qos_active"] = self.qos is not None
+        cfg["tenants"] = bool(cfg["tenants"])  # spec may be a path: no leak
+        if self.qos is not None:
+            cfg["qos_state"] = self.qos.snapshot()
+            cfg["qos_state"]["queued_by_class"] = {
+                name: d.queued_by_class()
+                for name, d in (
+                    ("deconv", self.dispatcher),
+                    ("dream", self.dream_dispatcher),
+                    ("sweep", self.sweep_dispatcher),
+                )
             }
         cfg["fault_injection_active"] = self.faults is not None
         if self.faults is not None:
@@ -1419,6 +1542,7 @@ class DeconvService:
                     x,
                     (layer, self.cfg.visualize_mode, self.cfg.stitch_k, "grid"),
                     deadline=req.deadline,
+                    tenant=req.tenant, tclass=req.tclass,
                 )
             n_valid = int(result["valid"].sum())
             if n_valid == 0:
@@ -1476,6 +1600,7 @@ class DeconvService:
                 result = await self._project(
                     form, mode, top_k, "tiles", sweep=True,
                     deadline=req.deadline,
+                    tenant=req.tenant, tclass=req.tclass,
                 )
                 with stage(self.metrics, "encode"):
                     names = list(result)
@@ -1492,7 +1617,8 @@ class DeconvService:
                      "layers": layers}
                 )
             result = await self._project(
-                form, mode, top_k, "tiles", deadline=req.deadline
+                form, mode, top_k, "tiles", deadline=req.deadline,
+                tenant=req.tenant, tclass=req.tclass,
             )
             with stage(self.metrics, "encode"):
                 payload = await self._encode_tiles_pooled(result)
@@ -1568,6 +1694,7 @@ class DeconvService:
                     result = await self.dream_dispatcher.submit(
                         x, ("__dream__", layers, steps, octaves, lr),
                         deadline=req.deadline,
+                        tenant=req.tenant, tclass=req.tclass,
                     )
                 except KeyError as e:
                     raise errors.UnknownLayer(str(e)) from e
@@ -1631,9 +1758,16 @@ class DeconvService:
         tr = job._trace
         token = trace_mod.activate(tr) if tr is not None else None
         try:
+            # a parked/resumed job keeps its tenant (journaled at
+            # submit): the resumed octaves queue under — and are
+            # charged to — the tenant that submitted the job
+            tclass = (
+                self.qos.class_of(job.tenant) if self.qos is not None else ""
+            )
             fut = asyncio.ensure_future(
                 dispatcher.submit(
-                    payload, key, deadline=self._job_deadline_pc(job)
+                    payload, key, deadline=self._job_deadline_pc(job),
+                    tenant=job.tenant, tclass=tclass,
                 )
             )
             job._inflight = fut
@@ -1897,12 +2031,32 @@ class DeconvService:
                     req.body,
                     req=req,
                 )
+            tenant = ""
+            if self.qos is not None:
+                # jobs tier tenancy (round 13): identity + the
+                # per-tenant queue-depth budget.  The idempotency index
+                # is scoped PER TENANT — two tenants posting identical
+                # bodies must not dedup onto each other's job, or one
+                # tenant's budget would carry the other's work (the
+                # shared response cache is different: a cached body is
+                # a pure function with no owner).
+                tenant = self.qos.tenant_of(req.headers)
+                req.tenant = tenant
+                idem = f"{tenant}|{idem}"
             # dedup and capacity BEFORE the decode: a retried submit and
             # an at-capacity 429 both answer without burning a
             # codec-pool slot on an image nobody will use
             existing = self.jobs.lookup(idem)
+            budget = 0
             if existing is None:
                 self.jobs.ensure_capacity()
+                if self.qos is not None:
+                    budget = self.qos.job_budget(tenant)
+                    try:
+                        self.jobs.ensure_tenant_capacity(tenant, budget)
+                    except errors.TenantOverQuota:
+                        self.qos.record_shed(tenant)
+                        raise
                 with stage(self.metrics, "decode"):
                     x = await self.codec_pool.run(
                         self._decode_preprocess, file_uri
@@ -1921,11 +2075,21 @@ class DeconvService:
                     self.jobs.spill_input,
                     {"input": np.asarray(x, np.float32)},
                 )
-                job, deduped = self.jobs.submit(
-                    kind, params, idem,
-                    input_spilled=spilled,
-                    deadline_ts=deadline_ts,
-                )
+                try:
+                    # tenant_budget re-checks max_jobs atomically inside
+                    # submit — the pre-decode check above can race other
+                    # submits parked on the decode/spill awaits
+                    job, deduped = self.jobs.submit(
+                        kind, params, idem,
+                        input_spilled=spilled,
+                        deadline_ts=deadline_ts,
+                        tenant=tenant,
+                        tenant_budget=budget,
+                    )
+                except errors.TenantOverQuota:
+                    if self.qos is not None:
+                        self.qos.record_shed(tenant)
+                    raise
             else:
                 job, deduped = existing, True
         except errors.DeconvError as e:
@@ -2088,11 +2252,13 @@ def _error_response(e: errors.DeconvError, request_id: str | None = None) -> Res
     carries the request id (round 8) so a client-side error log joins
     server logs and flight-recorder traces on one key."""
     resp = Response.json(errors.to_payload(e, request_id), e.status)
-    retry_s = getattr(e, "retry_after_s", None)
-    if retry_s:
-        import math
-
-        resp.headers["retry-after"] = str(max(1, math.ceil(retry_s)))
+    # ONE formatter for every Retry-After site (round 13 satellite):
+    # Overloaded sheds, breaker 503s, job-queue 429s and tenant-quota
+    # 429s all flow through errors.retry_after_value — integer seconds,
+    # never below 1, by construction
+    retry = errors.retry_after_value(getattr(e, "retry_after_s", None))
+    if retry is not None:
+        resp.headers["retry-after"] = retry
     return resp
 
 
@@ -2242,6 +2408,23 @@ def main(argv: list[str] | None = None) -> None:
         help="queued-or-running jobs admitted before submits 429 "
         "(default 64)",
     )
+    p.add_argument(
+        "--qos", action="store_true", default=None,
+        help="enable multi-tenant QoS: x-api-key/x-tenant identity, "
+        "priority classes, per-tenant device-time budgets, and "
+        "deficit-round-robin fair queues (default off)",
+    )
+    p.add_argument(
+        "--tenants", default=None, metavar="JSON|PATH",
+        help="tenant policy spec (inline JSON or a JSON file): "
+        '{"name": {"class": "bulk", "rate_ms": 50, "burst_ms": 200, '
+        '"max_inflight": 32, "max_jobs": 4}}; implies --qos',
+    )
+    p.add_argument(
+        "--qos-default-class", default=None,
+        metavar="interactive|standard|bulk",
+        help="priority class for tenants with no explicit class",
+    )
     args = p.parse_args(argv)
     overrides = {}
     if args.cache_bytes is not None:
@@ -2279,6 +2462,12 @@ def main(argv: list[str] | None = None) -> None:
         overrides["jobs_workers"] = args.jobs_workers
     if args.jobs_queue_depth is not None:
         overrides["jobs_queue_depth"] = args.jobs_queue_depth
+    if args.qos or args.tenants is not None:
+        overrides["qos"] = True
+    if args.tenants is not None:
+        overrides["tenants"] = args.tenants
+    if args.qos_default_class is not None:
+        overrides["qos_default_class"] = args.qos_default_class
     if args.host is not None:
         overrides["host"] = args.host
     if args.port is not None:

@@ -60,6 +60,15 @@ lane carries its own dispatch worker, its own fetch-permit budget
 (pipeline_depth becomes per-lane), and its own circuit breaker — one
 sick chip opens ONE lane's breaker and the pool degrades to the
 survivors instead of failing fast everywhere.
+
+Round 13 made the queue itself multi-tenant (serving/qos.py): with a
+QoS policy installed, the submit FIFO becomes a deficit-round-robin
+multi-queue keyed by (tenant, priority class) — quantum scaled by class
+weight, near-deadline interactive items jumping the rotation, overload
+evicting bulk first — and the resolve path charges every member request
+its measured share of the batch wall, the device-time meter that the
+admission token buckets debit.  Without a policy (the default) nothing
+here changes: plain FIFO, no charging.
 """
 
 from __future__ import annotations
@@ -546,6 +555,12 @@ class WorkItem:
     # absolute perf_counter deadline (round 9): expired items are reaped
     # at the queue-pop and pre-dispatch boundaries — never dispatched
     deadline: float | None = None
+    # tenancy (round 13, serving/qos.py): the DRR queue keys on
+    # (tenant, tclass), the resolve path charges the tenant its measured
+    # share of the batch wall.  Empty = the default tenant/class (every
+    # pre-QoS caller, and the whole qos-off path).
+    tenant: str = ""
+    tclass: str = ""
     future: asyncio.Future = field(default_factory=asyncio.Future)
     enqueued_at: float = field(default_factory=time.perf_counter)
 
@@ -580,8 +595,18 @@ class BatchingDispatcher:
         pipeline_depth: int = 2,
         breaker: CircuitBreaker | None = None,
         lane_pool: LanePool | None = None,
+        qos=None,
     ):
         self._runner = runner
+        # Multi-tenant QoS (round 13, serving/qos.py): with a policy
+        # installed the single FIFO becomes a deficit-round-robin
+        # multi-queue keyed by (tenant, class) — a backlogged tenant's
+        # items wait in ITS queue while every other queue keeps its
+        # weighted share of each drain window — and the resolve path
+        # charges each tenant its measured share of the batch wall (the
+        # device-time meter the admission buckets debit against).
+        # qos=None keeps the exact pre-QoS FIFO path.
+        self._qos = qos
         # Executor lanes (round 10): the service passes ONE pool shared
         # by all its dispatchers (their load and failures are correlated
         # per chip); a bare ``breaker=`` builds the exact pre-lane
@@ -597,7 +622,9 @@ class BatchingDispatcher:
         self._max_batch = max_batch
         self._window_s = window_ms / 1e3
         self._timeout_s = request_timeout_s
-        self._queue: asyncio.Queue[WorkItem] = asyncio.Queue()
+        # plain FIFO, or the QoS policy's DRR multi-queue — both expose
+        # the same put/get/get_nowait/qsize/empty slice
+        self._queue = qos.new_queue() if qos is not None else asyncio.Queue()
         self._task: asyncio.Task | None = None
         self._metrics = metrics
         self._shed_factor = shed_factor
@@ -765,6 +792,12 @@ class BatchingDispatcher:
                     errors.Unavailable("server shutting down")
                 )
 
+    def queued_by_class(self) -> dict[str, int]:
+        """Queued items per priority class (round 13 operator surface;
+        empty for the FIFO path — there are no classes to split by)."""
+        depths = getattr(self._queue, "depths", None)
+        return depths() if depths is not None else {}
+
     def _estimated_drain_s(self) -> float:
         """Time for the work ahead of a new arrival to clear.  0.0 while
         unmeasured (cold start) AND whenever the queue is empty: an
@@ -799,7 +832,12 @@ class BatchingDispatcher:
         return (depth / eff_batch + self._inflight) * p50
 
     async def submit(
-        self, image: Any, key: Any, deadline: float | None = None
+        self,
+        image: Any,
+        key: Any,
+        deadline: float | None = None,
+        tenant: str = "",
+        tclass: str = "",
     ) -> Any:
         if self._stopping:
             raise errors.Unavailable("server shutting down")
@@ -836,21 +874,56 @@ class BatchingDispatcher:
         if self._shed_factor > 0:
             drain_s = self._estimated_drain_s()
             if drain_s > self._timeout_s * self._shed_factor:
-                if tr is not None:
-                    # a shed request never enqueues: its queue-wait span
-                    # is zero-length but carries the drain estimate that
-                    # shed it, so the error trace explains the 503
-                    tr.add_span(
-                        "queue_wait", time.perf_counter(), 0.0,
-                        shed=True, drain_estimate_s=round(drain_s, 3),
+                # Class-ordered shed (round 13): a non-bulk arrival on a
+                # QoS queue EVICTS the newest queued bulk item instead
+                # of being rejected — overload costs the bulk tier
+                # first, and the eviction is charged to the evicted
+                # item's tenant (the shed split the noisy-neighbor
+                # drill pins).
+                evicted = None
+                if self._qos is not None and tclass != "bulk":
+                    evicted = self._queue.evict_bulk()
+                if evicted is not None:
+                    self._qos.record_shed(evicted.tenant)
+                    if evicted.trace is not None:
+                        evicted.trace.add_span(
+                            "queue_wait", evicted.enqueued_at,
+                            time.perf_counter() - evicted.enqueued_at,
+                            shed=True, evicted_for_class=tclass,
+                            drain_estimate_s=round(drain_s, 3),
+                        )
+                    if not evicted.future.done():
+                        evicted.future.set_exception(
+                            errors.Overloaded(
+                                "bulk request evicted under overload for a "
+                                "higher-class arrival",
+                                retry_after_s=drain_s,
+                            )
+                        )
+                    # the arrival takes the evicted slot: fall through
+                else:
+                    if self._qos is not None:
+                        self._qos.record_shed(tenant)
+                    if tr is not None:
+                        # a shed request never enqueues: its queue-wait
+                        # span is zero-length but carries the drain
+                        # estimate that shed it, so the error trace
+                        # explains the 503
+                        tr.add_span(
+                            "queue_wait", time.perf_counter(), 0.0,
+                            shed=True, drain_estimate_s=round(drain_s, 3),
+                        )
+                    # (route handlers record the error code; no
+                    # double-count)
+                    raise errors.Overloaded(
+                        f"queue drain estimate {drain_s:.1f}s exceeds "
+                        f"{self._timeout_s:.0f}s request timeout; shedding",
+                        retry_after_s=drain_s,
                     )
-                # (route handlers record the error code; no double-count)
-                raise errors.Overloaded(
-                    f"queue drain estimate {drain_s:.1f}s exceeds "
-                    f"{self._timeout_s:.0f}s request timeout; shedding",
-                    retry_after_s=drain_s,
-                )
-        item = WorkItem(image=image, key=key, trace=tr, deadline=deadline)
+        item = WorkItem(
+            image=image, key=key, trace=tr, deadline=deadline,
+            tenant=tenant, tclass=tclass,
+        )
         await self._queue.put(item)
         wait_s = self._timeout_s
         if deadline is not None:
@@ -1295,6 +1368,16 @@ class BatchingDispatcher:
         slow trace says which chip ran it."""
         now = time.perf_counter()
         lane_ix = lane.index if lane is not None else 0
+        if self._qos is not None:
+            # Device-time accounting (round 13): each member request is
+            # charged its share of the executed batch's wall — the
+            # EWMA-measured cost the admission bucket debits, so tenants
+            # pay for what their batches COST, not how many requests
+            # they sent (an efficient batching tenant pays less per
+            # request; a sweep-heavy one pays more).
+            per_s = (now - t0) / max(1, len(items))
+            for it in items:
+                self._qos.charge(it.tenant, per_s)
         slog.event(
             _log, "batch_done", level=10,  # DEBUG: per-request http_request
             # lines already cover the serving story at INFO

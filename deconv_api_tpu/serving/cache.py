@@ -242,7 +242,14 @@ class ResponseCache:
     def entry_count(self) -> int:
         return sum(len(s.entries) for s in self._shards)
 
-    def lookup(self, key: str) -> CacheEntry | None:
+    def lookup(self, key: str, charge=None) -> CacheEntry | None:
+        """``charge`` (round 13 multi-tenant QoS) is invoked on a HIT —
+        positive or negative — with no arguments: the admission layer
+        refunds the tenant's provisional device debit down to the fixed
+        hit cost.  Charging lives at the cache boundary so a hot-key
+        tenant cannot launder unlimited traffic through the hit path,
+        while tools and tests that read the cache directly stay
+        unmetered."""
         got = self._shard_for(key).get(key, self._clock())
         if isinstance(got, CacheEntry):
             with self._stat_lock:
@@ -252,6 +259,8 @@ class ResponseCache:
                 if got.negative
                 else "cache_hits_total"
             )
+            if charge is not None:
+                charge()
             self._publish_gauges()
             return got
         with self._stat_lock:

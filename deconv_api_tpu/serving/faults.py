@@ -57,6 +57,9 @@ SITES = (
     "http.slow_write",         # response write stalls :param ms
     "jobs.runner_crash",       # job runner dies at a checkpoint boundary
     "jobs.journal_write_error",  # job journal append raises (disk fault)
+    "qos.admission_raise",     # QoS admission layer crashes (fails OPEN
+                               # to the default tenant — availability
+                               # over accounting; serving/qos.py)
 )
 
 

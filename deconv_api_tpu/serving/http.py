@@ -72,6 +72,15 @@ class Request:
     # singleflight waiters time out on their OWN deadline independently
     # of the flight leader.
     deadline: float | None = None
+    # Tenant identity (round 13 QoS): stamped by the admission wrap
+    # (serving/qos.py resolves x-api-key / x-tenant) so the access-log
+    # line, the flight-recorder trace, and the dispatcher queue all
+    # carry the same identity.  Empty while QoS is off.
+    tenant: str = ""
+    tclass: str = ""
+    # the admission Grant (accounting handle) the QoS wrap stashes so
+    # the cache wrap can refund a hit's provisional device debit
+    _qos_grant: object = field(default=None, repr=False, compare=False)
     # memoized form() result — the response cache derives its key from
     # the parsed form and the route handler parses the same body again;
     # one parse serves both (round 7).  None = not parsed yet.
@@ -305,11 +314,13 @@ class HttpServer:
                     else logging.WARNING if resp.status >= 500
                     else logging.INFO
                 )
+                extra = {"tenant": req.tenant} if req.tenant else {}
                 slog.event(
                     _log, "http_request", level=lvl,
                     method=req.method, path=req.path, status=resp.status,
                     id=req.id,
                     ms=round((time.perf_counter() - t0) * 1e3, 1),
+                    **extra,
                 )
                 act = faults.check("http.slow_write")
                 if act is not None:

@@ -114,6 +114,11 @@ class Job:
     idem: str
     state: str  # queued | running | parked | done | failed | cancelled
     created_ts: float
+    # Owning tenant (round 13 QoS): journaled at submit so parked and
+    # resumed jobs keep their identity across restarts — the resumed
+    # job's device work is still charged to (and queued under) the
+    # tenant that submitted it.  '' = pre-QoS / qos-off submissions.
+    tenant: str = ""
     deadline_ts: float | None = None  # wall-clock completion deadline
     finished_ts: float | None = None  # when a terminal state was reached
     attempts: int = 0
@@ -383,6 +388,7 @@ class JobManager:
                     state="queued",
                     created_ts=rec.get("ts", self._clock()),
                     deadline_ts=rec.get("deadline_ts"),
+                    tenant=rec.get("tenant", ""),
                 )
                 job.events.append(
                     {"seq": 0, "event": "submitted",
@@ -447,7 +453,7 @@ class JobManager:
                     "rec": "submitted", "job": job.id, "kind": job.kind,
                     "params": job.params, "idem": job.idem,
                     "ts": job.created_ts, "deadline_ts": job.deadline_ts,
-                    "seq": 0,
+                    "tenant": job.tenant, "seq": 0,
                 }
             )
             if job.state in TERMINAL_STATES:
@@ -608,6 +614,32 @@ class JobManager:
                 retry_after_s=self.retry_after_s(depth),
             )
 
+    def tenant_depth(self, tenant: str) -> int:
+        """Queued+running jobs owned by one tenant — what the round-13
+        per-tenant ``max_jobs`` budget is checked against (the global
+        ``ensure_capacity`` still guards the whole queue)."""
+        return sum(
+            1
+            for j in self._jobs.values()
+            if j.tenant == tenant and j.state in ("queued", "running")
+        )
+
+    def ensure_tenant_capacity(self, tenant: str, budget: int) -> None:
+        """Raise TenantOverQuota when the tenant is at its ``max_jobs``
+        budget (0 = unlimited).  ONE rule for both callers: the submit
+        route's cheap pre-decode check and ``submit``'s atomic re-check
+        must reject with the same message and Retry-After or the two
+        sites drift."""
+        if budget <= 0:
+            return
+        depth = self.tenant_depth(tenant)
+        if depth >= budget:
+            raise errors.TenantOverQuota(
+                f"tenant {tenant!r} at its job budget ({depth}/{budget})",
+                retry_after_s=self.retry_after_s(depth),
+                tenant=tenant,
+            )
+
     def submit(
         self,
         kind: str,
@@ -616,6 +648,8 @@ class JobManager:
         input_arrays: dict | None = None,
         deadline_ts: float | None = None,
         input_spilled: tuple[str, str, str] | None = None,
+        tenant: str = "",
+        tenant_budget: int = 0,
     ) -> tuple[Job, bool]:
         """Create (or dedup onto) a job.  Returns (job, deduped).
 
@@ -623,12 +657,17 @@ class JobManager:
         — the HTTP route writes the input spill off-loop first and
         hands the reference in, so submit itself never blocks the event
         loop on a large fsync.  ``input_arrays`` is the synchronous
-        convenience form (tests, embedders)."""
+        convenience form (tests, embedders).  ``tenant_budget`` (> 0)
+        re-checks the tenant's ``max_jobs`` here, under the same rule as
+        ``ensure_capacity``: the route's cheap pre-decode check can race
+        N concurrent submits across its awaits, and only this re-check
+        runs with no await between it and the job registering."""
         self._evict_expired()
         existing = self.lookup(idem)
         if existing is not None:
             return existing, True
         self.ensure_capacity()
+        self.ensure_tenant_capacity(tenant, tenant_budget)
         job = Job(
             id=f"job-{os.urandom(6).hex()}",
             kind=kind,
@@ -637,6 +676,7 @@ class JobManager:
             state="queued",
             created_ts=self._clock(),
             deadline_ts=deadline_ts,
+            tenant=tenant,
         )
         # journal FIRST: a submit whose record cannot be made durable is
         # refused — an accepted job must survive a crash
@@ -645,7 +685,7 @@ class JobManager:
                 {
                     "rec": "submitted", "job": job.id, "kind": kind,
                     "params": params, "idem": idem, "ts": job.created_ts,
-                    "deadline_ts": deadline_ts, "seq": 0,
+                    "deadline_ts": deadline_ts, "tenant": tenant, "seq": 0,
                 }
             )
         except OSError as e:
@@ -738,6 +778,7 @@ class JobManager:
             "id": job.id,
             "kind": job.kind,
             "state": job.state,
+            "tenant": job.tenant or None,
             "created_ts": round(job.created_ts, 3),
             "attempts": job.attempts,
             "resumed": job.resumed,

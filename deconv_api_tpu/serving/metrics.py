@@ -152,19 +152,44 @@ class Metrics:
             return self._counters.get(name, 0)
 
     def inc_labeled(
-        self, family: str, label: str, value: str, n: int = 1
+        self, family: str, label, value, n: float = 1
     ) -> None:
         """Labeled monotonic counters (round 9: the robustness layer's
         ``faults_injected_total{site=...}`` and
         ``task_restarts_total{task=...}`` accounting) — one counter
         family, one sample line per label value, exactly like
-        ``errors_total{code=...}``."""
+        ``errors_total{code=...}``.
+
+        Round 13 generalised the label to a TUPLE for multi-label
+        families (``tenant_requests_total{tenant=...,class=...}``):
+        pass matching tuples for ``label`` and ``value``; single-label
+        callers keep passing strings.  Increments may be fractional
+        (``tenant_device_ms_total`` accumulates measured milliseconds —
+        float counters are valid exposition)."""
+        if isinstance(label, tuple) != isinstance(value, tuple):
+            raise TypeError("label and value must both be str or both tuple")
+        if isinstance(label, tuple) and len(label) != len(value):
+            # a short value tuple would zip-truncate at exposition time
+            # into an ambiguous sample missing labels — fail like the
+            # type mismatch does
+            raise ValueError(
+                f"labeled family {family!r}: {len(label)} label names "
+                f"but {len(value)} values"
+            )
         with self._lock:
-            _, series = self._labeled.setdefault(family, (label, {}))
+            stored_label, series = self._labeled.setdefault(
+                family, (label, {})
+            )
+            if stored_label != label:
+                raise ValueError(
+                    f"labeled family {family!r} already uses label "
+                    f"{stored_label!r}"
+                )
             series[value] = series.get(value, 0) + n
 
-    def labeled(self, family: str) -> dict[str, int]:
-        """{label value: count} for one labeled-counter family."""
+    def labeled(self, family: str) -> dict:
+        """{label value(s): count} for one labeled-counter family
+        (tuple keys for multi-label families)."""
         with self._lock:
             _, series = self._labeled.get(family, ("", {}))
             return dict(series)
@@ -192,7 +217,11 @@ class Metrics:
         with self._lock:
             self._gauges[name] = float(value)
 
-    def snapshot(self) -> dict:
+    def snapshot(self, *, _join_labeled: bool = True) -> dict:
+        # _join_labeled=False is prometheus()'s private view: "labeled"
+        # keeps its raw tuple keys (copied under the SAME lock as the
+        # rest of the snapshot) instead of paying for the JSON-able
+        # comma-join that the text exposition would only have to undo
         with self._lock:
             up = time.time() - self._started
             return {
@@ -214,10 +243,27 @@ class Metrics:
                 },
                 "gauges": dict(self._gauges),
                 "counters": dict(self._counters),
-                "labeled": {
-                    fam: (label, dict(series))
-                    for fam, (label, series) in self._labeled.items()
-                },
+                # multi-label families (round 13) keep the snapshot
+                # JSON-able: tuple label names become lists, tuple value
+                # keys join on ',' (in-process consumers that need exact
+                # tuples use the labeled() accessor instead)
+                "labeled": (
+                    {
+                        fam: (
+                            list(label) if isinstance(label, tuple) else label,
+                            {
+                                (",".join(k) if isinstance(k, tuple) else k): v
+                                for k, v in series.items()
+                            },
+                        )
+                        for fam, (label, series) in self._labeled.items()
+                    }
+                    if _join_labeled
+                    else {
+                        fam: (label, dict(series))
+                        for fam, (label, series) in self._labeled.items()
+                    }
+                ),
                 "labeled_gauges": {
                     fam: (label, dict(series))
                     for fam, (label, series) in self._labeled_gauges.items()
@@ -226,7 +272,7 @@ class Metrics:
 
     def prometheus(self) -> str:
         p = self._prefix
-        s = self.snapshot()
+        s = self.snapshot(_join_labeled=False)
         lines = [
             f"# TYPE {p}_requests_total counter",
             f"{p}_requests_total {s['requests_total']}",
@@ -285,13 +331,22 @@ class Metrics:
             lines.append(f"# TYPE {p}_{name} counter")
             lines.append(f"{p}_{name} {n}")
         # labeled counters (round 9): per-site fault injections, per-task
-        # supervisor restarts — one TYPE header per family
+        # supervisor restarts — one TYPE header per family.  Round 13:
+        # multi-label families (tenant_requests_total{tenant=,class=})
+        # render from the snapshot's raw tuple-key view.
         for fam, (label, series) in sorted(s["labeled"].items()):
             lines.append(f"# TYPE {p}_{fam} counter")
+            names = label if isinstance(label, tuple) else (label,)
             for value, n in sorted(series.items()):
-                lines.append(
-                    f'{p}_{fam}{{{label}="{escape_label(value)}"}} {n}'
+                values = value if isinstance(value, tuple) else (value,)
+                block = ",".join(
+                    f'{k}="{escape_label(v)}"' for k, v in zip(names, values)
                 )
+                # ints render exact (no %g six-significant-digit loss on
+                # a large counter); float accumulators round to 3dp —
+                # monotone either way
+                num = f"{int(n)}" if float(n).is_integer() else f"{n:.3f}"
+                lines.append(f"{p}_{fam}{{{block}}} {num}")
         # labeled gauges (round 10): per-lane in-flight depth and breaker
         # state — one TYPE header per family, one line per lane
         for fam, (label, series) in sorted(s["labeled_gauges"].items()):

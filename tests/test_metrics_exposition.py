@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import re
 
+import pytest
+
 from deconv_api_tpu.serving.metrics import Metrics, escape_label
 from deconv_api_tpu.serving.trace import FlightRecorder, RequestTrace
 
@@ -94,6 +96,18 @@ def _traffic(m: Metrics) -> None:
     m.set_labeled_gauge("lane_inflight", "lane", "0", 1)
     m.set_labeled_gauge("lane_breaker_state", "lane", "0", 0)
     m.set_gauge("lane_imbalance", 1.0)
+    # multi-tenant QoS series (round 13): a MULTI-label counter family
+    # (tenant + class), a float-increment counter (measured device ms),
+    # per-tenant shed accounting, and the fairness gauge
+    m.inc_labeled(
+        "tenant_requests_total", ("tenant", "class"), ("acme", "interactive")
+    )
+    m.inc_labeled(
+        "tenant_requests_total", ("tenant", "class"), ("acme", "bulk"), 2
+    )
+    m.inc_labeled("tenant_device_ms_total", "tenant", "acme", 12.345)
+    m.inc_labeled("tenant_shed_total", "tenant", "acme")
+    m.set_gauge("tenant_fairness", 1.0)
 
 
 def test_every_family_typed_once_and_labels_escape():
@@ -127,6 +141,31 @@ def test_every_family_typed_once_and_labels_escape():
     assert families["deconv_lane_imbalance"] == "gauge"
     assert samples[("deconv_lane_requests_total", 'lane="0"')] == 4.0
     assert samples[("deconv_lane_inflight", 'lane="0"')] == 1.0
+    # round-13 tenant series: the multi-label block parses and
+    # round-trips the escaping grammar, float counters render
+    assert families["deconv_tenant_requests_total"] == "counter"
+    assert families["deconv_tenant_device_ms_total"] == "counter"
+    assert families["deconv_tenant_shed_total"] == "counter"
+    assert families["deconv_tenant_fairness"] == "gauge"
+    assert samples[
+        ("deconv_tenant_requests_total", 'tenant="acme",class="interactive"')
+    ] == 1.0
+    assert samples[
+        ("deconv_tenant_requests_total", 'tenant="acme",class="bulk"')
+    ] == 2.0
+    assert samples[
+        ("deconv_tenant_device_ms_total", 'tenant="acme"')
+    ] == pytest.approx(12.345)
+    # mismatched label names on an existing family are a programming
+    # error, loudly
+    with pytest.raises(ValueError):
+        m.inc_labeled("tenant_requests_total", "tenant", "acme")
+    with pytest.raises(TypeError):
+        m.inc_labeled("tenant_requests_total", ("tenant", "class"), "acme")
+    # a short value tuple would zip-truncate into an ambiguous sample
+    # missing labels at exposition time — same loud failure
+    with pytest.raises(ValueError):
+        m.inc_labeled("tenant_requests_total", ("tenant", "class"), ("acme",))
     # the raw quote must not appear unescaped inside any label block
     for line in text.splitlines():
         if "we" in line and "ird" in line:

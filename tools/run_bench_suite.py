@@ -57,6 +57,15 @@ JOBS_SYNC_OVERHEAD_BUDGET_PCT = 3.0
 # actually spreading the key mix across chips.
 LANES_SPEEDUP_BUDGET = 1.4
 
+# Multi-tenant QoS budgets (round 13): the noisy-neighbor drill's
+# victim tenant may lose at most this much p99 versus its solo baseline
+# while a zipf bulk abuser runs at 4x its device-time budget...
+QOS_VICTIM_P99_BUDGET_PCT = 15.0
+# ...and the QoS machinery itself (admission + DRR queues, one
+# anonymous tenant) may cost the hot cached path at most this much
+# versus qos-off.
+QOS_SYNC_OVERHEAD_BUDGET_PCT = 3.0
+
 # Channel-packed backward-tail budget (round 12): the packed path must
 # not run SLOWER than the vmapped path it would replace — a recorded
 # regression (like the r3 prototype's 280-vs-368 img/s) keeps the
@@ -303,6 +312,90 @@ def run_jobs_guard(timeout_s: float = 1800.0) -> dict:
         problems.append(
             f"sync-path overhead {overhead:.1f}% with jobs enabled "
             f"(> {JOBS_SYNC_OVERHEAD_BUDGET_PCT:.0f}% budget)"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
+    return row
+
+
+def run_qos_guard(timeout_s: float = 1800.0) -> dict:
+    """Multi-tenant QoS drill + overhead guard (round 13).
+
+    Part 1 — the noisy-neighbor drill (tools/loopback_load.py
+    --tenants default): an interactive victim tenant and a zipf bulk
+    abuser tenant share one QoS-enabled server; the abuser's
+    device-time budget is calibrated to demand/4 so it runs 4x over.
+    The drill's own error field already pins the fairness contract
+    (victim p99 within QOS_VICTIM_P99_BUDGET_PCT of solo, zero sheds
+    charged to the victim, the abuser actually rejected); this guard
+    surfaces it plus the split columns.
+
+    Part 2 — the overhead A/B: the hot cached workload with QoS
+    enabled (one anonymous unmetered tenant — admission, DRR queue,
+    hit-refund accounting all live) versus off; overhead past
+    QOS_SYNC_OVERHEAD_BUDGET_PCT fails the row."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--tenants", "default"], timeout_s, env=env
+    )
+    # ALTERNATING best-of-2 runs per arm (on, off, on, off), best-of-3
+    # passes within each: this host shows 20-40% throughput swings
+    # between back-to-back loopback boots (see the contention note on
+    # the jobs token history), and a single on-then-off sequence
+    # attributes whichever swing it straddles to the QoS machinery
+    base = ["--key-dist", "hotset:8", "--passes", "3", "2"]
+    arms: dict[str, list] = {"on": [], "off": []}
+    for _ in range(2):
+        arms["on"].append(
+            run_cmd_json(
+                [sys.executable, loopback, "--qos", *base], timeout_s, env=env
+            )
+        )
+        arms["off"].append(
+            run_cmd_json([sys.executable, loopback, *base], timeout_s, env=env)
+        )
+    row = {"config": "qos", "which": "loopback_qos_drill"}
+    for runs in arms.values():
+        for r in runs:
+            if "error" in r:
+                row["error"] = r["error"]
+                return row
+    on_all = [r["requests_per_sec"] for r in arms["on"]]
+    off_all = [r["requests_per_sec"] for r in arms["off"]]
+    on_rs, off_rs = max(on_all), max(off_all)
+    overhead = (off_rs - on_rs) / off_rs * 100.0 if off_rs else 0.0
+    row.update(
+        victim_solo_p99_ms=drill.get("victim_solo_p99_ms"),
+        victim_mixed_p99_ms=drill.get("victim_mixed_p99_ms"),
+        solo_p99s_ms=drill.get("solo_p99s_ms"),
+        mixed_p99s_ms=drill.get("mixed_p99s_ms"),
+        victim_p99_degradation_pct=drill.get("victim_p99_degradation_pct"),
+        p99_budget_pct=QOS_VICTIM_P99_BUDGET_PCT,
+        capacity_ms_per_s=drill.get("capacity_ms_per_s"),
+        abuser_budget_ms_per_s=drill.get("abuser_budget_ms_per_s"),
+        abuser_offered_rps=drill.get("abuser_offered_rps"),
+        victim_split=drill.get("victim_split"),
+        abuser_split=drill.get("abuser_split"),
+        tenant_shed_total=drill.get("tenant_shed_total"),
+        victim_device_ms=drill.get("victim_device_ms"),
+        abuser_device_ms=drill.get("abuser_device_ms"),
+        fairness_gauge=drill.get("fairness_gauge"),
+        sync_qos_on_req_s=on_rs,
+        sync_qos_off_req_s=off_rs,
+        sync_qos_on_runs=on_all,
+        sync_qos_off_runs=off_all,
+        sync_overhead_pct=round(overhead, 2),
+        sync_budget_pct=QOS_SYNC_OVERHEAD_BUDGET_PCT,
+    )
+    problems = []
+    if "error" in drill:
+        problems.append(drill["error"])
+    if overhead > QOS_SYNC_OVERHEAD_BUDGET_PCT:
+        problems.append(
+            f"qos-on sync overhead {overhead:.1f}% "
+            f"(> {QOS_SYNC_OVERHEAD_BUDGET_PCT:.0f}% budget) on the hot "
+            "cached path"
         )
     if problems:
         row["error"] = "; ".join(problems)
@@ -659,6 +752,12 @@ def main() -> int:
             # sync-path 3% overhead budget
             result = run_jobs_guard()
             result["date"] = date
+        elif tok == "qos":
+            # multi-tenant QoS drill (round 13): zipf bulk abuser at 4x
+            # budget vs interactive victim — victim p99 within 15% of
+            # solo, sheds charged to the abuser, <=3% qos-on overhead
+            result = run_qos_guard()
+            result["date"] = date
         elif tok == "kpack":
             # channel-packed backward tail A/B (round 12): bit-equality
             # asserted in the probe, loud error on regression or a
@@ -680,7 +779,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos'])}",
             }
         else:
             n = int(tok)

@@ -36,6 +36,19 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "victim_mixed_p99_ms" in row:
+        # qos noisy-neighbor rows (round 13): the fairness contract in
+        # one line — victim p99 solo vs mixed, the shed split, and the
+        # error kept visible (a degraded victim is the row's point)
+        line = (
+            f"victim p99 {row.get('victim_solo_p99_ms')}→"
+            f"{row.get('victim_mixed_p99_ms')}ms "
+            f"({row.get('victim_p99_degradation_pct')}%), "
+            f"shed={row.get('tenant_shed_total')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     for key in (
         "img_per_sec", "images_per_sec", "requests_per_sec", "value",
         "ms_per_batch", "dreams_per_min",
