@@ -49,8 +49,30 @@ def cmd_serve(args: argparse.Namespace) -> int:
         argv += ["--no-singleflight"]
     if getattr(args, "qos", False):
         argv += ["--qos"]
+    if getattr(args, "peer_fill", False):
+        argv += ["--peer-fill"]
     serve_main(argv)
     return 0
+
+
+def cmd_fleet_router(args: argparse.Namespace) -> int:
+    """The fleet routing tier (round 14, serving/fleet.py): a
+    cache-affine consistent-hash router over N backend serve processes.
+    Deliberately jax-free — a router host needs no accelerator, no
+    model weights, and boots in milliseconds."""
+    from deconv_api_tpu.serving.fleet import main as fleet_main
+
+    argv = ["--backends", args.backends]
+    for flag in (
+        "host", "port", "vnodes", "probe_interval_s", "probe_timeout_s",
+        "eject_threshold", "cooldown_s", "forward_timeout_s",
+    ):
+        val = getattr(args, flag, None)
+        if val is not None:
+            argv += [f"--{flag.replace('_', '-')}", str(val)]
+    if args.no_peer_fill:
+        argv += ["--no-peer-fill"]
+    return fleet_main(argv)
 
 
 def _load_service(args: argparse.Namespace):
@@ -353,8 +375,58 @@ def main(argv: list[str] | None = None) -> int:
         metavar="interactive|standard|bulk",
         help="priority class for tenants with no explicit class",
     )
+    s.add_argument(
+        "--peer-fill", action="store_true", dest="peer_fill",
+        help="fleet tier: honor x-peer-fill hints + serve the internal "
+        "cache-read route to ring peers (trusted meshes; default off)",
+    )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser(
+        "fleet-router",
+        help="cache-affine consistent-hash router over N serve backends",
+    )
+    s.add_argument(
+        "--backends", required=True, metavar="HOST:PORT,HOST:PORT",
+        help="comma-separated backend list (the `serve` processes)",
+    )
+    s.add_argument("--host", default=None)
+    s.add_argument("--port", type=int, default=None)
+    s.add_argument(
+        "--vnodes", type=int, default=None, dest="vnodes",
+        help="virtual nodes per backend on the hash ring (default 64)",
+    )
+    s.add_argument(
+        "--probe-interval-s", type=float, default=None,
+        dest="probe_interval_s",
+        help="seconds between /readyz health sweeps (default 2)",
+    )
+    s.add_argument(
+        "--probe-timeout-s", type=float, default=None,
+        dest="probe_timeout_s", help="per-probe timeout (default 2)",
+    )
+    s.add_argument(
+        "--eject-threshold", type=int, default=None, dest="eject_threshold",
+        help="consecutive probe/forward failures before a backend is "
+        "ejected from the ring (default 3)",
+    )
+    s.add_argument(
+        "--cooldown-s", type=float, default=None, dest="cooldown_s",
+        help="seconds an ejected backend cools before its half-open "
+        "re-probe (default 5)",
+    )
+    s.add_argument(
+        "--forward-timeout-s", type=float, default=None,
+        dest="forward_timeout_s",
+        help="per-forward client timeout (default 330; cover the "
+        "slowest route's server timeout)",
+    )
+    s.add_argument(
+        "--no-peer-fill", action="store_true", dest="no_peer_fill",
+        help="never attach x-peer-fill hints on rebalanced keys",
+    )
+    s.set_defaults(fn=cmd_fleet_router)
 
     s = sub.add_parser("visualize", help="deconv visualization of one image")
     s.add_argument("--image", required=True)

@@ -205,6 +205,20 @@ class ServerConfig:
     # bucket (the real cost is ~0.08 ms of host time): hits are metered
     # traffic, not free laundering of a hot key.
     qos_hit_cost_ms: float = 0.05
+    # --- fleet tier (round 14: serving/fleet.py) ---
+    # Peer cache fill: honor the router's ``x-peer-fill: host:port``
+    # hint on a cache miss — ask the key's PREVIOUS ring owner for the
+    # finished payload (GET /v1/internal/cache/{digest}) before
+    # computing, so a ring rebalance (drain, ejection, scale-out) moves
+    # bytes between hosts instead of stampeding the device with
+    # recomputes.  Also registers the internal cache route this backend
+    # serves to ITS peers.  OFF by default: the hint names a host to
+    # fetch from, so this belongs on trusted meshes behind the router
+    # tier only (docs/OPERATIONS.md "Fleet serving").
+    fleet_peer_fill: bool = False
+    # Per-peer-fetch timeout: past this the miss just computes — a slow
+    # peer must never cost more than the compute it would have saved.
+    peer_fill_timeout_s: float = 2.0
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

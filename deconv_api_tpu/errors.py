@@ -203,6 +203,24 @@ def retry_after_value(retry_after_s: float | None) -> str | None:
     return str(max(1, math.ceil(retry_after_s)))
 
 
+class BackendUnavailable(DeconvError):
+    """The fleet router (round 14, serving/fleet.py) could not reach a
+    backend for this request: the ring is empty (every backend ejected/
+    draining), or the key's owner AND its failover neighbour both
+    infra-failed.  502 — the gateway speaking about its upstream, as
+    distinct from a backend's own 503 backpressure (which passes
+    through the router untouched).  Carries a Retry-After derived from
+    the ejection cooldown: by then the half-open probe has either
+    re-admitted a backend or the fleet is genuinely down."""
+
+    status = 502
+    code = "backend_unavailable"
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class FaultInjected(DeconvError):
     """An armed fault-injection site fired (serving/faults.py).  Its own
     taxonomy code so a chaos run's error budget can split EXPECTED

@@ -25,6 +25,7 @@ import asyncio
 import contextlib
 import json
 import os
+import re
 import threading
 import time
 
@@ -431,6 +432,16 @@ class DeconvService:
             self.server.route("GET", "/v1/jobs")(self._jobs_collection)
             self.server.route_prefix("GET", "/v1/jobs/")(self._jobs_entity)
             self.server.route_prefix("DELETE", "/v1/jobs/")(self._jobs_delete)
+        # Fleet peer cache fill (round 14, serving/fleet.py): the
+        # internal digest-read surface this backend serves to its ring
+        # peers, plus the x-peer-fill hint honored in _cache_wrap.
+        # Registered ONLY with fleet_peer_fill on (trusted meshes): a
+        # default server exposes no internal surface and ignores the
+        # header entirely.
+        if self.cache is not None and self.cfg.fleet_peer_fill:
+            self.server.route_prefix("GET", "/v1/internal/cache/")(
+                self._internal_cache
+            )
 
     # ---------------------------------------------------------- device side
 
@@ -1057,6 +1068,80 @@ class DeconvService:
 
     # ----------------------------------------------------- response cache
 
+    async def _internal_cache(self, req: Request) -> Response:
+        """GET /v1/internal/cache/{digest} — the peer cache-fill read
+        surface (round 14, fleet tier).  Serves a POSITIVE cached
+        payload verbatim (body + content type) for a peer backend that
+        just inherited this digest's keyspace slice; 404 ``cache_miss``
+        otherwise.  Reads via ``ResponseCache.peek``: no hit/miss
+        counters, no LRU promotion — a peer's read is not this
+        backend's traffic.  Negative entries are not served (their TTL
+        is seconds; the peer re-validates more cheaply than it
+        round-trips)."""
+        digest = req.path[len("/v1/internal/cache/"):]
+        if not re.fullmatch(r"[0-9a-f]{16,64}", digest):
+            return _error_response(
+                errors.BadRequest("malformed cache digest"), req.id
+            )
+        entry = self.cache.peek(digest) if self.cache is not None else None
+        if entry is None or entry.negative or entry.status != 200:
+            resp = Response.json(
+                {"error": "cache_miss", "request_id": req.id}, 404
+            )
+            # never negative-cached on the PEER side: the route is
+            # internal and the 404 is a statement about this instant
+            resp.headers["cache-control"] = "no-store"
+            return resp
+        self.metrics.inc_counter("cache_peer_reads_total")
+        return Response(
+            status=200,
+            body=entry.body,
+            headers={"content-type": entry.content_type, "x-cache": "peer"},
+        )
+
+    async def _peer_fill(self, req: Request, key: str, tr) -> Response | None:
+        """Honor the router's ``x-peer-fill`` hint on a miss: fetch the
+        finished payload for ``key`` from the previous ring owner before
+        computing (round 14).  Returns the peer's Response (stored
+        locally by the caller's common store path) or None — every
+        failure mode (malformed hint, unreachable peer, peer miss, slow
+        peer) falls through to the normal compute path; a fill may only
+        ever SAVE work."""
+        peer = req.headers.get("x-peer-fill", "")
+        if not peer or not self.cfg.fleet_peer_fill or self.cache is None:
+            return None
+        m = re.fullmatch(r"([A-Za-z0-9_.\-]+):(\d{1,5})", peer)
+        if m is None:
+            return None
+        from deconv_api_tpu.serving import fleet
+
+        t0 = time.perf_counter()
+        try:
+            status, headers, body = await fleet.raw_request(
+                m.group(1), int(m.group(2)), "GET",
+                f"/v1/internal/cache/{key}", {}, b"",
+                self.cfg.peer_fill_timeout_s,
+            )
+        except Exception:  # noqa: BLE001 — any peer failure = just compute
+            status, headers, body = 0, {}, b""
+        dt = time.perf_counter() - t0
+        if tr is not None:
+            tr.add_span("peer_fill", t0, dt, peer=peer, hit=status == 200)
+        if status != 200:
+            self.metrics.inc_counter("cache_peer_fill_misses_total")
+            return None
+        self.metrics.inc_counter("cache_peer_fills_total")
+        return Response(
+            status=200,
+            body=body,
+            headers={
+                "content-type": headers.get(
+                    "content-type", "application/json"
+                ),
+                "x-cache": "peer-fill",
+            },
+        )
+
     def _cache_wrap(self, route: str, handler, metrics: Metrics):
         """Put the response cache + singleflight table in front of a
         compute route.
@@ -1180,8 +1265,20 @@ class DeconvService:
                             "x-request-id": req.id,
                         },
                     )
+                # peer cache fill (round 14): on a rebalanced key the
+                # router hints at the PREVIOUS owner — fetch its finished
+                # payload before computing.  Leader-side only: waiters
+                # ride whatever the leader publishes.  The await runs
+                # AFTER flights.begin, so any escape (a CancelledError
+                # from the leader's dying connection — _peer_fill eats
+                # plain Exceptions itself) must finish the flight or the
+                # key's future stays in the table forever and every
+                # later identical request coalesces onto it.
                 try:
-                    resp = await handler(req)
+                    filled = await self._peer_fill(req, key, tr)
+                    resp = (
+                        filled if filled is not None else await handler(req)
+                    )
                 except asyncio.CancelledError:
                     # waiters must not inherit the leader's cancellation
                     # (their own tasks are alive); fail them cleanly
@@ -1194,22 +1291,36 @@ class DeconvService:
                     raise
                 except errors.DeadlineExpired:
                     # the leader's PERSONAL x-deadline-ms lapsed — not a
-                    # property of the shared work.  Waiters (who may have
-                    # no deadline at all) get a retryable 503, never a
-                    # 504 that is not theirs (round 9)
+                    # property of the shared work.  Waiters (who may
+                    # have no deadline at all) get a retryable 503,
+                    # never a 504 that is not theirs (round 9).  Only
+                    # handler() raises this — _peer_fill eats its own
+                    # plain Exceptions
                     self.flights.finish(
                         key,
                         exc=errors.Unavailable(
-                            "coalesced request's leader hit its own deadline"
+                            "coalesced request's leader hit its own "
+                            "deadline"
                         ),
                     )
                     raise
                 except BaseException as e:  # noqa: BLE001 — publish, re-raise
                     self.flights.finish(key, exc=e)
                     raise
-                if (
+                if filled is not None:
+                    # a peer fill moves bytes, not device work: refund
+                    # the provisional QoS debit down to the fixed hit
+                    # cost, same as a cache hit (round 13) — otherwise
+                    # rebalanced hot keys drain their tenant's bucket on
+                    # pure cache-transfer traffic
+                    if self.qos is not None and req._qos_grant is not None:
+                        self.qos.charge_hit(req._qos_grant)
+                    metrics.observe_request(time.perf_counter() - t0)
+                    self.flights.finish(key, resp)
+                elif (
                     resp.status >= 400
-                    and errors.code_from_body(resp.body) == "deadline_expired"
+                    and errors.code_from_body(resp.body)
+                    == "deadline_expired"
                 ):
                     # route handlers map DeadlineExpired to a 504
                     # RESPONSE (they never re-raise), so the deadline
@@ -1218,13 +1329,26 @@ class DeconvService:
                     self.flights.finish(
                         key,
                         exc=errors.Unavailable(
-                            "coalesced request's leader hit its own deadline"
+                            "coalesced request's leader hit its own "
+                            "deadline"
                         ),
                     )
                 else:
                     self.flights.finish(key, resp)
             else:
-                resp = await handler(req)
+                # a no-cache/no-store bypass is a forced RECOMPUTE: it
+                # must not be satisfied from a peer's cache either
+                resp = (
+                    None if bypass else await self._peer_fill(req, key, tr)
+                )
+                if resp is not None:
+                    # refund to hit cost: no device work ran (see the
+                    # singleflight peer-fill branch above)
+                    if self.qos is not None and req._qos_grant is not None:
+                        self.qos.charge_hit(req._qos_grant)
+                    metrics.observe_request(time.perf_counter() - t0)
+                else:
+                    resp = await handler(req)
             if self.cache is not None and "no-store" not in cc:
                 self.cache.store(
                     key,
@@ -2425,6 +2549,12 @@ def main(argv: list[str] | None = None) -> None:
         metavar="interactive|standard|bulk",
         help="priority class for tenants with no explicit class",
     )
+    p.add_argument(
+        "--peer-fill", action="store_true", default=None,
+        help="fleet tier (round 14): honor the router's x-peer-fill "
+        "hint on cache misses and serve GET /v1/internal/cache/{digest} "
+        "to ring peers (trusted meshes only; default off)",
+    )
     args = p.parse_args(argv)
     overrides = {}
     if args.cache_bytes is not None:
@@ -2468,6 +2598,8 @@ def main(argv: list[str] | None = None) -> None:
         overrides["tenants"] = args.tenants
     if args.qos_default_class is not None:
         overrides["qos_default_class"] = args.qos_default_class
+    if args.peer_fill:
+        overrides["fleet_peer_fill"] = True
     if args.host is not None:
         overrides["host"] = args.host
     if args.port is not None:

@@ -271,6 +271,25 @@ class ResponseCache:
         self._publish_gauges()
         return None
 
+    def peek(self, key: str) -> CacheEntry | None:
+        """Read an entry WITHOUT counters, LRU promotion, or QoS charge —
+        the peer cache-fill surface (round 14, ``GET
+        /v1/internal/cache/{digest}``).  A peer's internal read must not
+        inflate this backend's hit ratio or keep an entry hot that its
+        OWN traffic no longer touches; expired entries read as absent
+        (reaped lazily by the next metered lookup)."""
+        shard = self._shard_for(key)
+        with shard.lock:
+            entry = shard.entries.get(key)
+            if entry is None:
+                return None
+            if (
+                entry.expires_at is not None
+                and self._clock() >= entry.expires_at
+            ):
+                return None
+            return entry
+
     def store(self, key: str, status: int, body: bytes, content_type: str) -> bool:
         """Cache a finished response if its status is cacheable: 200 →
         positive (cache_ttl_s; 0 = until evicted), deterministic 4xx →

@@ -1,0 +1,1268 @@
+"""Fleet tier: a cache-affine HTTP router over N deconv backends (round 14).
+
+Round 10 scaled serving to every chip on ONE host (executor lanes); the
+next order of magnitude is N hosts, and the naive front-end — a
+round-robin load balancer — destroys exactly the two things the serving
+stack spent rounds 7-13 building:
+
+- the content-addressed response cache fragments: each backend holds a
+  PRIVATE LRU, so a hot key warms N caches with N device computations
+  and the fleet-wide hit ratio collapses toward 1/N of a single node's;
+- singleflight coalescing stays per-process: N identical in-flight
+  requests spread over N backends dispatch N times.
+
+This module is the fix: a lightweight asyncio **router** that
+consistent-hashes the SAME canonical request digest the backend cache
+uses (serving/cache.py:canonical_digest — field order, multipart
+boundaries and encoding choice already canonicalize out) onto a hash
+ring of backends.  Identical requests land on the same backend, so its
+local LRU becomes that keyspace's one cache and its local Singleflight
+dedups identical in-flight work FLEET-wide.  N private LRUs become one
+logical cache with zero shared state and zero coordination traffic —
+the classic distributed-memo-cache construction (consistent hashing
+with virtual nodes), matched to TensorFlow-Serving's multi-worker
+front-end framing (arXiv:1605.08695) where the routing tier is a
+first-class subsystem, not an afterthought.
+
+Pieces:
+
+- ``HashRing``: consistent hashing with ``vnodes`` virtual nodes per
+  member (default 64).  Placement is a pure function of (member name,
+  key), so every router replica computes the same assignment, and
+  adding/removing one of N members moves ~1/N of the keyspace — the
+  vnode count bounds the variance (pinned by tests/test_fleet.py).
+
+- ``BackendMember``: one backend's health state.  Membership is
+  health-gated through the backend's existing ``/readyz`` surface:
+  periodic probes admit a backend when it answers 200, remove it
+  GRACEFULLY when it reports draining (``/readyz`` 503 with
+  ``checks.not_draining == false`` — the round-9 drain contract), and
+  EJECT it on consecutive probe/forward failures.  Ejection and
+  half-open re-admission reuse the batcher's ``CircuitBreaker`` state
+  machine verbatim: consecutive failures open it (backend leaves the
+  ring), the cooldown elapses, ``allow()`` claims exactly one half-open
+  probe, and a 200 closes it (backend rejoins).  Designed backpressure
+  — 503 sheds, 504 deadlines — is NOT a failure signal: ejecting an
+  overloaded backend would cascade its keyspace onto its neighbours at
+  peak load (the http.py WARNING-vs-ERROR split, applied to routing).
+
+- ``FleetRouter``: the proxy itself.  POST bodies are digested (one
+  form parse, memoized on the Request) and forwarded to the key's ring
+  owner; non-keyed traffic (GETs, probes) round-robins over ring
+  members.  Headers pass through UNCHANGED — ``x-request-id`` (minted
+  here per the RID grammar when absent, so the id joins router access
+  lines with the backend's flight recorder), tenant/QoS headers,
+  ``x-deadline-ms``, ``cache-control`` — and responses come back with
+  ``Retry-After``/``x-cache`` intact plus an ``x-backend`` stamp naming
+  the backend that served them.  Infra failures (connect refused,
+  timeout, torn response) retry ONCE on the next distinct ring owner —
+  compute responses are pure functions of the request, so a replay is
+  safe — and exhaust into a 502 ``backend_unavailable`` with a
+  cooldown-derived Retry-After through the unified
+  ``errors.retry_after_value`` helper.
+
+- Job affinity: the durable job subsystem (round 11) is per-backend
+  state the ring knows nothing about, so ``/v1/jobs/{id}`` entity
+  traffic follows the JOB — each id is pinned to the backend whose 202
+  answered its submit (bounded LRU; a forgotten pin degrades to asking
+  every live member, reading 404 ``job_not_found`` as "not here").
+  ``/v1/jobs/{id}/events`` forwards PROGRESSIVELY (head bounded by the
+  forward timeout, SSE body an open pipe for the job's lifetime), and
+  ``GET /v1/jobs`` scatter-gathers every member's collection into one
+  fleet view (jobs stamped with ``backend``, counts summed,
+  ``partial`` flagging unanswering members).
+
+- Peer cache fill (the failover stretch): when membership changes, the
+  router keeps the PREVIOUS ring for a bounded window; a request whose
+  owner moved carries an ``x-peer-fill: host:port`` hint naming the old
+  owner, and the NEW owner's cache wrap (serving/app.py) asks that peer
+  ``GET /v1/internal/cache/{digest}`` before computing — so a rebalance
+  shifts bytes between hosts instead of stampeding the device with
+  recomputes.  Off by default on backends (``fleet_peer_fill`` config;
+  trusted-mesh only — the hint names a host to fetch from).
+
+Observability rides the existing machinery: a ``Metrics`` registry in
+non-core mode (prefix ``router``) carries
+``router_requests_total{backend=}`` / ``router_backend_state{backend=}``
+(0 healthy / 1 joining / 2 ejected / 3 draining) /
+``router_rebalanced_keys_total`` plus forward-latency stages, and the
+router serves its own ``/healthz``, ``/readyz`` (ready while ANY backend
+is in the ring), ``/v1/config`` (full ring snapshot) and ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import re
+import time
+import urllib.parse
+from bisect import bisect_left
+from collections import OrderedDict
+from typing import Callable
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.serving.batcher import CircuitBreaker
+from deconv_api_tpu.serving.cache import canonical_digest
+from deconv_api_tpu.serving.http import HttpServer, Request, Response
+from deconv_api_tpu.serving.metrics import Metrics
+from deconv_api_tpu.utils import slog
+
+_log = slog.get_logger("deconv.fleet")
+
+# backend address grammar: host:port, host a sane DNS token — the same
+# shape the x-peer-fill hint is validated against on the backend side
+# (serving/app.py), so a hint can never smuggle a URL or a header
+BACKEND_RE = re.compile(r"^[A-Za-z0-9_.\-]+:\d{1,5}$")
+
+# Hop-by-hop / recomputed headers never forwarded in either direction.
+_HOP_HEADERS = frozenset(
+    ("connection", "content-length", "transfer-encoding", "keep-alive",
+     "host", "upgrade", "te", "trailer", "proxy-connection")
+)
+
+# How long a moved key keeps its previous-owner hint after a rebalance:
+# past this, the new owner has either filled (peer or compute) or the
+# entry was cold anyway — a stale hint only costs a pointless peer miss.
+PEER_FILL_WINDOW_S = 60.0
+
+# /v1/jobs/{id}[/sub] entity traffic follows the JOB, not the ring: the
+# durable job subsystem (round 11) is per-backend state, so a poll or
+# cancel routed by ring walk lands on a backend that never heard of the
+# id.  The router pins each id to the backend that answered its submit.
+_JOBS_ENTITY_RE = re.compile(r"^/v1/jobs/([A-Za-z0-9_\-]+)(/[A-Za-z0-9_\-/]*)?$")
+_JOB_OWNERS_MAX = 4096
+
+# router_backend_state gauge values, one line per backend
+_STATE_GAUGE = {"healthy": 0, "joining": 1, "ejected": 2, "draining": 3}
+
+
+def _ring_point(data: bytes) -> int:
+    """64-bit ring position — blake2b like the cache key itself, so the
+    placement function has no second hash family to reason about."""
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big"
+    )
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Pure data structure: ``members`` in, deterministic ``owner(key)``
+    out.  Rebuilt (cheap — N*vnodes points) on membership change; the
+    router keeps the previous instance for rebalance accounting and
+    peer-fill hints.  Placement depends only on (member name, vnode
+    index, key), so two routers over the same member set agree."""
+
+    def __init__(self, members=(), vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self.members: tuple[str, ...] = tuple(sorted(set(members)))
+        points: list[tuple[int, str]] = []
+        for m in self.members:
+            for i in range(self.vnodes):
+                points.append((_ring_point(f"{m}#{i}".encode()), m))
+        points.sort()
+        self._points = points
+        self._keys = [p for p, _ in points]
+
+    def __len__(self) -> int:
+        return len(self._points)
+
+    def owner(self, key: str) -> str | None:
+        """The member owning ``key`` (a hex digest string), or None on an
+        empty ring: first vnode clockwise of the key's ring position."""
+        if not self._points:
+            return None
+        i = bisect_left(self._keys, _ring_point(key.encode()))
+        if i == len(self._keys):
+            i = 0  # wrap
+        return self._points[i][1]
+
+    def owners(self, key: str) -> list[str]:
+        """Every member in clockwise preference order from ``key`` —
+        owner first, then each next DISTINCT member.  The failover walk:
+        attempt 2 after an infra failure goes to ``owners(key)[1]``."""
+        if not self._points:
+            return []
+        start = bisect_left(self._keys, _ring_point(key.encode()))
+        seen: list[str] = []
+        for off in range(len(self._points)):
+            m = self._points[(start + off) % len(self._points)][1]
+            if m not in seen:
+                seen.append(m)
+                if len(seen) == len(self.members):
+                    break
+        return seen
+
+
+class BackendMember:
+    """One backend's membership state, health-gated by the breaker.
+
+    States: ``joining`` (configured, not yet probed healthy — out of
+    ring), ``healthy`` (in ring), ``draining`` (graceful leave: the
+    backend itself said so via /readyz — out of ring, no breaker
+    involvement, rejoins if it comes back ready), ``ejected`` (breaker
+    OPEN after consecutive failures — out of ring until a half-open
+    probe succeeds)."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        eject_threshold: int = 3,
+        cooldown_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not BACKEND_RE.match(name):
+            raise ValueError(
+                f"backend {name!r} must be host:port (no scheme, no path)"
+            )
+        self.name = name
+        host, _, port = name.rpartition(":")
+        self.host = host
+        self.port = int(port)
+        if not 0 < self.port < 65536:
+            raise ValueError(f"backend {name!r}: port out of range")
+        self.state = "joining"
+        # ejection/half-open machinery IS the round-9 breaker: N
+        # consecutive failures open it (leave ring), cooldown, allow()
+        # claims one probe, success closes it (rejoin).  metrics=None —
+        # the router publishes its own labeled gauge per backend.
+        self.breaker = CircuitBreaker(
+            eject_threshold, cooldown_s, clock=clock
+        )
+        self.requests_total = 0
+
+    @property
+    def in_ring(self) -> bool:
+        return self.state == "healthy"
+
+
+class _BackendError(Exception):
+    """Infra-level forward failure: connect refused/reset, timeout, torn
+    response.  The ONLY failure kind that retries on the next owner and
+    feeds the ejection breaker from the forward path."""
+
+
+async def _read_all(chunks) -> bytes:
+    parts = []
+    async for c in chunks:
+        parts.append(c)
+    return b"".join(parts)
+
+
+def _build_request_head(
+    method: str,
+    target: str,
+    host: str,
+    port: int,
+    headers: dict[str, str],
+    body: bytes,
+) -> str:
+    """The one place the fleet's request dialect is spelled out, shared
+    by the buffered and streaming clients so they cannot diverge."""
+    head = f"{method} {target} HTTP/1.1\r\n"
+    hdrs = {"host": f"{host}:{port}", "connection": "close", **headers}
+    if body or method not in ("GET", "HEAD", "DELETE"):
+        hdrs["content-length"] = str(len(body))
+    return head + "".join(f"{k}: {v}\r\n" for k, v in hdrs.items())
+
+
+async def raw_request(
+    host: str,
+    port: int,
+    method: str,
+    target: str,
+    headers: dict[str, str],
+    body: bytes,
+    timeout_s: float,
+) -> tuple[int, dict[str, str], bytes]:
+    """One HTTP/1.1 request over a fresh connection, response read to
+    EOF (``connection: close`` is always sent).  Shared by the router's
+    forward/probe paths and the backend's peer-fill client
+    (serving/app.py), so the fleet speaks exactly one dialect.
+
+    Raises ``_BackendError`` on any infra failure; HTTP-level errors
+    (4xx/5xx) return normally — they are the backend SPEAKING, not the
+    backend being gone."""
+    head = _build_request_head(method, target, host, port, headers, body)
+
+    async def _roundtrip() -> bytes:
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            writer.write(head.encode() + b"\r\n" + body)
+            await writer.drain()
+            return await reader.read()
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    try:
+        raw = await asyncio.wait_for(_roundtrip(), timeout_s)
+    except (OSError, asyncio.TimeoutError, TimeoutError) as e:
+        raise _BackendError(f"{host}:{port}: {type(e).__name__}: {e}") from e
+    if b"\r\n\r\n" not in raw:
+        raise _BackendError(f"{host}:{port}: torn response ({len(raw)}B)")
+    head_raw, _, payload = raw.partition(b"\r\n\r\n")
+    status, resp_headers = _parse_response_head(head_raw, f"{host}:{port}")
+    # A graceful FIN mid-body looks exactly like EOF; without this check
+    # a truncated 200 would be forwarded (and, on the peer-fill path,
+    # CACHED) as if complete.
+    cl = resp_headers.get("content-length")
+    if cl is not None and cl.isdigit():
+        want = int(cl)
+        if len(payload) < want:
+            raise _BackendError(
+                f"{host}:{port}: truncated body "
+                f"({len(payload)}B of content-length {want})"
+            )
+        payload = payload[:want]
+    return status, resp_headers, payload
+
+
+def _parse_response_head(
+    head_raw: bytes, who: str
+) -> tuple[int, dict[str, str]]:
+    lines = head_raw.decode("latin-1").split("\r\n")
+    try:
+        status = int(lines[0].split(" ", 2)[1])
+    except (IndexError, ValueError) as e:
+        raise _BackendError(f"{who}: bad status line {lines[0]!r}") from e
+    resp_headers: dict[str, str] = {}
+    for line in lines[1:]:
+        k, sep, v = line.partition(":")
+        if sep:
+            resp_headers[k.strip().lower()] = v.strip()
+    return status, resp_headers
+
+
+async def raw_request_stream(
+    host: str,
+    port: int,
+    method: str,
+    target: str,
+    headers: dict[str, str],
+    body: bytes,
+    head_timeout_s: float,
+) -> tuple[int, dict[str, str], object]:
+    """Like ``raw_request`` but progressive: the payload comes back as
+    an async chunk iterator instead of a buffered read-to-EOF.  Only the
+    HEAD (status line + headers) is bounded by ``head_timeout_s`` — the
+    body is an open pipe, because its one caller is the jobs SSE surface
+    (round 11 progressive delivery) where a healthy stream lives exactly
+    as long as the job it narrates; clamping it under the forward
+    timeout would both break progressiveness and misread a long job as
+    backend death.  The caller owns the iterator: exhaust it or
+    ``aclose()`` it (the router's serve loop does either), both release
+    the connection."""
+    head = _build_request_head(method, target, host, port, headers, body)
+    try:
+        reader, writer = await asyncio.open_connection(host, port)
+    except OSError as e:
+        raise _BackendError(f"{host}:{port}: {type(e).__name__}: {e}") from e
+
+    async def _close() -> None:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+    try:
+        writer.write(head.encode() + b"\r\n" + body)
+        await writer.drain()
+        head_raw = await asyncio.wait_for(
+            reader.readuntil(b"\r\n\r\n"), head_timeout_s
+        )
+        status, resp_headers = _parse_response_head(
+            head_raw[:-4], f"{host}:{port}"
+        )
+    except _BackendError:
+        await _close()
+        raise
+    except (
+        OSError,
+        asyncio.TimeoutError,
+        TimeoutError,
+        asyncio.IncompleteReadError,
+        asyncio.LimitOverrunError,
+    ) as e:
+        await _close()
+        raise _BackendError(f"{host}:{port}: {type(e).__name__}: {e}") from e
+
+    async def _chunks():
+        try:
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    return
+                yield chunk
+        finally:
+            await _close()
+
+    return status, resp_headers, _chunks()
+
+
+class FleetRouter:
+    """The routing tier: one of these per router process (or embedded in
+    a drill).  ``start()`` binds the listener and launches the prober;
+    ``stop()`` drains and shuts both down."""
+
+    def __init__(
+        self,
+        backends: list[str] | tuple[str, ...],
+        *,
+        vnodes: int = 64,
+        probe_interval_s: float = 2.0,
+        probe_timeout_s: float = 2.0,
+        eject_threshold: int = 3,
+        cooldown_s: float = 5.0,
+        peer_fill: bool = True,
+        forward_timeout_s: float = 330.0,
+        idle_timeout_s: float = 30.0,
+        body_timeout_s: float = 20.0,
+        max_connections: int = 1024,
+        metrics: Metrics | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not backends:
+            raise ValueError("fleet router needs at least one backend")
+        self.vnodes = int(vnodes)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.eject_threshold = int(eject_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.peer_fill = bool(peer_fill)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self._clock = clock
+        self.metrics = metrics or Metrics(prefix="router", core=False)
+        self.members: dict[str, BackendMember] = {}
+        for name in backends:
+            if name in self.members:
+                raise ValueError(f"duplicate backend {name!r}")
+            self.members[name] = BackendMember(
+                name,
+                eject_threshold=eject_threshold,
+                cooldown_s=cooldown_s,
+                clock=clock,
+            )
+        self.ring = HashRing((), vnodes)
+        # previous topology, for rebalance accounting + peer-fill hints
+        self._prev_ring: HashRing | None = None
+        self._prev_ring_at = 0.0
+        # keys already counted against router_rebalanced_keys_total for
+        # the CURRENT topology (bounded: oldest forgotten first — a
+        # forgotten key double-counts at worst, it never grows state)
+        self._moved_seen: OrderedDict[str, None] = OrderedDict()
+        # job-id -> backend name, learned from 202 Locations and entity
+        # polls (bounded LRU: a forgotten id degrades to the fan-out
+        # walk in _proxy_job, never to an error)
+        self._job_owners: OrderedDict[str, str] = OrderedDict()
+        self._rr = 0  # round-robin cursor for non-keyed traffic
+        self.draining = False
+        self._probe_task: asyncio.Task | None = None
+        self.bound: tuple[str, int] | None = None
+
+        self.server = HttpServer(
+            idle_timeout_s=idle_timeout_s,
+            body_timeout_s=body_timeout_s,
+            max_connections=max_connections,
+        )
+        self.server.route("GET", "/healthz")(self._healthz)
+        self.server.route("GET", "/readyz")(self._readyz)
+        self.server.route("GET", "/v1/config")(self._config)
+        self.server.route("GET", "/metrics")(self._metrics_route)
+        self.server.route("GET", "/v1/metrics")(self._metrics_route)
+        for method in ("GET", "POST", "DELETE", "PUT"):
+            # everything else proxies; exact routes above win
+            self.server.route_prefix(method, "/")(self._proxy)
+        for m in self.members.values():
+            self._publish_state(m)
+
+    @property
+    def walk_timeout_s(self) -> float:
+        """Per-member bound for blind fan-out hops (the job-entity walk
+        and the fleet collection view): a wedged member that accepts TCP
+        but never answers must cost seconds, not the full forward
+        timeout (330s default) per hop."""
+        return min(
+            self.forward_timeout_s, max(10.0, 2 * self.probe_timeout_s)
+        )
+
+    # ------------------------------------------------------------ membership
+
+    def _publish_state(self, m: BackendMember) -> None:
+        self.metrics.set_labeled_gauge(
+            "backend_state", "backend", m.name, _STATE_GAUGE[m.state]
+        )
+        self.metrics.set_gauge(
+            "backends_in_ring",
+            sum(1 for b in self.members.values() if b.in_ring),
+        )
+
+    def _set_state(self, m: BackendMember, state: str, reason: str) -> None:
+        if m.state == state:
+            return
+        old = m.state
+        m.state = state
+        slog.event(
+            _log, "backend_state", level=logging.WARNING,
+            backend=m.name, state=state, was=old, reason=reason,
+        )
+        self._publish_state(m)
+        self._rebuild_ring(reason)
+
+    def _rebuild_ring(self, reason: str) -> None:
+        live = [n for n, m in self.members.items() if m.in_ring]
+        if tuple(sorted(live)) == self.ring.members:
+            return
+        # keep the old topology around: rebalance accounting and the
+        # peer-fill hints both ask "who owned this key BEFORE the move".
+        # Only once the ring has SERVED something, though — a cold
+        # boot's staggered admissions ({} -> {b1} -> {b1,b2} -> ...)
+        # would otherwise count ~1/N of the keyspace as "rebalanced" on
+        # every clean start and hint peer fills at members that cannot
+        # hold anything yet (a guaranteed-404 internal round trip per
+        # moved key).
+        if self.ring.members and any(
+            m.requests_total for m in self.members.values()
+        ):
+            self._prev_ring = self.ring
+            self._prev_ring_at = self._clock()
+        self._moved_seen.clear()
+        self.ring = HashRing(live, self.vnodes)
+        slog.event(
+            _log, "ring_rebalance", level=logging.WARNING,
+            members=sorted(live), vnodes=self.vnodes, reason=reason,
+        )
+
+    def _note_forward_result(self, m: BackendMember, ok: bool) -> None:
+        """Passive health: forward outcomes feed the same breaker the
+        probes do, so a dead backend is ejected by its own traffic
+        between probe ticks."""
+        if ok:
+            m.breaker.record_success()
+            if (
+                m.state == "ejected"
+                and m.breaker.state == CircuitBreaker.CLOSED
+            ):
+                # a live forward answered while ejected AND the breaker
+                # actually closed (it was half-open: this success was
+                # the probe).  record_success is a deliberate no-op in
+                # OPEN — a straggler that dispatched before the
+                # ejection must not flap a dead backend back into the
+                # ring with zero failure tolerance; the half-open
+                # probe path owns that re-admission.
+                self._set_state(m, "healthy", "forward_ok")
+            return
+        m.breaker.record_failure()
+        if m.breaker.state == CircuitBreaker.OPEN and m.state != "ejected":
+            self._set_state(m, "ejected", "consecutive_forward_failures")
+
+    # --------------------------------------------------------------- probing
+
+    async def probe_once(self) -> None:
+        """One health sweep over every backend (the prober loop's body;
+        tests drive it directly)."""
+        await asyncio.gather(
+            *(self._probe(m) for m in self.members.values())
+        )
+
+    async def _probe(self, m: BackendMember) -> None:
+        if m.state == "ejected":
+            allowed, _retry = m.breaker.allow()
+            if not allowed:
+                return  # still cooling; no half-open claim available
+        try:
+            status, _h, body = await raw_request(
+                m.host, m.port, "GET", "/readyz", {}, b"",
+                self.probe_timeout_s,
+            )
+        except _BackendError as e:
+            m.breaker.record_failure()
+            if m.breaker.state == CircuitBreaker.OPEN:
+                self._set_state(m, "ejected", f"probe: {e}")
+            elif m.in_ring:
+                # below threshold: stay in ring (one blip is not death)
+                slog.event(
+                    _log, "probe_failed", level=logging.WARNING,
+                    backend=m.name, error=str(e),
+                )
+            return
+        if status == 200:
+            m.breaker.record_success()
+            if m.state != "healthy":
+                self._set_state(m, "healthy", "probe_ok")
+            return
+        checks = {}
+        try:
+            checks = json.loads(body).get("checks", {})
+        except (ValueError, AttributeError):
+            pass
+        if checks.get("not_draining") is False:
+            # graceful leave (round 9 drain contract): the backend ASKED
+            # to go — its keyspace rebalances with bounded movement, and
+            # no breaker state accrues (it rejoins the moment a probe
+            # sees 200 after the restart)
+            m.breaker.record_success()
+            self._set_state(m, "draining", "backend_draining")
+            return
+        # not ready and not draining (warmup, dead pool, open breaker):
+        # a failure for ejection purposes — consecutive ones open it
+        m.breaker.record_failure()
+        if m.breaker.state == CircuitBreaker.OPEN:
+            self._set_state(m, "ejected", f"readyz_{status}")
+        elif m.in_ring:
+            self._set_state(m, "joining", f"readyz_{status}")
+
+    async def _probe_loop(self) -> None:
+        while True:
+            try:
+                await self.probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — prober must survive
+                slog.event(
+                    _log, "probe_loop_error", level=logging.ERROR,
+                    error=f"{type(e).__name__}: {e}",
+                )
+            await asyncio.sleep(self.probe_interval_s)
+
+    # -------------------------------------------------------------- routing
+
+    def _pick(self, key: str | None, tried: set[str]) -> BackendMember | None:
+        """The ring owner for a keyed request (failover walks clockwise
+        past ``tried``); round-robin over ring members otherwise."""
+        if key is not None:
+            if not tried:
+                # hot path: one bisect; the full owners() walk (scan
+                # until every distinct member is seen) is retry-only
+                name = self.ring.owner(key)
+                return None if name is None else self.members[name]
+            for name in self.ring.owners(key):
+                if name not in tried:
+                    return self.members[name]
+            return None
+        live = [m for m in self.members.values() if m.in_ring
+                and m.name not in tried]
+        if not live:
+            return None
+        self._rr += 1
+        return live[self._rr % len(live)]
+
+    def _peer_hint(self, key: str, owner: str) -> str | None:
+        """Previous ring owner for a key whose placement moved in the
+        last PEER_FILL_WINDOW_S — the ``x-peer-fill`` hint — and the
+        rebalanced-keys accounting (each moved key counted once per
+        topology)."""
+        if self._prev_ring is None:
+            return None
+        if self._clock() - self._prev_ring_at > PEER_FILL_WINDOW_S:
+            return None
+        prev = self._prev_ring.owner(key)
+        if prev is None or prev == owner:
+            return None
+        if key not in self._moved_seen:
+            self._moved_seen[key] = None
+            while len(self._moved_seen) > 4096:
+                self._moved_seen.popitem(last=False)
+            self.metrics.inc_counter("rebalanced_keys_total")
+        pm = self.members.get(prev)
+        if not self.peer_fill or pm is None or pm.state in ("ejected",):
+            # a crashed previous owner cannot serve a fill; a DRAINING
+            # one still can (its listener lives until the grace lapses)
+            return None
+        return pm.name
+
+    def _forward_headers(
+        self, req: Request, key: str | None, owner: str
+    ) -> dict[str, str]:
+        # x-peer-fill is router-authoritative: a client-supplied hint
+        # would point a trusting backend at an arbitrary host:port
+        fwd_headers = {
+            k: v for k, v in req.headers.items()
+            if k not in _HOP_HEADERS and k != "x-peer-fill"
+        }
+        # the router's id IS the fleet's id: honored inbound ids pass
+        # through untouched; minted ones (absent/insane inbound) are
+        # stamped here so the backend's flight recorder, the backend
+        # access line, the router access line and the client response
+        # all join on one key (satellite: cross-tier trace continuity)
+        fwd_headers["x-request-id"] = req.id
+        if key is not None:
+            hint = self._peer_hint(key, owner)
+            if hint is not None:
+                fwd_headers["x-peer-fill"] = hint
+        return fwd_headers
+
+    @staticmethod
+    def _forward_target(req: Request) -> str:
+        # req.path was percent-DECODED at parse (http.py); re-quote it
+        # so decoded CR/LF/space can't break the forwarded request line
+        target = urllib.parse.quote(req.path)
+        if req.query:
+            target += "?" + urllib.parse.urlencode(req.query)
+        return target
+
+    def _respond(
+        self,
+        req: Request,
+        m: BackendMember,
+        status: int,
+        headers: dict[str, str],
+        body: bytes,
+        t0: float,
+        stream: object | None = None,
+    ) -> Response:
+        """Per-forward bookkeeping + the response the client sees (the
+        success tail shared by the keyed, job-entity and fan-out paths).
+        For a stream the latency recorded is head latency — the body's
+        lifetime belongs to the job, not the router."""
+        m.requests_total += 1
+        dt = time.perf_counter() - t0
+        self.metrics.inc_labeled("requests_total", "backend", m.name)
+        self.metrics.observe_stage("forward", dt)
+        code = errors.code_from_body(body) if status >= 400 else None
+        self.metrics.observe_request(dt, code)
+        slog.event(
+            _log, "router_request",
+            level=logging.WARNING if status >= 500 else logging.INFO,
+            method=req.method, path=req.path, status=status,
+            backend=m.name, id=req.id,
+            ms=round(dt * 1e3, 1),
+            **({"stream": True} if stream is not None else {}),
+        )
+        resp_headers = {
+            k: v for k, v in headers.items() if k not in _HOP_HEADERS
+        }
+        resp_headers["x-backend"] = m.name
+        return Response(
+            status=status, body=body, headers=resp_headers, stream=stream
+        )
+
+    def _unavailable(self, req: Request, t0: float, last_err: str) -> Response:
+        # no backend reachable (empty ring, or every candidate
+        # infra-failed)
+        e = errors.BackendUnavailable(
+            "no backend available"
+            + (f" (last: {last_err})" if last_err else ""),
+            retry_after_s=self.cooldown_s,
+        )
+        dt = time.perf_counter() - t0
+        self.metrics.observe_request(dt, e.code)
+        slog.event(
+            _log, "router_request", level=logging.ERROR,
+            method=req.method, path=req.path, status=e.status,
+            backend=None, id=req.id, ms=round(dt * 1e3, 1),
+            error=e.code,
+        )
+        resp = Response.json(errors.to_payload(e, req.id), e.status)
+        retry = errors.retry_after_value(e.retry_after_s)
+        if retry is not None:
+            resp.headers["retry-after"] = retry
+        return resp
+
+    def _learn_job_owner(self, job_id: str, backend: str) -> None:
+        self._job_owners.pop(job_id, None)
+        self._job_owners[job_id] = backend
+        while len(self._job_owners) > _JOB_OWNERS_MAX:
+            self._job_owners.popitem(last=False)
+
+    async def _proxy(self, req: Request) -> Response:
+        t0 = time.perf_counter()
+        if req.path.startswith("/v1/internal/"):
+            # the peer-fill surface is backend-to-backend on the trusted
+            # mesh: unauthenticated and QoS-unmetered BY DESIGN, which
+            # is exactly why the router must not re-export it to
+            # clients.  Same shape as a route that does not exist.
+            return Response.json(
+                {"error": f"no route for {req.path}"}, 404
+            )
+        if req.method in ("GET", "DELETE"):
+            if req.method == "GET" and req.path.rstrip("/") == "/v1/jobs":
+                return await self._proxy_jobs_collection(req, t0)
+            jm = _JOBS_ENTITY_RE.match(req.path)
+            if jm is not None:
+                return await self._proxy_job(req, jm.group(1), t0)
+        key = None
+        if req.method == "POST" and req.body:
+            # the SAME canonicalization as the backend cache key
+            # (serving/cache.py): field order / multipart boundaries /
+            # encoding choice collapse, so every spelling of one logical
+            # request lands on one backend.  The prefix differs from the
+            # backend's (the router knows no model config) — irrelevant
+            # for affinity, which only needs determinism per body.
+            key = canonical_digest(
+                f"fleet|{req.path}",
+                req.headers.get("content-type", ""),
+                req.body,
+                req=req,
+            )
+        tried: set[str] = set()
+        last_err = ""
+        target = self._forward_target(req)
+        # infra failures replay once on the next distinct ring owner —
+        # safe for compute routes (pure functions of the request) but
+        # NOT for job submits: the idempotency index is per-backend, so
+        # a torn 202 replayed elsewhere would silently double-submit a
+        # durable job.  One attempt, honest 502, client decides.
+        attempts = (
+            1 if req.method == "POST" and req.path == "/v1/jobs" else 2
+        )
+        for _attempt in range(attempts):
+            m = self._pick(key, tried)
+            if m is None:
+                break
+            try:
+                status, headers, body = await raw_request(
+                    m.host, m.port, req.method, target,
+                    self._forward_headers(req, key, m.name),
+                    req.body, self.forward_timeout_s,
+                )
+            except _BackendError as e:
+                last_err = str(e)
+                self._note_forward_result(m, ok=False)
+                tried.add(m.name)
+                slog.event(
+                    _log, "forward_failed", level=logging.WARNING,
+                    backend=m.name, id=req.id, error=last_err,
+                )
+                continue
+            # 500/502 = the backend (or ITS downstream) crashing — a
+            # passive-ejection signal like a timeout.  503/504 are
+            # designed backpressure (sheds, breakers, deadlines): they
+            # pass through with their Retry-After and never eject.
+            self._note_forward_result(m, ok=status not in (500, 502))
+            if (
+                status == 202
+                and req.method == "POST"
+                and req.path == "/v1/jobs"
+            ):
+                # pin the new job to its backend so entity polls follow
+                # it instead of the ring (jobs are per-backend state)
+                jid = headers.get("location", "").rsplit("/", 1)[-1]
+                if jid:
+                    self._learn_job_owner(jid, m.name)
+            return self._respond(req, m, status, headers, body, t0)
+        return self._unavailable(req, t0, last_err)
+
+    async def _proxy_job(
+        self, req: Request, job_id: str, t0: float
+    ) -> Response:
+        """GET/DELETE ``/v1/jobs/{id}[/...]`` — follow the JOB, not the
+        ring.  The owner pinned at submit time goes first; after a
+        router restart (or an evicted pin) the walk degrades to asking
+        every live member, reading a 404 ``job_not_found`` as "not here,
+        next".  ``/events`` forwards PROGRESSIVELY: only the response
+        head is bounded by the forward timeout, then the SSE body rides
+        an open pipe for the job's lifetime — buffering it to EOF would
+        break the round-11 streaming contract, and a long job's timeout
+        would feed the ejection breaker and evict a healthy backend."""
+        sticky = self._job_owners.get(job_id)
+        sm = self.members.get(sticky) if sticky is not None else None
+        cands: list[BackendMember] = []
+        if sm is not None and sm.state in ("healthy", "draining"):
+            # a DRAINING owner still answers (its listener lives out the
+            # grace window) and is the only holder of its jobs' state
+            cands.append(sm)
+        cands += [
+            m
+            for m in self.members.values()
+            # draining members are asked too: after a router restart (or
+            # an evicted pin) the walk is the only way back to a job held
+            # by a backend mid-rolling-restart
+            if (m.in_ring or m.state == "draining") and m is not sm
+        ]
+        is_stream = req.method == "GET" and req.path.endswith("/events")
+        target = self._forward_target(req)
+        miss: tuple | None = None
+        no_route: tuple | None = None
+        last_err = ""
+        for m in cands:
+            fwd_headers = self._forward_headers(req, None, m.name)
+            stream = None
+            # the pinned owner gets the full forward timeout (a /result
+            # body may be large); blind-walk candidates get a short
+            # bound, else one wedged member stalls an unknown-id poll
+            # for forward_timeout_s (330s default) PER candidate
+            timeout = (
+                self.forward_timeout_s if m is sm else self.walk_timeout_s
+            )
+            try:
+                if is_stream:
+                    status, headers, stream = await raw_request_stream(
+                        m.host, m.port, req.method, target, fwd_headers,
+                        req.body, timeout,
+                    )
+                    body = b""
+                    if status != 200:
+                        # an error head is a small buffered payload:
+                        # drain it (bounded — a backend that sends the
+                        # head then stalls must read as an infra
+                        # failure, not hang the walk) so the miss-walk
+                        # below can read the machine code
+                        try:
+                            body = await asyncio.wait_for(
+                                _read_all(stream), timeout
+                            )
+                        except (asyncio.TimeoutError, TimeoutError) as te:
+                            await stream.aclose()
+                            raise _BackendError(
+                                f"{m.name}: stalled error body"
+                            ) from te
+                        stream = None
+                else:
+                    status, headers, body = await raw_request(
+                        m.host, m.port, req.method, target, fwd_headers,
+                        req.body, timeout,
+                    )
+            except _BackendError as e:
+                last_err = str(e)
+                self._note_forward_result(m, ok=False)
+                slog.event(
+                    _log, "forward_failed", level=logging.WARNING,
+                    backend=m.name, id=req.id, error=last_err,
+                )
+                continue
+            self._note_forward_result(m, ok=status not in (500, 502))
+            if status == 404:
+                # neither 404 form is an authoritative answer about the
+                # job: job_not_found is "not MY job, next", and a
+                # jobs-disabled member (no jobs_dir -> the route is
+                # never registered) answers a generic no-route 404 that
+                # says nothing about a job living elsewhere.  Keep
+                # walking either way — and never pin the id to a member
+                # that just said it does not have it.
+                if errors.code_from_body(body) == "job_not_found":
+                    miss = (m, status, headers, body)
+                else:
+                    no_route = (m, status, headers, body)
+                continue  # (an is_stream 404 was already drained above)
+            if status < 500:
+                self._learn_job_owner(job_id, m.name)
+            return self._respond(
+                req, m, status, headers, body, t0, stream=stream
+            )
+        # members not askable right now (ejected, or still joining) may
+        # be this durable job's only holder — their jobs survive on disk
+        # and resume after the backend rejoins, so their absence makes a
+        # fleet-wide 404 just as inconclusive as an in-walk infra failure
+        unreachable = [
+            m.name
+            for m in self.members.values()
+            if not (m.in_ring or m.state == "draining")
+        ]
+        if not last_err and not unreachable:
+            # EVERY member was asked, answered, and disowned the id: an
+            # honest 404 beats a 502 — the job is gone (or jobs are
+            # disabled fleet-wide), not the fleet.  But if any member
+            # infra-failed or was unreachable, the one backend that
+            # holds this durable job may be the one that never answered:
+            # a 404 then would tell the client a live job does not exist
+            # (inviting a duplicate re-submit), so report retryable
+            # unavailability instead.
+            final = miss if miss is not None else no_route
+            if final is not None:
+                m, status, headers, body = final
+                return self._respond(req, m, status, headers, body, t0)
+        return self._unavailable(
+            req, t0,
+            last_err or f"unreachable members: {', '.join(unreachable)}",
+        )
+
+    async def _proxy_jobs_collection(
+        self, req: Request, t0: float
+    ) -> Response:
+        """GET ``/v1/jobs`` — scatter-gather over every in-ring member:
+        jobs are per-backend state, so a single-backend view through the
+        router is a lie by sampling.  Jobs concatenate (each stamped
+        with its ``backend``, created-order preserved), counts and queue
+        depth sum; a member that fails to answer sets ``partial`` rather
+        than failing the whole view.  DRAINING members are asked too —
+        they are out of the ring but their listener lives out the grace
+        window and they are the only holders of their jobs' state, so
+        skipping them during a rolling restart would make those jobs
+        vanish from the fleet view with ``partial: false``."""
+        members = [
+            m
+            for m in self.members.values()
+            if m.in_ring or m.state == "draining"
+        ]
+        if not members:
+            return self._unavailable(req, t0, "")
+        target = self._forward_target(req)
+
+        async def one(m: BackendMember):
+            try:
+                # walk bound, not the forward timeout: the gather below
+                # barriers on the slowest member, so one wedged listing
+                # must cost seconds, not stall every fleet view for
+                # minutes (no member is "pinned" for a listing)
+                return m, await raw_request(
+                    m.host, m.port, "GET", target,
+                    self._forward_headers(req, None, m.name), b"",
+                    self.walk_timeout_s,
+                )
+            except _BackendError as e:
+                return m, e
+
+        jobs: list = []
+        counts: dict[str, int] = {}
+        queue_depth = 0
+        partial = False
+        for m, got in await asyncio.gather(*(one(m) for m in members)):
+            if isinstance(got, _BackendError):
+                self._note_forward_result(m, ok=False)
+                partial = True
+                continue
+            status, _headers, body = got
+            self._note_forward_result(m, ok=status not in (500, 502))
+            doc = None
+            if status == 200:
+                try:
+                    doc = json.loads(body)
+                except ValueError:
+                    doc = None
+            if not isinstance(doc, dict):
+                # a 404 here means jobs are disabled on that backend
+                # (no jobs_dir) — still a partial fleet view
+                partial = True
+                continue
+            m.requests_total += 1
+            # keep the Prometheus family in lockstep with the
+            # /v1/config per-member counter (as _respond does)
+            self.metrics.inc_labeled("requests_total", "backend", m.name)
+            for j in doc.get("jobs", ()):
+                # a malformed element from one member must not 500 the
+                # whole view (the sort below assumes dicts)
+                if isinstance(j, dict):
+                    j.setdefault("backend", m.name)
+                    jobs.append(j)
+                else:
+                    partial = True
+            for k, v in (doc.get("counts") or {}).items():
+                if isinstance(v, int):
+                    counts[k] = counts.get(k, 0) + v
+            qd = doc.get("queue_depth")
+            if isinstance(qd, int):
+                queue_depth += qd
+
+        def _created(j: dict) -> float:
+            try:
+                return float(j.get("created_ts") or 0)
+            except (TypeError, ValueError):
+                return 0.0
+
+        jobs.sort(key=_created)
+        dt = time.perf_counter() - t0
+        self.metrics.observe_stage("forward", dt)
+        self.metrics.observe_request(dt)
+        slog.event(
+            _log, "router_request", method=req.method, path=req.path,
+            status=200, backend="*", id=req.id, ms=round(dt * 1e3, 1),
+            fanout=len(members),
+        )
+        resp = Response.json(
+            {
+                "jobs": jobs,
+                "counts": counts,
+                "queue_depth": queue_depth,
+                "partial": partial,
+                "backends": len(members),
+            }
+        )
+        resp.headers["x-backend"] = "*"
+        return resp
+
+    # -------------------------------------------------------- own surfaces
+
+    async def _healthz(self, _req: Request) -> Response:
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.sleep(0)
+        return Response.json(
+            {
+                "status": "ok",
+                "router": True,
+                "event_loop_lag_ms": round((loop.time() - t0) * 1e3, 3),
+            }
+        )
+
+    async def _readyz(self, _req: Request) -> Response:
+        by_state: dict[str, int] = {}
+        for m in self.members.values():
+            by_state[m.state] = by_state.get(m.state, 0) + 1
+        in_ring = by_state.get("healthy", 0)
+        checks = {
+            # the router is USEFUL while any backend accepts; a
+            # zero-member ring is the one condition an LB must route
+            # around
+            "backends_in_ring": in_ring > 0,
+            "not_draining": not self.draining,
+        }
+        ok = all(checks.values())
+        return Response.json(
+            {
+                "ready": ok,
+                "checks": checks,
+                "backends": {"total": len(self.members), **by_state},
+            },
+            status=200 if ok else 503,
+        )
+
+    async def _config(self, _req: Request) -> Response:
+        """GET /v1/config — the live ring snapshot: members, per-backend
+        state/vnode count/served totals, probe/eject policy.  The
+        operator's "who owns what and who is out" surface."""
+        return Response.json(
+            {
+                "router": True,
+                "vnodes": self.vnodes,
+                "probe_interval_s": self.probe_interval_s,
+                "probe_timeout_s": self.probe_timeout_s,
+                "eject_threshold": self.eject_threshold,
+                "cooldown_s": self.cooldown_s,
+                "peer_fill": self.peer_fill,
+                "forward_timeout_s": self.forward_timeout_s,
+                "ring_points": len(self.ring),
+                "rebalanced_keys_total": self.metrics.counter(
+                    "rebalanced_keys_total"
+                ),
+                "draining": self.draining,
+                "members": {
+                    m.name: {
+                        "state": m.state,
+                        "in_ring": m.in_ring,
+                        "vnodes": self.vnodes if m.in_ring else 0,
+                        "requests_total": m.requests_total,
+                        "breaker": m.breaker.state_name,
+                    }
+                    for m in self.members.values()
+                },
+                "bound_host": self.bound[0] if self.bound else None,
+                "bound_port": self.bound[1] if self.bound else None,
+            }
+        )
+
+    async def _metrics_route(self, _req: Request) -> Response:
+        return Response.text(
+            self.metrics.prometheus(),
+            content_type="text/plain; version=0.0.4",
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, host: str = "0.0.0.0", port: int = 8100) -> int:
+        bound = await self.server.start(host, port)
+        self.bound = (host, bound)
+        # one immediate sweep so a fully-healthy fleet serves from the
+        # first request instead of waiting out a probe interval
+        await self.probe_once()
+        self._probe_task = asyncio.create_task(self._probe_loop())
+        return bound
+
+    def begin_drain(self) -> None:
+        self.draining = True
+        self.server.draining = True
+
+    async def stop(self, grace_s: float = 5.0) -> None:
+        self.begin_drain()
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except asyncio.CancelledError:
+                pass
+            self._probe_task = None
+        await self.server.stop(grace_s)
+
+
+async def _serve_forever(router: FleetRouter, host: str, port: int) -> None:
+    import signal
+
+    bound = await router.start(host, port)
+    slog.configure()
+    slog.event(
+        _log, "router_start", host=host, port=bound,
+        backends=sorted(router.members),
+    )
+    print(
+        f"deconv fleet router on {host}:{bound} over "
+        f"{len(router.members)} backends",
+        flush=True,
+    )
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop_ev.set)
+        except NotImplementedError:  # pragma: no cover — non-unix hosts
+            pass
+    await stop_ev.wait()
+    slog.event(_log, "router_shutdown")
+    await router.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``deconv-api-tpu fleet-router`` — the router-tier entrypoint."""
+    import argparse
+
+    p = argparse.ArgumentParser(description="deconv fleet router")
+    p.add_argument(
+        "--backends", required=True,
+        help="comma-separated host:port backend list",
+    )
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument(
+        "--vnodes", type=int, default=64,
+        help="virtual nodes per backend (movement granularity; default 64)",
+    )
+    p.add_argument(
+        "--probe-interval-s", type=float, default=2.0,
+        help="seconds between /readyz health sweeps",
+    )
+    p.add_argument(
+        "--probe-timeout-s", type=float, default=2.0,
+        help="per-probe timeout",
+    )
+    p.add_argument(
+        "--eject-threshold", type=int, default=3,
+        help="consecutive probe/forward failures before ejection",
+    )
+    p.add_argument(
+        "--cooldown-s", type=float, default=5.0,
+        help="seconds an ejected backend cools before its half-open probe",
+    )
+    p.add_argument(
+        "--forward-timeout-s", type=float, default=330.0,
+        help="per-forward client timeout (cover the slowest route's "
+        "server-side timeout; dreams default 300s)",
+    )
+    p.add_argument(
+        "--no-peer-fill", action="store_true",
+        help="never attach x-peer-fill hints on rebalanced keys",
+    )
+    args = p.parse_args(argv)
+    backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    router = FleetRouter(
+        backends,
+        vnodes=args.vnodes,
+        probe_interval_s=args.probe_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        eject_threshold=args.eject_threshold,
+        cooldown_s=args.cooldown_s,
+        peer_fill=not args.no_peer_fill,
+        forward_timeout_s=args.forward_timeout_s,
+    )
+    asyncio.run(_serve_forever(router, args.host, args.port))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -48,6 +48,7 @@ _STATUS_TEXT = {
     413: "Payload Too Large", 422: "Unprocessable Entity",
     429: "Too Many Requests",
     500: "Internal Server Error", 501: "Not Implemented",
+    502: "Bad Gateway",
     503: "Service Unavailable", 504: "Gateway Timeout",
 }
 

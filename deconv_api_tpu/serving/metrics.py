@@ -59,8 +59,15 @@ class _Reservoir:
 
 
 class Metrics:
-    def __init__(self, prefix: str = "deconv"):
+    def __init__(self, prefix: str = "deconv", *, core: bool = True):
+        # core=False (round 14, the fleet router): the registry carries
+        # only the generic counter/gauge/labeled/stage families — the
+        # fixed request/batch pipeline families are a batching SERVER's
+        # shape, and rendering them at zero from a router would be noise
+        # (and would collide with a labeled `requests_total{backend=}`
+        # family under the same prefix: two TYPE lines, lint failure).
         self._prefix = prefix
+        self._core = core
         self._lock = threading.Lock()
         self._started = time.time()
         self.requests_total = 0
@@ -273,7 +280,7 @@ class Metrics:
     def prometheus(self) -> str:
         p = self._prefix
         s = self.snapshot(_join_labeled=False)
-        lines = [
+        lines = [] if not self._core else [
             f"# TYPE {p}_requests_total counter",
             f"{p}_requests_total {s['requests_total']}",
             f"# TYPE {p}_images_total counter",

@@ -1,0 +1,1461 @@
+"""Fleet tier tests (round 14, serving/fleet.py): hash-ring properties,
+health-gated membership lifecycle, and end-to-end routing over real
+backend services — byte parity, request-id continuity, peer cache fill."""
+
+import asyncio
+import base64
+import json
+import threading
+import time
+
+import httpx
+import numpy as np
+import pytest
+
+import jax
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.models.spec import init_params
+from deconv_api_tpu.serving import fleet
+from deconv_api_tpu.serving.app import DeconvService
+from deconv_api_tpu.serving.fleet import (
+    BackendMember,
+    FleetRouter,
+    HashRing,
+)
+from deconv_api_tpu.serving.http import Request
+from deconv_api_tpu.serving.trace import RID_RE
+from tests.test_engine_parity import TINY
+
+
+# ------------------------------------------------------------------- ring
+
+
+def _keys(n: int) -> list[str]:
+    import random
+
+    return [f"{random.Random(i).getrandbits(160):040x}" for i in range(n)]
+
+
+def test_ring_deterministic_and_order_independent():
+    members = ["h0:8000", "h1:8001", "h2:8002", "h3:8003"]
+    a = HashRing(members, 64)
+    b = HashRing(list(reversed(members)), 64)
+    ks = _keys(512)
+    assert [a.owner(k) for k in ks] == [b.owner(k) for k in ks]
+    # stable across instances (pure function of names + key)
+    c = HashRing(members, 64)
+    assert [a.owner(k) for k in ks] == [c.owner(k) for k in ks]
+
+
+def test_ring_evenness_across_64_vnodes():
+    members = [f"h{i}:80{i:02d}" for i in range(4)]
+    ring = HashRing(members, 64)
+    ks = _keys(8000)
+    from collections import Counter
+
+    counts = Counter(ring.owner(k) for k in ks)
+    assert set(counts) == set(members)  # nobody starved
+    mean = len(ks) / len(members)
+    assert max(counts.values()) / mean <= 1.35
+    assert min(counts.values()) / mean >= 0.65
+
+
+def test_ring_bounded_movement_on_remove():
+    members = [f"h{i}:80{i:02d}" for i in range(4)]
+    full = HashRing(members, 64)
+    less = HashRing(members[:3], 64)
+    ks = _keys(6000)
+    moved_collateral = lost = 0
+    for k in ks:
+        was = full.owner(k)
+        now = less.owner(k)
+        if was == members[3]:
+            lost += 1
+        elif was != now:
+            moved_collateral += 1
+    # consistent hashing's defining property: ONLY the removed member's
+    # keys move; every other key keeps its owner
+    assert moved_collateral == 0
+    assert 0 < lost / len(ks) <= 1.5 / 4
+
+
+def test_ring_bounded_movement_on_add():
+    members = [f"h{i}:80{i:02d}" for i in range(4)]
+    ring = HashRing(members, 64)
+    grown = HashRing(members + ["h4:8004"], 64)
+    ks = _keys(6000)
+    remapped = sum(1 for k in ks if ring.owner(k) != grown.owner(k))
+    # ~1/(N+1) of keys move to the new member; vnodes bound the variance
+    assert 0.5 / 5 <= remapped / len(ks) <= 1.5 / 5
+    # everything that moved moved TO the new member
+    assert all(
+        grown.owner(k) == "h4:8004"
+        for k in ks
+        if ring.owner(k) != grown.owner(k)
+    )
+
+
+def test_ring_empty_and_owners_walk():
+    assert HashRing((), 64).owner("ab" * 20) is None
+    ring = HashRing(["a:1", "b:2", "c:3"], 32)
+    for k in _keys(64):
+        walk = ring.owners(k)
+        assert walk[0] == ring.owner(k)
+        assert sorted(walk) == ["a:1", "b:2", "c:3"]  # all distinct members
+
+
+def test_backend_member_name_validation():
+    for bad in ("nohost", "http://h:80", "h:0", "h:99999", "h:80/x", "h :80"):
+        with pytest.raises(ValueError):
+            BackendMember(bad)
+    m = BackendMember("node-3.rack_1:8080")
+    assert (m.host, m.port) == ("node-3.rack_1", 8080)
+
+
+# -------------------------------------------------- membership lifecycle
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _router(clock, **kw):
+    kw.setdefault("eject_threshold", 2)
+    kw.setdefault("cooldown_s", 5.0)
+    return FleetRouter(
+        ["b0:8000", "b1:8001"], clock=clock, **kw
+    )
+
+
+def _probe_script(monkeypatch, responses):
+    """monkeypatch fleet.raw_request with a per-backend response script:
+    responses[name] is a callable -> (status, headers, body) or raises."""
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        return responses[f"{host}:{port}"]()
+
+    monkeypatch.setattr(fleet, "raw_request", fake)
+
+
+def _ready_200():
+    return 200, {}, json.dumps({"ready": True}).encode()
+
+
+def _draining_503():
+    return 503, {}, json.dumps(
+        {"ready": False, "checks": {"not_draining": False, "warmed": True}}
+    ).encode()
+
+
+def _down():
+    raise fleet._BackendError("connection refused")
+
+
+def test_health_gate_admit_eject_and_half_open_readmit(monkeypatch):
+    clock = _FakeClock()
+    router = _router(clock)
+    script = {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    _probe_script(monkeypatch, script)
+
+    async def go():
+        await router.probe_once()
+        assert {m.name for m in router.members.values() if m.in_ring} == {
+            "b0:8000", "b1:8001",
+        }
+        # b1 starts failing: first failure keeps it in the ring (a blip
+        # is not death), the threshold'th ejects it
+        script["b1:8001"] = _down
+        await router.probe_once()
+        assert router.members["b1:8001"].in_ring
+        await router.probe_once()
+        m = router.members["b1:8001"]
+        assert m.state == "ejected" and not m.in_ring
+        assert router.ring.members == ("b0:8000",)
+        # cooling: probes are skipped entirely (no half-open claim yet)
+        script["b1:8001"] = _ready_200
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "ejected"
+        # cooldown elapses -> exactly one half-open probe -> re-admit
+        clock.t += 5.1
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "healthy"
+        assert router.ring.members == ("b0:8000", "b1:8001")
+
+    asyncio.run(go())
+
+
+def test_health_gate_failed_half_open_probe_reopens(monkeypatch):
+    clock = _FakeClock()
+    router = _router(clock)
+    script = {"b0:8000": _ready_200, "b1:8001": _down}
+    _probe_script(monkeypatch, script)
+
+    async def go():
+        await router.probe_once()
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "ejected"
+        clock.t += 5.1  # half-open window opens...
+        await router.probe_once()  # ...probe runs, still down: reopen
+        assert router.members["b1:8001"].state == "ejected"
+        # a fresh cooldown is required before the next probe
+        clock.t += 2.0
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "ejected"
+        script["b1:8001"] = _ready_200
+        clock.t += 3.2
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "healthy"
+
+    asyncio.run(go())
+
+
+def test_health_gate_drain_leaves_gracefully(monkeypatch):
+    clock = _FakeClock()
+    router = _router(clock)
+    script = {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    _probe_script(monkeypatch, script)
+
+    async def go():
+        await router.probe_once()
+        script["b1:8001"] = _draining_503
+        await router.probe_once()
+        m = router.members["b1:8001"]
+        # graceful: out of the ring IMMEDIATELY (no threshold wait), no
+        # breaker state accrued
+        assert m.state == "draining" and not m.in_ring
+        assert m.breaker.state_name == "closed"
+        assert router.ring.members == ("b0:8000",)
+        # the restarted backend rejoins on its first healthy probe
+        script["b1:8001"] = _ready_200
+        await router.probe_once()
+        assert m.state == "healthy" and m.in_ring
+
+    asyncio.run(go())
+
+
+def test_passive_forward_failures_eject(monkeypatch):
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+
+    async def go():
+        await router.probe_once()
+        m = router.members["b1:8001"]
+        router._note_forward_result(m, ok=False)
+        assert m.in_ring  # one blip
+        router._note_forward_result(m, ok=False)
+        assert m.state == "ejected" and router.ring.members == ("b0:8000",)
+        # a success resets the streak for healthy members
+        b0 = router.members["b0:8000"]
+        router._note_forward_result(b0, ok=False)
+        router._note_forward_result(b0, ok=True)
+        router._note_forward_result(b0, ok=False)
+        assert b0.in_ring
+
+    asyncio.run(go())
+
+
+def test_rebalance_accounting_and_peer_hint(monkeypatch):
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+
+    async def go():
+        await router.probe_once()
+        # boot churn is NOT a rebalance: the staggered admission sweep
+        # must leave no previous-ring window (nothing has served yet,
+        # so there is nothing to fill from and nothing "moved")
+        assert router._prev_ring is None
+        # mark the ring as serving (rebalance accounting only engages
+        # once there is traffic whose cache residency could move)
+        router.members["b0:8000"].requests_total += 1
+        ks = _keys(400)
+        owner = {k: router.ring.owner(k) for k in ks}
+        # eject b1: its keys move to b0 and carry NO hint (a crashed
+        # peer cannot serve a fill) — but each moved key still counts
+        # once toward router_rebalanced_keys_total
+        m = router.members["b1:8001"]
+        router._note_forward_result(m, ok=False)
+        router._note_forward_result(m, ok=False)
+        moved = [k for k in ks if owner[k] == "b1:8001"]
+        for k in moved:
+            assert router._peer_hint(k, "b0:8000") is None
+        assert router.metrics.counter("rebalanced_keys_total") == len(moved)
+        # same keys again: counted once, not twice
+        for k in moved:
+            router._peer_hint(k, "b0:8000")
+        assert router.metrics.counter("rebalanced_keys_total") == len(moved)
+        # a DRAINING previous owner CAN serve fills: re-admit, then drain
+        router._note_forward_result(m, ok=True)
+        m.state = "healthy"
+        router._rebuild_ring("test_readmit")
+        router._set_state(m, "draining", "test_drain")
+        hinted = [
+            k for k in ks
+            if router.ring.owner(k) is not None
+            and router._peer_hint(k, router.ring.owner(k)) == "b1:8001"
+        ]
+        assert hinted  # every key b1 owned now hints at it
+        # hints expire with the window
+        clock.t += fleet.PEER_FILL_WINDOW_S + 1
+        assert all(
+            router._peer_hint(k, "b0:8000") is None for k in hinted
+        )
+
+    asyncio.run(go())
+
+
+def test_proxy_strips_client_supplied_peer_fill_hint(monkeypatch):
+    # x-peer-fill is router-authoritative: a client-forged hint would
+    # point a trusting backend's peer-fill fetch at an arbitrary
+    # host:port (cache poisoning / SSRF on a trusted mesh)
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    seen = {}
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        seen.update(headers)
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        req = Request(
+            method="POST", path="/v1/deconv", query={},
+            headers={"x-peer-fill": "evil.host:80", "x-tenant": "t1"},
+            body=b"layer=block5_conv1", id="rid-peer-forge",
+        )
+        resp = await router._proxy(req)
+        assert resp.status == 200
+        assert "x-peer-fill" not in seen
+        assert seen["x-tenant"] == "t1"  # legit headers still pass
+
+    asyncio.run(go())
+
+
+def test_proxy_requotes_decoded_path_in_forwarded_request_line(monkeypatch):
+    # http.py percent-decodes the path at parse; the forward must
+    # re-quote it or a %0d%0a path injects headers into the backend hop
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    seen = {}
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        seen["target"] = target
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        req = Request(
+            method="GET", path="/\r\nx-api-key: admin\r\n", query={},
+            headers={}, body=b"", id="rid-crlf",
+        )
+        await router._proxy(req)
+        assert "\r" not in seen["target"] and "\n" not in seen["target"]
+        assert " " not in seen["target"]
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------------- e2e
+
+
+class FleetFixture:
+    """N real backend services + one router, all on a background loop."""
+
+    def __init__(self, n_backends=2, cfg=None, router_kw=None):
+        self.cfg = cfg or ServerConfig(
+            image_size=16,
+            max_batch=4,
+            batch_window_ms=1.0,
+            compilation_cache_dir="",
+            fleet_peer_fill=True,
+        )
+        self.n_backends = n_backends
+        self.router_kw = dict(
+            probe_interval_s=0.2, probe_timeout_s=2.0,
+            eject_threshold=2, cooldown_s=1.0,
+        )
+        self.router_kw.update(router_kw or {})
+        self.services: list[DeconvService] = []
+        self.ports: list[int] = []
+        self.router: FleetRouter | None = None
+        self.router_port: int | None = None
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._started = threading.Event()
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            params = init_params(TINY, jax.random.PRNGKey(3))
+            for _ in range(self.n_backends):
+                svc = DeconvService(self.cfg, spec=TINY, params=params)
+                port = await svc.start("127.0.0.1", 0)
+                svc.ready = True
+                self.services.append(svc)
+                self.ports.append(port)
+            self.router = FleetRouter(
+                [f"127.0.0.1:{p}" for p in self.ports], **self.router_kw
+            )
+            self.router_port = await self.router.start("127.0.0.1", 0)
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        self._loop.run_forever()
+
+    def __enter__(self):
+        self._thread.start()
+        assert self._started.wait(30)
+        return self
+
+    def __exit__(self, *exc):
+        async def shutdown():
+            await self.router.stop()
+            for svc in self.services:
+                if not svc.draining:
+                    await svc.stop()
+
+        fut = asyncio.run_coroutine_threadsafe(shutdown(), self._loop)
+        fut.result(20)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(10)
+
+    def on_loop(self, coro, timeout=20):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
+            timeout
+        )
+
+    @property
+    def router_url(self):
+        return f"http://127.0.0.1:{self.router_port}"
+
+    def backend_url(self, i):
+        return f"http://127.0.0.1:{self.ports[i]}"
+
+
+@pytest.fixture(scope="module")
+def fleet2():
+    with FleetFixture(n_backends=2) as f:
+        yield f
+
+
+def _data_url(rng_seed=0, size=16):
+    import cv2
+
+    rng = np.random.default_rng(rng_seed)
+    img = (rng.random((size, size, 3)) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".png", img)
+    assert ok
+    return "data:image/png;base64," + base64.b64encode(buf.tobytes()).decode()
+
+
+def test_e2e_byte_parity_and_request_id_end_to_end(fleet2):
+    form = {"file": _data_url(11), "layer": "b2c1"}
+    r1 = httpx.post(
+        fleet2.router_url + "/", data=form,
+        headers={"x-request-id": "fleet-parity-1"}, timeout=60,
+    )
+    assert r1.status_code == 200, r1.text
+    backend = r1.headers["x-backend"]
+    assert backend in {f"127.0.0.1:{p}" for p in fleet2.ports}
+    # the inbound id survives router -> backend -> response untouched
+    assert r1.headers["x-request-id"] == "fleet-parity-1"
+    # byte parity: the same request DIRECT to the chosen backend
+    direct = httpx.post(f"http://{backend}/", data=form, timeout=60)
+    assert direct.status_code == 200
+    assert direct.content == r1.content
+
+
+def test_e2e_affinity_makes_one_logical_cache(fleet2):
+    form = {"file": _data_url(12), "layer": "b2c1"}
+    r1 = httpx.post(fleet2.router_url + "/", data=form, timeout=60)
+    r2 = httpx.post(fleet2.router_url + "/", data=form, timeout=60)
+    assert r1.status_code == r2.status_code == 200
+    # identical requests land on the SAME backend and the second is a
+    # cache hit there — the fleet-wide one-logical-cache contract
+    assert r1.headers["x-backend"] == r2.headers["x-backend"]
+    assert r2.headers["x-cache"] == "hit"
+    assert r2.content == r1.content
+
+
+def test_e2e_minted_request_id_matches_grammar(fleet2):
+    r = httpx.post(
+        fleet2.router_url + "/",
+        data={"file": _data_url(13), "layer": "b2c1"},
+        timeout=60,
+    )
+    assert r.status_code == 200
+    assert RID_RE.match(r.headers["x-request-id"])
+
+
+def test_e2e_cross_tier_trace_continuity(fleet2):
+    """The satellite pin: a request's id joins the ROUTER's forward with
+    the BACKEND's flight-recorder trace — `/v1/debug/requests?id=` on
+    the stamped backend returns the request's span timeline."""
+    rid = "fleet-trace-join-1"
+    r = httpx.post(
+        fleet2.router_url + "/",
+        data={"file": _data_url(14), "layer": "b2c1"},
+        headers={"x-request-id": rid}, timeout=60,
+    )
+    assert r.status_code == 200
+    backend = r.headers["x-backend"]
+    dbg = httpx.get(
+        f"http://{backend}/v1/debug/requests", params={"id": rid}, timeout=30
+    )
+    assert dbg.status_code == 200
+    traces = dbg.json()["requests"]
+    assert len(traces) == 1 and traces[0]["id"] == rid
+    assert traces[0]["status"] == 200
+    assert any(s["name"] == "queue_wait" for s in traces[0]["spans"])
+
+
+def test_e2e_cache_control_passthrough(fleet2):
+    form = {"file": _data_url(15), "layer": "b2c1"}
+    httpx.post(fleet2.router_url + "/", data=form, timeout=60)
+    r = httpx.post(
+        fleet2.router_url + "/", data=form,
+        headers={"cache-control": "no-cache"}, timeout=60,
+    )
+    assert r.status_code == 200
+    # the bypass header crossed the router: the backend recomputed
+    assert r.headers["x-cache"] == "bypass"
+
+
+def test_e2e_deadline_header_passthrough(fleet2):
+    r = httpx.post(
+        fleet2.router_url + "/",
+        data={"file": _data_url(16), "layer": "b2c1"},
+        headers={"x-deadline-ms": "1"}, timeout=60,
+    )
+    # the 1 ms budget lapses inside the backend pipeline: its 504
+    # deadline_expired crosses back through the router unchanged
+    assert r.status_code == 504, r.text
+    assert r.json()["error"] == "deadline_expired"
+    assert "x-backend" in r.headers
+
+
+def test_e2e_peer_cache_fill(fleet2):
+    """Warm backend A with a key, then hand backend B the same request
+    with an x-peer-fill hint at A: B must serve A's bytes (x-cache:
+    peer-fill), store them, and serve its OWN hit next time."""
+    form = {"file": _data_url(17), "layer": "b2c1"}
+    a, b = fleet2.ports[0], fleet2.ports[1]
+    warm = httpx.post(f"http://127.0.0.1:{a}/", data=form, timeout=60)
+    assert warm.status_code == 200
+    filled = httpx.post(
+        f"http://127.0.0.1:{b}/", data=form,
+        headers={"x-peer-fill": f"127.0.0.1:{a}"}, timeout=60,
+    )
+    assert filled.status_code == 200
+    assert filled.headers["x-cache"] == "peer-fill"
+    assert filled.content == warm.content
+    again = httpx.post(f"http://127.0.0.1:{b}/", data=form, timeout=60)
+    assert again.headers["x-cache"] == "hit"
+    assert again.content == warm.content
+    assert fleet2.services[1].metrics.counter("cache_peer_fills_total") >= 1
+
+
+def test_e2e_internal_cache_route(fleet2):
+    # a digest nobody computed: 404 cache_miss, never negative-cached
+    r = httpx.get(
+        fleet2.backend_url(0) + "/v1/internal/cache/" + "ab" * 20,
+        timeout=30,
+    )
+    assert r.status_code == 404
+    assert r.json()["error"] == "cache_miss"
+    r = httpx.get(
+        fleet2.backend_url(0) + "/v1/internal/cache/NOT-A-DIGEST",
+        timeout=30,
+    )
+    assert r.status_code == 400
+
+
+def test_e2e_router_surfaces(fleet2):
+    ready = httpx.get(fleet2.router_url + "/readyz", timeout=30)
+    assert ready.status_code == 200
+    assert ready.json()["checks"]["backends_in_ring"] is True
+    cfg = httpx.get(fleet2.router_url + "/v1/config", timeout=30)
+    assert cfg.status_code == 200
+    snap = cfg.json()
+    assert snap["router"] is True and snap["vnodes"] == 64
+    assert len(snap["members"]) == 2
+    assert all(m["state"] == "healthy" for m in snap["members"].values())
+    # per-member vnode counts and ring size line up
+    assert snap["ring_points"] == 2 * 64
+    hz = httpx.get(fleet2.router_url + "/healthz", timeout=30)
+    assert hz.status_code == 200 and hz.json()["router"] is True
+
+
+def test_e2e_router_metrics_lint(fleet2):
+    from tests.test_metrics_exposition import lint_exposition
+
+    # traffic exists from the earlier tests in this module
+    text = httpx.get(fleet2.router_url + "/metrics", timeout=30).text
+    families, samples = lint_exposition(text)
+    assert families["router_requests_total"] == "counter"
+    assert families["router_backend_state"] == "gauge"
+    assert families["router_backends_in_ring"] == "gauge"
+    assert any(
+        name == "router_requests_total" and label.startswith("backend=")
+        for name, label in samples
+    )
+    # non-core registry: the batching server's fixed families are absent
+    assert "router_batches_total" not in families
+    assert "router_images_total" not in families
+
+
+def test_e2e_draining_backend_leaves_and_traffic_survives(fleet2):
+    """Flip one backend into drain (the rolling-restart recipe): the
+    router must move it out of the ring on the next probe and keep
+    serving every request from the survivor."""
+    victim = fleet2.services[1]
+    victim_name = f"127.0.0.1:{fleet2.ports[1]}"
+
+    fleet2.on_loop(_drain_and_probe(fleet2.router, victim))
+    assert not fleet2.router.members[victim_name].in_ring
+    assert fleet2.router.members[victim_name].state == "draining"
+    for seed in (30, 31, 32):
+        r = httpx.post(
+            fleet2.router_url + "/",
+            data={"file": _data_url(seed), "layer": "b2c1"},
+            timeout=60,
+        )
+        assert r.status_code == 200
+        assert r.headers["x-backend"] != victim_name
+    # drain over (simulated restart): it rejoins on the next probe
+    fleet2.on_loop(_undrain_and_probe(fleet2.router, victim))
+    assert fleet2.router.members[victim_name].in_ring
+
+
+async def _drain_and_probe(router, victim):
+    victim.begin_drain()
+    await router.probe_once()
+
+
+async def _undrain_and_probe(router, victim):
+    victim.draining = False
+    victim.server.draining = False
+    await router.probe_once()
+
+
+def test_empty_ring_502_backend_unavailable():
+    """A router whose backends never came up answers 502
+    backend_unavailable with a Retry-After — the router error taxonomy
+    contract (docs/API.md)."""
+
+    async def go():
+        router = FleetRouter(
+            ["127.0.0.1:1"], probe_interval_s=30.0, probe_timeout_s=0.2,
+            cooldown_s=3.0,
+        )
+        port = await router.server.start("127.0.0.1", 0)
+        try:
+            status, headers, body = await fleet.raw_request(
+                "127.0.0.1", port, "POST", "/v1/deconv",
+                {"content-type": "application/x-www-form-urlencoded"},
+                b"layer=x", 10.0,
+            )
+            payload = json.loads(body)
+            assert status == 502
+            assert payload["error"] == "backend_unavailable"
+            assert "request_id" in payload
+            assert int(headers["retry-after"]) >= 1
+        finally:
+            await router.server.stop(0.5)
+
+    asyncio.run(go())
+
+
+def test_backend_unavailable_error_shape():
+    e = errors.BackendUnavailable("gone", retry_after_s=2.5)
+    assert e.status == 502 and e.code == "backend_unavailable"
+    assert errors.retry_after_value(e.retry_after_s) == "3"
+
+
+# ---------------------------------------------------------- job affinity
+
+
+def test_job_affinity_sticky_and_fanout(monkeypatch):
+    """/v1/jobs/{id} entity traffic follows the JOB, not the ring: the
+    id is pinned to the backend whose 202 answered the submit, polls and
+    cancels go there (round-robin would alternate), and a forgotten pin
+    (router restart) degrades to the 404-walk that re-learns it."""
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    jid = "job-abc123def456"
+    owner: list[str] = []  # filled once the submit's 202 comes back
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        if method == "POST" and target == "/v1/jobs":
+            return (
+                202,
+                {"location": f"/v1/jobs/{jid}"},
+                json.dumps({"id": jid}).encode(),
+            )
+        if target.startswith("/v1/jobs/"):
+            if target.startswith(f"/v1/jobs/{jid}") and name == owner[0]:
+                return 200, {}, json.dumps(
+                    {"id": jid, "state": "running"}
+                ).encode()
+            return 404, {}, json.dumps({"error": "job_not_found"}).encode()
+        return 200, {}, b"{}"
+
+    def _req(method, path, i):
+        return Request(
+            method=method, path=path, query={}, headers={}, body=b"",
+            id=f"rid-job-{i}",
+        )
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        resp = await router._proxy(
+            Request(
+                method="POST", path="/v1/jobs", query={},
+                headers={"content-type": "application/json"},
+                body=b'{"kind": "dream"}', id="rid-job-submit",
+            )
+        )
+        assert resp.status == 202
+        owner.append(resp.headers["x-backend"])
+        assert router._job_owners[jid] == owner[0]
+        # every poll lands on the owner (round-robin would alternate)
+        for i in range(4):
+            r = await router._proxy(_req("GET", f"/v1/jobs/{jid}", i))
+            assert r.status == 200
+            assert r.headers["x-backend"] == owner[0]
+        # forgotten pin: the fan-out walk reads 404 job_not_found as
+        # "not here, next", finds the owner, re-learns the pin
+        router._job_owners.clear()
+        r = await router._proxy(_req("GET", f"/v1/jobs/{jid}", "f"))
+        assert r.status == 200 and r.headers["x-backend"] == owner[0]
+        assert router._job_owners[jid] == owner[0]
+        # DELETE follows the pin too
+        r = await router._proxy(_req("DELETE", f"/v1/jobs/{jid}", "d"))
+        assert r.status == 200 and r.headers["x-backend"] == owner[0]
+        # an id NO member owns: an honest 404 through, never a 502
+        r = await router._proxy(_req("GET", "/v1/jobs/job-000000000000", "n"))
+        assert r.status == 404
+        assert json.loads(r.body)["error"] == "job_not_found"
+
+    asyncio.run(go())
+
+
+def test_job_walk_infra_failure_is_502_not_404(monkeypatch):
+    """If ANY walk candidate infra-fails, a 404 from the others is not
+    conclusive — the silent member may be the one holding this durable
+    job.  The client must see retryable unavailability, never a
+    confident 404 that invites a duplicate re-submit."""
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        if f"{host}:{port}" == "b0:8000":
+            raise fleet._BackendError("b0:8000: ConnectionRefusedError")
+        return 404, {}, json.dumps({"error": "job_not_found"}).encode()
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        r = await router._proxy(
+            Request(
+                method="GET", path="/v1/jobs/job-aa11bb22cc33", query={},
+                headers={}, body=b"", id="rid-job-infra",
+            )
+        )
+        assert r.status == 502
+        assert json.loads(r.body)["error"] == "backend_unavailable"
+
+    asyncio.run(go())
+
+
+def test_job_walk_asks_draining_member(monkeypatch):
+    """A lost pin (router restart) during a rolling restart: the
+    draining backend is out of the ring but still the only holder of
+    its jobs' state — the fan-out walk must include it."""
+    clock = _FakeClock()
+    router = _router(clock)
+    script = {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    _probe_script(monkeypatch, script)
+    jid = "job-drainwalk01"
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        if f"{host}:{port}" == "b1:8001" and target == f"/v1/jobs/{jid}":
+            return 200, {}, json.dumps(
+                {"id": jid, "state": "running"}
+            ).encode()
+        return 404, {}, json.dumps({"error": "job_not_found"}).encode()
+
+    async def go():
+        await router.probe_once()
+        script["b1:8001"] = _draining_503
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "draining"
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        # no pin: the walk must reach the draining holder
+        r = await router._proxy(
+            Request(
+                method="GET", path=f"/v1/jobs/{jid}", query={},
+                headers={}, body=b"", id="rid-job-drainwalk",
+            )
+        )
+        assert r.status == 200
+        assert r.headers["x-backend"] == "b1:8001"
+
+    asyncio.run(go())
+
+
+def test_job_walk_jobs_disabled_member_does_not_mask_or_pin(monkeypatch):
+    """A jobs-disabled member (no jobs_dir -> generic no-route 404) is
+    not an authoritative answer: the walk must continue past it to the
+    real holder and must never pin the id to it.  When jobs are
+    disabled fleet-wide, the generic 404 passes through (not a 502)."""
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    jid = "job-nomask12345"
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        if f"{host}:{port}" == "b0:8000":
+            return 404, {}, json.dumps(
+                {"error": f"no route for /v1/jobs/{jid}"}
+            ).encode()
+        return 200, {}, json.dumps({"id": jid, "state": "running"}).encode()
+
+    async def fake_all_disabled(
+        host, port, method, target, headers, body, timeout_s
+    ):
+        return 404, {}, json.dumps(
+            {"error": f"no route for /v1/jobs/{jid}"}
+        ).encode()
+
+    def _req(i):
+        return Request(
+            method="GET", path=f"/v1/jobs/{jid}", query={}, headers={},
+            body=b"", id=f"rid-nomask-{i}",
+        )
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        r = await router._proxy(_req(1))
+        assert r.status == 200 and r.headers["x-backend"] == "b1:8001"
+        assert router._job_owners[jid] == "b1:8001"
+        # jobs disabled everywhere: honest 404 through, not a 502
+        router._job_owners.clear()
+        monkeypatch.setattr(fleet, "raw_request", fake_all_disabled)
+        r = await router._proxy(_req(2))
+        assert r.status == 404
+        assert "no route" in json.loads(r.body)["error"]
+        assert jid not in router._job_owners
+
+    asyncio.run(go())
+
+
+def test_job_walk_bounds_timeout_for_unpinned_candidates(monkeypatch):
+    """Blind-walk candidates get a short per-member bound (one wedged
+    member must not stall an unknown-id poll for forward_timeout_s per
+    hop); the pinned owner keeps the full forward timeout (its /result
+    body may be large)."""
+    clock = _FakeClock()
+    router = _router(clock, forward_timeout_s=330.0, probe_timeout_s=2.0)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    jid = "job-timeoutwalk1"
+    seen: dict[str, float] = {}
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        seen[f"{host}:{port}"] = timeout_s
+        if f"{host}:{port}" == "b1:8001":
+            return 200, {}, json.dumps({"id": jid, "state": "done"}).encode()
+        return 404, {}, json.dumps({"error": "job_not_found"}).encode()
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        r = await router._proxy(
+            Request(
+                method="GET", path=f"/v1/jobs/{jid}", query={},
+                headers={}, body=b"", id="rid-walk-to-1",
+            )
+        )
+        assert r.status == 200
+        # both hops were blind-walk candidates: short bound
+        assert all(t == 10.0 for t in seen.values()), seen
+        # now pinned: the owner gets the full forward timeout
+        seen.clear()
+        r = await router._proxy(
+            Request(
+                method="GET", path=f"/v1/jobs/{jid}", query={},
+                headers={}, body=b"", id="rid-walk-to-2",
+            )
+        )
+        assert r.status == 200 and seen == {"b1:8001": 330.0}
+
+    asyncio.run(go())
+
+
+def test_job_walk_ejected_holder_makes_404_inconclusive(monkeypatch):
+    """An ejected member may be the durable job's only holder (its jobs
+    survive on disk and resume after rejoin): while any member is
+    unreachable, a fleet-wide job_not_found is inconclusive and must
+    read as retryable 502, not a confident 404 — the pre-excluded
+    twin of the in-walk infra-failure rule."""
+    clock = _FakeClock()
+    router = _router(clock)
+    script = {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    _probe_script(monkeypatch, script)
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        return 404, {}, json.dumps({"error": "job_not_found"}).encode()
+
+    async def go():
+        await router.probe_once()
+        script["b1:8001"] = _down
+        await router.probe_once()
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "ejected"
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        r = await router._proxy(
+            Request(
+                method="GET", path="/v1/jobs/job-ejectedhold1", query={},
+                headers={}, body=b"", id="rid-job-ejected",
+            )
+        )
+        assert r.status == 502
+        assert json.loads(r.body)["error"] == "backend_unavailable"
+
+    asyncio.run(go())
+
+
+def test_jobs_collection_uses_walk_timeout(monkeypatch):
+    """The collection gather barriers on its slowest member — each hop
+    must be bounded by the short walk timeout, not forward_timeout_s,
+    or one wedged member stalls every fleet view for minutes."""
+    clock = _FakeClock()
+    router = _router(clock, forward_timeout_s=330.0, probe_timeout_s=2.0)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    seen: dict[str, float] = {}
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        seen[f"{host}:{port}"] = timeout_s
+        return 200, {}, json.dumps(
+            {"jobs": [], "counts": {}, "queue_depth": 0}
+        ).encode()
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        r = await router._proxy(
+            Request(
+                method="GET", path="/v1/jobs", query={}, headers={},
+                body=b"", id="rid-coll-timeout",
+            )
+        )
+        assert r.status == 200
+        assert seen == {"b0:8000": 10.0, "b1:8001": 10.0}
+
+    asyncio.run(go())
+
+
+def test_jobs_collection_scatter_gather(monkeypatch):
+    """GET /v1/jobs merges every member's collection: jobs concatenated
+    in created order and stamped with their backend, counts summed, a
+    failed member flagged as partial instead of failing the view."""
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        if f"{host}:{port}" == "b0:8000":
+            return 200, {}, json.dumps(
+                {
+                    "jobs": [{"id": "job-aa", "created_ts": 2.0}],
+                    "counts": {"running": 1},
+                    "queue_depth": 1,
+                }
+            ).encode()
+        return 200, {}, json.dumps(
+            {
+                "jobs": [{"id": "job-bb", "created_ts": 1.0}],
+                "counts": {"running": 2, "done": 1},
+                "queue_depth": 0,
+            }
+        ).encode()
+
+    async def fake_b0_down(host, port, method, target, headers, body, timeout_s):
+        if f"{host}:{port}" == "b0:8000":
+            raise fleet._BackendError("b0:8000: ConnectionRefusedError")
+        return 200, {}, json.dumps(
+            {"jobs": [], "counts": {}, "queue_depth": 0}
+        ).encode()
+
+    def _req(i):
+        return Request(
+            method="GET", path="/v1/jobs", query={}, headers={}, body=b"",
+            id=f"rid-coll-{i}",
+        )
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        r = await router._proxy(_req(1))
+        assert r.status == 200
+        doc = json.loads(r.body)
+        assert [j["id"] for j in doc["jobs"]] == ["job-bb", "job-aa"]
+        assert doc["jobs"][0]["backend"] == "b1:8001"
+        assert doc["jobs"][1]["backend"] == "b0:8000"
+        assert doc["counts"] == {"running": 3, "done": 1}
+        assert doc["queue_depth"] == 1
+        assert doc["partial"] is False and doc["backends"] == 2
+        assert r.headers["x-backend"] == "*"
+        # the Prometheus family moves in lockstep with the /v1/config
+        # per-member counter on fan-out traffic too
+        fam = router.metrics.labeled("requests_total")
+        assert fam.get("b0:8000") == 1 and fam.get("b1:8001") == 1
+        # one member down: the view survives, flagged partial
+        monkeypatch.setattr(fleet, "raw_request", fake_b0_down)
+        r = await router._proxy(_req(2))
+        assert r.status == 200
+        assert json.loads(r.body)["partial"] is True
+
+        # a malformed element (non-dict job, junk created_ts) from one
+        # member must not 500 the whole view either
+        async def fake_malformed(
+            host, port, method, target, headers, body, timeout_s
+        ):
+            if f"{host}:{port}" == "b0:8000":
+                return 200, {}, json.dumps(
+                    {
+                        "jobs": [None, {"id": "job-ok",
+                                        "created_ts": "oops"}],
+                        "counts": {},
+                        "queue_depth": 0,
+                    }
+                ).encode()
+            return 200, {}, json.dumps(
+                {"jobs": [], "counts": {}, "queue_depth": 0}
+            ).encode()
+
+        monkeypatch.setattr(fleet, "raw_request", fake_malformed)
+        r = await router._proxy(_req(3))
+        assert r.status == 200
+        doc = json.loads(r.body)
+        assert doc["partial"] is True
+        assert [j["id"] for j in doc["jobs"]] == ["job-ok"]
+
+    asyncio.run(go())
+
+
+def test_jobs_collection_includes_draining_member(monkeypatch):
+    """A DRAINING backend is out of the ring but still the only holder
+    of its jobs' state (its listener lives out the grace window) — the
+    fleet view must keep asking it, or a rolling restart silently drops
+    its jobs from GET /v1/jobs with partial: false."""
+    clock = _FakeClock()
+    router = _router(clock)
+    script = {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    _probe_script(monkeypatch, script)
+
+    async def fake_jobs(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        jid = "job-drain" if name == "b1:8001" else "job-live"
+        return 200, {}, json.dumps(
+            {
+                "jobs": [{"id": jid, "created_ts": 1.0}],
+                "counts": {"running": 1},
+                "queue_depth": 0,
+            }
+        ).encode()
+
+    async def go():
+        await router.probe_once()
+        script["b1:8001"] = _draining_503
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "draining"
+        monkeypatch.setattr(fleet, "raw_request", fake_jobs)
+        r = await router._proxy(
+            Request(
+                method="GET", path="/v1/jobs", query={}, headers={},
+                body=b"", id="rid-drain-coll",
+            )
+        )
+        assert r.status == 200
+        doc = json.loads(r.body)
+        assert {j["id"] for j in doc["jobs"]} == {"job-live", "job-drain"}
+        assert doc["partial"] is False and doc["backends"] == 2
+
+    asyncio.run(go())
+
+
+# ----------------------------------------------------- raw client framing
+
+
+def _one_shot_server(payload: bytes):
+    """An asyncio TCP server that answers every connection with a fixed
+    raw byte payload, then closes (graceful FIN)."""
+
+    async def handle(reader, writer):
+        await reader.read(4096)
+        writer.write(payload)
+        await writer.drain()
+        writer.close()
+
+    return asyncio.start_server(handle, "127.0.0.1", 0)
+
+
+def test_raw_request_rejects_truncated_body():
+    """A graceful FIN mid-body must read as an infra failure, not a
+    complete response: without the content-length check a truncated 200
+    would be forwarded to clients — and on the peer-fill path CACHED as
+    a valid positive entry."""
+
+    async def go():
+        server = await _one_shot_server(
+            b"HTTP/1.1 200 OK\r\ncontent-length: 100\r\n"
+            b"connection: close\r\n\r\nonly twenty bytes!!!"
+        )
+        port = server.sockets[0].getsockname()[1]
+        try:
+            with pytest.raises(fleet._BackendError, match="truncated body"):
+                await fleet.raw_request(
+                    "127.0.0.1", port, "GET", "/x", {}, b"", 5.0
+                )
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_raw_request_trims_bytes_past_content_length():
+    """Bytes past content-length (a sloppy speaker) are dropped, not
+    handed to the caller as part of the payload."""
+
+    async def go():
+        server = await _one_shot_server(
+            b"HTTP/1.1 200 OK\r\ncontent-length: 4\r\n"
+            b"connection: close\r\n\r\nbodyTRAILING-JUNK"
+        )
+        port = server.sockets[0].getsockname()[1]
+        try:
+            status, headers, body = await fleet.raw_request(
+                "127.0.0.1", port, "GET", "/x", {}, b"", 5.0
+            )
+            assert status == 200 and body == b"body"
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------- SSE passthrough
+
+
+def test_raw_request_stream_is_progressive():
+    """The streaming client delivers each chunk as it arrives — the
+    first SSE event must come through while the backend still holds the
+    connection open (a buffered read-to-EOF would block until close)."""
+
+    async def go():
+        gate = asyncio.Event()
+
+        async def handle(reader, writer):
+            await reader.readuntil(b"\r\n\r\n")
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"content-type: text/event-stream\r\n"
+                b"connection: close\r\n\r\n"
+            )
+            writer.write(b"data: one\n\n")
+            await writer.drain()
+            await gate.wait()
+            writer.write(b"data: two\n\n")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            status, headers, chunks = await fleet.raw_request_stream(
+                "127.0.0.1", port, "GET", "/v1/jobs/job-x/events", {},
+                b"", 2.0,
+            )
+            assert status == 200
+            assert headers["content-type"] == "text/event-stream"
+            it = chunks.__aiter__()
+            first = await asyncio.wait_for(it.__anext__(), 2.0)
+            assert b"data: one" in first  # before the stream ended
+            gate.set()
+            rest = b""
+            async for c in it:
+                rest += c
+            assert b"data: two" in rest
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_router_streams_job_events_past_forward_timeout():
+    """/v1/jobs/{id}/events through the router: the response is a
+    STREAM (head under the forward timeout, body an open pipe), a quiet
+    period longer than the forward timeout neither truncates it nor
+    feeds the ejection breaker — the round-14 review finding where a
+    long job's SSE stream ejected its healthy backend."""
+
+    async def go():
+        async def handle(reader, writer):
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert b"GET /v1/jobs/job-x/events" in head
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"content-type: text/event-stream\r\n"
+                b"connection: close\r\n\r\n"
+            )
+            writer.write(b"data: one\n\n")
+            await writer.drain()
+            await asyncio.sleep(0.6)  # > forward_timeout_s below
+            writer.write(b"data: two\n\n")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        name = f"127.0.0.1:{port}"
+        try:
+            router = FleetRouter(
+                [name], probe_interval_s=30.0, forward_timeout_s=0.2,
+            )
+            m = router.members[name]
+            router._set_state(m, "healthy", "test_admit")
+            resp = await router._proxy(
+                Request(
+                    method="GET", path="/v1/jobs/job-x/events", query={},
+                    headers={}, body=b"", id="rid-sse",
+                )
+            )
+            assert resp.status == 200
+            assert resp.stream is not None
+            assert resp.headers["x-backend"] == name
+            body = b""
+            async for c in resp.stream:
+                body += c
+            assert b"data: one" in body and b"data: two" in body
+            # the 0.6 s quiet period was NOT an infra failure
+            assert m.in_ring and m.breaker.state_name == "closed"
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_router_blocks_internal_surface(monkeypatch):
+    """/v1/internal/* is backend-to-backend (unauthenticated,
+    QoS-unmetered by design): the router must answer 404 without
+    forwarding, or the catch-all proxy re-exports the peer cache-read
+    surface to external clients."""
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    called = []
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        called.append(target)
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        r = await router._proxy(
+            Request(
+                method="GET", path="/v1/internal/cache/" + "ab" * 20,
+                query={}, headers={}, body=b"", id="rid-internal",
+            )
+        )
+        assert r.status == 404
+        assert called == []  # never left the router
+
+    asyncio.run(go())
+
+
+def test_job_submit_never_replays_on_failover(monkeypatch):
+    """A torn POST /v1/jobs must NOT replay on the failover owner: the
+    idempotency index is per-backend, so the replay would silently
+    double-submit a durable job.  Compute POSTs still retry once."""
+    clock = _FakeClock()
+    router = _router(clock)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    calls: list[str] = []
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        calls.append(f"{host}:{port}")
+        raise fleet._BackendError(f"{host}:{port}: torn response (0B)")
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        r = await router._proxy(
+            Request(
+                method="POST", path="/v1/jobs", query={},
+                headers={"content-type": "application/json"},
+                body=b'{"type": "dream"}', id="rid-noreplay",
+            )
+        )
+        assert r.status == 502
+        assert len(calls) == 1, calls  # exactly one attempt
+        calls.clear()
+        r = await router._proxy(
+            Request(
+                method="POST", path="/v1/deconv", query={},
+                headers={"content-type": "application/json"},
+                body=b'{"layer": "x"}', id="rid-compute",
+            )
+        )
+        assert r.status == 502
+        assert len(calls) == 2, calls  # compute replays once
+
+    asyncio.run(go())
+
+
+def test_job_events_stalled_error_head_is_infra_failure():
+    """A backend that sends a non-200 head on the SSE path and then
+    stalls (alive socket, no body) must read as an infra failure within
+    the forward timeout — not hang the router request forever."""
+
+    async def go():
+        async def handle(reader, writer):
+            await reader.readuntil(b"\r\n\r\n")
+            writer.write(
+                b"HTTP/1.1 503 Service Unavailable\r\n"
+                b"content-type: application/json\r\n"
+                b"connection: close\r\n\r\n"
+            )
+            await writer.drain()
+            await asyncio.sleep(5)  # stall, holding the socket open
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        name = f"127.0.0.1:{port}"
+        try:
+            router = FleetRouter(
+                [name], probe_interval_s=30.0, forward_timeout_s=0.2,
+            )
+            m = router.members[name]
+            router._set_state(m, "healthy", "test_admit")
+            t0 = time.perf_counter()
+            resp = await router._proxy(
+                Request(
+                    method="GET", path="/v1/jobs/job-x/events", query={},
+                    headers={}, body=b"", id="rid-stall",
+                )
+            )
+            took = time.perf_counter() - t0
+            assert resp.status == 502  # the only candidate infra-failed
+            assert took < 2.0, took  # bounded by the drain timeout
+        finally:
+            # the stalled handler task dies with asyncio.run teardown
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+# ------------------------------------- peer-fill singleflight integrity
+
+
+def test_peer_fill_cancel_does_not_poison_singleflight(fleet2):
+    """Round-14 review regression: the leader awaits _peer_fill between
+    flights.begin and the try that finishes the flight — a
+    CancelledError escaping there (client gone mid-fetch) must finish
+    the flight, or the key's future stays in the table forever and
+    every later identical request coalesces onto it and hangs."""
+    import urllib.parse as _up
+
+    svc = fleet2.services[0]
+    handler = svc.server._routes[("POST", "/v1/deconv")]
+    body = _up.urlencode(
+        {"file": _data_url(77), "layer": "b2c1"}
+    ).encode()
+    ctype = {"content-type": "application/x-www-form-urlencoded"}
+
+    async def go():
+        started = asyncio.Event()
+
+        async def hang(req, key, tr):
+            started.set()
+            await asyncio.Event().wait()
+
+        svc._peer_fill = hang  # instance attr shadows the bound method
+        try:
+            task = asyncio.ensure_future(
+                handler(
+                    Request(
+                        method="POST", path="/v1/deconv", query={},
+                        headers={**ctype, "x-peer-fill": "127.0.0.1:1"},
+                        body=body, id="rid-poison-1",
+                    )
+                )
+            )
+            await asyncio.wait_for(started.wait(), 10)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+        finally:
+            del svc.__dict__["_peer_fill"]
+        # the key must be recomputable: a fresh identical request becomes
+        # a NEW leader (pre-fix it coalesced onto the dead future forever)
+        resp = await asyncio.wait_for(
+            handler(
+                Request(
+                    method="POST", path="/v1/deconv", query={},
+                    headers=dict(ctype), body=body, id="rid-poison-2",
+                )
+            ),
+            30,
+        )
+        assert resp.status == 200
+
+    fleet2.on_loop(go(), timeout=60)

@@ -172,6 +172,54 @@ def test_every_family_typed_once_and_labels_escape():
             assert '\\"' in line
 
 
+def test_router_families_lint_in_non_core_registry():
+    """Round-14 fleet router: its registry runs core=False — only the
+    generic counter/gauge/labeled/stage families render, so the labeled
+    ``router_requests_total{backend=}`` family cannot collide with the
+    batching server's fixed ``requests_total`` under the same prefix."""
+    m = Metrics(prefix="router", core=False)
+    m.inc_labeled("requests_total", "backend", "10.0.0.1:8000", 3)
+    m.inc_labeled("requests_total", "backend", 'we"ird\\host:1')
+    m.set_labeled_gauge("backend_state", "backend", "10.0.0.1:8000", 0)
+    m.set_labeled_gauge("backend_state", "backend", "10.0.0.2:8000", 2)
+    m.inc_counter("rebalanced_keys_total", 7)
+    m.set_gauge("backends_in_ring", 1)
+    m.observe_stage("forward", 0.004)
+    m.observe_request(0.004)
+    m.observe_request(0.009, error_code="backend_unavailable")
+    text = m.prometheus()
+    families, samples = lint_exposition(text)
+    assert families["router_requests_total"] == "counter"
+    assert families["router_backend_state"] == "gauge"
+    assert families["router_rebalanced_keys_total"] == "counter"
+    assert families["router_backends_in_ring"] == "gauge"
+    assert families["router_stage_seconds"] == "summary"
+    assert families["router_errors_total"] == "counter"
+    assert samples[
+        ("router_requests_total", 'backend="10.0.0.1:8000"')
+    ] == 3.0
+    assert samples[
+        ("router_backend_state", 'backend="10.0.0.2:8000"')
+    ] == 2.0
+    assert samples[("router_rebalanced_keys_total", "")] == 7.0
+    # hostile backend label round-trips the escaping grammar
+    assert any(
+        '\\"' in label for name, label in samples
+        if name == "router_requests_total"
+    )
+    # the core batching-server families are ABSENT, not rendered at zero
+    for absent in (
+        "router_batches_total", "router_images_total",
+        "router_request_latency_seconds", "router_batch_size",
+        "router_images_per_sec",
+    ):
+        assert absent not in families
+        assert not any(name == absent for name, _ in samples)
+    # default registries are unaffected by the flag's existence
+    core_families, _ = lint_exposition(Metrics().prometheus())
+    assert core_families["deconv_requests_total"] == "counter"
+
+
 def test_counters_monotone_across_two_snapshots():
     m = Metrics()
     _traffic(m)

@@ -745,6 +745,68 @@ def test_cache_hit_debits_fixed_cost_not_device_estimate():
         )
 
 
+def test_peer_fill_refunds_to_hit_cost():
+    """A peer fill (round 14) moves bytes, not device work: the
+    tenant's provisional device debit must be refunded down to
+    hit_cost_ms exactly like a cache hit — otherwise a ring rebalance
+    drains the tenant's bucket on pure cache-transfer traffic."""
+    from deconv_api_tpu.serving.http import Response
+
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    cfg = ServerConfig(
+        image_size=16, max_batch=4, batch_window_ms=1.0,
+        compilation_cache_dir="", qos=True, qos_hit_cost_ms=0.5,
+        fleet_peer_fill=True,
+        tenants='{"mover": {"class": "standard", "rate_ms": 0.1,'
+        ' "burst_ms": 1000}}',
+    )
+    svc = DeconvService(cfg, spec=TINY, params=params)
+
+    async def fake_fill(req, key, tr):
+        return Response(
+            status=200, body=b'{"peer": true}',
+            headers={
+                "content-type": "application/json",
+                "x-cache": "peer-fill",
+            },
+        )
+
+    with ServiceFixture(cfg, service=svc) as s:
+        # one real miss warms the device-cost estimate the admission
+        # layer debits provisionally
+        r = httpx.post(
+            s.base_url + "/",
+            data={"file": _data_url(21), "layer": "b2c1"},
+            headers={"x-tenant": "mover"},
+            timeout=60,
+        )
+        assert r.status_code == 200 and r.headers["x-cache"] == "miss"
+        assert svc.qos.snapshot()["tenants"]["mover"]["device_ms"] > 0
+        svc._peer_fill = fake_fill  # instance attr shadows the method
+        try:
+            t0 = svc.qos.snapshot()["tenants"]["mover"]["tokens_ms"]
+            for i in range(3):
+                r = httpx.post(
+                    s.base_url + "/",
+                    data={"file": _data_url(30 + i), "layer": "b2c1"},
+                    headers={
+                        "x-tenant": "mover",
+                        "x-peer-fill": "127.0.0.1:1",
+                    },
+                    timeout=60,
+                )
+                assert r.status_code == 200, r.text
+                assert r.headers["x-cache"] == "peer-fill"
+            t1 = svc.qos.snapshot()["tenants"]["mover"]["tokens_ms"]
+        finally:
+            del svc.__dict__["_peer_fill"]
+        spent = t0 - t1  # refill makes this an UNDERestimate of debits
+        assert spent <= 3 * 0.5 + 0.1, (
+            f"3 peer fills cost {spent:.3f}ms of tokens; a fill must "
+            "debit the fixed hit cost, not the device estimate"
+        )
+
+
 # ------------------------------------------------------------- jobs tier
 
 

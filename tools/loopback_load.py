@@ -18,7 +18,21 @@ Usage: python tools/loopback_load.py [--passes N] [--no-donate]
            [--trace-ring N] [--slow-ms F] [--dump-slow PATH]
            [--chaos site=spec,...] [--pool-decode] [--lanes N]
            [--compile-cache-dir DIR] [--heavy] [--jobs]
-           [--jobs-dir DIR] [--qos] [--tenants default|SPEC] [depth ...]
+           [--jobs-dir DIR] [--qos] [--tenants default|SPEC]
+           [--fleet N] [depth ...]
+
+Round 14 added `--fleet N` — the fleet-tier drill (run_fleet_drill):
+one cache-affine consistent-hash router (serving/fleet.py) over N
+in-process backend services, each with its own private response cache.
+Phase 1 runs the zipf keystream against a SINGLE backend (the hit-ratio
+reference), phase 2 runs the same stream through the router (the
+aggregate hit ratio must match within a few percent — N LRUs routed by
+key affinity behave as ONE logical cache), and phase 3 kills one
+backend abruptly mid-stream and pins ~1/N keyspace impact: zero errors
+on keys owned by surviving backends, zero resident-entry loss on the
+survivors, and the moved-key fraction equal to the victim's keyspace
+share.  `tools/run_bench_suite.py`'s `fleet` token records the row
+with loud error fields on any violation.
 
 Round 13 added `--tenants` — the multi-tenant QoS noisy-neighbor drill
 (run_qos_drill): an interactive victim and a zipf bulk abuser share one
@@ -898,6 +912,321 @@ def run_qos_drill(
     return asyncio.run(drive())
 
 
+def _tiny_spec():
+    """The host-floor tiny spec (32px, three convs) shared by run_load
+    and the fleet drill: device time negligible, serving machinery (and
+    for the fleet, the ROUTING tier) is the measured quantity."""
+    from deconv_api_tpu.models.spec import Layer, ModelSpec
+
+    return ModelSpec(
+        name="loopback_tiny",
+        input_shape=(32, 32, 3),
+        layers=(
+            Layer("input_1", "input"),
+            Layer("c1", "conv", activation="relu", filters=16),
+            Layer("p1", "pool"),
+            Layer("c2", "conv", activation="relu", filters=32),
+            Layer("p2", "pool"),
+            Layer("c3", "conv", activation="relu", filters=32),
+        ),
+    )
+
+
+def run_fleet_drill(
+    n_backends: int = 3,
+    n_requests: int = 384,
+    concurrency: int = 32,
+    key_dist: str = "zipf:1.1",
+) -> dict:
+    """The round-14 fleet drill: one cache-affine router over N
+    in-process backend services (each a REAL DeconvService on its own
+    loopback port with its own private LRU), versus a single backend on
+    the SAME deterministic zipf keystream.
+
+    What the row pins:
+
+    - **N LRUs behave as one logical cache.**  The router
+      consistent-hashes each request body's canonical digest, so every
+      key cold-misses exactly ONCE fleet-wide; the aggregate hit ratio
+      must land within a few percent of the single backend's on the same
+      stream (a round-robin front-end would cold-miss every key ~N
+      times).  Per-backend hit ratios + request spread are recorded.
+
+    - **Killing one backend has ~1/N keyspace impact and zero
+      collateral.**  Mid-way through a second traffic phase the victim
+      backend is stopped ABRUPTLY (crash, not drain).  The router's
+      passive ejection (consecutive forward failures -> breaker opens ->
+      ring rebuild) plus its one-hop failover retry must keep keys owned
+      by SURVIVING backends at zero errors, leave the survivors'
+      resident cache entries untouched, and remap only ~1/N of the
+      keyspace (measured against the pre-kill ring).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving.app import DeconvService
+    from deconv_api_tpu.serving.cache import canonical_digest
+    from deconv_api_tpu.serving.fleet import FleetRouter
+
+    spec = _tiny_spec()
+    size = spec.input_shape[0]
+    params = init_params(spec, jax.random.PRNGKey(0))
+    cfg = ServerConfig(
+        image_size=size,
+        max_batch=16,
+        batch_window_ms=3.0,
+        compilation_cache_dir="",
+        platform="cpu",
+        warmup_all_buckets=False,
+        cache_bytes=cfg_cache_bytes(),
+        # trusted loopback mesh: a drained/rebalanced key may fill from
+        # its previous owner instead of recomputing
+        fleet_peer_fill=True,
+    )
+
+    rng = np.random.default_rng(0)
+    # two phases drawn from ONE zipf process: measure, then kill
+    streams = _key_streams(key_dist, n_requests, 2, rng)
+    uris: dict[int, str] = {}
+    for idx in sorted({i for stream in streams for i in stream}):
+        img = Image.fromarray(
+            np.random.default_rng(idx).integers(
+                0, 255, (size, size, 3), np.uint8
+            ),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        uris[idx] = (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+
+    import urllib.parse
+
+    bodies = {
+        idx: urllib.parse.urlencode({"file": uri, "layer": "c3"}).encode()
+        for idx, uri in uris.items()
+    }
+    # the key the ROUTER hashes for affinity (serving/fleet.py uses the
+    # same canonicalization): precomputed per image index so the kill
+    # phase can classify every response by its pre-kill ring owner
+    keys = {
+        idx: canonical_digest(
+            "fleet|/", "application/x-www-form-urlencoded", body
+        )
+        for idx, body in bodies.items()
+    }
+
+    async def boot_backend():
+        svc = DeconvService(cfg, spec=spec, params=params)
+        port = await svc.start("127.0.0.1", 0)
+        await asyncio.to_thread(svc.warmup, "c3")
+        return svc, port
+
+    async def post(port: int, idx: int) -> tuple[float, int, str, str]:
+        t0 = time.perf_counter()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        req = (
+            b"POST / HTTP/1.1\r\nHost: x\r\nContent-Type: "
+            b"application/x-www-form-urlencoded\r\nContent-Length: "
+            + str(len(bodies[idx])).encode()
+            + b"\r\nConnection: close\r\n\r\n"
+            + bodies[idx]
+        )
+        writer.write(req)
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        status, _code = _resp_status_code(raw)
+        kind, _rid = _resp_meta(raw)
+        backend = ""
+        for line in raw.split(b"\r\n\r\n", 1)[0].split(b"\r\n"):
+            name, _, value = line.partition(b":")
+            if name.strip().lower() == b"x-backend":
+                backend = value.strip().decode()
+        return time.perf_counter() - t0, status, kind, backend
+
+    async def drive_stream(
+        port: int, stream: list[int], on_done=None
+    ) -> list[tuple[int, float, int, str, str]]:
+        sem = asyncio.Semaphore(concurrency)
+        out: list[tuple[int, float, int, str, str]] = []
+
+        async def one(idx: int):
+            async with sem:
+                dt, status, kind, backend = await post(port, idx)
+            out.append((idx, dt, status, kind, backend))
+            if on_done is not None:
+                await on_done(len(out))
+
+        await asyncio.gather(*(one(i) for i in stream))
+        return out
+
+    def hit_split(samples) -> dict:
+        kinds: dict[str, int] = {}
+        for _i, _dt, _s, kind, _b in samples:
+            kinds[kind] = kinds.get(kind, 0) + 1
+        hits = kinds.get("hit", 0) + kinds.get("hit-negative", 0)
+        total = max(1, len(samples))
+        return {"kinds": kinds, "hit_ratio": round(hits / total, 4)}
+
+    async def drive() -> dict:
+        # ---- phase 1: single backend, the reference hit ratio --------
+        single, sport = await boot_backend()
+        t0 = time.perf_counter()
+        s_samples = await drive_stream(sport, streams[0])
+        single_wall = time.perf_counter() - t0
+        single_split = hit_split(s_samples)
+        assert all(s == 200 for _i, _d, s, _k, _b in s_samples)
+        await single.stop()
+
+        # ---- phase 2: N backends behind the router -------------------
+        backends = [await boot_backend() for _ in range(n_backends)]
+        names = [f"127.0.0.1:{port}" for _svc, port in backends]
+        by_name = {f"127.0.0.1:{port}": svc for svc, port in backends}
+        router = FleetRouter(
+            names,
+            probe_interval_s=0.25,
+            probe_timeout_s=1.0,
+            eject_threshold=2,
+            cooldown_s=2.0,
+        )
+        rport = await router.start("127.0.0.1", 0)
+        t0 = time.perf_counter()
+        f_samples = await drive_stream(rport, streams[0])
+        fleet_wall = time.perf_counter() - t0
+        fleet_split = hit_split(f_samples)
+        assert all(s == 200 for _i, _d, s, _k, _b in f_samples)
+        per_backend = {}
+        for name, svc in by_name.items():
+            snap = svc.metrics.snapshot()
+            c = snap["counters"]
+            h = c.get("cache_hits_total", 0)
+            m = c.get("cache_misses_total", 0)
+            per_backend[name] = {
+                "requests": snap["requests_total"],
+                "hits": h,
+                "misses": m,
+                "hit_ratio": round(h / max(1, h + m), 4),
+                "entries": svc.cache.entry_count,
+            }
+
+        # ---- phase 3: kill one backend mid-run -----------------------
+        # the victim: whoever owns the MOST sampled keys (maximum
+        # detectable keyspace impact)
+        owner_before = {k: router.ring.owner(keys[k]) for k in bodies}
+        from collections import Counter
+
+        owned = Counter(owner_before.values())
+        victim_name = owned.most_common(1)[0][0]
+        victim = by_name[victim_name]
+        survivors = {n: s for n, s in by_name.items() if n != victim_name}
+        surv_entries_before = {
+            n: s.cache.entry_count for n, s in survivors.items()
+        }
+        kill_at = max(1, len(streams[1]) // 4)
+        killed = asyncio.Event()
+
+        async def on_done(done: int):
+            if done >= kill_at and not killed.is_set():
+                killed.set()
+                # ABRUPT: no drain announcement reaches the router —
+                # it must discover the death passively/by probe
+                await victim.stop()
+
+        t0 = time.perf_counter()
+        k_samples = await drive_stream(rport, streams[1], on_done=on_done)
+        kill_wall = time.perf_counter() - t0
+        victim_key_errors = collateral_errors = 0
+        failover_ok = 0
+        for idx, _dt, status, _kind, backend in k_samples:
+            was_victims = owner_before[idx] == victim_name
+            if status != 200:
+                if was_victims:
+                    victim_key_errors += 1
+                else:
+                    collateral_errors += 1
+            elif was_victims and backend != victim_name:
+                failover_ok += 1
+        surv_entries_after = {
+            n: s.cache.entry_count for n, s in survivors.items()
+        }
+        resident_lost = sum(
+            max(0, surv_entries_before[n] - surv_entries_after[n])
+            for n in survivors
+        )
+        owner_after = {k: router.ring.owner(keys[k]) for k in bodies}
+        moved = sum(
+            1 for k in bodies if owner_before[k] != owner_after[k]
+        )
+        peer_fills = sum(
+            s.metrics.counter("cache_peer_fills_total")
+            for s in by_name.values()
+        )
+        rsnap = router.metrics.snapshot()
+        states = {m.name: m.state for m in router.members.values()}
+        await router.stop()
+        for name, svc in survivors.items():
+            await svc.stop()
+
+        delta_pct = (
+            (single_split["hit_ratio"] - fleet_split["hit_ratio"])
+            / single_split["hit_ratio"] * 100.0
+            if single_split["hit_ratio"]
+            else 0.0
+        )
+        return {
+            "which": f"loopback_fleet{n_backends}_{key_dist.replace(':', '')}",
+            "platform": "cpu-loopback",
+            "n_backends": n_backends,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "key_dist": key_dist,
+            "unique_keys": len(bodies),
+            "single_req_s": round(len(streams[0]) / single_wall, 1),
+            "single_hit_ratio": single_split["hit_ratio"],
+            "fleet_req_s": round(len(streams[0]) / fleet_wall, 1),
+            "aggregate_hit_ratio": fleet_split["hit_ratio"],
+            "hit_ratio_delta_pct": round(delta_pct, 2),
+            "client_kinds_single": single_split["kinds"],
+            "client_kinds_fleet": fleet_split["kinds"],
+            "per_backend": per_backend,
+            "kill": {
+                "victim": victim_name,
+                "requests": len(k_samples),
+                "req_s": round(len(k_samples) / kill_wall, 1),
+                "victim_key_errors": victim_key_errors,
+                "collateral_errors": collateral_errors,
+                "failover_ok": failover_ok,
+                "moved_key_frac": round(moved / max(1, len(bodies)), 4),
+                "expected_moved_frac": round(
+                    owned[victim_name] / max(1, len(bodies)), 4
+                ),
+                "survivor_entries_before": surv_entries_before,
+                "survivor_entries_after": surv_entries_after,
+                "survivor_resident_lost": resident_lost,
+                "backend_states_after": states,
+            },
+            "router": {
+                "rebalanced_keys_total": rsnap["counters"].get(
+                    "rebalanced_keys_total", 0
+                ),
+                "requests_by_backend": rsnap["labeled"].get(
+                    "requests_total", ("backend", {})
+                )[1],
+                "peer_fills": peer_fills,
+            },
+        }
+
+    return asyncio.run(drive())
+
+
 def run_load(
     pipeline_depth: int,
     n_requests: int = 512,
@@ -949,18 +1278,7 @@ def run_load(
         # single stream serializes)
         layer_pool = ("c1", "c2", "c3", "c4", "c5", "c6")
     else:
-        spec = ModelSpec(
-            name="loopback_tiny",
-            input_shape=(32, 32, 3),
-            layers=(
-                Layer("input_1", "input"),
-                Layer("c1", "conv", activation="relu", filters=16),
-                Layer("p1", "pool"),
-                Layer("c2", "conv", activation="relu", filters=32),
-                Layer("p2", "pool"),
-                Layer("c3", "conv", activation="relu", filters=32),
-            ),
-        )
+        spec = _tiny_spec()
         layer_pool = ("c3",)
     size = spec.input_shape[0]
     params = init_params(spec, jax.random.PRNGKey(0))
@@ -1439,6 +1757,7 @@ def main() -> int:
     jobs_mode = False
     jobs_dir = ""
     qos_on = False
+    fleet_n: int | None = None
     tenants_drill: str | None = None
     concurrency = 64
     depths: list[int] = []
@@ -1489,6 +1808,12 @@ def main() -> int:
         elif args[i] == "--qos":
             qos_on = True
             i += 1
+        elif args[i] == "--fleet":
+            # the round-14 fleet drill: one cache-affine router over N
+            # in-process backends, aggregate-vs-single hit ratio + a
+            # mid-run backend kill with collateral accounting
+            fleet_n = int(args[i + 1])
+            i += 2
         elif args[i] == "--tenants":
             # the multi-tenant noisy-neighbor drill (round 13):
             # 'default' = the built-in victim/abuser pair with the
@@ -1533,6 +1858,18 @@ def main() -> int:
         except ValueError as e:
             print(e, file=sys.stderr)
             return 2
+    if fleet_n is not None:
+        if fleet_n < 2:
+            print("--fleet needs at least 2 backends", file=sys.stderr)
+            return 2
+        row = run_fleet_drill(
+            n_backends=fleet_n,
+            n_requests=n_requests or 384,
+            concurrency=min(concurrency, 48),
+            key_dist=key_dist or "zipf:1.1",
+        )
+        print(json.dumps(row), flush=True)
+        return 0
     if jobs_mode:
         # the durable-jobs chaos drill (round 11): depths are irrelevant
         # — jobs ride the dispatchers whatever the depth

@@ -66,6 +66,13 @@ QOS_VICTIM_P99_BUDGET_PCT = 15.0
 # versus qos-off.
 QOS_SYNC_OVERHEAD_BUDGET_PCT = 3.0
 
+# Fleet-tier budget (round 14): the cache-affine router over N
+# backends must deliver an aggregate hit ratio within this of a single
+# backend on the same zipf keystream — the N-LRUs-as-one-cache claim.
+# (A round-robin front-end fragments the cache and misses ~N times per
+# key; 5% absorbs coalescing-vs-hit timing jitter, not fragmentation.)
+FLEET_HIT_RATIO_BUDGET_PCT = 5.0
+
 # Channel-packed backward-tail budget (round 12): the packed path must
 # not run SLOWER than the vmapped path it would replace — a recorded
 # regression (like the r3 prototype's 280-vs-368 img/s) keeps the
@@ -396,6 +403,78 @@ def run_qos_guard(timeout_s: float = 1800.0) -> dict:
             f"qos-on sync overhead {overhead:.1f}% "
             f"(> {QOS_SYNC_OVERHEAD_BUDGET_PCT:.0f}% budget) on the hot "
             "cached path"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
+    return row
+
+
+def run_fleet_guard(timeout_s: float = 1800.0) -> dict:
+    """Fleet-tier drill guard (round 14): tools/loopback_load.py
+    --fleet 3 — one cache-affine router over three in-process backends
+    on the zipf keystream, then an abrupt mid-run backend kill.
+
+    The row fails LOUDLY (`error` field) when:
+    - the aggregate fleet hit ratio falls more than
+      FLEET_HIT_RATIO_BUDGET_PCT below the single-backend reference on
+      the same keystream (the one-logical-cache claim broke);
+    - the kill phase sees ANY error on a key owned by a surviving
+      backend (collateral — ejection/failover is leaking);
+    - any surviving backend LOST resident cache entries over the kill
+      (a crash elsewhere must not evict a healthy node's hot set);
+    - the victim's keyspace did not actually move (~1/N expected:
+      ejection never happened, the drill is vacuous)."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--fleet", "3"], timeout_s, env=env
+    )
+    row = {"config": "fleet", "which": "loopback_fleet_drill"}
+    if "error" in drill:
+        row["error"] = drill["error"]
+        return row
+    kill = drill.get("kill", {})
+    row.update(
+        n_backends=drill.get("n_backends"),
+        single_req_s=drill.get("single_req_s"),
+        fleet_req_s=drill.get("fleet_req_s"),
+        single_hit_ratio=drill.get("single_hit_ratio"),
+        aggregate_hit_ratio=drill.get("aggregate_hit_ratio"),
+        hit_ratio_delta_pct=drill.get("hit_ratio_delta_pct"),
+        hit_ratio_budget_pct=FLEET_HIT_RATIO_BUDGET_PCT,
+        per_backend=drill.get("per_backend"),
+        kill_victim=kill.get("victim"),
+        victim_key_errors=kill.get("victim_key_errors"),
+        collateral_errors=kill.get("collateral_errors"),
+        failover_ok=kill.get("failover_ok"),
+        moved_key_frac=kill.get("moved_key_frac"),
+        expected_moved_frac=kill.get("expected_moved_frac"),
+        survivor_resident_lost=kill.get("survivor_resident_lost"),
+        backend_states_after=kill.get("backend_states_after"),
+        router=drill.get("router"),
+    )
+    problems = []
+    delta = drill.get("hit_ratio_delta_pct")
+    if delta is None or delta > FLEET_HIT_RATIO_BUDGET_PCT:
+        problems.append(
+            f"aggregate hit ratio {delta}% below single backend "
+            f"(> {FLEET_HIT_RATIO_BUDGET_PCT:.0f}% budget — the fleet "
+            "is fragmenting the cache)"
+        )
+    if kill.get("collateral_errors", 1):
+        problems.append(
+            f"{kill.get('collateral_errors')} errors on keys owned by "
+            "SURVIVING backends during the kill"
+        )
+    if kill.get("survivor_resident_lost", 1):
+        problems.append(
+            f"survivors lost {kill.get('survivor_resident_lost')} "
+            "resident cache entries over the kill"
+        )
+    if not kill.get("moved_key_frac"):
+        problems.append(
+            "victim keyspace never moved (ejection never happened; "
+            "drill vacuous)"
         )
     if problems:
         row["error"] = "; ".join(problems)
@@ -758,6 +837,12 @@ def main() -> int:
             # solo, sheds charged to the abuser, <=3% qos-on overhead
             result = run_qos_guard()
             result["date"] = date
+        elif tok == "fleet":
+            # fleet-tier drill (round 14): router over 3 backends —
+            # aggregate hit ratio within budget of single-backend, zero
+            # collateral on the mid-run kill
+            result = run_fleet_guard()
+            result["date"] = date
         elif tok == "kpack":
             # channel-packed backward tail A/B (round 12): bit-equality
             # asserted in the probe, loud error on regression or a
@@ -779,7 +864,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet'])}",
             }
         else:
             n = int(tok)

@@ -49,6 +49,19 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "aggregate_hit_ratio" in row:
+        # fleet-tier rows (round 14): the one-logical-cache claim plus
+        # the kill phase's collateral in one line, error kept visible
+        kill = row.get("kill", {})
+        line = (
+            f"fleet hit {row.get('aggregate_hit_ratio')} vs single "
+            f"{row.get('single_hit_ratio')} "
+            f"({row.get('hit_ratio_delta_pct')}%), kill collateral="
+            f"{row.get('collateral_errors', kill.get('collateral_errors'))}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     for key in (
         "img_per_sec", "images_per_sec", "requests_per_sec", "value",
         "ms_per_batch", "dreams_per_min",
