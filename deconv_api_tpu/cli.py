@@ -39,6 +39,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "drain_grace_s", "lanes", "lowc_kpack", "compile_cache_dir",
         "jobs_dir", "jobs_workers", "jobs_queue_depth",
         "tenants", "qos_default_class",
+        "serve_models", "pinned_models", "hbm_budget_bytes", "weight_dtype",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -379,6 +380,30 @@ def main(argv: list[str] | None = None) -> int:
         "--peer-fill", action="store_true", dest="peer_fill",
         help="fleet tier: honor x-peer-fill hints + serve the internal "
         "cache-read route to ring peers (trusted meshes; default off)",
+    )
+    s.add_argument(
+        "--serve-models", default=None, dest="serve_models",
+        metavar="all|M1,M2",
+        help="registry models served per-request via model=/x-model "
+        "('all', a comma list, or unset for single-model)",
+    )
+    s.add_argument(
+        "--pinned-models", default=None, dest="pinned_models",
+        metavar="M1,M2",
+        help="models paged in + warmed at boot, never evicted "
+        "(default: just --model)",
+    )
+    s.add_argument(
+        "--hbm-budget-bytes", type=int, default=None,
+        dest="hbm_budget_bytes",
+        help="per-lane HBM byte budget for resident model weights "
+        "(LRU page-out above it; 0 = unlimited)",
+    )
+    s.add_argument(
+        "--weight-dtype", default=None, dest="weight_dtype",
+        metavar="f32|bf16|int8",
+        help="stored weight precision in HBM (quantized tiers trade "
+        "PSNR-bounded fidelity for resident models)",
     )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)

@@ -205,6 +205,35 @@ class ServerConfig:
     # bucket (the real cost is ~0.08 ms of host time): hits are metered
     # traffic, not free laundering of a hot key.
     qos_hit_cost_ms: float = 0.05
+    # --- multi-model serving (round 15: serving/weight_manager.py) ---
+    # The set of registry models THIS process serves per-request
+    # (``model=`` form field / ``x-model`` header): '' = only `model`
+    # (the classic single-model server — the manager stays inert and
+    # the hot path is byte-identical to pre-round-15), 'all' = the whole
+    # registry, or a comma list.  `model` is always included and stays
+    # the default when a request names nothing.
+    serve_models: str = ""
+    # Models paged into HBM and compile-warmed at BOOT, never evicted:
+    # '' = just `model`.  Everything else served is ON-DEMAND — its
+    # first request pays the page-in (and first-use compile) inside its
+    # own latency, visible as a weight_page_in span/stage.
+    pinned_models: str = ""
+    # Per-lane device-memory byte budget for resident model weights
+    # (REAL device_put bytes).  0 = unlimited (nothing is ever paged
+    # out).  When the working set exceeds it, the least-recently-used
+    # unpinned model with no in-flight batches is paged out; if every
+    # resident model is pinned or in flight the budget overshoots
+    # LOUDLY (weight_budget_overcommit_total) instead of failing
+    # requests.
+    hbm_budget_bytes: int = 0
+    # Stored weight precision for the HBM copies: 'f32' (exact, the
+    # default), 'bf16' (half the bytes; cast-on-use), 'int8'
+    # (per-tensor symmetric kernels, ~quarter the kernel bytes, f32
+    # dequant-on-use).  Quantized tiers trade bounded fidelity (PSNR
+    # parity floors in tests/test_weight_manager.py) for ~2x resident
+    # models per budget; the knob folds into the response-cache prefix
+    # so a precision change invalidates every cached payload.
+    weight_dtype: str = "f32"
     # --- fleet tier (round 14: serving/fleet.py) ---
     # Peer cache fill: honor the router's ``x-peer-fill: host:port``
     # hint on a cache miss — ask the key's PREVIOUS ring owner for the

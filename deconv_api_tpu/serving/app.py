@@ -61,10 +61,18 @@ _DREAM_DEFAULTS = {"steps": 10, "octaves": 10, "lr": 0.01}
 class DeconvService:
     """Owns the model bundle, the dispatcher and the HTTP routes."""
 
-    def __init__(self, cfg: ServerConfig | None = None, *, spec=None, params=None):
+    def __init__(
+        self,
+        cfg: ServerConfig | None = None,
+        *,
+        spec=None,
+        params=None,
+        registry=None,
+    ):
         import dataclasses
 
         from deconv_api_tpu.serving.models import REGISTRY, spec_bundle
+        from deconv_api_tpu.serving.weight_manager import WEIGHT_DTYPES
 
         self.cfg = cfg or ServerConfig.from_env()
         apply_platform(self.cfg)
@@ -75,28 +83,49 @@ class DeconvService:
         from deconv_api_tpu.engine.deconv import resolve_kpack_chan
 
         resolve_kpack_chan(self.cfg.lowc_kpack, self.cfg.top_k)
+        if self.cfg.weight_dtype not in WEIGHT_DTYPES:
+            raise ValueError(
+                f"weight_dtype must be one of {WEIGHT_DTYPES}, got "
+                f"{self.cfg.weight_dtype!r}"
+            )
+        # ``registry`` (round 15): the model-builder map this process
+        # serves from — defaults to the real REGISTRY; tests and drills
+        # inject small spec families to exercise paging without 224²
+        # backbones.
+        self._registry = REGISTRY if registry is None else dict(registry)
         if spec is not None:
-            # injected sequential model (tests, embedding)
+            # injected sequential model (tests, embedding): it joins the
+            # served set under its own name, alongside any injected
+            # registry (multi-model drills inject BOTH)
             self.bundle = spec_bundle(spec, params)
             model_name = spec.name
+            self._registry = dict(self._registry)
+            self._registry[model_name] = lambda: self.bundle
+            if registry is None:
+                # a bare injected spec serves ONLY itself (the classic
+                # test/embedding contract — the real registry must not
+                # leak into its served set via serve_models='all')
+                self._registry = {model_name: lambda: self.bundle}
         else:
-            if self.cfg.model not in REGISTRY:
+            if self.cfg.model not in self._registry:
                 raise errors.UnknownModel(
-                    f"unknown model {self.cfg.model!r}; available: {sorted(REGISTRY)}"
+                    f"unknown model {self.cfg.model!r}; available: "
+                    f"{sorted(self._registry)}"
                 )
-            self.bundle = REGISTRY[self.cfg.model]()
+            self.bundle = self._registry[self.cfg.model]()
             model_name = self.cfg.model
+        self._default_model = model_name
         if self.cfg.weights_path:
             # one load path for registry and injected-spec bundles, so a
-            # fine-tuned checkpoint serves under either
-            from deconv_api_tpu.models.weights import load_model_weights
-
-            self.bundle.params = load_model_weights(
-                model_name,
-                self.bundle.spec,
-                self.cfg.weights_path,
-                self.bundle.params,
-            )
+            # fine-tuned checkpoint serves under either.  A DIRECTORY
+            # (round 15 multi-model: `fetch_weights --all --dest DIR`)
+            # loads <dir>/<model>.h5 per served model instead.
+            self._load_weights(model_name, self.bundle)
+        # what the operator explicitly pinned (0 = derive per model):
+        # captured BEFORE the default-model resolution below, because a
+        # per-request model must resize to ITS OWN native size unless
+        # the operator forced one
+        self._image_size_override = max(0, self.cfg.image_size)
         if self.cfg.image_size <= 0:
             # resolve on a copy: the caller's config object stays untouched
             self.cfg = dataclasses.replace(
@@ -148,10 +177,51 @@ class DeconvService:
             self.cfg.serve_lanes, _jax.device_count(), self.mesh is not None
         )
         self._lane_dp = 1
+        lane_places = None
         if self.lane_count > 1:
-            placements = lane_placements(self.lane_count)
-            self.bundle.set_lanes(placements)
+            lane_places = lane_placements(self.lane_count)
             self._lane_dp = _jax.device_count() // self.lane_count
+        # HBM weight manager (round 15, serving/weight_manager.py): every
+        # served model's host archive + per-lane device residency.  The
+        # classic single-model f32 config keeps the manager INERT — the
+        # default bundle's params object and the per-lane set_lanes
+        # replication are exactly the pre-round-15 path; multi-model /
+        # quantized / budgeted configs get explicit LRU paging.
+        from deconv_api_tpu.serving.weight_manager import WeightManager
+
+        served = self._parse_model_list(
+            self.cfg.serve_models, model_name, "serve_models"
+        )
+        pinned = self._parse_model_list(
+            self.cfg.pinned_models, model_name, "pinned_models"
+        )
+        # The dispatcher key head-strip (_dispatch_inner) relies on
+        # served model names and the DEFAULT model's layer names living
+        # in disjoint namespaces — bare keys are the default model's,
+        # and a layer named like a served model would be stripped as
+        # one.  Real registry names never collide; injected ones must
+        # fail at boot, not corrupt dispatch.
+        clash = set(served) & (
+            set(self.bundle.layer_names) | {"__dream__", "__dream_octave__"}
+        )
+        if clash:
+            raise ValueError(
+                f"serve_models: model name(s) {sorted(clash)} collide with "
+                f"the default model {model_name!r}'s layer names — served "
+                "names must be disjoint from dispatch-key vocabulary"
+            )
+        self.weights = WeightManager(
+            {name: self._registry[name] for name in served},
+            model_name,
+            default_bundle=self.bundle,
+            pinned=tuple(pinned),
+            placements=lane_places,
+            mesh=self.mesh,
+            budget_bytes=self.cfg.hbm_budget_bytes,
+            weight_dtype=self.cfg.weight_dtype,
+            metrics=self.metrics,
+            weights_loader=self._load_weights,
+        )
         # warmup() records its wall time here; /v1/config reports it so
         # the compile-cache A/B (cold vs warm restart) is observable on
         # a live server
@@ -324,28 +394,16 @@ class DeconvService:
             if self.cfg.trace_ring > 0
             else None
         )
-        self._cache_prefix = "|".join(
-            str(x)
-            for x in (
-                self.bundle.name,
-                self.cfg.image_size,
-                self.cfg.visualize_mode,
-                self.cfg.stitch_k,
-                self.cfg.top_k,
-                self.cfg.bug_compat,
-                self.cfg.strict_compat,
-                self.cfg.dtype,
-                self.cfg.backward_dtype,
-                # backward-tail packing policy (round 12): pinned
-                # bit-inert (tests/test_kpack.py), but config changes
-                # invalidate every key by rule — same treatment as
-                # DECONV_FWD_LOWC_BF16 below.
-                self.cfg.lowc_kpack,
-                self.cfg.weights_path,
-                # engine env knob that changes output bytes (BASELINE r4c)
-                os.environ.get("DECONV_FWD_LOWC_BF16", "0"),
-            )
-        )
+        # Cache-key prefixes are PER MODEL since round 15: the model (and
+        # its effective image size) moved from the one config prefix into
+        # the per-request portion of the key.  A default-model request
+        # derives the SAME prefix it always did, and the resolved name —
+        # not the raw selector — rides the key, so `model=<default>`
+        # explicit, `x-model: <default>`, and a bare request all hash to
+        # one entry (the `model` form field is excluded from the field
+        # digest for the same reason; canonical_digest(exclude=)).
+        self._prefix_cache: dict[str, str] = {}
+        self._cache_prefix = self._model_prefix(model_name)
         self.server = HttpServer(
             idle_timeout_s=self.cfg.conn_idle_timeout_s,
             body_timeout_s=self.cfg.body_read_timeout_s,
@@ -443,6 +501,164 @@ class DeconvService:
                 self._internal_cache
             )
 
+    # ------------------------------------------------- multi-model plumbing
+
+    def _parse_model_list(
+        self, raw: str, default: str, what: str
+    ) -> list[str]:
+        """serve_models / pinned_models grammar: '' = just the default
+        model, 'all' = every registry entry, else a comma list.  The
+        default model is always a member.  Unknown names fail at BOOT."""
+        raw = (raw or "").strip()
+        if not raw:
+            names = [default]
+        elif raw == "all":
+            names = sorted(self._registry)
+        else:
+            names = [s.strip() for s in raw.split(",") if s.strip()]
+        unknown = [n for n in names if n not in self._registry]
+        if unknown:
+            raise ValueError(
+                f"{what}: unknown model(s) {unknown}; available: "
+                f"{sorted(self._registry)}"
+            )
+        if default not in names:
+            names.insert(0, default)
+        return list(dict.fromkeys(names))
+
+    def _load_weights(self, name: str, bundle) -> None:
+        """Per-model checkpoint load (round 15).  weights_path as a FILE
+        keeps the classic contract — it belongs to the default model
+        only (loading one model's h5 into another's tree would be
+        garbage).  As a DIRECTORY, each served model loads
+        ``<dir>/<model>.h5`` (or ``.npz``) when present; a served model
+        with no file stays at its init and says so once, loudly."""
+        wp = self.cfg.weights_path
+        if not wp:
+            return
+        from deconv_api_tpu.utils import slog as _slog
+
+        path = wp
+        if os.path.isdir(wp):
+            # per-model convention first: <dir>/<model>.h5 (or .npz).
+            # Absent that, the directory may be a CHECKPOINT dir (the
+            # train->serve roundtrip; load_model_weights understands
+            # those) — classic single-model semantics: it belongs to
+            # the default model only.
+            for cand in (
+                os.path.join(wp, f"{name}.h5"),
+                os.path.join(wp, f"{name}.npz"),
+            ):
+                if os.path.exists(cand):
+                    path = cand
+                    break
+            else:
+                if name != self._default_model:
+                    _slog.event(
+                        _slog.get_logger("deconv.app"), "weights_missing",
+                        level=30, model=name, dir=True,
+                        note="serving init weights; add <model>.h5 to the "
+                        "weights dir (tools/fetch_weights.py --all)",
+                    )
+                    return
+        elif name != self._default_model:
+            # a FILE path is one model's weights — the default's
+            return
+        from deconv_api_tpu.models.weights import load_model_weights
+
+        bundle.params = load_model_weights(
+            name, bundle.spec, path, bundle.params
+        )
+
+    def _model_image_size(self, bundle) -> int:
+        """The size requests for this model resize to: the operator's
+        explicit image_size when one was configured, else the model's
+        own native size (224 VGG/ResNet, 299 Inception, 32 tiny)."""
+        return self._image_size_override or bundle.image_size
+
+    def _model_prefix(self, model: str) -> str:
+        """The response-cache key prefix for one served model — every
+        response-determining server setting plus the resolved model and
+        its effective image size.  Builds the model's bundle on first
+        use (callers off the event loop, or via asyncio.to_thread in
+        the cache wrap)."""
+        p = self._prefix_cache.get(model)
+        if p is not None:
+            return p
+        bundle = self.weights.bundle(model)
+        p = "|".join(
+            str(x)
+            for x in (
+                model,
+                self._model_image_size(bundle),
+                self.cfg.visualize_mode,
+                self.cfg.stitch_k,
+                self.cfg.top_k,
+                self.cfg.bug_compat,
+                self.cfg.strict_compat,
+                self.cfg.dtype,
+                self.cfg.backward_dtype,
+                # backward-tail packing policy (round 12): pinned
+                # bit-inert (tests/test_kpack.py), but config changes
+                # invalidate every key by rule — same treatment as
+                # DECONV_FWD_LOWC_BF16 below.
+                self.cfg.lowc_kpack,
+                # stored weight precision (round 15): bf16/int8 tiers
+                # change output bytes within their PSNR bounds, so a
+                # precision change must invalidate every cached payload
+                self.cfg.weight_dtype,
+                self.cfg.weights_path,
+                # engine env knob that changes output bytes (BASELINE r4c)
+                os.environ.get("DECONV_FWD_LOWC_BF16", "0"),
+            )
+        )
+        self._prefix_cache[model] = p
+        return p
+
+    def _resolve_model(self, req: Request, form: dict | None = None) -> str:
+        """Resolve and validate the request's target model — ``model=``
+        form field (wins) or ``x-model`` header, default otherwise —
+        memoized on the request so the cache wrap, route handler, and
+        trace annotation agree on ONE resolution.  Unknown or unserved
+        names raise UnknownModel (422)."""
+        if req.model:
+            return req.model
+        if form is None:
+            try:
+                form = req.form()
+            except Exception:  # noqa: BLE001 — unparseable body: the
+                form = {}  # handler 400s it; model defaults
+        name = (form.get("model") or req.headers.get("x-model", "")).strip()
+        if not name:
+            name = self.weights.default
+        if name not in self.weights.served:
+            raise errors.UnknownModel(
+                f"unknown or unserved model {name!r}; serving: "
+                f"{sorted(self.weights.served)}"
+            )
+        req.model = name
+        tr = trace_mod.current_trace()
+        if tr is not None:
+            tr.annotate(model=name)
+        return name
+
+    async def _bundle_async(self, model: str):
+        """The model's bundle without blocking the event loop: a dict
+        hit when built, else the (possibly expensive — weight init +
+        checkpoint load) build on a thread."""
+        b = self.weights.peek_bundle(model)
+        if b is not None:
+            return b
+        return await asyncio.to_thread(self.weights.bundle, model)
+
+    def _model_key(self, model: str, key: tuple) -> tuple:
+        """Dispatcher keys gain the model dimension (round 15): batches
+        only group within one model.  Default-model keys stay EXACTLY
+        the pre-round-15 tuples — tests, embedders, and the warmup loop
+        keep their shapes — and _dispatch_inner strips a leading served
+        model name back off."""
+        return key if model == self.weights.default else (model, *key)
+
     # ---------------------------------------------------------- device side
 
     @contextlib.contextmanager
@@ -514,10 +730,20 @@ class DeconvService:
         if act is not None:
             time.sleep((act.param or 100.0) / 1e3)
         faults_mod.raise_if_armed("device.dispatch_error", where=lane)
+        # Per-request model routing (round 15): a non-default model rides
+        # as the key's HEAD (so batches only ever group within one
+        # model); bare keys — every pre-round-15 caller, warmup, tests —
+        # are the default model's.  Model names and layer/kind markers
+        # live in disjoint namespaces (registry names vs layer names /
+        # "__dream__"), so the head test is unambiguous.
+        model = self.weights.default
+        if key and key[0] in self.weights.served:
+            model, key = key[0], tuple(key[1:])
+        bundle = self.weights.bundle(model)
         if key[0] == "__dream__":
-            return self._dispatch_dream(key, images, lane)
+            return self._dispatch_dream(model, bundle, key, images, lane)
         if key[0] == "__dream_octave__":
-            return self._dispatch_dream_octave(key, images, lane)
+            return self._dispatch_dream_octave(model, bundle, key, images, lane)
         # 4-tuple: single-layer (the default); 5-tuple adds sweep=True
         layer_name, mode, top_k, post, *rest = key
         sweep = bool(rest[0]) if rest else False
@@ -525,19 +751,13 @@ class DeconvService:
         # the visualizer program: one device dispatch per batch instead of
         # two, the fp32 projections never round-trip HBM between programs,
         # and only uint8 crosses to the host.
-        fn = self.bundle.batched_visualizer(
+        fn = bundle.batched_visualizer(
             layer_name, mode, top_k, self.cfg.bug_compat,
             self.cfg.backward_dtype or None, post, sweep,
             donate=self.cfg.donate_inputs, lane=lane,
             lowc_kpack=self.cfg.lowc_kpack,
         )
         bucket = self._bucket_for(len(images))
-        # Assemble the padded batch into a reusable input-ring buffer
-        # (released after materialise — device execution complete), and
-        # DONATE the device copy into the program: the device reuses the
-        # input's memory for outputs instead of holding both live, while
-        # the next batch stages into a different ring slot.
-        batch = self.input_ring.assemble(images, bucket)
         # cfg.dtype is the forward/selection dtype (the engine follows the
         # input dtype).  float32 is the parity-safe default; bfloat16 trades
         # seed/switch exactness for throughput (+4.3% measured, round 4c)
@@ -547,10 +767,28 @@ class DeconvService:
         fwd_dtype = (
             jnp.bfloat16 if self.cfg.dtype == "bfloat16" else jnp.float32
         )
-        out_all = fn(
-            self.bundle.lane_params(lane),
-            self._stage_batch(batch, fwd_dtype, lane),
-        )
+        # checkout pages the model's weights into this lane's HBM if
+        # cold (one coalesced transfer per (model, lane)) and PINS them
+        # against eviction until the results are materialised — BEFORE
+        # the ring slot is claimed, so a failed page-in leaks nothing
+        params, page_s = self.weights.checkout(model, lane)
+        # Assemble the padded batch into a reusable input-ring buffer
+        # (released after materialise — device execution complete), and
+        # DONATE the device copy into the program: the device reuses the
+        # input's memory for outputs instead of holding both live, while
+        # the next batch stages into a different ring slot.
+        batch = None
+        try:
+            batch = self.input_ring.assemble(images, bucket)
+            out_all = fn(
+                params,
+                self._stage_batch(bundle, batch, fwd_dtype, lane),
+            )
+        except BaseException:
+            self.weights.release(model, lane)
+            if batch is not None:
+                self.input_ring.release(batch)
+            raise
         n = len(images)
 
         def materialise():
@@ -627,12 +865,19 @@ class DeconvService:
                 ]
             finally:
                 # results fetched => device execution done; the staging
-                # buffer can rejoin the ring
+                # buffer can rejoin the ring and the weight pin drop
                 self.input_ring.release(batch)
+                self.weights.release(model, lane)
 
+        if page_s:
+            # span attribution (round 15): the batcher stamps a
+            # weight_page_in span on every member request's trace from
+            # these thunk attributes
+            materialise.page_in_s = page_s
+            materialise.page_model = model
         return materialise
 
-    def _stage_batch(self, batch: np.ndarray, dtype, lane: int):
+    def _stage_batch(self, bundle, batch: np.ndarray, dtype, lane: int):
         """Host staging buffer -> the device array one dispatch consumes.
         Without lanes: the default-device jnp.asarray the program always
         used.  With lanes: cast on the host (ml_dtypes covers bfloat16)
@@ -643,7 +888,7 @@ class DeconvService:
         import jax
         import jax.numpy as jnp
 
-        pl = self.bundle.lane_placement(lane)
+        pl = bundle.lane_placement(lane)
         if pl is None:
             return jnp.asarray(batch, dtype=dtype)
         host = np.asarray(batch, dtype=dtype)
@@ -653,11 +898,13 @@ class DeconvService:
             return host
         return jax.device_put(host, pl)
 
-    def _dispatch_dream(self, key, images: list[np.ndarray], lane: int = 0):
+    def _dispatch_dream(
+        self, model, bundle, key, images: list[np.ndarray], lane: int = 0
+    ):
         from deconv_api_tpu.engine import deepdream_batch
 
         _, layers, steps, octaves, lr = key
-        fwd = self.bundle.dream_forward(layers)
+        fwd = bundle.dream_forward(layers)
         # Concurrent dreams with the same config ride ONE octave pyramid:
         # per-image gradient normalisation keeps them independent while the
         # device sees a single batched conv chain per ascent step.  Pad to
@@ -667,37 +914,50 @@ class DeconvService:
         # octave programs run dp-sharded (VERDICT r2: dreams previously
         # used 1 chip while the deconv path used all of them).
         bucket = self._round_to_dp(pad_bucket(len(images), self.cfg.dream_max_batch))
-        batch = self.input_ring.assemble(
-            [np.asarray(img) for img in images], bucket
-        )
-        # lane placement (round 10): the octave programs follow their
-        # committed inputs — a device lane pins the whole ascent to its
-        # chip, a mesh-slice lane runs it dp-sharded over the slice.
-        lane_pl = self.bundle.lane_placement(lane)
-        lane_mesh = None
-        if lane_pl is not None:
-            from jax.sharding import Mesh
+        # page in (and pin) BEFORE the ring slot is claimed — a failed
+        # page-in must leak nothing
+        params, page_s = self.weights.checkout(model, lane)
+        try:
+            batch = self.input_ring.assemble(
+                [np.asarray(img) for img in images], bucket
+            )
+        except BaseException:
+            self.weights.release(model, lane)
+            raise
+        try:
+            # lane placement (round 10): the octave programs follow
+            # their committed inputs — a device lane pins the whole
+            # ascent to its chip, a mesh-slice lane runs it dp-sharded
+            # over the slice.
+            lane_pl = bundle.lane_placement(lane)
+            lane_mesh = None
+            if lane_pl is not None:
+                from jax.sharding import Mesh
 
-            if isinstance(lane_pl, Mesh):
-                lane_mesh = lane_pl
-        mesh = self.mesh if self.mesh is not None else lane_mesh
-        staged = batch
-        if lane_pl is not None and lane_mesh is None:
-            import jax
+                if isinstance(lane_pl, Mesh):
+                    lane_mesh = lane_pl
+            mesh = self.mesh if self.mesh is not None else lane_mesh
+            staged = batch
+            if lane_pl is not None and lane_mesh is None:
+                import jax
 
-            staged = jax.device_put(batch, lane_pl)
-        out, losses = deepdream_batch(
-            fwd,
-            self.bundle.lane_params(lane),
-            staged,
-            layers=layers,
-            steps_per_octave=steps,
-            num_octaves=octaves,
-            lr=lr,
-            min_size=self.bundle.min_dream_size,
-            mesh=mesh,
-            donate=self.cfg.donate_inputs and mesh is None,
-        )
+                staged = jax.device_put(batch, lane_pl)
+            out, losses = deepdream_batch(
+                fwd,
+                params,
+                staged,
+                layers=layers,
+                steps_per_octave=steps,
+                num_octaves=octaves,
+                lr=lr,
+                min_size=bundle.min_dream_size,
+                mesh=mesh,
+                donate=self.cfg.donate_inputs and mesh is None,
+            )
+        except BaseException:
+            self.weights.release(model, lane)
+            self.input_ring.release(batch)
+            raise
         n = len(images)
 
         def materialise():
@@ -708,10 +968,16 @@ class DeconvService:
                 return [{"image": o[i], "loss": float(ls[i])} for i in range(n)]
             finally:
                 self.input_ring.release(batch)
+                self.weights.release(model, lane)
 
+        if page_s:
+            materialise.page_in_s = page_s
+            materialise.page_model = model
         return materialise
 
-    def _dispatch_dream_octave(self, key, images: list, lane: int = 0):
+    def _dispatch_dream_octave(
+        self, model, bundle, key, images: list, lane: int = 0
+    ):
         """ONE checkpointable dream octave as a single device dispatch
         (round 11 job runner).  ``images`` entries are ``(x, base)``
         pairs — the evolving dream at the previous octave's resolution
@@ -720,18 +986,19 @@ class DeconvService:
         ``make_octave_runner`` fused form, walking exactly the
         ``octave_shapes`` ladder the whole-dream program uses, so the
         checkpointed walk cannot drift from the fused one.  Keyed by
-        (layers, steps, lr, ladder, octave index): concurrent jobs at
-        the same octave of the same config batch into one dispatch."""
+        (model, layers, steps, lr, ladder, octave index): concurrent
+        jobs at the same octave of the same config batch into one
+        dispatch."""
         import jax
         import numpy as np_mod
 
         from deconv_api_tpu.engine.deepdream import make_octave_runner
 
         _, layers, steps, lr, shapes, i = key
-        fwd = self.bundle.dream_forward(layers)
+        fwd = bundle.dream_forward(layers)
         out_hw = shapes[i]
         prev_hw = shapes[i - 1] if i > 0 else None
-        lane_pl = self.bundle.lane_placement(lane)
+        lane_pl = bundle.lane_placement(lane)
         lane_mesh = None
         if lane_pl is not None:
             from jax.sharding import Mesh
@@ -761,12 +1028,25 @@ class DeconvService:
         if lane_pl is not None and lane_mesh is None:
             xs = jax.device_put(xs, lane_pl)
             bases = jax.device_put(bases, lane_pl)
-        out, losses = fn(self.bundle.lane_params(lane), xs, bases)
+        params, page_s = self.weights.checkout(model, lane)
+        try:
+            out, losses = fn(params, xs, bases)
+        except BaseException:
+            self.weights.release(model, lane)
+            raise
 
         def materialise():
-            o, ls = jax.device_get((out, losses))  # one host transfer
-            return [{"image": o[j], "loss": float(ls[j])} for j in range(n)]
+            try:
+                o, ls = jax.device_get((out, losses))  # one host transfer
+                return [
+                    {"image": o[j], "loss": float(ls[j])} for j in range(n)
+                ]
+            finally:
+                self.weights.release(model, lane)
 
+        if page_s:
+            materialise.page_in_s = page_s
+            materialise.page_model = model
         return materialise
 
     def _round_to_dp(self, bucket: int) -> int:
@@ -801,67 +1081,95 @@ class DeconvService:
         persistent compile cache attacks: warm restarts skip the
         per-bucket-per-lane compile tax entirely.
         `warmup_all_buckets=False` restores the fast single-bucket warmup
-        (tests, dev loops)."""
+        (tests, dev loops).
+
+        Multi-model (round 15): EVERY PINNED model is paged in and
+        compile-warmed here — the pin list is exactly the set whose
+        first request must never pay a page-in or compile.  On-demand
+        served models deliberately stay cold (their first request's
+        latency is the documented cost, visible as weight_page_in).
+        The dream/sweep programs are warmed for the DEFAULT model only
+        (they are opt-in warmups and per-model dream ladders multiply
+        the compile tax; docs/OPERATIONS.md "Serving multiple
+        backbones")."""
         t_start = time.perf_counter()
-        names = self.bundle.layer_names
-        layer = layer_name
-        if layer is None or layer not in names:
-            # flagship layer if present, else the middle of the stack
-            layer = (
-                "block5_conv1"
-                if "block5_conv1" in names
-                else names[len(names) // 2]
-            )
-        img = np.zeros((self.cfg.image_size, self.cfg.image_size, 3), np.float32)
         if self.cfg.warmup_all_buckets:
             sizes = sorted({self._bucket_for(n) for n in range(1, self.cfg.max_batch + 1)})
         else:
             sizes = [self._bucket_for(1)]
-        # both route defaults, so /ready implies neither pays a first-hit
-        # compile: POST / uses (stitch_k, grid), /v1/deconv (top_k, tiles)
-        for lane in range(self.lane_count):
-            for size in sizes:
-                self._run_batch(
-                    (layer, self.cfg.visualize_mode, self.cfg.stitch_k, "grid"),
-                    [img] * size, lane=lane,
+        for m_name in self.weights.pinned:
+            bundle = self.weights.bundle(m_name)
+            names = bundle.layer_names
+            layer = layer_name
+            if layer is None or layer not in names:
+                # flagship layer if present, else the middle of the stack
+                layer = (
+                    "block5_conv1"
+                    if "block5_conv1" in names
+                    else names[len(names) // 2]
                 )
-                self._run_batch(
-                    (layer, self.cfg.visualize_mode, self.cfg.top_k, "tiles"),
-                    [img] * size, lane=lane,
-                )
-            if self.cfg.warmup_sweep:
-                # the sweep program is ~15x a single-layer request;
-                # compiling it here keeps the first sweep request out of
-                # its own sweep_timeout_s window
-                self._run_batch(
-                    (layer, self.cfg.visualize_mode, self.cfg.top_k,
-                     "tiles", True),
-                    [img] * self._bucket_for(1), lane=lane,
-                )
-            if self.cfg.warmup_dream and self.bundle.dream_layers:
-                # the whole-dream program (r5: one executable per octave
-                # ladder) is the route's largest compile; warm the DEFAULT
-                # request shape (the shared _DREAM_DEFAULTS the route uses)
-                # so first dreams serve inside their window — every dream
-                # bucket under warmup_all_buckets, else just the first
-                if self.cfg.warmup_all_buckets:
-                    dream_sizes = sorted(
-                        {
-                            self._round_to_dp(pad_bucket(n, self.cfg.dream_max_batch))
-                            for n in range(1, self.cfg.dream_max_batch + 1)
-                        }
-                    )
-                else:
-                    dream_sizes = [self._round_to_dp(pad_bucket(1, self.cfg.dream_max_batch))]
-                for size in dream_sizes:
+            size_px = self._model_image_size(bundle)
+            img = np.zeros((size_px, size_px, 3), np.float32)
+            is_default = m_name == self.weights.default
+            # both route defaults, so /ready implies neither pays a
+            # first-hit compile: POST / uses (stitch_k, grid),
+            # /v1/deconv (top_k, tiles)
+            for lane in range(self.lane_count):
+                for size in sizes:
                     self._run_batch(
-                        (
-                            "__dream__", self.bundle.dream_layers,
-                            _DREAM_DEFAULTS["steps"], _DREAM_DEFAULTS["octaves"],
-                            _DREAM_DEFAULTS["lr"],
+                        self._model_key(
+                            m_name,
+                            (layer, self.cfg.visualize_mode,
+                             self.cfg.stitch_k, "grid"),
                         ),
                         [img] * size, lane=lane,
                     )
+                    self._run_batch(
+                        self._model_key(
+                            m_name,
+                            (layer, self.cfg.visualize_mode,
+                             self.cfg.top_k, "tiles"),
+                        ),
+                        [img] * size, lane=lane,
+                    )
+                if self.cfg.warmup_sweep and is_default:
+                    # the sweep program is ~15x a single-layer request;
+                    # compiling it here keeps the first sweep request out
+                    # of its own sweep_timeout_s window
+                    self._run_batch(
+                        (layer, self.cfg.visualize_mode, self.cfg.top_k,
+                         "tiles", True),
+                        [img] * self._bucket_for(1), lane=lane,
+                    )
+                if (
+                    self.cfg.warmup_dream
+                    and is_default
+                    and bundle.dream_layers
+                ):
+                    # the whole-dream program (r5: one executable per
+                    # octave ladder) is the route's largest compile; warm
+                    # the DEFAULT request shape (the shared
+                    # _DREAM_DEFAULTS the route uses) so first dreams
+                    # serve inside their window — every dream bucket
+                    # under warmup_all_buckets, else just the first
+                    if self.cfg.warmup_all_buckets:
+                        dream_sizes = sorted(
+                            {
+                                self._round_to_dp(pad_bucket(n, self.cfg.dream_max_batch))
+                                for n in range(1, self.cfg.dream_max_batch + 1)
+                            }
+                        )
+                    else:
+                        dream_sizes = [self._round_to_dp(pad_bucket(1, self.cfg.dream_max_batch))]
+                    for size in dream_sizes:
+                        self._run_batch(
+                            (
+                                "__dream__", bundle.dream_layers,
+                                _DREAM_DEFAULTS["steps"], _DREAM_DEFAULTS["octaves"],
+                                _DREAM_DEFAULTS["lr"],
+                            ),
+                            [img] * size, lane=lane,
+                        )
         # ACCUMULATED across calls: drivers that warm several layers
         # (loopback --heavy warms one per request-nameable layer) must
         # report the process's total compile tax, not the last slice
@@ -872,15 +1180,19 @@ class DeconvService:
 
     # ----------------------------------------------------------- pipeline
 
-    def _decode_preprocess(self, file_uri: str) -> np.ndarray:
+    def _decode_preprocess(self, file_uri: str, bundle=None) -> np.ndarray:
         """data-URI -> preprocessed model input; runs on a codec-pool
-        worker, never on the event loop."""
+        worker, never on the event loop.  ``bundle`` selects the target
+        model's resize + preprocess (round 15); default model otherwise."""
+        if bundle is None:
+            bundle = self.bundle
         try:
             img = codec.decode_data_url(file_uri)
         except codec.CodecError as e:
             raise errors.InvalidImage(str(e)) from e
-        img = codec.resize224(img, (self.cfg.image_size, self.cfg.image_size))
-        return self.bundle.preprocess(img)
+        size = self._model_image_size(bundle)
+        img = codec.resize224(img, (size, size))
+        return bundle.preprocess(img)
 
     async def _project(
         self,
@@ -892,6 +1204,7 @@ class DeconvService:
         deadline: float | None = None,
         tenant: str = "",
         tclass: str = "",
+        model: str | None = None,
     ):
         if not self.ready:
             # Pre-warmup requests would silently pay a full XLA compile
@@ -901,12 +1214,14 @@ class DeconvService:
             raise errors.ModelNotReady(
                 "model executables are still compiling; poll /ready"
             )
+        model = model or self.weights.default
+        bundle = await self._bundle_async(model)
         file_uri = form.get("file")
         layer = form.get("layer")
         if not file_uri or not layer:
             raise errors.BadRequest("form fields 'file' and 'layer' are required")
         try:
-            self.bundle.check_layer(layer)
+            bundle.check_layer(layer)
         except ValueError as e:
             raise errors.UnknownLayer(str(e)) from None
 
@@ -918,19 +1233,25 @@ class DeconvService:
             # the decode stage falls behind; small payloads decode inline
             # (the handoff costs more than the decode).
             if len(file_uri) <= self.cfg.codec_inline_bytes:
-                x = self._decode_preprocess(file_uri)
+                x = self._decode_preprocess(file_uri, bundle)
             else:
-                x = await self.codec_pool.run(self._decode_preprocess, file_uri)
+                x = await self.codec_pool.run(
+                    self._decode_preprocess, file_uri, bundle
+                )
 
         if sweep:
             with stage(self.sweep_metrics, "compute"):
                 return await self.sweep_dispatcher.submit(
-                    x, (layer, mode, top_k, post, True), deadline=deadline,
+                    x,
+                    self._model_key(model, (layer, mode, top_k, post, True)),
+                    deadline=deadline,
                     tenant=tenant, tclass=tclass,
                 )
         with stage(self.metrics, "compute"):
             return await self.dispatcher.submit(
-                x, (layer, mode, top_k, post), deadline=deadline,
+                x,
+                self._model_key(model, (layer, mode, top_k, post)),
+                deadline=deadline,
                 tenant=tenant, tclass=tclass,
             )
 
@@ -1014,6 +1335,13 @@ class DeconvService:
             code = (
                 errors.code_from_body(resp.body) if resp.status >= 400 else None
             )
+            if req.model:
+                # backstop for resolutions that happened OFF the loop
+                # (cache+singleflight disabled routes resolve inside a
+                # codec worker, where the trace contextvar is absent) —
+                # the ?model= flight-recorder filter must see every
+                # request (round 15)
+                tr.annotate(model=req.model)
             tr.finish(
                 status=resp.status,
                 error=code,
@@ -1055,6 +1383,9 @@ class DeconvService:
             # round 13: "which tenant is slow" straight off the flight
             # recorder — filters on the admission wrap's annotation
             tenant=req.query.get("tenant") or None,
+            # round 15: "is it only vgg19 requests" — filters on the
+            # model-resolution annotation
+            model=req.query.get("model") or None,
             limit=max(1, min(limit, 10 * max(1, self.cfg.trace_ring))),
         )
         return Response.json(
@@ -1164,17 +1495,33 @@ class DeconvService:
         stats."""
         if self.cache is None and self.flights is None:
             return handler
-        prefix = f"{self._cache_prefix}|{route}"
 
         async def cached(req: Request) -> Response:
             t0 = time.perf_counter()
             tr = trace_mod.current_trace()
             cc = req.headers.get("cache-control", "").lower()
             bypass = "no-cache" in cc or "no-store" in cc
+            # Per-request model routing (round 15): the RESOLVED model
+            # rides the key's prefix and the raw `model` field is
+            # excluded from the field digest — model=<default> explicit,
+            # x-model: <default>, and a bare request all hash to ONE
+            # key.  An unknown name 422s here, before any flight/decode.
+            try:
+                model = self._resolve_model(req)
+            except errors.DeconvError as e:
+                metrics.observe_request(time.perf_counter() - t0, e.code)
+                return _error_response(e, req.id)
+            mprefix = self._prefix_cache.get(model)
+            if mprefix is None:
+                # first request for a cold model: the bundle build
+                # (weight init + checkpoint) runs off the event loop
+                mprefix = await asyncio.to_thread(self._model_prefix, model)
+            prefix = f"{mprefix}|{route}"
             # passing req shares the memoized form parse with the handler:
             # one parse per request, key derivation included
             key = canonical_digest(
-                prefix, req.headers.get("content-type", ""), req.body, req=req
+                prefix, req.headers.get("content-type", ""), req.body,
+                req=req, exclude=("model",),
             )
             if self.cache is not None and not bypass:
                 charge = None
@@ -1429,6 +1776,11 @@ class DeconvService:
                 "total": self.lane_pool.size,
                 "accepting": self.lane_pool.accepting_count(),
             }
+        if len(self.weights.served) > 1:
+            # multi-model serving (round 15): which models answer WARM
+            # right now, straight off the probe — a router/pin dashboard
+            # reads residency without /v1/config
+            body["models"] = self.weights.ready_block()
         if self.jobs is not None:
             # operators (and the drain runbook) read the park/queue
             # picture straight off the readiness probe
@@ -1493,6 +1845,11 @@ class DeconvService:
             cfg[key] = bool(cfg[key])
         cfg["mesh_active"] = self.mesh is not None
         cfg["model_active"] = self.bundle.name
+        # multi-model serving (round 15): the served/pinned sets, the
+        # weight tier, and LIVE per-lane residency + page accounting —
+        # the one place an operator confirms "which models does this
+        # process answer, which are warm, how full is the budget"
+        cfg["weights"] = self.weights.snapshot()
         # Low-channel backward-tail packing (round 12): the channel
         # threshold the POLICY resolves to — 0 when the policy is off OR
         # the active model is a DAG backbone (the vjp walk has no packed
@@ -1574,6 +1931,9 @@ class DeconvService:
         info = registry_info()
         for entry in info:
             entry["active"] = entry["model"] == self.bundle.name
+            # round 15: which registry entries THIS process answers
+            # per-request (model= / x-model) — clients pick from these
+            entry["served"] = entry["model"] in self.weights.served
         # injected specs (tests/embedding) are not in the registry; surface
         # the live bundle so discovery is never empty or wrong
         if not any(e["active"] for e in info):
@@ -1587,6 +1947,7 @@ class DeconvService:
                     "layers": list(self.bundle.layer_names),
                     "dream_layers": list(self.bundle.dream_layers),
                     "active": True,
+                    "served": True,
                 }
             )
         return Response.json({"models": info})
@@ -1644,19 +2005,31 @@ class DeconvService:
                     raise errors.BadRequest(
                         "form fields 'file' and 'layer' are required"
                     )
+                # model resolution (round 15): memoized on the request —
+                # the cache wrap usually resolved it already; with the
+                # cache off this worker-side call does (a cold bundle
+                # build then rides this codec worker, off the loop)
+                model = self._resolve_model(req, form)
+                bundle = self.weights.bundle(model)
                 try:
-                    self.bundle.check_layer(layer)
+                    bundle.check_layer(layer)
                 except ValueError as e:
                     raise errors.UnknownLayer(str(e)) from None
-                return layer, self._decode_preprocess(file_uri)
+                return model, layer, self._decode_preprocess(file_uri, bundle)
 
             with stage(self.metrics, "decode"):
                 if len(req.body) <= self.cfg.codec_inline_bytes:
                     # small payload: the pool handoff (two loop hops +
-                    # worker wakeup) costs more than the decode itself
-                    layer, x = parse_decode()
+                    # worker wakeup) costs more than the decode itself.
+                    # A COLD model's bundle build (weight init + h5
+                    # load, potentially seconds) must still ride a
+                    # thread — only the parse/decode runs inline.
+                    m = self._resolve_model(req, _parse_form(req))
+                    if self.weights.peek_bundle(m) is None:
+                        await self._bundle_async(m)
+                    model, layer, x = parse_decode()
                 else:
-                    layer, x = await self.codec_pool.run(parse_decode)
+                    model, layer, x = await self.codec_pool.run(parse_decode)
             # The reference ranks top-8 but serves tiles [0..3] (SURVEY
             # §2.2.3/§2.2.4): the top-4 of 8 ARE the top-4, so computing
             # stitch_k projections halves the backward work; the grid is
@@ -1664,7 +2037,11 @@ class DeconvService:
             with stage(self.metrics, "compute"):
                 result = await self.dispatcher.submit(
                     x,
-                    (layer, self.cfg.visualize_mode, self.cfg.stitch_k, "grid"),
+                    self._model_key(
+                        model,
+                        (layer, self.cfg.visualize_mode,
+                         self.cfg.stitch_k, "grid"),
+                    ),
                     deadline=req.deadline,
                     tenant=req.tenant, tclass=req.tclass,
                 )
@@ -1714,6 +2091,7 @@ class DeconvService:
         t0 = time.perf_counter()
         try:
             form = _parse_form(req)
+            model = self._resolve_model(req, form)
             mode, top_k = self._deconv_params(form)
             sweep = form.get("sweep", "").lower() in ("1", "true", "yes", "on")
             if sweep:
@@ -1724,7 +2102,7 @@ class DeconvService:
                 result = await self._project(
                     form, mode, top_k, "tiles", sweep=True,
                     deadline=req.deadline,
-                    tenant=req.tenant, tclass=req.tclass,
+                    tenant=req.tenant, tclass=req.tclass, model=model,
                 )
                 with stage(self.metrics, "encode"):
                     names = list(result)
@@ -1742,7 +2120,7 @@ class DeconvService:
                 )
             result = await self._project(
                 form, mode, top_k, "tiles", deadline=req.deadline,
-                tenant=req.tenant, tclass=req.tclass,
+                tenant=req.tenant, tclass=req.tclass, model=model,
             )
             with stage(self.metrics, "encode"):
                 payload = await self._encode_tiles_pooled(result)
@@ -1758,18 +2136,21 @@ class DeconvService:
         )
 
     def _dream_params(
-        self, form: dict[str, str]
+        self, form: dict[str, str], bundle=None
     ) -> tuple[tuple[str, ...], int, int, float]:
         """Validate a dream request's knobs — the ONE rule set shared by
         the synchronous /v1/dream route and POST /v1/jobs dream
         submission (round 11), so the async tier can never accept a
-        config the sync tier would reject."""
+        config the sync tier would reject.  ``bundle`` selects the
+        target model's default dream layers (round 15)."""
+        if bundle is None:
+            bundle = self.bundle
         layers = tuple(
             s for s in form.get("layers", "").split(",") if s
-        ) or self.bundle.dream_layers
+        ) or bundle.dream_layers
         if not layers:
             raise errors.BadRequest(
-                f"model {self.bundle.name!r} has no default dream layers; "
+                f"model {bundle.name!r} has no default dream layers; "
                 "pass 'layers' explicitly"
             )
         steps = int(form.get("steps", _DREAM_DEFAULTS["steps"]))
@@ -1797,26 +2178,30 @@ class DeconvService:
                     "model executables are still compiling; poll /ready"
                 )
             form = _parse_form(req)
+            model = self._resolve_model(req, form)
+            bundle = await self._bundle_async(model)
             file_uri = form.get("file")
             if not file_uri:
                 raise errors.BadRequest("form field 'file' is required")
-            layers, steps, octaves, lr = self._dream_params(form)
+            layers, steps, octaves, lr = self._dream_params(form, bundle)
             def decode():
                 try:
                     img = codec.decode_data_url(file_uri)
                 except codec.CodecError as e:
                     raise errors.InvalidImage(str(e)) from e
-                img = codec.resize224(
-                    img, (self.cfg.image_size, self.cfg.image_size)
-                )
-                return self.bundle.preprocess(img)
+                size = self._model_image_size(bundle)
+                img = codec.resize224(img, (size, size))
+                return bundle.preprocess(img)
 
             with stage(self.dream_metrics, "decode"):
                 x = await self.codec_pool.run(decode)
             with stage(self.dream_metrics, "compute"):
                 try:
                     result = await self.dream_dispatcher.submit(
-                        x, ("__dream__", layers, steps, octaves, lr),
+                        x,
+                        self._model_key(
+                            model, ("__dream__", layers, steps, octaves, lr)
+                        ),
                         deadline=req.deadline,
                         tenant=req.tenant, tclass=req.tclass,
                     )
@@ -1825,7 +2210,7 @@ class DeconvService:
             with stage(self.dream_metrics, "encode"):
                 data_url = await self.codec_pool.run(
                     lambda: codec.encode_data_url(
-                        self.bundle.unpreprocess(result["image"])
+                        bundle.unpreprocess(result["image"])
                     )
                 )
         except errors.DeconvError as e:
@@ -1957,6 +2342,20 @@ class DeconvService:
         finally:
             job._trace = None
 
+    def _job_model(self, job):
+        """The (model name, bundle) a journaled job targets (round 15):
+        jobs journal their model at submit, so a resume after restart
+        dispatches against the same backbone.  A journaled model no
+        longer in the served set is a DETERMINISTIC failure — retrying
+        cannot heal a config change."""
+        name = job.params.get("model") or self.weights.default
+        if name not in self.weights.served:
+            raise errors.DeconvError(
+                f"job {job.id} targets model {name!r}, no longer in the "
+                f"served set {sorted(self.weights.served)}"
+            )
+        return name, self.weights.bundle(name)
+
     @staticmethod
     def _job_input(ckpts, load):
         """The decoded input image out of a job's checkpoint chain (it
@@ -1983,16 +2382,17 @@ class DeconvService:
         from deconv_api_tpu.serving.jobs import Checkpoint, Result
 
         p = job.params
+        model, bundle = self._job_model(job)
         layers = tuple(
             s for s in p.get("layers", "").split(",") if s
-        ) or self.bundle.dream_layers
+        ) or bundle.dream_layers
         steps = int(p.get("steps", _DREAM_DEFAULTS["steps"]))
         octaves = int(p.get("octaves", _DREAM_DEFAULTS["octaves"]))
         lr = float(p.get("lr", _DREAM_DEFAULTS["lr"]))
         base = self._job_input(ckpts, load)
         h, w = base.shape[:2]
         shapes = octave_shapes(
-            h, w, octaves, min_size=self.bundle.min_dream_size
+            h, w, octaves, min_size=bundle.min_dream_size
         )
         start, x, loss = 0, base, None
         last_rec = None
@@ -2012,7 +2412,10 @@ class DeconvService:
                     job,
                     self.dream_dispatcher,
                     (np.asarray(x), np.asarray(base)),
-                    ("__dream_octave__", layers, steps, lr, shapes, i),
+                    self._model_key(
+                        model,
+                        ("__dream_octave__", layers, steps, lr, shapes, i),
+                    ),
                 )
             except KeyError as e:
                 # unknown dream activation surfaces at trace time — a
@@ -2026,9 +2429,7 @@ class DeconvService:
                 meta={"loss": loss, "hw": list(shapes[i])},
             )
         data_url = await self.codec_pool.run(
-            lambda: codec.encode_data_url(
-                self.bundle.unpreprocess(np.asarray(x))
-            )
+            lambda: codec.encode_data_url(bundle.unpreprocess(np.asarray(x)))
         )
         body = json.dumps(
             {
@@ -2051,6 +2452,7 @@ class DeconvService:
         from deconv_api_tpu.serving.jobs import Checkpoint, Result
 
         p = job.params
+        model, bundle = self._job_model(job)
         layer = p["layer"]
         mode = p.get("mode", self.cfg.visualize_mode)
         top_k = int(p.get("top_k", self.cfg.top_k))
@@ -2061,14 +2463,14 @@ class DeconvService:
                 payload = load(rec)
                 if payload is not None and "name" in payload:
                     done[payload["name"]] = payload["entry"]
-        names = self.bundle.sweep_layers(layer)
+        names = bundle.sweep_layers(layer)
         for i, name in enumerate(names):
             if name in done:
                 continue
             faults_mod.raise_if_armed("jobs.runner_crash")
             result = await self._job_dispatch(
                 job, self.sweep_dispatcher, np.asarray(x),
-                (name, mode, top_k, "tiles"),
+                self._model_key(model, (name, mode, top_k, "tiles")),
             )
             entry = await self._encode_tiles_pooled(result)
             done[name] = entry
@@ -2093,13 +2495,15 @@ class DeconvService:
         from deconv_api_tpu.serving.jobs import Result
 
         p = job.params
+        model, bundle = self._job_model(job)
         layer = p["layer"]
         mode = p.get("mode", self.cfg.visualize_mode)
         top_k = int(p.get("top_k", self.cfg.top_k))
         x = self._job_input(ckpts, load)
         faults_mod.raise_if_armed("jobs.runner_crash")
         result = await self._job_dispatch(
-            job, self.dispatcher, np.asarray(x), (layer, mode, top_k, "tiles")
+            job, self.dispatcher, np.asarray(x),
+            self._model_key(model, (layer, mode, top_k, "tiles")),
         )
         payload = await self._encode_tiles_pooled(result)
         body = json.dumps({"layer": layer, "mode": mode, **payload}).encode()
@@ -2124,25 +2528,34 @@ class DeconvService:
                 raise errors.BadRequest(
                     f"type must be deconv, dream or sweep, got {kind!r}"
                 )
+            # per-request model (round 15): journaled with the job so a
+            # resume after restart re-dispatches against the SAME
+            # backbone regardless of the process's default
+            model = self._resolve_model(req, form)
+            bundle = await self._bundle_async(model)
             file_uri = form.get("file")
             if not file_uri:
                 raise errors.BadRequest("form field 'file' is required")
             if kind == "dream":
-                layers, steps, octaves, lr = self._dream_params(form)
+                layers, steps, octaves, lr = self._dream_params(form, bundle)
                 params = {
                     "layers": ",".join(layers), "steps": str(steps),
                     "octaves": str(octaves), "lr": repr(lr),
+                    "model": model,
                 }
             else:
                 layer = form.get("layer")
                 if not layer:
                     raise errors.BadRequest("form field 'layer' is required")
                 try:
-                    self.bundle.check_layer(layer)
+                    bundle.check_layer(layer)
                 except ValueError as e:
                     raise errors.UnknownLayer(str(e)) from None
                 mode, top_k = self._deconv_params(form)
-                params = {"layer": layer, "mode": mode, "top_k": str(top_k)}
+                params = {
+                    "layer": layer, "mode": mode, "top_k": str(top_k),
+                    "model": model,
+                }
             idem = req.headers.get("x-idempotency-key", "")
             if idem and not trace_mod.RID_RE.match(idem):
                 raise errors.BadRequest(
@@ -2150,10 +2563,15 @@ class DeconvService:
                 )
             if not idem:
                 idem = canonical_digest(
-                    f"{self._cache_prefix}|jobs",
+                    # the model's OWN prefix (round 15): identical bodies
+                    # targeting different models must never dedup onto
+                    # one job; the raw `model` field is excluded exactly
+                    # like the response-cache key
+                    f"{self._model_prefix(model)}|jobs",
                     req.headers.get("content-type", ""),
                     req.body,
                     req=req,
+                    exclude=("model",),
                 )
             tenant = ""
             if self.qos is not None:
@@ -2183,7 +2601,7 @@ class DeconvService:
                         raise
                 with stage(self.metrics, "decode"):
                     x = await self.codec_pool.run(
-                        self._decode_preprocess, file_uri
+                        self._decode_preprocess, file_uri, bundle
                     )
                 deadline_ts = None
                 if req.deadline is not None:
@@ -2550,6 +2968,29 @@ def main(argv: list[str] | None = None) -> None:
         help="priority class for tenants with no explicit class",
     )
     p.add_argument(
+        "--serve-models", default=None, metavar="all|M1,M2",
+        help="registry models this process serves per-request "
+        "(model= form field / x-model header): 'all', a comma list, or "
+        "unset for the classic single-model server",
+    )
+    p.add_argument(
+        "--pinned-models", default=None, metavar="M1,M2",
+        help="models paged in + compile-warmed at boot and never "
+        "evicted (default: just --model); everything else served is "
+        "on-demand",
+    )
+    p.add_argument(
+        "--hbm-budget-bytes", type=int, default=None,
+        help="per-lane device-memory budget for resident model weights; "
+        "LRU page-out above it (0 = unlimited)",
+    )
+    p.add_argument(
+        "--weight-dtype", default=None, metavar="f32|bf16|int8",
+        help="stored weight precision in HBM: bf16 halves the bytes, "
+        "int8 quarters the kernels (f32 dequant-on-use; PSNR-bounded "
+        "fidelity — see docs/API.md)",
+    )
+    p.add_argument(
         "--peer-fill", action="store_true", default=None,
         help="fleet tier (round 14): honor the router's x-peer-fill "
         "hint on cache misses and serve GET /v1/internal/cache/{digest} "
@@ -2598,6 +3039,14 @@ def main(argv: list[str] | None = None) -> None:
         overrides["tenants"] = args.tenants
     if args.qos_default_class is not None:
         overrides["qos_default_class"] = args.qos_default_class
+    if args.serve_models is not None:
+        overrides["serve_models"] = args.serve_models
+    if args.pinned_models is not None:
+        overrides["pinned_models"] = args.pinned_models
+    if args.hbm_budget_bytes is not None:
+        overrides["hbm_budget_bytes"] = args.hbm_budget_bytes
+    if args.weight_dtype is not None:
+        overrides["weight_dtype"] = args.weight_dtype
     if args.peer_fill:
         overrides["fleet_peer_fill"] = True
     if args.host is not None:

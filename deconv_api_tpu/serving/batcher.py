@@ -1347,7 +1347,15 @@ class BatchingDispatcher:
             self._pool.record_done(
                 lane, True, time.perf_counter() - t0, len(items)
             )
-        self._resolve(items, results, t0, dispatched_at, lane)
+        self._resolve(
+            items, results, t0, dispatched_at, lane,
+            # weight page-in attribution (round 15): a cold-model
+            # dispatch tags its materialise thunk with the transfer
+            # wall so every member request's trace shows WHY this
+            # batch's dispatch span is fat
+            page_in_s=getattr(thunk, "page_in_s", None),
+            page_model=getattr(thunk, "page_model", None),
+        )
 
     def _resolve(
         self,
@@ -1356,6 +1364,8 @@ class BatchingDispatcher:
         t0: float,
         dispatched_at: float | None = None,
         lane: ExecutorLane | None = None,
+        page_in_s: float | None = None,
+        page_model: str | None = None,
     ) -> None:
         """Shared epilogue for both execution modes: metrics + futures.
         Cadence (interval between completions while more work is in
@@ -1408,6 +1418,14 @@ class BatchingDispatcher:
             if it.trace is not None:
                 it.trace.annotate(batch_id=bid, batch_size=len(items), lane=lane_ix)
                 it.trace.add_span("queue_wait", it.enqueued_at, t0 - it.enqueued_at)
+                if page_in_s:
+                    # the cold-model transfer this batch waited on
+                    # (round 15): starts at dispatch, rides inside the
+                    # dispatch wall the QoS meter charges
+                    it.trace.add_span(
+                        "weight_page_in", t0, page_in_s,
+                        model=page_model, lane=lane_ix,
+                    )
                 if dispatched_at is not None:
                     it.trace.add_span(
                         "dispatch", t0, dispatched_at - t0, batch_id=bid,

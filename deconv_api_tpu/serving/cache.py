@@ -73,7 +73,11 @@ ENTRY_OVERHEAD = 256
 
 
 def canonical_digest(
-    prefix: str, content_type: str, body: bytes, req: Request | None = None
+    prefix: str,
+    content_type: str,
+    body: bytes,
+    req: Request | None = None,
+    exclude: tuple[str, ...] = (),
 ) -> str:
     """Digest of the canonicalized request — the cache/singleflight key.
 
@@ -87,6 +91,13 @@ def canonical_digest(
     Pass the live ``req`` when there is one: ``Request.form()`` memoizes,
     so the parse done here is the SAME parse the route handler consumes
     on a miss — one form parse per request, not two.
+
+    ``exclude`` drops named fields from the canonical form (round 15:
+    the ``model`` field — its RESOLVED value already rides the prefix,
+    so ``model=vgg16`` explicit, ``x-model: vgg16``, and a bare default
+    request all hash to ONE key instead of fragmenting the hot set
+    three ways).  Only applies to parseable bodies; raw-bytes fallbacks
+    hash everything (they 400 deterministically anyway).
     """
     h = hashlib.blake2b(digest_size=20)
     h.update(prefix.encode())
@@ -107,6 +118,8 @@ def canonical_digest(
         # identically to a different multi-field one — a cache-poisoning
         # primitive.  len:bytes framing is injective.
         for k in sorted(fields):
+            if k in exclude:
+                continue
             for chunk in (k.encode("utf-8", "replace"),
                           fields[k].encode("utf-8", "replace")):
                 h.update(str(len(chunk)).encode())

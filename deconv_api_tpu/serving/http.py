@@ -79,6 +79,12 @@ class Request:
     # carry the same identity.  Empty while QoS is off.
     tenant: str = ""
     tclass: str = ""
+    # Resolved per-request model (round 15 multi-model serving): the
+    # validated ``model=`` form field / ``x-model`` header, or the
+    # server default.  Memoized by DeconvService._resolve_model so the
+    # cache wrap, the route handler, and the trace annotation all agree
+    # on one resolution per request.  Empty = not resolved yet.
+    model: str = ""
     # the admission Grant (accounting handle) the QoS wrap stashes so
     # the cache wrap can refund a hit's provisional device debit
     _qos_grant: object = field(default=None, repr=False, compare=False)

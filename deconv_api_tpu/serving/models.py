@@ -34,8 +34,18 @@ class ModelBundle:
     spec: object = None  # ModelSpec, set for sequential models
     mesh: object = None  # jax.sharding.Mesh — set by DeconvService when
     # cfg.mesh_shape is configured; visualizers then run dp-sharded
+    # Stored weight precision (round 15, serving/weight_manager.py):
+    # 'f32' (exact), 'bf16' (store bf16, cast on use) or 'int8'
+    # (per-tensor symmetric kernels, f32 dequant-on-use).  Set by the
+    # weight manager in managed mode; every params-consuming program
+    # this bundle builds then dequantises INSIDE its jitted trace, so
+    # HBM holds the quantized bytes and the f32 view is a temporary.
+    weight_dtype: str = "f32"
     _vis_cache: dict = dataclasses.field(default_factory=dict)
     _dream_cache: dict = dataclasses.field(default_factory=dict)
+    # stable dequant-wrapped DAG forward (octave programs jit-cache by
+    # forward identity — a fresh wrapper per call would recompile)
+    _forward_q: Callable | None = None
     # Executor lanes (round 10): one placement (Device, or a small dp
     # Mesh) and one param replica per lane, set once by set_lanes().
     # Empty = single-stream serving with the original params.
@@ -128,13 +138,34 @@ class ModelBundle:
                 f"known: {list(self.layer_names)}"
             )
 
+    def _wrap_weight_dtype(self, fwd):
+        """Compose a forward with in-program dequantisation when this
+        bundle stores a quantized weight tier (round 15).  Callers must
+        CACHE the result: the octave/dream jit caches key on forward
+        identity, so a fresh wrapper per request would recompile."""
+        if self.weight_dtype == "f32":
+            return fwd
+        from deconv_api_tpu.serving.weight_manager import dequantize_params
+
+        def fwd_q(params, x, *args, **kwargs):
+            return fwd(dequantize_params(params), x, *args, **kwargs)
+
+        return fwd_q
+
     def dream_forward(self, layers: tuple[str, ...]):
         """A resolution-robust forward for octave dreaming: DAG models
         as-is; sequential specs truncated below their flatten/dense head.
         Cached per layer set so repeated dream requests reuse the same
-        closure (and therefore the same jit cache)."""
+        closure (and therefore the same jit cache).  When the bundle
+        stores a quantized weight tier the cached forward dequantises
+        in-program (the wrapper identity is stable per bundle, so the
+        octave jit cache holds)."""
         if self.forward_fn is not None:
-            return self.forward_fn
+            if self.weight_dtype == "f32":
+                return self.forward_fn
+            if self._forward_q is None:
+                self._forward_q = self._wrap_weight_dtype(self.forward_fn)
+            return self._forward_q
         if layers not in self._dream_cache:
             from deconv_api_tpu.models.apply import spec_forward
 
@@ -150,7 +181,9 @@ class ModelBundle:
                     )
             names = self.spec.layer_names()
             deepest = max(layers, key=names.index)
-            self._dream_cache[layers] = spec_forward(self.spec.truncated(deepest))
+            self._dream_cache[layers] = self._wrap_weight_dtype(
+                spec_forward(self.spec.truncated(deepest))
+            )
         return self._dream_cache[layers]
 
     def batched_visualizer(
@@ -268,6 +301,18 @@ class ModelBundle:
                 else:
                     raw = lambda params, batch: {layer: vmapped(params, batch)}  # noqa: E731
 
+            if self.weight_dtype != "f32":
+                # quantized weight tier (round 15): the program consumes
+                # the STORED tree and dequantises inside its own trace —
+                # HBM holds bf16/int8 bytes, the f32 view is a temporary
+                from deconv_api_tpu.serving.weight_manager import (
+                    dequantize_params,
+                )
+
+                inner = raw
+                raw = lambda params, batch: inner(  # noqa: E731
+                    dequantize_params(params), batch
+                )
             fn = raw if post is None else _fuse_post(raw, post)
             if mesh is not None:
                 from deconv_api_tpu.parallel.batch import shard_batched_fn
@@ -320,6 +365,21 @@ def spec_bundle(
         dream_layers=dream_layers,
         forward_fn=None,
         spec=spec,
+    )
+
+
+def _vgg_tiny_bundle() -> ModelBundle:
+    """The CI/dry-run backbone (models/tiny.py) as a first-class registry
+    member (round 15): multi-model serving needs a backbone that builds
+    and compiles in seconds — warm-pool drills, fleet tests, and
+    paging-pressure experiments all run against it on CPU hosts.  No
+    pretrained weights exist (random init); it is a structural model,
+    not a semantic one, and fetch_weights deliberately has no entry."""
+    from deconv_api_tpu.models.tiny import vgg_tiny_init
+
+    spec, params = vgg_tiny_init()
+    return spec_bundle(
+        spec, params, dream_layers=("block2_conv2", "block3_conv1")
     )
 
 
@@ -432,6 +492,7 @@ REGISTRY: dict[str, Callable[[], ModelBundle]] = {
     "inception_v3": _inception_v3_bundle,
     "mobilenet_v1": _mobilenet_v1_bundle,
     "mobilenet_v2": _mobilenet_v2_bundle,
+    "vgg_tiny": _vgg_tiny_bundle,
 }
 
 
@@ -442,6 +503,7 @@ def registry_info() -> list[dict]:
     from deconv_api_tpu.models import mobilenet_v2 as mb2
     from deconv_api_tpu.models.inception_v3 import DREAM_LAYERS
     from deconv_api_tpu.models.resnet50 import DECONV_LAYERS
+    from deconv_api_tpu.models.tiny import VGG_TINY_SPEC as spec_tiny
     from deconv_api_tpu.models.vgg16 import VGG16_SPEC as spec
     from deconv_api_tpu.models.vgg19 import VGG19_SPEC as spec19
     return [
@@ -486,5 +548,14 @@ def registry_info() -> list[dict]:
             "engine": "autodiff-deconv (DAG, inverted residuals)",
             "layers": list(mb2.DECONV_LAYERS),
             "dream_layers": list(mb2.DREAM_LAYERS),
+        },
+        {
+            "model": "vgg_tiny",
+            "image_size": spec_tiny.input_shape[0],
+            "engine": "switch-deconv (sequential spec, CI-scale)",
+            "layers": [
+                l.name for l in spec_tiny.layers if l.kind != "input"
+            ],
+            "dream_layers": ["block2_conv2", "block3_conv1"],
         },
     ]

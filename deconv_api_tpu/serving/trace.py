@@ -281,14 +281,16 @@ class FlightRecorder:
         error: bool = False,
         trace_id: str | None = None,
         tenant: str | None = None,
+        model: str | None = None,
         limit: int = 50,
     ) -> list[dict]:
         """Newest-first traces.  ``trace_id`` searches every ring;
         ``slow`` / ``error`` select their rings (both = union, deduped —
         the same trace dict can sit in several rings); neither = the
-        recent ring.  ``tenant`` (round 13 QoS) filters whichever pool
-        was selected on the trace's tenant annotation — ``?tenant=x``
-        alone searches every ring, so "which tenant is slow" is one
+        recent ring.  ``tenant`` (round 13 QoS) and ``model`` (round 15
+        multi-model serving) filter whichever pool was selected on the
+        trace's annotations — either alone searches every ring, so
+        "which tenant is slow" / "is it only vgg19 requests" is one
         query, not a log grep."""
         with self._lock:
             if trace_id is not None:
@@ -300,14 +302,16 @@ class FlightRecorder:
                     pool.extend(self._errors)
                 if slow:
                     pool.extend(self._slow)
-            elif tenant is not None:
-                # tenant-only query: the caller is asking about an
-                # identity, not a ring — search everything retained
+            elif tenant is not None or model is not None:
+                # identity-only query: the caller is asking about an
+                # annotation, not a ring — search everything retained
                 pool = list(self._errors) + list(self._slow) + list(self._recent)
             else:
                 pool = list(self._recent)
         if tenant is not None:
             pool = [d for d in pool if d.get("tenant") == tenant]
+        if model is not None:
+            pool = [d for d in pool if d.get("model") == model]
         uniq: list[dict] = []
         seen: set[int] = set()
         for d in sorted(pool, key=lambda d: d["ts"], reverse=True):

@@ -1,0 +1,550 @@
+"""HBM weight manager: multi-model serving from one device pool (round 15).
+
+The server has always loaded exactly ONE backbone per process
+(`config.model`, resolved once at boot) even though the registry ships
+seven specs — a fleet serving all of them pays N× processes and N× HBM.
+The two serving levers every production system pulls here are device
+MEMORY and weight PRECISION (the Gemma-on-Cloud-TPU serving comparison
+and TVM both frame serving cost exactly this way — PAPERS.md); this
+module builds both:
+
+- **Paged residency.**  Model params live as host-side archives; a
+  per-lane LRU pages them into HBM on demand under ``hbm_budget_bytes``
+  (accounting REAL per-lane ``device_put`` bytes).  Cold-model requests
+  queue behind a singleflight page-in promise — one transfer per
+  (model, lane), concurrent requests for the same cold model coalesce —
+  and eviction is lane-aware and NEVER unloads a model with in-flight
+  batches (a pin count guards every dispatched batch).  Pinned models
+  (the boot-warmed set) are never evicted at all.
+
+- **A quantized weight tier.**  ``weight_dtype`` selects what the HBM
+  copy stores: ``f32`` (exact — the default), ``bf16`` (store bf16,
+  cast to f32 on use: half the bytes), or ``int8`` (per-tensor
+  symmetric int8 for the conv/dense kernels with f32 dequant-on-use:
+  ~quarter the kernel bytes).  Dequantisation happens INSIDE the jitted
+  programs (serving/models.py wraps every params-consuming entry), so
+  HBM holds the quantized form and the f32 view only materialises as
+  program temporaries.  Fidelity is bounded by PSNR parity tests
+  (tests/test_weight_manager.py), not byte equality — the precision
+  knob folds into the response-cache prefix so a dtype change
+  invalidates every cached payload.
+
+Two operating modes keep the single-model hot path untouched:
+
+- **Inert** (one served model, f32, no budget — the default config):
+  byte-for-byte the pre-manager path.  The bundle keeps its original
+  params object (``lane_params(0) is params``), lanes replicate via
+  ``ModelBundle.set_lanes`` exactly as before, and ``checkout`` is a
+  dict lookup.  Zero new work per dispatch.
+
+- **Managed** (any of: several served models, a quantized tier, a byte
+  budget): bundle params are archived to host numpy at build time, the
+  quantized form is precomputed once, and HBM residency is explicit —
+  ``checkout`` pages in (or waits on the in-flight page-in), pins, and
+  returns the device tree; ``release`` unpins after the batch's results
+  are materialised.
+
+Thread model: ``checkout``/``release`` run on dispatch worker threads
+(page-in wait deliberately blocks the LANE's dispatch worker — that is
+the "cold requests queue behind the promise" contract; other lanes and
+the event loop never block).  Bundle builds are serialized by a build
+lock; all bookkeeping sits under one mutex.  Page-in wall time rides
+the existing metrics spine as a ``weight_page_in`` stage observation
+(the wait histogram) and, via the batcher, as a ``weight_page_in`` span
+on every member request's trace; because the transfer happens inside
+the dispatch wall, the QoS device-time meter charges it to the
+requesting tenants automatically.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from deconv_api_tpu import errors
+from deconv_api_tpu.utils import slog
+
+_log = slog.get_logger("deconv.weights")
+
+WEIGHT_DTYPES = ("f32", "bf16", "int8")
+
+# Reserved leaf keys marking a per-tensor symmetric int8 quantized
+# kernel inside a params pytree.  The dict IS the leaf: dequantize walks
+# the tree structurally, so these names must never collide with real
+# parameter names (model params use layer/leaf names like 'kernel').
+_Q8_KEY = "__q8__"
+_Q8_SCALE = "__q8_scale__"
+
+
+def _is_q8_leaf(node: Any) -> bool:
+    return isinstance(node, dict) and _Q8_KEY in node
+
+
+def quantize_params(tree: Any, weight_dtype: str) -> Any:
+    """Host-side quantisation of a params pytree into its stored form.
+
+    ``f32`` passes leaves through untouched.  ``bf16`` stores every
+    float leaf as bfloat16 (ml_dtypes — numpy-native, zero-copy into
+    jax).  ``int8`` stores kernels (ndim >= 2 float leaves: conv HWIO
+    kernels and dense matrices — where the bytes are) as per-tensor
+    symmetric int8 with an f32 scale; biases/BN vectors stay f32, their
+    bytes are noise and their dynamic range is not.
+    """
+    if weight_dtype not in WEIGHT_DTYPES:
+        raise ValueError(
+            f"weight_dtype must be one of {WEIGHT_DTYPES}, got {weight_dtype!r}"
+        )
+    if weight_dtype == "f32":
+        return tree
+
+    import ml_dtypes
+
+    def q(node):
+        if isinstance(node, dict):
+            return {k: q(v) for k, v in node.items()}
+        arr = np.asarray(node)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return arr
+        if weight_dtype == "bf16":
+            return arr.astype(ml_dtypes.bfloat16)
+        if arr.ndim >= 2:
+            # per-tensor symmetric: scale maps the widest weight onto
+            # ±127; an all-zero tensor keeps scale 1.0 (no div-by-zero,
+            # dequantises back to exact zeros)
+            amax = float(np.max(np.abs(arr))) if arr.size else 0.0
+            scale = np.float32(amax / 127.0) if amax > 0 else np.float32(1.0)
+            qarr = np.clip(
+                np.round(arr.astype(np.float32) / scale), -127, 127
+            ).astype(np.int8)
+            return {_Q8_KEY: qarr, _Q8_SCALE: scale}
+        return arr.astype(np.float32)
+
+    return q(tree)
+
+
+def dequantize_params(tree: Any) -> Any:
+    """The in-program inverse of :func:`quantize_params` — pure jax ops,
+    traceable, so jitted programs consume the stored tree directly and
+    the f32 view exists only as program temporaries (dequant-on-use:
+    HBM holds the quantized bytes).  f32 trees pass through unchanged,
+    which keeps the wrapper free for the default tier."""
+    import jax.numpy as jnp
+
+    def dq(node):
+        if _is_q8_leaf(node):
+            return node[_Q8_KEY].astype(jnp.float32) * node[_Q8_SCALE]
+        if isinstance(node, dict):
+            return {k: dq(v) for k, v in node.items()}
+        if hasattr(node, "dtype") and node.dtype == jnp.bfloat16:
+            return node.astype(jnp.float32)
+        return node
+
+    return dq(tree)
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total bytes of every array leaf — for a device tree this is the
+    real per-lane HBM charge (replicated mesh lanes hold one full copy
+    per device; the budget is per single copy)."""
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+@dataclass
+class _Resident:
+    tree: Any
+    nbytes: int
+
+
+class WeightManager:
+    """Own every served model's host archive and HBM residency.
+
+    ``builders`` maps model name -> zero-arg ModelBundle factory (the
+    registry's entries, or injected specs in tests/tools); ``default``
+    is the boot model — always served, always pinned.  ``placements``
+    is one entry per executor lane (a Device, a Mesh slice, the
+    whole-pool Mesh, or None for the single default-device stream).
+    ``weights_loader`` is the service's per-model checkpoint hook,
+    invoked once at bundle build."""
+
+    def __init__(
+        self,
+        builders: dict[str, Callable[[], Any]],
+        default: str,
+        *,
+        default_bundle: Any = None,
+        pinned: tuple[str, ...] = (),
+        placements: list | None = None,
+        mesh=None,
+        budget_bytes: int = 0,
+        weight_dtype: str = "f32",
+        metrics=None,
+        weights_loader: Callable[[str, Any], None] | None = None,
+    ):
+        if weight_dtype not in WEIGHT_DTYPES:
+            raise ValueError(
+                f"weight_dtype must be one of {WEIGHT_DTYPES}, got "
+                f"{weight_dtype!r}"
+            )
+        if default not in builders:
+            raise ValueError(
+                f"default model {default!r} missing from the served set "
+                f"{sorted(builders)}"
+            )
+        self.builders = dict(builders)
+        self.default = default
+        self.served = frozenset(self.builders)
+        # default is always pinned: the boot-warmed model must never pay
+        # a page-in tax mid-traffic because colder models pushed it out
+        self.pinned = tuple(dict.fromkeys((default, *pinned)))
+        unknown = [p for p in self.pinned if p not in self.served]
+        if unknown:
+            raise ValueError(
+                f"pinned model(s) {unknown} are not in the served set "
+                f"{sorted(self.served)}"
+            )
+        self.placements = list(placements) if placements else [mesh]
+        self.mesh = mesh
+        self.budget_bytes = int(budget_bytes)
+        self.weight_dtype = weight_dtype
+        # Managed mode: anything beyond the classic single-model f32
+        # server needs explicit residency.  Inert mode IS the pre-round-15
+        # path, kept byte- and object-identical (test_lanes pins
+        # ``lane_params(0) is params`` and per-lane ``set_lanes``
+        # replication).
+        self.managed = (
+            len(self.served) > 1 or weight_dtype != "f32" or self.budget_bytes > 0
+        )
+        self._metrics = metrics
+        self._weights_loader = weights_loader
+        self._lock = threading.Lock()
+        self._build_lock = threading.Lock()
+        self._bundles: dict[str, Any] = {}
+        self._archives: dict[str, Any] = {}  # quantized host trees (managed)
+        self._resident: list[OrderedDict[str, _Resident]] = [
+            OrderedDict() for _ in self.placements
+        ]
+        self._pins: dict[tuple[str, int], int] = {}
+        self._paging: dict[tuple[str, int], threading.Event] = {}
+        self.page_ins = 0
+        self.page_outs = 0
+        self.page_in_bytes = 0
+        self.overcommits = 0
+        if default_bundle is not None:
+            self._adopt(default, default_bundle)
+
+    # ------------------------------------------------------------- bundles
+
+    @property
+    def lane_count(self) -> int:
+        return len(self.placements)
+
+    def _adopt(self, name: str, bundle) -> None:
+        """Register a pre-built bundle (the service builds the default —
+        weights loaded, mesh attached — before the manager exists)."""
+        self._prepare(name, bundle, load_weights=False)
+        with self._lock:
+            self._bundles[name] = bundle
+
+    def _prepare(self, name: str, bundle, *, load_weights: bool) -> None:
+        """One-time per-bundle setup: mesh, checkpoint load, and (in
+        managed mode) the host archive + precomputed quantized form."""
+        if self.mesh is not None and bundle.mesh is None:
+            bundle.mesh = self.mesh
+        if load_weights and self._weights_loader is not None:
+            self._weights_loader(name, bundle)
+        if not self.managed:
+            # inert multi-lane: the classic boot-time replication
+            if self.lane_count > 1:
+                bundle.set_lanes(self.placements)
+            return
+        # Managed: params become a host numpy archive (jax-initialised
+        # params are DEVICE arrays — without this, "paging out" would
+        # free nothing because the init copy pins HBM forever), and the
+        # quantized stored form is computed ONCE (page-in is then a pure
+        # device_put, not a re-quantisation per transfer).
+        import jax
+
+        bundle.params = jax.tree_util.tree_map(np.asarray, bundle.params)
+        bundle.weight_dtype = self.weight_dtype
+        if self.lane_count > 1:
+            # placement metadata only — batched_visualizer reads it to
+            # shard mesh-slice lanes and _stage_batch to commit inputs;
+            # the param replicas themselves live in this manager
+            bundle._lane_placements = list(self.placements)
+        self._archives[name] = quantize_params(bundle.params, self.weight_dtype)
+
+    def peek_bundle(self, name: str):
+        """The bundle when already built, else None — the event loop's
+        fast path (builds happen on worker threads)."""
+        with self._lock:
+            return self._bundles.get(name)
+
+    def bundle(self, name: str):
+        """The model's host-resident bundle, built on first use (weights
+        init + checkpoint load under the build lock — one build at a
+        time; callers for an already-built model never wait)."""
+        with self._lock:
+            b = self._bundles.get(name)
+        if b is not None:
+            return b
+        if name not in self.builders:
+            raise errors.UnknownModel(
+                f"unknown or unserved model {name!r}; serving: "
+                f"{sorted(self.served)}"
+            )
+        with self._build_lock:
+            with self._lock:
+                b = self._bundles.get(name)
+            if b is not None:
+                return b
+            t0 = time.perf_counter()
+            b = self.builders[name]()
+            self._prepare(name, b, load_weights=True)
+            with self._lock:
+                self._bundles[name] = b
+            slog.event(
+                _log, "model_built", model=name,
+                ms=round((time.perf_counter() - t0) * 1e3, 1),
+                managed=self.managed,
+            )
+            return b
+
+    # ----------------------------------------------------------- residency
+
+    def checkout(self, name: str, lane: int = 0):
+        """The device params tree one dispatch must read, paged in if
+        cold, PINNED against eviction until :meth:`release`.  Returns
+        ``(tree, page_in_seconds)`` — 0.0 on the warm path.  Runs on a
+        dispatch worker thread; a cold model blocks only that lane's
+        worker (concurrent requests for the same cold (model, lane)
+        coalesce onto ONE transfer via the paging promise)."""
+        bundle = self.bundle(name)
+        if not self.managed:
+            return bundle.lane_params(lane), 0.0
+        key = (name, lane)
+        while True:
+            with self._lock:
+                res = self._resident[lane].get(name)
+                if res is not None:
+                    self._resident[lane].move_to_end(name)
+                    self._pins[key] = self._pins.get(key, 0) + 1
+                    return res.tree, 0.0
+                ev = self._paging.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._paging[key] = ev
+                    break  # this thread is the page-in leader
+            # a transfer for this (model, lane) is in flight: wait for
+            # its promise, then re-check — if the leader failed, the
+            # paging slot is empty again and a waiter takes over
+            if not ev.wait(timeout=600.0):
+                raise errors.Unavailable(
+                    f"weight page-in for model {name!r} lane {lane} did "
+                    "not complete"
+                )
+        t0 = time.perf_counter()
+        try:
+            tree = self._place(self._archives[name], self.placements[lane])
+            nbytes = tree_nbytes(tree)
+        except BaseException:
+            with self._lock:
+                self._paging.pop(key, None)
+            ev.set()
+            raise
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._resident[lane][name] = _Resident(tree, nbytes)
+            self._pins[key] = self._pins.get(key, 0) + 1
+            self.page_ins += 1
+            self.page_in_bytes += nbytes
+            evicted = self._evict_locked(lane, exclude=name)
+            self._paging.pop(key, None)
+        ev.set()
+        if self._metrics is not None:
+            self._metrics.inc_counter("weight_page_ins_total")
+            self._metrics.inc_counter("weight_page_bytes_total", nbytes)
+            # the page-in WAIT histogram (stage quantiles + exposition)
+            self._metrics.observe_stage("weight_page_in", dt)
+        self._publish_gauges()
+        slog.event(
+            _log, "weight_page_in", model=name, lane=lane,
+            mb=round(nbytes / 1e6, 2), ms=round(dt * 1e3, 1),
+            evicted=evicted or None,
+        )
+        return tree, dt
+
+    def release(self, name: str, lane: int = 0) -> None:
+        """Drop one dispatch's eviction pin (the batch's results are
+        materialised; the device is done with this replica)."""
+        if not self.managed:
+            return
+        key = (name, lane)
+        with self._lock:
+            n = self._pins.get(key, 0)
+            if n <= 1:
+                self._pins.pop(key, None)
+            else:
+                self._pins[key] = n - 1
+            if n > 0 and name not in self._resident[lane]:
+                # invariant tripwire: a pinned model must NEVER leave
+                # residency while its dispatch runs — if this fires the
+                # eviction guard has a bug (the model-mix drill errors
+                # loudly on this counter)
+                if self._metrics is not None:
+                    self._metrics.inc_counter("weight_evict_inflight_total")
+                slog.event(
+                    _log, "weight_evict_inflight", level=logging.ERROR,
+                    model=name, lane=lane,
+                )
+
+    def _place(self, tree: Any, placement) -> Any:
+        """One real device transfer: the stored (possibly quantized)
+        tree onto a lane's chip / mesh slice / the default device."""
+        import jax
+        from jax.sharding import Mesh
+
+        if placement is None:
+            return jax.device_put(tree, jax.devices()[0])
+        if isinstance(placement, Mesh):
+            from deconv_api_tpu.parallel.mesh import replicated
+
+            return jax.device_put(tree, replicated(placement))
+        return jax.device_put(tree, placement)
+
+    def _evict_locked(self, lane: int, exclude: str) -> list[str]:
+        """LRU page-out down to the byte budget — called under the lock
+        right after an insert.  Skips pinned models, any model with
+        in-flight batches on this lane, and the entry that triggered the
+        eviction (evicting the page-in we are completing would thrash).
+        When nothing is evictable the budget OVERSHOOTS loudly rather
+        than failing requests: availability over accounting."""
+        if self.budget_bytes <= 0:
+            return []
+        od = self._resident[lane]
+        total = sum(r.nbytes for r in od.values())
+        evicted: list[str] = []
+        for victim in list(od):
+            if total <= self.budget_bytes:
+                break
+            if (
+                victim == exclude
+                or victim in self.pinned
+                or self._pins.get((victim, lane), 0) > 0
+            ):
+                continue
+            total -= od.pop(victim).nbytes
+            self.page_outs += 1
+            evicted.append(victim)
+            if self._metrics is not None:
+                self._metrics.inc_counter("weight_page_outs_total")
+        if total > self.budget_bytes:
+            self.overcommits += 1
+            if self._metrics is not None:
+                self._metrics.inc_counter("weight_budget_overcommit_total")
+            slog.event(
+                _log, "weight_budget_overcommit", level=logging.WARNING,
+                lane=lane, resident_bytes=total, budget_bytes=self.budget_bytes,
+                note="every resident model is pinned or in flight; "
+                "eviction never unloads in-flight weights",
+            )
+        return evicted
+
+    def enforce_budget(self) -> list[str]:
+        """Apply the byte budget NOW: page out LRU victims on every lane
+        until each is within budget (pinned and in-flight models still
+        never move).  Eviction normally runs at page-in time; this is
+        the hook for a budget LOWERED at runtime (drills; a future admin
+        surface)."""
+        out: list[str] = []
+        with self._lock:
+            for lane in range(self.lane_count):
+                out.extend(self._evict_locked(lane, exclude=""))
+        self._publish_gauges()
+        return out
+
+    def _publish_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        with self._lock:
+            per_lane = [
+                (i, len(od), sum(r.nbytes for r in od.values()))
+                for i, od in enumerate(self._resident)
+            ]
+        for lane, count, nbytes in per_lane:
+            self._metrics.set_labeled_gauge(
+                "resident_models", "lane", str(lane), count
+            )
+            self._metrics.set_labeled_gauge(
+                "weight_resident_bytes", "lane", str(lane), nbytes
+            )
+
+    # ------------------------------------------------------------ surfaces
+
+    def resident_models(self, lane: int = 0) -> list[str]:
+        """Models resident on one lane, LRU order (oldest first).  In
+        inert mode the default model is the whole answer — its params
+        are device-resident by construction."""
+        if not self.managed:
+            return [self.default]
+        with self._lock:
+            return list(self._resident[lane])
+
+    def inflight_pins(self, name: str, lane: int = 0) -> int:
+        with self._lock:
+            return self._pins.get((name, lane), 0)
+
+    def snapshot(self) -> dict:
+        """Live residency for /v1/config (and the drills)."""
+        with self._lock:
+            lanes = {
+                str(i): {
+                    "resident": list(od),
+                    "bytes": sum(r.nbytes for r in od.values()),
+                }
+                for i, od in enumerate(self._resident)
+            }
+            built = sorted(self._bundles)
+        return {
+            "managed": self.managed,
+            "weight_dtype": self.weight_dtype,
+            "hbm_budget_bytes": self.budget_bytes,
+            "served": sorted(self.served),
+            "pinned": list(self.pinned),
+            "built": built,
+            "lanes": lanes if self.managed else {
+                str(i): {"resident": [self.default], "bytes": 0}
+                for i in range(self.lane_count)
+            },
+            "page_ins": self.page_ins,
+            "page_outs": self.page_outs,
+            "page_in_bytes": self.page_in_bytes,
+            "overcommits": self.overcommits,
+        }
+
+    def ready_block(self) -> dict:
+        """The compact residency block /readyz carries when more than
+        one model is served (operators read "which models answer warm
+        right now" straight off the probe)."""
+        with self._lock:
+            resident = {
+                str(i): list(od) for i, od in enumerate(self._resident)
+            }
+        if not self.managed:
+            resident = {
+                str(i): [self.default] for i in range(self.lane_count)
+            }
+        return {
+            "served": len(self.served),
+            "pinned": len(self.pinned),
+            "resident": resident,
+        }

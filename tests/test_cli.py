@@ -27,7 +27,7 @@ def test_models_lists_registry(capsys):
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert {l["model"] for l in lines} == {
         "vgg16", "vgg19", "resnet50", "inception_v3", "mobilenet_v1",
-        "mobilenet_v2",
+        "mobilenet_v2", "vgg_tiny",
     }
     assert all("layers" in l and "engine" in l for l in lines)
 

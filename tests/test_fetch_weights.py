@@ -92,7 +92,66 @@ def test_verify_rejects_wrong_shape(tmp_path):
 
 def test_manifest_covers_registry():
     """Every registry family has a fetch entry — a new model family must
-    ship its weights recipe."""
+    ship its weights recipe.  vgg_tiny (round 15) is the one deliberate
+    exception: a random-init CI/dry-run backbone with no pretrained
+    artifact to fetch."""
     from deconv_api_tpu.serving.models import REGISTRY
 
-    assert set(fw.MANIFEST) == set(REGISTRY)
+    assert set(fw.MANIFEST) == set(REGISTRY) - {"vgg_tiny"}
+
+
+def test_all_flag_covers_manifest(monkeypatch, capsys):
+    """--all (round 15) prefetches + verifies EVERY manifest backbone in
+    one call and prints the multi-model serve line; incompatible flags
+    are argparse errors."""
+    fetched, verified = [], []
+    monkeypatch.setattr(
+        fw, "fetch", lambda name, dest, sha=None: fetched.append(name) or f"/x/{name}.h5"
+    )
+    monkeypatch.setattr(
+        fw,
+        "verify_h5",
+        lambda name, path, forward_smoke=True: verified.append(name)
+        or {"model": name},
+    )
+    monkeypatch.setattr("sys.argv", ["fetch_weights.py", "--all", "--no-smoke"])
+    assert fw.main() == 0
+    assert fetched == sorted(fw.MANIFEST)
+    assert verified == sorted(fw.MANIFEST)
+    assert "--serve-models all" in capsys.readouterr().err
+
+    monkeypatch.setattr("sys.argv", ["fetch_weights.py", "vgg16", "--all"])
+    with pytest.raises(SystemExit) as e:
+        fw.main()
+    assert e.value.code == 2
+
+    monkeypatch.setattr(
+        "sys.argv", ["fetch_weights.py", "--all", "--verify-only", "/x.h5"]
+    )
+    with pytest.raises(SystemExit) as e:
+        fw.main()
+    assert e.value.code == 2
+
+    monkeypatch.setattr("sys.argv", ["fetch_weights.py"])
+    with pytest.raises(SystemExit):
+        fw.main()
+
+
+def test_fetch_writes_model_alias(tmp_path, monkeypatch):
+    """fetch() leaves a <model>.h5 alias next to the upstream basename so
+    `serve --weights <dir>` finds every model by convention."""
+    src = tmp_path / "mobilenet_1_0_224_tf.h5"
+    src.write_bytes(b"weights")
+
+    def fake_retrieve(url, tmp):
+        shutil.copyfile(src, tmp)
+
+    monkeypatch.setattr(
+        "urllib.request.urlretrieve", fake_retrieve, raising=False
+    )
+    dest = tmp_path / "dest"
+    path = fw.fetch("mobilenet_v1", str(dest))
+    assert os.path.basename(path) == "mobilenet_1_0_224_tf.h5"
+    alias = dest / "mobilenet_v1.h5"
+    assert alias.exists()
+    assert alias.read_bytes() == b"weights"

@@ -380,7 +380,7 @@ def test_proxy_requotes_decoded_path_in_forwarded_request_line(monkeypatch):
 class FleetFixture:
     """N real backend services + one router, all on a background loop."""
 
-    def __init__(self, n_backends=2, cfg=None, router_kw=None):
+    def __init__(self, n_backends=2, cfg=None, router_kw=None, registry=None):
         self.cfg = cfg or ServerConfig(
             image_size=16,
             max_batch=4,
@@ -388,6 +388,7 @@ class FleetFixture:
             compilation_cache_dir="",
             fleet_peer_fill=True,
         )
+        self.registry = registry  # extra models every backend serves
         self.n_backends = n_backends
         self.router_kw = dict(
             probe_interval_s=0.2, probe_timeout_s=2.0,
@@ -408,7 +409,10 @@ class FleetFixture:
         async def boot():
             params = init_params(TINY, jax.random.PRNGKey(3))
             for _ in range(self.n_backends):
-                svc = DeconvService(self.cfg, spec=TINY, params=params)
+                svc = DeconvService(
+                    self.cfg, spec=TINY, params=params,
+                    registry=self.registry,
+                )
                 port = await svc.start("127.0.0.1", 0)
                 svc.ready = True
                 self.services.append(svc)
@@ -1459,3 +1463,74 @@ def test_peer_fill_cancel_does_not_poison_singleflight(fleet2):
         assert resp.status == 200
 
     fleet2.on_loop(go(), timeout=60)
+
+
+def test_e2e_x_model_passes_through_and_affinity_holds():
+    """Round 15 satellite pin: the router forwards `x-model` / `model=`
+    UNCHANGED (it is not hop-by-hop), and because the `model` form
+    field rides the body — and therefore the canonical digest the ring
+    hashes — per-model cache affinity needs no router change: the same
+    (body, model) request always lands on the same backend and its
+    second send is that backend's cache hit."""
+    from dataclasses import replace
+
+    from deconv_api_tpu.models.spec import Layer, ModelSpec
+    from deconv_api_tpu.serving.models import spec_bundle
+
+    alt_spec = ModelSpec(
+        name="alt_vgg",
+        input_shape=(16, 16, 3),
+        layers=(
+            Layer("input_1", "input"),
+            Layer("b1c1", "conv", activation="relu", filters=4),
+            Layer("b1p", "pool"),
+            Layer("b2c1", "conv", activation="relu", filters=6),
+        ),
+    )
+    alt_params = init_params(alt_spec, jax.random.PRNGKey(9))
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        compilation_cache_dir="",
+        fleet_peer_fill=True,
+        serve_models="tiny_vgg,alt_vgg",
+    )
+    registry = {"alt_vgg": lambda: spec_bundle(alt_spec, alt_params)}
+    with FleetFixture(n_backends=2, cfg=cfg, registry=registry) as f:
+        base = {"file": _data_url(31), "layer": "b2c1"}
+        # default model through the router
+        r_def = httpx.post(f.router_url + "/", data=base, timeout=60)
+        assert r_def.status_code == 200, r_def.text
+        # model= form field: inside the body => inside the ring digest
+        r1 = httpx.post(
+            f.router_url + "/", data={**base, "model": "alt_vgg"},
+            timeout=60,
+        )
+        assert r1.status_code == 200, r1.text
+        assert r1.content != r_def.content, "alt model must differ"
+        r2 = httpx.post(
+            f.router_url + "/", data={**base, "model": "alt_vgg"},
+            timeout=60,
+        )
+        assert r2.status_code == 200
+        assert r2.headers["x-backend"] == r1.headers["x-backend"]
+        assert r2.headers["x-cache"] == "hit"
+        assert r2.content == r1.content
+        # x-model HEADER: not in the body, so it rides the DEFAULT
+        # body's ring key — same backend as the bare request, but the
+        # backend resolves the header and serves the alt model's bytes
+        # under the alt model's cache prefix
+        rh = httpx.post(
+            f.router_url + "/", data=base,
+            headers={"x-model": "alt_vgg"}, timeout=60,
+        )
+        assert rh.status_code == 200
+        assert rh.headers["x-backend"] == r_def.headers["x-backend"]
+        assert rh.content == r1.content
+        # unknown model 422s straight through the router
+        rbad = httpx.post(
+            f.router_url + "/", data={**base, "model": "ghost"}, timeout=60
+        )
+        assert rbad.status_code == 422
+        assert rbad.json()["error"] == "unknown_model"

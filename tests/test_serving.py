@@ -355,7 +355,7 @@ def test_model_registry_bundles():
 
     assert set(REGISTRY) == {
         "vgg16", "vgg19", "resnet50", "inception_v3", "mobilenet_v1",
-        "mobilenet_v2",
+        "mobilenet_v2", "vgg_tiny",
     }
     b = REGISTRY["vgg16"]()
     assert b.image_size == 224 and "block5_conv1" in b.layer_names

@@ -208,6 +208,20 @@ def fetch(model_name: str, dest_dir: str, sha256: str | None = None) -> str:
             f"{path}: sha256 {digest} != pinned {sha256} — delete the file "
             "and re-download, or fix the pin"
         )
+    # <model>.h5 alias (round 15): `serve --weights <dir>` loads each
+    # served model from <dir>/<model>.h5, and the upstream basenames do
+    # not follow that convention (mobilenet_1_0_224_tf.h5 never names
+    # mobilenet_v1).  Symlink where possible, copy where not.
+    alias = os.path.join(dest_dir, f"{model_name}.h5")
+    if os.path.abspath(alias) != os.path.abspath(path):
+        try:
+            if os.path.islink(alias) or os.path.exists(alias):
+                os.remove(alias)
+            os.symlink(os.path.basename(path), alias)
+        except OSError:
+            import shutil
+
+            shutil.copyfile(path, alias)
     return path
 
 
@@ -215,7 +229,16 @@ def main() -> int:
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
     )
-    ap.add_argument("model", help=f"one of {sorted(MANIFEST)} or 'all'")
+    ap.add_argument(
+        "model", nargs="?", default=None,
+        help=f"one of {sorted(MANIFEST)} or 'all'",
+    )
+    ap.add_argument(
+        "--all", action="store_true", dest="fetch_all",
+        help="prefetch + verify EVERY registry backbone in one call "
+        "(equivalent to model=all) — a multi-model server must never "
+        "lazily download mid-request; boot from a fully fetched dir",
+    )
     ap.add_argument("--dest", default=os.path.expanduser("~/.cache/deconv_api_tpu/weights"))
     ap.add_argument("--sha256", default=None, help="pin for single-model fetches")
     ap.add_argument(
@@ -227,6 +250,17 @@ def main() -> int:
     )
     args = ap.parse_args()
 
+    if args.fetch_all:
+        if args.model not in (None, "all"):
+            ap.error("--all names every model; drop the positional model")
+        args.model = "all"
+    if args.model is None:
+        ap.error("name a model, 'all', or pass --all")
+    if args.verify_only and args.model == "all":
+        ap.error(
+            "--verify-only checks ONE file against one model; it cannot "
+            "be combined with model=all/--all"
+        )
     if args.sha256 and args.model == "all":
         # one pin cannot match six different files — every per-model fetch
         # after the first would fail spuriously against it (ADVICE r5)
@@ -246,6 +280,16 @@ def main() -> int:
             f"# serve it:\n"
             f"DECONV_MODEL={name} DECONV_WEIGHTS_PATH={path} "
             f"python -m deconv_api_tpu serve --port 80",
+            file=sys.stderr,
+        )
+    if args.model == "all" and not args.verify_only:
+        # the whole registry is fetched + verified + aliased: the
+        # multi-model boot line (round 15) loads per-model files from
+        # the directory
+        print(
+            f"# serve every backbone from one pool:\n"
+            f"python -m deconv_api_tpu serve --serve-models all "
+            f"--weights {args.dest} --port 80",
             file=sys.stderr,
         )
     return 0

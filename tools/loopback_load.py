@@ -976,6 +976,25 @@ def run_fleet_drill(
     spec = _tiny_spec()
     size = spec.input_shape[0]
     params = init_params(spec, jax.random.PRNGKey(0))
+    # second backbone for the two-model phase (round 15): same topology,
+    # different widths — distinct params, distinct output bytes, so a
+    # routing mistake is visible in the payload
+    from deconv_api_tpu.models.spec import Layer, ModelSpec
+    from deconv_api_tpu.serving.models import spec_bundle
+
+    alt_spec = ModelSpec(
+        name="loopback_alt",
+        input_shape=(32, 32, 3),
+        layers=(
+            Layer("input_1", "input"),
+            Layer("c1", "conv", activation="relu", filters=8),
+            Layer("p1", "pool"),
+            Layer("c2", "conv", activation="relu", filters=16),
+            Layer("p2", "pool"),
+            Layer("c3", "conv", activation="relu", filters=16),
+        ),
+    )
+    alt_params = init_params(alt_spec, jax.random.PRNGKey(7))
     cfg = ServerConfig(
         image_size=size,
         max_batch=16,
@@ -984,6 +1003,9 @@ def run_fleet_drill(
         platform="cpu",
         warmup_all_buckets=False,
         cache_bytes=cfg_cache_bytes(),
+        # two-model phase: every backend serves both backbones from one
+        # pool (the alt model pages in ON DEMAND at its first request)
+        serve_models="loopback_tiny,loopback_alt",
         # trusted loopback mesh: a drained/rebalanced key may fill from
         # its previous owner instead of recomputing
         fleet_peer_fill=True,
@@ -1024,20 +1046,25 @@ def run_fleet_drill(
     }
 
     async def boot_backend():
-        svc = DeconvService(cfg, spec=spec, params=params)
+        svc = DeconvService(
+            cfg, spec=spec, params=params,
+            registry={
+                "loopback_alt": lambda: spec_bundle(alt_spec, alt_params)
+            },
+        )
         port = await svc.start("127.0.0.1", 0)
         await asyncio.to_thread(svc.warmup, "c3")
         return svc, port
 
-    async def post(port: int, idx: int) -> tuple[float, int, str, str]:
+    async def post_raw(port: int, body: bytes) -> tuple[float, int, str, str]:
         t0 = time.perf_counter()
         reader, writer = await asyncio.open_connection("127.0.0.1", port)
         req = (
             b"POST / HTTP/1.1\r\nHost: x\r\nContent-Type: "
             b"application/x-www-form-urlencoded\r\nContent-Length: "
-            + str(len(bodies[idx])).encode()
+            + str(len(body)).encode()
             + b"\r\nConnection: close\r\n\r\n"
-            + bodies[idx]
+            + body
         )
         writer.write(req)
         await writer.drain()
@@ -1051,6 +1078,9 @@ def run_fleet_drill(
             if name.strip().lower() == b"x-backend":
                 backend = value.strip().decode()
         return time.perf_counter() - t0, status, kind, backend
+
+    async def post(port: int, idx: int) -> tuple[float, int, str, str]:
+        return await post_raw(port, bodies[idx])
 
     async def drive_stream(
         port: int, stream: list[int], on_done=None
@@ -1116,6 +1146,54 @@ def run_fleet_drill(
                 "hit_ratio": round(h / max(1, h + m), 4),
                 "entries": svc.cache.entry_count,
             }
+
+        # ---- phase 2b: two models through the SAME router ------------
+        # (round 15) Every backend serves loopback_tiny AND
+        # loopback_alt; the model rides the request body (`model=`
+        # field), so it is ALREADY inside the canonical digest the
+        # router hashes — affinity needs no router change.  What the
+        # phase pins: (a) x-model/model pass through unchanged and
+        # every request answers 200 (the alt model pages in on demand
+        # at each backend's first alt request), (b) the SECOND pass of
+        # an identical stream hits the same backend's cache (per-key
+        # backend stickiness + one-logical-cache, per model).
+        sample = sorted(bodies)[: min(24, len(bodies))]
+        tm_bodies = {}
+        for idx in sample:
+            for m_name in ("loopback_tiny", "loopback_alt"):
+                tm_bodies[(idx, m_name)] = urllib.parse.urlencode(
+                    {"file": uris[idx], "layer": "c3", "model": m_name}
+                ).encode()
+        tm_errors = 0
+        first_backend: dict = {}
+        for key2, body in tm_bodies.items():
+            _dt, status, _kind, backend = await post_raw(rport, body)
+            if status != 200:
+                tm_errors += 1
+            first_backend[key2] = backend
+        tm_hits = tm_affinity_ok = 0
+        for key2, body in tm_bodies.items():
+            _dt, status, kind, backend = await post_raw(rport, body)
+            if status != 200:
+                tm_errors += 1
+            if kind in ("hit", "hit-negative"):
+                tm_hits += 1
+            if backend == first_backend[key2]:
+                tm_affinity_ok += 1
+        resident_by_backend = {
+            name: svc.weights.snapshot()["lanes"]["0"]["resident"]
+            for name, svc in by_name.items()
+        }
+        two_model = {
+            "models": ["loopback_tiny", "loopback_alt"],
+            "requests": 2 * len(tm_bodies),
+            "errors": tm_errors,
+            "pass2_hit_ratio": round(tm_hits / max(1, len(tm_bodies)), 4),
+            "affinity_ok_frac": round(
+                tm_affinity_ok / max(1, len(tm_bodies)), 4
+            ),
+            "resident_by_backend": resident_by_backend,
+        }
 
         # ---- phase 3: kill one backend mid-run -----------------------
         # the victim: whoever owns the MOST sampled keys (maximum
@@ -1197,6 +1275,7 @@ def run_fleet_drill(
             "client_kinds_single": single_split["kinds"],
             "client_kinds_fleet": fleet_split["kinds"],
             "per_backend": per_backend,
+            "two_model": two_model,
             "kill": {
                 "victim": victim_name,
                 "requests": len(k_samples),
@@ -1223,6 +1302,360 @@ def run_fleet_drill(
                 "peer_fills": peer_fills,
             },
         }
+
+    return asyncio.run(drive())
+
+
+def run_model_mix_drill(
+    n_models: int = 3,
+    n_requests: int = 360,
+    concurrency: int = 16,
+) -> dict:
+    """The round-15 multi-model paging drill: zipf traffic over three
+    differently-sized backbones served from ONE process under an HBM
+    budget smaller than their combined f32 footprint, versus (a) the
+    classic single-model server and (b) the same single model with the
+    paging machinery engaged.
+
+    What the row pins:
+
+    - **Paging machinery is free for single-model traffic.**  Phase A
+      (inert manager — the pre-round-15 path) vs phase A2 (managed:
+      budget set, same one model): byte-identical responses, throughput
+      within MODELS_OVERHEAD_BUDGET_PCT (best-of-2 each side).
+    - **N models serve from one pool under a budget that forces
+      paging.**  Phase B zipf-mixes models; the budget holds ~2 of 3
+      models, so the LRU must page.  Row records per-model cold/warm
+      latency split (the first request per model pays the page-in —
+      visible, bounded, never an error), page-in count, and residency
+      churn (page-outs).  Error conditions: ANY failed request, zero
+      page-outs (budget never forced paging — vacuous), any in-flight
+      eviction/overcommit where it should not happen, warm-path p50
+      more than 50% above the single-model baseline, or byte drift on
+      the default model's responses after churn.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import urllib.parse
+
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+    from deconv_api_tpu.serving.app import DeconvService
+    from deconv_api_tpu.serving.models import spec_bundle
+    from deconv_api_tpu.serving.weight_manager import tree_nbytes
+
+    size = 32
+    widths = [(16, 32), (24, 48), (32, 64)][:n_models]
+    names = [f"mix{chr(ord('a') + i)}" for i in range(n_models)]
+    specs, params_by, bytes_by = {}, {}, {}
+    for name, (f1, f2) in zip(names, widths):
+        spec = ModelSpec(
+            name=name,
+            input_shape=(size, size, 3),
+            layers=(
+                Layer("input_1", "input"),
+                Layer("c1", "conv", activation="relu", filters=f1),
+                Layer("p1", "pool"),
+                Layer("c2", "conv", activation="relu", filters=f2),
+                Layer("p2", "pool"),
+                Layer("c3", "conv", activation="relu", filters=f2),
+            ),
+        )
+        specs[name] = spec
+        params_by[name] = init_params(spec, jax.random.PRNGKey(names.index(name)))
+        bytes_by[name] = tree_nbytes(
+            jax.tree_util.tree_map(np.asarray, params_by[name])
+        )
+    registry = {
+        name: (lambda name=name: spec_bundle(specs[name], params_by[name]))
+        for name in names
+    }
+    total_bytes = sum(bytes_by.values())
+    # hold roughly two of three models: every third-model arrival after
+    # the set fills must page something out
+    budget = max(int(total_bytes * 0.75), max(bytes_by.values()) + 1)
+
+    def cfg_for(**kw):
+        base = dict(
+            image_size=size,
+            max_batch=16,
+            batch_window_ms=3.0,
+            compilation_cache_dir="",
+            platform="cpu",
+            warmup_all_buckets=False,
+            model=names[0],
+            # paging — not caching — is the measured quantity
+            cache_bytes=0,
+            singleflight=False,
+        )
+        base.update(kw)
+        return ServerConfig(**base)
+
+    rng = np.random.default_rng(0)
+    n_images = 24
+    uris = {}
+    for idx in range(n_images):
+        img = Image.fromarray(
+            np.random.default_rng(idx).integers(
+                0, 255, (size, size, 3), np.uint8
+            ),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        uris[idx] = (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+    img_stream = rng.integers(0, n_images, n_requests)
+    # zipf over MODELS: the default is hot, the tail models collectively
+    # frequent enough that the paging set keeps churning
+    model_stream = rng.choice(
+        names, size=n_requests, p=[0.5, 0.3, 0.2][:n_models]
+    )
+    ref_body = urllib.parse.urlencode(
+        {"file": uris[0], "layer": "c3"}
+    ).encode()
+
+    async def post_raw(port, body):
+        t0 = time.perf_counter()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        req = (
+            b"POST / HTTP/1.1\r\nHost: x\r\nContent-Type: "
+            b"application/x-www-form-urlencoded\r\nContent-Length: "
+            + str(len(body)).encode()
+            + b"\r\nConnection: close\r\n\r\n"
+            + body
+        )
+        writer.write(req)
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        status, _ = _resp_status_code(raw)
+        payload = raw.split(b"\r\n\r\n", 1)[1] if b"\r\n\r\n" in raw else b""
+        return time.perf_counter() - t0, status, payload
+
+    async def single_phase(cfg):
+        """Best-of-2 single-model throughput + p50 + the REF payload."""
+        svc = DeconvService(cfg, registry=registry)
+        port = await svc.start("127.0.0.1", 0)
+        await asyncio.to_thread(svc.warmup, "c3")
+        _dt, status, ref = await post_raw(port, ref_body)
+        assert status == 200, "single-model ref request failed"
+        best = 0.0
+        lat = []
+        for _ in range(2):
+            sem = asyncio.Semaphore(concurrency)
+            samples = []
+
+            async def one(i):
+                body = urllib.parse.urlencode(
+                    {"file": uris[int(img_stream[i])], "layer": "c3"}
+                ).encode()
+                async with sem:
+                    dt, status, _p = await post_raw(port, body)
+                samples.append((dt, status))
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(one(i) for i in range(n_requests)))
+            wall = time.perf_counter() - t0
+            assert all(s == 200 for _d, s in samples)
+            rate = n_requests / wall
+            if rate > best:
+                best = rate
+                lat = sorted(d for d, _s in samples)
+        await svc.stop()
+        return best, lat[len(lat) // 2] * 1e3, ref
+
+    async def mix_phase(paging_budget: int):
+        """One three-model zipf pass.  Every backbone is COMPILE-warmed
+        at boot with the budget lifted (first-use XLA compiles are a
+        boot-time cost in production too — the drill measures PAGING,
+        not compilation); with ``paging_budget`` > 0 the budget is then
+        restored and enforced, so the traffic starts from a
+        paged-down-to-budget state and every cold-model arrival pays a
+        real page-in."""
+        svc = DeconvService(
+            cfg_for(
+                serve_models=",".join(names),
+                pinned_models="all",
+                hbm_budget_bytes=paging_budget,
+            ),
+            registry=registry,
+        )
+        port = await svc.start("127.0.0.1", 0)
+        svc.weights.budget_bytes = 0  # compile-warm without thrash
+        await asyncio.to_thread(svc.warmup, "c3")
+        if paging_budget:
+            # only the default stays pinned; the budget applies NOW
+            svc.weights.pinned = (names[0],)
+            svc.weights.budget_bytes = paging_budget
+            svc.weights.enforce_budget()
+        # boot-time page activity (warmup + budget enforcement) is not
+        # the drill's subject: the row reports TRAFFIC-driven paging
+        boot_page_ins = svc.weights.page_ins
+        boot_page_outs = svc.weights.page_outs
+        boot_overcommits = svc.weights.overcommits
+        sem = asyncio.Semaphore(concurrency)
+        by_model: dict[str, list] = {n: [] for n in names}
+        failures = 0
+
+        async def one(i):
+            nonlocal failures
+            m = str(model_stream[i])
+            body = urllib.parse.urlencode(
+                {
+                    "file": uris[int(img_stream[i])],
+                    "layer": "c3",
+                    "model": m,
+                }
+            ).encode()
+            async with sem:
+                dt, status, _p = await post_raw(port, body)
+            if status != 200:
+                failures += 1
+            by_model[m].append((i, dt))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i) for i in range(n_requests)))
+        wall = time.perf_counter() - t0
+        per_model = {}
+        for m in names:
+            samples = sorted(by_model[m])  # arrival order
+            if not samples:
+                per_model[m] = {"requests": 0}
+                continue
+            warm = sorted(d for _i, d in samples[1:]) or [samples[0][1]]
+            per_model[m] = {
+                "requests": len(samples),
+                "cold_first_ms": round(samples[0][1] * 1e3, 1),
+                "warm_p50_ms": round(warm[len(warm) // 2] * 1e3, 3),
+                "warm_p99_ms": round(
+                    warm[min(len(warm) - 1, int(len(warm) * 0.99))] * 1e3, 3
+                ),
+                "bytes_f32": bytes_by[m],
+            }
+        # byte-identity after churn: the default model's ref request
+        # recomputed once everything paged in and out around it
+        _dt, status, ref_after = await post_raw(port, ref_body)
+        wsnap = svc.weights.snapshot()
+        c = svc.metrics.snapshot()["counters"]
+        await svc.stop()
+        warm_all = sorted(d for m in names for _i, d in by_model[m][1:])
+        return {
+            "req_s": round(n_requests / wall, 1),
+            "warm_p50_ms": round(
+                warm_all[len(warm_all) // 2] * 1e3 if warm_all else 0.0, 3
+            ),
+            "per_model": per_model,
+            "failures": failures,
+            "ref_after": (status, ref_after),
+            "page_ins": wsnap["page_ins"] - boot_page_ins,
+            "page_outs": wsnap["page_outs"] - boot_page_outs,
+            "overcommits": wsnap["overcommits"] - boot_overcommits,
+            "inflight_evictions": c.get("weight_evict_inflight_total", 0),
+        }
+
+    async def drive():
+        # ---- phase A: the classic inert single-model server ----------
+        a_rate, a_p50_ms, ref = await single_phase(cfg_for())
+        # ---- phase A2: same model, paging machinery ENGAGED ----------
+        a2_rate, a2_p50_ms, ref2 = await single_phase(
+            cfg_for(hbm_budget_bytes=budget)
+        )
+        paging_identical = ref == ref2
+        overhead_pct = (a_rate - a2_rate) / a_rate * 100.0 if a_rate else 0.0
+
+        # ---- phase B0: three models, NO budget (the mix baseline) ----
+        b0 = await mix_phase(0)
+        # ---- phase B1: same mix, budget forces paging ----------------
+        b1 = await mix_phase(budget)
+        churn_identical = (
+            b1["ref_after"][0] == 200 and b1["ref_after"][1] == ref
+        )
+        warm_ratio = (
+            b1["warm_p50_ms"] / b0["warm_p50_ms"]
+            if b0["warm_p50_ms"]
+            else 0.0
+        )
+
+        row = {
+            "which": f"loopback_model_mix_{n_models}",
+            "platform": "cpu-loopback",
+            "n_models": n_models,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "model_bytes_f32": bytes_by,
+            "hbm_budget_bytes": budget,
+            "combined_f32_bytes": total_bytes,
+            "single_req_s": round(a_rate, 1),
+            "single_p50_ms": round(a_p50_ms, 3),
+            "paged_single_req_s": round(a2_rate, 1),
+            "paged_single_p50_ms": round(a2_p50_ms, 3),
+            "paging_overhead_pct": round(overhead_pct, 2),
+            "paging_byte_identical": paging_identical,
+            "mix_baseline_req_s": b0["req_s"],
+            "mix_baseline_warm_p50_ms": b0["warm_p50_ms"],
+            "mix_req_s": b1["req_s"],
+            "mix_warm_p50_ms": b1["warm_p50_ms"],
+            "mix_warm_p50_ratio": round(warm_ratio, 3),
+            "per_model": b1["per_model"],
+            "per_model_baseline": b0["per_model"],
+            "failed_requests": b0["failures"] + b1["failures"],
+            "page_ins": b1["page_ins"],
+            "page_outs": b1["page_outs"],
+            "overcommits": b1["overcommits"],
+            "inflight_evictions": (
+                b0["inflight_evictions"] + b1["inflight_evictions"]
+            ),
+            "churn_byte_identical": churn_identical,
+        }
+        problems = []
+        if row["failed_requests"]:
+            problems.append(
+                f"{row['failed_requests']} failed requests in the mix phases"
+            )
+        if not paging_identical:
+            problems.append("paged single-model bytes differ from inert")
+        if not churn_identical:
+            problems.append("default-model bytes drifted under paging churn")
+        # counts are TRAFFIC-driven (boot warmup/enforcement excluded):
+        # a vacuous drill is one where requests never paged anything
+        if not b1["page_ins"]:
+            problems.append("traffic never paged a model in (drill vacuous)")
+        if not b1["page_outs"]:
+            problems.append(
+                "budget never forced a page-out under traffic (drill vacuous)"
+            )
+        if row["inflight_evictions"]:
+            problems.append(
+                f"{row['inflight_evictions']} evictions of in-flight models"
+            )
+        if warm_ratio > 1.5:
+            problems.append(
+                f"warm p50 under paging {b1['warm_p50_ms']:.1f}ms is "
+                f"{warm_ratio:.2f}x the no-paging mix baseline "
+                f"{b0['warm_p50_ms']:.1f}ms (warm path regressed)"
+            )
+        cold_budget_ms = 2000.0
+        slow_cold = {
+            m: e["cold_first_ms"]
+            for m, e in b1["per_model"].items()
+            if e.get("cold_first_ms", 0) > cold_budget_ms
+        }
+        if slow_cold:
+            problems.append(
+                f"cold-start regression: first request over "
+                f"{cold_budget_ms:.0f}ms for {slow_cold} (page-in of "
+                "warm-compiled models should cost milliseconds)"
+            )
+        if problems:
+            row["error"] = "; ".join(problems)
+        return row
 
     return asyncio.run(drive())
 
@@ -1757,6 +2190,7 @@ def main() -> int:
     jobs_mode = False
     jobs_dir = ""
     qos_on = False
+    model_mix = False
     fleet_n: int | None = None
     tenants_drill: str | None = None
     concurrency = 64
@@ -1808,6 +2242,12 @@ def main() -> int:
         elif args[i] == "--qos":
             qos_on = True
             i += 1
+        elif args[i] == "--model-mix":
+            # the round-15 multi-model paging drill: zipf traffic over
+            # three backbones under an HBM budget that forces paging,
+            # plus the single-model paging-overhead A/B
+            model_mix = True
+            i += 1
         elif args[i] == "--fleet":
             # the round-14 fleet drill: one cache-affine router over N
             # in-process backends, aggregate-vs-single hit ratio + a
@@ -1858,6 +2298,13 @@ def main() -> int:
         except ValueError as e:
             print(e, file=sys.stderr)
             return 2
+    if model_mix:
+        row = run_model_mix_drill(
+            n_requests=n_requests or 360,
+            concurrency=min(concurrency, 16),
+        )
+        print(json.dumps(row), flush=True)
+        return 0
     if fleet_n is not None:
         if fleet_n < 2:
             print("--fleet needs at least 2 backends", file=sys.stderr)

@@ -73,6 +73,14 @@ QOS_SYNC_OVERHEAD_BUDGET_PCT = 3.0
 # key; 5% absorbs coalescing-vs-hit timing jitter, not fragmentation.)
 FLEET_HIT_RATIO_BUDGET_PCT = 5.0
 
+# Multi-model paging budget (round 15): the weight-manager machinery
+# engaged for a SINGLE model (budget set, no second model) may cost the
+# hot path at most this much throughput versus the inert pre-round-15
+# path, and its bytes must be identical.  The drill itself also errors
+# on any failed request, vacuous paging, in-flight eviction, or a >50%
+# warm-path p50 regression under the three-model zipf mix.
+MODELS_OVERHEAD_BUDGET_PCT = 3.0
+
 # Channel-packed backward-tail budget (round 12): the packed path must
 # not run SLOWER than the vmapped path it would replace — a recorded
 # regression (like the r3 prototype's 280-vs-368 img/s) keeps the
@@ -452,8 +460,25 @@ def run_fleet_guard(timeout_s: float = 1800.0) -> dict:
         survivor_resident_lost=kill.get("survivor_resident_lost"),
         backend_states_after=kill.get("backend_states_after"),
         router=drill.get("router"),
+        two_model=drill.get("two_model"),
     )
     problems = []
+    tm = drill.get("two_model") or {}
+    if tm.get("errors", 1):
+        problems.append(
+            f"{tm.get('errors')} errors in the two-model phase "
+            "(x-model/model passthrough or on-demand paging broke)"
+        )
+    if tm.get("affinity_ok_frac", 0) < 1.0:
+        problems.append(
+            f"two-model affinity only {tm.get('affinity_ok_frac')} "
+            "(model-in-digest stickiness broke)"
+        )
+    if tm.get("pass2_hit_ratio", 0) < 0.9:
+        problems.append(
+            f"two-model pass-2 hit ratio {tm.get('pass2_hit_ratio')} < 0.9 "
+            "(per-model cache keys fragmenting)"
+        )
     delta = drill.get("hit_ratio_delta_pct")
     if delta is None or delta > FLEET_HIT_RATIO_BUDGET_PCT:
         problems.append(
@@ -475,6 +500,58 @@ def run_fleet_guard(timeout_s: float = 1800.0) -> dict:
         problems.append(
             "victim keyspace never moved (ejection never happened; "
             "drill vacuous)"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
+    return row
+
+
+def run_models_guard(timeout_s: float = 1800.0) -> dict:
+    """Multi-model serving drill guard (round 15):
+    tools/loopback_load.py --model-mix — zipf traffic over three
+    backbones under an HBM budget that forces paging, plus the
+    single-model inert-vs-managed A/B.
+
+    The row fails LOUDLY (`error` field) when the drill's own
+    invariants broke (failed requests, vacuous paging, in-flight
+    eviction, byte drift, warm-path regression) or when the managed
+    single-model path costs more than MODELS_OVERHEAD_BUDGET_PCT
+    throughput versus the inert path."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--model-mix"], timeout_s, env=env
+    )
+    row = {"config": "models", "which": "loopback_model_mix_drill"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    row.update(
+        {
+            k: drill.get(k)
+            for k in (
+                "n_models", "requests", "model_bytes_f32",
+                "hbm_budget_bytes", "combined_f32_bytes",
+                "single_req_s", "single_p50_ms",
+                "paged_single_req_s", "paged_single_p50_ms",
+                "paging_overhead_pct", "paging_byte_identical",
+                "mix_baseline_req_s", "mix_baseline_warm_p50_ms",
+                "mix_req_s", "mix_warm_p50_ms", "mix_warm_p50_ratio",
+                "per_model", "failed_requests", "page_ins", "page_outs",
+                "overcommits", "inflight_evictions",
+                "churn_byte_identical",
+            )
+        }
+    )
+    row["overhead_budget_pct"] = MODELS_OVERHEAD_BUDGET_PCT
+    problems = []
+    if drill.get("error"):
+        problems.append(drill["error"])
+    overhead = drill.get("paging_overhead_pct")
+    if overhead is None or overhead > MODELS_OVERHEAD_BUDGET_PCT:
+        problems.append(
+            f"managed single-model overhead {overhead}% over the "
+            f"{MODELS_OVERHEAD_BUDGET_PCT:.0f}% budget"
         )
     if problems:
         row["error"] = "; ".join(problems)
@@ -843,6 +920,12 @@ def main() -> int:
             # collateral on the mid-run kill
             result = run_fleet_guard()
             result["date"] = date
+        elif tok == "models":
+            # multi-model paging drill (round 15): three backbones from
+            # one pool under a budget that forces paging + the
+            # single-model inert-vs-managed overhead A/B
+            result = run_models_guard()
+            result["date"] = date
         elif tok == "kpack":
             # channel-packed backward tail A/B (round 12): bit-equality
             # asserted in the probe, loud error on regression or a
@@ -864,7 +947,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'models'])}",
             }
         else:
             n = int(tok)

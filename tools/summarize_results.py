@@ -49,6 +49,20 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "paging_overhead_pct" in row:
+        # multi-model paging rows (round 15): the managed-vs-inert
+        # overhead, the mix's paging activity, and the warm-path ratio
+        # in one line; error kept visible
+        line = (
+            f"paging overhead {row.get('paging_overhead_pct')}% "
+            f"(budget {row.get('overhead_budget_pct', 3)}%), mix "
+            f"{row.get('mix_req_s')} req/s warm x"
+            f"{row.get('mix_warm_p50_ratio', '?')}, "
+            f"page_ins={row.get('page_ins')} outs={row.get('page_outs')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "aggregate_hit_ratio" in row:
         # fleet-tier rows (round 14): the one-logical-cache claim plus
         # the kill phase's collateral in one line, error kept visible
