@@ -40,6 +40,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "jobs_dir", "jobs_workers", "jobs_queue_depth",
         "tenants", "qos_default_class",
         "serve_models", "pinned_models", "hbm_budget_bytes", "weight_dtype",
+        "l2_dir", "l2_bytes", "fleet_routers", "fleet_token",
+        "fleet_advertise",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -63,10 +65,14 @@ def cmd_fleet_router(args: argparse.Namespace) -> int:
     model weights, and boots in milliseconds."""
     from deconv_api_tpu.serving.fleet import main as fleet_main
 
-    argv = ["--backends", args.backends]
+    argv = []
+    if args.backends:
+        argv += ["--backends", args.backends]
     for flag in (
         "host", "port", "vnodes", "probe_interval_s", "probe_timeout_s",
         "eject_threshold", "cooldown_s", "forward_timeout_s",
+        "membership_file", "fleet_token", "hot_key_top_k",
+        "hot_key_replicas",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -405,6 +411,32 @@ def main(argv: list[str] | None = None) -> int:
         help="stored weight precision in HBM (quantized tiers trade "
         "PSNR-bounded fidelity for resident models)",
     )
+    s.add_argument(
+        "--l2-dir", default=None, dest="l2_dir", metavar="DIR",
+        help="durable L2 response cache directory (digest-verified "
+        "write-through; a rolling restart recovers the hitset from "
+        "disk; default off)",
+    )
+    s.add_argument(
+        "--l2-bytes", type=int, default=None, dest="l2_bytes",
+        help="L2 byte budget (oldest entries sweep; default 1 GiB)",
+    )
+    s.add_argument(
+        "--fleet-routers", default=None, dest="fleet_routers",
+        metavar="HOST:PORT,HOST:PORT",
+        help="router addresses to self-register with on boot and "
+        "announce drain to on SIGTERM (needs --fleet-token)",
+    )
+    s.add_argument(
+        "--fleet-token", default=None, dest="fleet_token",
+        help="shared fleet secret for registration announcements",
+    )
+    s.add_argument(
+        "--fleet-advertise", default=None, dest="fleet_advertise",
+        metavar="HOST:PORT",
+        help="address this backend registers as (default "
+        "<hostname>:<port>)",
+    )
     _add_common(s)
     s.set_defaults(fn=cmd_serve)
 
@@ -413,8 +445,32 @@ def main(argv: list[str] | None = None) -> int:
         help="cache-affine consistent-hash router over N serve backends",
     )
     s.add_argument(
-        "--backends", required=True, metavar="HOST:PORT,HOST:PORT",
-        help="comma-separated backend list (the `serve` processes)",
+        "--backends", default=None, metavar="HOST:PORT,HOST:PORT",
+        help="comma-separated backend list (the `serve` processes); "
+        "optional when --membership-file/--fleet-token let backends "
+        "join dynamically",
+    )
+    s.add_argument(
+        "--membership-file", default=None, dest="membership_file",
+        metavar="PATH",
+        help="shared membership view: N routers over one watched file "
+        "converge on one member set (HA router tier)",
+    )
+    s.add_argument(
+        "--fleet-token", default=None, dest="fleet_token",
+        help="shared secret authenticating backend self-registration "
+        "(POST /v1/internal/register)",
+    )
+    s.add_argument(
+        "--hot-key-top-k", type=int, default=None, dest="hot_key_top_k",
+        help="replicate the K hottest keys to --hot-key-replicas ring "
+        "owners, spreading reads (0 = off, the default)",
+    )
+    s.add_argument(
+        "--hot-key-replicas", type=int, default=None,
+        dest="hot_key_replicas",
+        help="ring owners a promoted hot key spreads reads over "
+        "(default 2)",
     )
     s.add_argument("--host", default=None)
     s.add_argument("--port", type=int, default=None)

@@ -248,6 +248,33 @@ class ServerConfig:
     # Per-peer-fetch timeout: past this the miss just computes — a slow
     # peer must never cost more than the compute it would have saved.
     peer_fill_timeout_s: float = 2.0
+    # --- zero-SPOF fleet (round 16: HA routers + durable L2) ---
+    # Durable L2 response cache: a disk tier behind the in-memory LRU
+    # (serving/cache.py L2Store).  Positive entries write through
+    # asynchronously under the l2_bytes budget and are looked up on a
+    # memory miss BEFORE compute, digest-verified (corruption reads as a
+    # miss, never an error) — so a rolling restart recovers the hitset
+    # from disk in seconds instead of recomputing it.  Empty = DISABLED:
+    # the default server touches no disk and is byte-identical to the
+    # pre-round-16 path (pinned by test).
+    l2_dir: str = ""
+    # L2 byte budget; oldest entries (by last-read mtime, which survives
+    # restarts) sweep when exceeded.  0 = unbounded.
+    l2_bytes: int = 1024 * 1024 * 1024
+    # Shared fleet secret: backends present it (x-fleet-token) when
+    # self-registering with routers, and routers require it on
+    # POST /v1/internal/register.  Empty disables registration on both
+    # sides — routers then 404 the route and backends never announce.
+    fleet_token: str = ""
+    # Router addresses ('host:port,host:port') this backend announces
+    # itself to: register on boot, drain on SIGTERM — replacing the
+    # router's static --backends list.  Empty = no announcements.
+    fleet_routers: str = ""
+    # The host:port THIS backend registers as (what routers will probe
+    # and forward to).  Empty = '<hostname>:<bound port>' — set it
+    # explicitly whenever the bind address is not what peers should
+    # dial (0.0.0.0 binds, NAT, container port maps).
+    fleet_advertise: str = ""
     # device placement
     platform: str = ""  # '' = jax default; 'cpu'/'tpu' force a backend
     mesh_shape: tuple[int, ...] = ()  # () = single device; (n,) = dp over n

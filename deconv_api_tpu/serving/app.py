@@ -28,6 +28,7 @@ import os
 import re
 import threading
 import time
+import urllib.parse
 
 import numpy as np
 
@@ -41,6 +42,7 @@ from deconv_api_tpu.serving.batcher import (
     pad_bucket,
 )
 from deconv_api_tpu.serving.cache import (
+    L2Store,
     ResponseCache,
     Singleflight,
     canonical_digest,
@@ -377,7 +379,23 @@ class DeconvService:
             if self.cfg.cache_bytes > 0
             else None
         )
+        # Durable L2 tier (round 16, serving/cache.py L2Store): positive
+        # payloads write through asynchronously to disk and are looked
+        # up on a memory miss BEFORE compute — a rolling restart
+        # recovers the hitset from disk in seconds.  '' = disabled: the
+        # default server touches no disk (pinned byte-identical).
+        self.l2 = (
+            L2Store(
+                self.cfg.l2_dir, self.cfg.l2_bytes, metrics=self.metrics
+            )
+            if self.cfg.l2_dir
+            else None
+        )
         self.flights = Singleflight() if self.cfg.singleflight else None
+        # drain announcement sent at most once per process lifetime
+        # (round 16 self-registration; both serve_forever and stop()
+        # announce, whichever runs first wins)
+        self._drain_announced = False
         # Per-request tracing spine (round 8, serving/trace.py): every
         # compute request gets a span-structured trace — decode, cache
         # lookup/coalesce, queue wait, batch membership, device
@@ -1473,6 +1491,30 @@ class DeconvService:
             },
         )
 
+    async def _l2_lookup(self, key: str, tr) -> Response | None:
+        """Durable L2 read on a memory miss (round 16): a digest-verified
+        disk hit serves the finished payload without touching the codec
+        pool, batcher, or device — the rolling-restart recovery path.
+        Corrupt/truncated entries read as None (the store deletes them),
+        so this can only ever SAVE the compute that would follow."""
+        if self.l2 is None:
+            return None
+        t0 = time.perf_counter()
+        got = await asyncio.to_thread(self.l2.get, key)
+        if tr is not None:
+            tr.add_span(
+                "l2_lookup", t0, time.perf_counter() - t0,
+                hit=got is not None,
+            )
+        if got is None:
+            return None
+        status, body, content_type = got
+        return Response(
+            status=status,
+            body=body,
+            headers={"content-type": content_type, "x-cache": "l2"},
+        )
+
     def _cache_wrap(self, route: str, handler, metrics: Metrics):
         """Put the response cache + singleflight table in front of a
         compute route.
@@ -1493,7 +1535,7 @@ class DeconvService:
         per-request accounting (requests_total, latency) goes to the
         route's own stream, so dream-route hits don't pollute deconv SLO
         stats."""
-        if self.cache is None and self.flights is None:
+        if self.cache is None and self.flights is None and self.l2 is None:
             return handler
 
         async def cached(req: Request) -> Response:
@@ -1623,6 +1665,11 @@ class DeconvService:
                 # later identical request coalesces onto it.
                 try:
                     filled = await self._peer_fill(req, key, tr)
+                    if filled is None:
+                        # durable L2 (round 16): disk before device — a
+                        # restarted backend's memory is cold but its L2
+                        # holds the pre-restart hitset
+                        filled = await self._l2_lookup(key, tr)
                     resp = (
                         filled if filled is not None else await handler(req)
                     )
@@ -1684,10 +1731,12 @@ class DeconvService:
                     self.flights.finish(key, resp)
             else:
                 # a no-cache/no-store bypass is a forced RECOMPUTE: it
-                # must not be satisfied from a peer's cache either
+                # must not be satisfied from a peer's cache — or the L2
                 resp = (
                     None if bypass else await self._peer_fill(req, key, tr)
                 )
+                if resp is None and not bypass:
+                    resp = await self._l2_lookup(key, tr)
                 if resp is not None:
                     # refund to hit cost: no device work ran (see the
                     # singleflight peer-fill branch above)
@@ -1698,6 +1747,23 @@ class DeconvService:
                     resp = await handler(req)
             if self.cache is not None and "no-store" not in cc:
                 self.cache.store(
+                    key,
+                    resp.status,
+                    resp.body,
+                    resp.headers.get("content-type", "application/json"),
+                )
+            if (
+                self.l2 is not None
+                and "no-store" not in cc
+                and resp.status == 200
+                and resp.headers.get("x-cache") != "l2"
+            ):
+                # positive write-through, ASYNC by contract (a bounded
+                # queue + writer thread; the serving path never blocks
+                # on disk) — an entry that just came FROM the L2 is not
+                # rewritten.  Negative entries stay memory-only: their
+                # TTL is seconds, durability would serve stale errors.
+                self.l2.put_async(
                     key,
                     resp.status,
                     resp.body,
@@ -2725,6 +2791,79 @@ class DeconvService:
 
     # ---------------------------------------------------------- lifecycle
 
+    def _advertise_name(self) -> str:
+        """The host:port this backend registers as: cfg.fleet_advertise
+        when set, else '<hostname>:<bound port>' — the bind host is
+        often 0.0.0.0, which no peer can dial."""
+        if self.cfg.fleet_advertise:
+            return self.cfg.fleet_advertise
+        import socket
+
+        port = self.bound[1] if self.bound else self.cfg.port
+        return f"{socket.gethostname()}:{port}"
+
+    async def announce_to_routers(self, action: str) -> int:
+        """Backend self-registration (round 16): POST
+        /v1/internal/register (authenticated by the shared fleet token)
+        to every configured router — ``register`` on boot, ``drain`` on
+        SIGTERM, replacing the router's static --backends list.  Best
+        effort by design: an unreachable router learns the same facts
+        from its membership file or its probes, so failures log and
+        move on.  Returns how many routers acknowledged."""
+        if not self.cfg.fleet_routers:
+            return 0
+        if action == "drain":
+            if self._drain_announced:
+                return 0
+            self._drain_announced = True
+        from deconv_api_tpu.serving import fleet
+        from deconv_api_tpu.utils import slog as _slog
+
+        adv = self._advertise_name()
+        body = urllib.parse.urlencode(
+            {"backend": adv, "action": action}
+        ).encode()
+        headers = {
+            "content-type": "application/x-www-form-urlencoded",
+            "x-fleet-token": self.cfg.fleet_token,
+        }
+        acks = 0
+        for router in self.cfg.fleet_routers.split(","):
+            router = router.strip()
+            host, _, port = router.rpartition(":")
+            if not host or not port.isdigit():
+                _slog.event(
+                    _slog.get_logger("deconv.app"), "announce_bad_router",
+                    level=30, router=router,
+                )
+                continue
+            try:
+                status, _h, rbody = await fleet.raw_request(
+                    host, int(port), "POST", "/v1/internal/register",
+                    headers, body, 5.0,
+                )
+            except Exception as e:  # noqa: BLE001 — best effort
+                _slog.event(
+                    _slog.get_logger("deconv.app"), "announce_failed",
+                    level=30, router=router, action=action,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                continue
+            if status == 200:
+                acks += 1
+            else:
+                _slog.event(
+                    _slog.get_logger("deconv.app"), "announce_rejected",
+                    level=40, router=router, action=action, status=status,
+                    body=rbody[:200].decode("utf-8", "replace"),
+                )
+        _slog.event(
+            _slog.get_logger("deconv.app"), "announce_done",
+            backend=adv, action=action, acks=acks,
+            routers=len([r for r in self.cfg.fleet_routers.split(",") if r.strip()]),
+        )
+        return acks
+
     async def start(self, host: str | None = None, port: int | None = None) -> int:
         if self.codec_pool.closed:
             # stop() -> start() restart cycle (the dispatchers support it;
@@ -2735,6 +2874,14 @@ class DeconvService:
                 max_pending=self.cfg.codec_queue_depth,
                 metrics=self.metrics,
             )
+        if self.l2 is not None and self.l2.closed:
+            # same restart contract: a fresh writer thread + a rescan of
+            # the directory (the previous generation's entries ARE the
+            # point — the hitset survives the restart)
+            self.l2 = L2Store(
+                self.cfg.l2_dir, self.cfg.l2_bytes, metrics=self.metrics
+            )
+        self._drain_announced = False
         await self.dispatcher.start()
         await self.dream_dispatcher.start()
         await self.sweep_dispatcher.start()
@@ -2767,6 +2914,10 @@ class DeconvService:
 
     async def stop(self, grace_s: float = 10.0) -> None:
         self.begin_drain()
+        # round 16: tell the routers FIRST — the announcement is a
+        # faster, authoritative signal than their next probe tick, so
+        # they stop routing here before the listener starts dying
+        await self.announce_to_routers("drain")
         if self.jobs is not None:
             # BEFORE the dispatchers die: a runner parking mid-octave
             # journals from its cancellation handler, and any in-flight
@@ -2781,6 +2932,11 @@ class DeconvService:
         for d in (self.dispatcher, self.dream_dispatcher, self.sweep_dispatcher):
             await d.stop(grace_s=max(0.0, deadline - time.perf_counter()))
         self.codec_pool.close()
+        if self.l2 is not None:
+            # flush queued write-throughs: the restarted process's L2
+            # must hold everything this one served (the rolling-restart
+            # recovery contract)
+            self.l2.close()
         if self.faults is not None:
             # release the module hook only if it is still OURS (another
             # service constructed later may have installed its own)
@@ -2825,6 +2981,10 @@ async def serve_forever(cfg: ServerConfig) -> None:
         lanes=service.lane_count,
     )
     print(f"deconv_api_tpu serving on {service.cfg.host}:{port}", flush=True)
+    # self-registration (round 16): announce BEFORE warmup — routers
+    # start probing immediately and admit this backend into the ring the
+    # moment /readyz first answers 200 (ring entry stays probe-gated)
+    await service.announce_to_routers("register")
     await asyncio.to_thread(service.warmup)
     slog.event(slog.get_logger("deconv.app"), "warmup_done")
     print("model warmed up; /ready now 200", flush=True)
@@ -2855,6 +3015,9 @@ async def serve_forever(cfg: ServerConfig) -> None:
     # open for drain_grace_s so load balancers observe the flip and stop
     # routing before connections start dying (round 9).
     service.begin_drain()
+    # drain announcement rides AHEAD of the grace window: routers skip
+    # this backend now, not at their next probe tick (round 16)
+    await service.announce_to_routers("drain")
     if cfg.drain_grace_s > 0:
         await asyncio.sleep(cfg.drain_grace_s)
     await service.stop()
@@ -2996,6 +3159,35 @@ def main(argv: list[str] | None = None) -> None:
         "hint on cache misses and serve GET /v1/internal/cache/{digest} "
         "to ring peers (trusted meshes only; default off)",
     )
+    p.add_argument(
+        "--l2-dir", default=None, metavar="DIR",
+        help="durable L2 response cache: positive payloads write "
+        "through to this directory (digest-verified, byte-budgeted) and "
+        "are read back on memory misses — a rolling restart recovers "
+        "the hitset from disk (default off)",
+    )
+    p.add_argument(
+        "--l2-bytes", type=int, default=None,
+        help="L2 byte budget; oldest entries sweep above it "
+        "(default 1 GiB, 0 = unbounded)",
+    )
+    p.add_argument(
+        "--fleet-routers", default=None, metavar="HOST:PORT,HOST:PORT",
+        help="router addresses this backend announces itself to: "
+        "register on boot, drain on SIGTERM (replaces the router's "
+        "static --backends list; needs --fleet-token)",
+    )
+    p.add_argument(
+        "--fleet-token", default=None,
+        help="shared fleet secret presented on registration "
+        "announcements (x-fleet-token)",
+    )
+    p.add_argument(
+        "--fleet-advertise", default=None, metavar="HOST:PORT",
+        help="the address this backend registers as (default "
+        "<hostname>:<port>; set it when the bind address is not what "
+        "peers should dial)",
+    )
     args = p.parse_args(argv)
     overrides = {}
     if args.cache_bytes is not None:
@@ -3049,6 +3241,16 @@ def main(argv: list[str] | None = None) -> None:
         overrides["weight_dtype"] = args.weight_dtype
     if args.peer_fill:
         overrides["fleet_peer_fill"] = True
+    if args.l2_dir is not None:
+        overrides["l2_dir"] = args.l2_dir
+    if args.l2_bytes is not None:
+        overrides["l2_bytes"] = args.l2_bytes
+    if args.fleet_routers is not None:
+        overrides["fleet_routers"] = args.fleet_routers
+    if args.fleet_token is not None:
+        overrides["fleet_token"] = args.fleet_token
+    if args.fleet_advertise is not None:
+        overrides["fleet_advertise"] = args.fleet_advertise
     if args.host is not None:
         overrides["host"] = args.host
     if args.port is not None:

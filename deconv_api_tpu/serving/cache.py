@@ -35,6 +35,15 @@ comes from never doing the work twice.  Three pieces live here:
   the downstream machinery — 5xxs (shed, timeout, crash) are transient
   by definition and never cached.
 
+- ``L2Store`` (round 16): a DURABLE disk tier behind the in-memory LRU,
+  built on the job subsystem's digest-verified tmp-then-rename storage
+  idiom (serving/jobs.py SpillStore).  Positive entries are written
+  through asynchronously under a byte budget with an LRU sweep and
+  looked up on a memory miss BEFORE compute; a digest mismatch or a
+  corrupt/truncated file reads as a miss, never an error — so a rolling
+  restart of every backend recovers its hitset from disk in seconds
+  instead of recomputing it from zero (the fleet-ha drill pins this).
+
 - ``Singleflight``: a flight table coalescing concurrent identical
   misses onto ONE in-flight future.  N identical requests in flight →
   exactly one decode / device dispatch / encode; the leader publishes
@@ -55,6 +64,11 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import json
+import logging
+import os
+import queue
+import re
 import threading
 import time
 from collections import OrderedDict
@@ -64,6 +78,9 @@ from typing import Callable
 from deconv_api_tpu import errors
 from deconv_api_tpu.serving import trace as trace_mod
 from deconv_api_tpu.serving.http import Request, Response
+from deconv_api_tpu.utils import slog
+
+_log = slog.get_logger("deconv.cache")
 
 # Rough per-entry bookkeeping charged against the byte budget on top of
 # the payload: key string, OrderedDict slot, dataclass fields.  Keeps a
@@ -417,3 +434,278 @@ class Singleflight:
             fut.exception()
         else:
             fut.set_result(result)
+
+
+# cache keys are canonical_digest hexdigests — anything else must never
+# reach the filesystem layer as a file name
+_L2_KEY_RE = re.compile(r"^[0-9a-f]{16,128}$")
+
+# sanity bound on the header line of an .l2 file: a corrupt file whose
+# first newline is megabytes in must read as corrupt, not allocate-and-parse
+_L2_HEADER_MAX = 4096
+
+
+class L2Store:
+    """Durable disk tier behind the in-memory ``ResponseCache`` (round 16).
+
+    One file per key under ``root``: a single JSON header line (status,
+    content type, body digest, body length) followed by the raw payload
+    bytes.  Every write is tmp-then-rename with fsync (the SpillStore
+    idiom — a crash leaves either a complete entry or a stale ``.tmp``
+    the next boot sweeps); every read verifies the recorded blake2b
+    digest and length, and ANY defect — torn header, short body, digest
+    mismatch — deletes the file and reads as a miss, never an error.
+
+    Budgeting: ``max_bytes`` bounds resident bytes (0 = unbounded); the
+    in-memory index (rebuilt from the directory at boot, ordered by
+    mtime) is the LRU — a read touches the file's mtime so recency
+    SURVIVES a restart, and an insert sweeps oldest-first until the
+    budget holds.  An entry larger than the whole budget is not stored.
+
+    Writes are asynchronous by contract: ``put_async`` hands the entry
+    to a single daemon writer thread (bounded queue; a full queue drops
+    the write with a counter — the disk tier is an optimization, it must
+    never backpressure the serving path).  ``get`` is synchronous
+    (callers run it via ``asyncio.to_thread``).
+
+    Counters/gauges (through the injected Metrics registry):
+    ``cache_l2_{hits,misses,stores,sweeps,corrupt}_total`` and
+    ``cache_l2_resident_bytes``."""
+
+    def __init__(
+        self,
+        root: str,
+        max_bytes: int = 0,
+        *,
+        metrics=None,
+        queue_depth: int = 256,
+    ):
+        self.root = root
+        self.max_bytes = int(max_bytes)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        # key -> charged bytes, oldest-mtime first (the LRU order)
+        self._index: OrderedDict[str, int] = OrderedDict()
+        self._resident = 0
+        self.closed = False
+        os.makedirs(root, exist_ok=True)
+        self._rescan()
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
+        self._worker = threading.Thread(
+            target=self._drain, name="l2-writer", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------ internals
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self._metrics is not None:
+            self._metrics.inc_counter(name, n)
+
+    def _publish(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge("cache_l2_resident_bytes", self._resident)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + ".l2")
+
+    def _rescan(self) -> None:
+        """Rebuild the index from the directory (boot / restart): stale
+        ``.tmp`` files from a crashed writer are swept, complete entries
+        come back oldest-mtime-first so LRU order survives the restart."""
+        entries: list[tuple[float, str, int]] = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            names = []
+        for fn in names:
+            path = os.path.join(self.root, fn)
+            if fn.endswith(".tmp"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            if not fn.endswith(".l2"):
+                continue
+            key = fn[: -len(".l2")]
+            if not _L2_KEY_RE.match(key):
+                continue
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, key, st.st_size))
+        entries.sort()
+        with self._lock:
+            self._index = OrderedDict(
+                (key, size) for _mt, key, size in entries
+            )
+            self._resident = sum(size for _mt, _k, size in entries)
+        self._publish()
+
+    def _evict_locked(self, key: str) -> None:
+        size = self._index.pop(key, None)
+        if size is not None:
+            self._resident -= size
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- surface
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident
+
+    @property
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def get(self, key: str) -> tuple[int, bytes, str] | None:
+        """``(status, body, content_type)`` for a verified entry, None on
+        miss.  Corruption in any form deletes the file and counts
+        ``cache_l2_corrupt_total`` on top of the miss — the disk tier can
+        degrade, it can never serve wrong bytes or raise."""
+        if not _L2_KEY_RE.match(key):
+            return None
+        with self._lock:
+            known = key in self._index
+        if not known:
+            self._count("cache_l2_misses_total")
+            return None
+        try:
+            with open(self._path(key), "rb") as f:
+                raw = f.read()
+        except OSError:
+            # raced a sweep, or the file vanished underneath us: a miss
+            with self._lock:
+                self._index.pop(key, None)
+            self._count("cache_l2_misses_total")
+            return None
+        head, sep, body = raw.partition(b"\n")
+        ok = bool(sep) and len(head) <= _L2_HEADER_MAX
+        meta = None
+        if ok:
+            try:
+                meta = json.loads(head)
+            except ValueError:
+                ok = False
+        if ok:
+            ok = (
+                isinstance(meta, dict)
+                and isinstance(meta.get("status"), int)
+                and meta.get("len") == len(body)
+                and meta.get("digest")
+                == hashlib.blake2b(body, digest_size=16).hexdigest()
+            )
+        if not ok:
+            slog.event(
+                _log, "l2_corrupt_entry", level=logging.WARNING, key=key
+            )
+            with self._lock:
+                self._evict_locked(key)
+            self._count("cache_l2_corrupt_total")
+            self._count("cache_l2_misses_total")
+            self._publish()
+            return None
+        with self._lock:
+            if key in self._index:
+                self._index.move_to_end(key)
+        try:
+            # recency must survive a restart: _rescan orders by mtime
+            os.utime(self._path(key))
+        except OSError:
+            pass
+        self._count("cache_l2_hits_total")
+        return meta["status"], body, str(meta.get("ct", "application/json"))
+
+    def put(self, key: str, status: int, body: bytes, content_type: str) -> bool:
+        """Synchronous write-through of one POSITIVE entry (the writer
+        thread's body; tests call it directly).  Returns whether stored."""
+        if status != 200 or not _L2_KEY_RE.match(key):
+            return False
+        head = json.dumps(
+            {
+                "v": 1,
+                "status": status,
+                "ct": content_type,
+                "len": len(body),
+                "digest": hashlib.blake2b(body, digest_size=16).hexdigest(),
+            },
+            separators=(",", ":"),
+        ).encode()
+        data = head + b"\n" + body
+        if self.max_bytes and len(data) > self.max_bytes:
+            # one oversized payload must not evict the whole durable set
+            return False
+        path = self._path(key)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            slog.event(
+                _log, "l2_write_error", level=logging.ERROR,
+                key=key, error=f"{type(e).__name__}: {e}",
+            )
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        swept = 0
+        with self._lock:
+            old = self._index.pop(key, None)
+            if old is not None:
+                self._resident -= old
+            self._index[key] = len(data)
+            self._resident += len(data)
+            while (
+                self.max_bytes
+                and self._resident > self.max_bytes
+                and len(self._index) > 1
+            ):
+                victim = next(iter(self._index))
+                self._evict_locked(victim)
+                swept += 1
+        if swept:
+            self._count("cache_l2_sweeps_total", swept)
+        self._count("cache_l2_stores_total")
+        self._publish()
+        return True
+
+    def put_async(self, key: str, status: int, body: bytes, content_type: str) -> None:
+        """Enqueue a write for the background writer; a full queue drops
+        the entry (counted) rather than stalling the caller."""
+        try:
+            self._queue.put_nowait((key, status, body, content_type))
+        except queue.Full:
+            self._count("cache_l2_store_drops_total")
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            try:
+                self.put(*item)
+            except Exception as e:  # noqa: BLE001 — writer must survive
+                slog.event(
+                    _log, "l2_writer_error", level=logging.ERROR,
+                    error=f"{type(e).__name__}: {e}",
+                )
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Flush queued writes and stop the writer thread (idempotent)."""
+        if self.closed:
+            return
+        self.closed = True
+        self._queue.put(None)
+        self._worker.join(timeout_s)

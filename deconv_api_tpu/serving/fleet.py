@@ -81,21 +81,59 @@ Pieces:
   recomputes.  Off by default on backends (``fleet_peer_fill`` config;
   trusted-mesh only — the hint names a host to fetch from).
 
+Round 16 removes the router tier's remaining single points of failure —
+every process in the fleet becomes killable with zero request loss:
+
+- **HA routers**: N stateless router processes share ONE membership
+  view through a watched membership file (``--membership-file``; JSON,
+  tmp-then-rename writes, mtime-polled every probe tick).  Key
+  ownership is a pure function of the member set, so routers over the
+  same view make identical placements and are interchangeable behind
+  any TCP load balancer — each router's existing ``/readyz`` gates it.
+
+- **Backend self-registration**: backends announce themselves on boot
+  (``POST /v1/internal/register``, authenticated by the shared fleet
+  token) and announce drain on SIGTERM, replacing the static
+  ``--backends`` list.  A registered backend enters the ring only
+  after its first healthy probe — the health-gate/eject/half-open
+  machinery is unchanged.  A SELF-ANNOUNCED drain is authoritative and
+  immediate: round-robin picks and the jobs collection fan-out skip
+  the member before the next probe tick could observe its readyz 503
+  (the jobs ENTITY walk still asks it, bounded by the walk timeout —
+  it may be the only holder of a polled job's state, and its listener
+  lives out the drain grace window).
+
+- **Hot-key replication**: consistent hashing pins a super-hot key to
+  ONE backend; the ``HotKeyTracker`` measures per-key EWMA request
+  rates (entry-capped with decay — attacker-chosen unique keys cannot
+  grow router memory), promotes the zipf head (top-K over a rate
+  floor) and spreads its READS round-robin over R ring owners.  A
+  non-primary replica is forwarded with an ``x-peer-fill`` hint naming
+  the primary, so its first miss fills from the primary's cache
+  instead of recomputing — writes (forced recomputes via
+  ``cache-control``) still route to the primary only, where the
+  backend's singleflight dedups them.
+
 Observability rides the existing machinery: a ``Metrics`` registry in
 non-core mode (prefix ``router``) carries
 ``router_requests_total{backend=}`` / ``router_backend_state{backend=}``
 (0 healthy / 1 joining / 2 ejected / 3 draining) /
-``router_rebalanced_keys_total`` plus forward-latency stages, and the
-router serves its own ``/healthz``, ``/readyz`` (ready while ANY backend
-is in the ring), ``/v1/config`` (full ring snapshot) and ``/metrics``.
+``router_rebalanced_keys_total`` /
+``router_membership_source{kind=}`` (members by static/file/announce) /
+``router_hot_keys_active`` / ``router_replica_reads_total{backend=}``
+plus forward-latency stages, and the router serves its own
+``/healthz``, ``/readyz`` (ready while ANY backend is in the ring),
+``/v1/config`` (full ring snapshot) and ``/metrics``.
 """
 
 from __future__ import annotations
 
 import asyncio
 import hashlib
+import hmac
 import json
 import logging
+import os
 import re
 import time
 import urllib.parse
@@ -137,6 +175,114 @@ _JOB_OWNERS_MAX = 4096
 
 # router_backend_state gauge values, one line per backend
 _STATE_GAUGE = {"healthy": 0, "joining": 1, "ejected": 2, "draining": 3}
+
+# Explicit cap on the rebalance `seen`-set (round 16 satellite: the same
+# attacker-chosen-cardinality rule PR 8 applied to tenants — unbounded
+# unique keys must never grow router memory; a clipped key double-counts
+# at worst, and the clip itself is counted).
+MOVED_SEEN_MAX = 4096
+
+
+class HotKeyTracker:
+    """Per-key EWMA request-rate tracker + zipf-head promotion (round 16).
+
+    Consistent hashing's pathology is the SUPER-hot key: one owner
+    serves the whole head of a zipf distribution while its peers idle.
+    The router already sees every keyed request, so this tracker keeps a
+    decayed per-key score (each observation adds 1, the total halves
+    every ``halflife_s`` — a rate-in-recent-window, cheap to update
+    lazily) and promotes the top ``top_k`` keys whose score clears
+    ``min_rate`` into the HOT set.  Promotion/demotion happens at
+    ``recompute()`` (driven every ``recompute_every`` observations and
+    by the router's probe tick, so demotion-on-cooldown needs no
+    traffic on the cooled key).
+
+    Memory is explicitly bounded (the PR 8 tenant-cardinality rule):
+    at most ``max_entries`` tracked keys — past it the coldest half is
+    dropped in one pass and ``hot_tracker_clipped_total`` counts what
+    the cap clipped.  Attacker-chosen unique keys cost at most the cap.
+    """
+
+    def __init__(
+        self,
+        top_k: int,
+        *,
+        max_entries: int = 4096,
+        halflife_s: float = 30.0,
+        min_rate: float = 8.0,
+        recompute_every: int = 64,
+        clock: Callable[[], float] = time.monotonic,
+        metrics: Metrics | None = None,
+    ):
+        self.top_k = int(top_k)
+        self.max_entries = max(self.top_k, int(max_entries))
+        self.halflife_s = float(halflife_s)
+        self.min_rate = float(min_rate)
+        self.recompute_every = max(1, int(recompute_every))
+        self._clock = clock
+        self._metrics = metrics
+        # key -> (score at last update, last update timestamp)
+        self._scores: dict[str, tuple[float, float]] = {}
+        self._hot: frozenset[str] = frozenset()
+        self._since_recompute = 0
+
+    def _decayed(self, score: float, last: float, now: float) -> float:
+        if now <= last:
+            return score
+        return score * 0.5 ** ((now - last) / self.halflife_s)
+
+    def observe(self, key: str) -> None:
+        now = self._clock()
+        score, last = self._scores.get(key, (0.0, now))
+        self._scores[key] = (self._decayed(score, last, now) + 1.0, now)
+        if len(self._scores) > self.max_entries:
+            self._clip(now)
+        self._since_recompute += 1
+        if self._since_recompute >= self.recompute_every:
+            self.recompute()
+
+    def _clip(self, now: float) -> None:
+        """One-pass cap enforcement: keep the hottest half, count the
+        rest.  Amortized — runs only when an insert crosses the cap."""
+        ranked = sorted(
+            self._scores.items(),
+            key=lambda kv: self._decayed(kv[1][0], kv[1][1], now),
+            reverse=True,
+        )
+        keep = max(self.top_k, self.max_entries // 2)
+        clipped = len(ranked) - keep
+        self._scores = dict(ranked[:keep])
+        if clipped > 0 and self._metrics is not None:
+            self._metrics.inc_counter("hot_tracker_clipped_total", clipped)
+
+    def recompute(self) -> None:
+        """Refresh the hot set: decay every score to now, drop entries
+        that have cooled to noise, promote the top-K above the floor.
+        A key whose traffic stopped decays below ``min_rate`` and is
+        demoted here even if it is never observed again."""
+        self._since_recompute = 0
+        now = self._clock()
+        live: dict[str, tuple[float, float]] = {}
+        candidates: list[tuple[float, str]] = []
+        for key, (score, last) in self._scores.items():
+            d = self._decayed(score, last, now)
+            if d < 0.05:
+                continue  # stone cold: self-clean
+            live[key] = (d, now)
+            if d >= self.min_rate:
+                candidates.append((d, key))
+        self._scores = live
+        candidates.sort(reverse=True)
+        self._hot = frozenset(k for _d, k in candidates[: self.top_k])
+        if self._metrics is not None:
+            self._metrics.set_gauge("hot_keys_active", len(self._hot))
+
+    def is_hot(self, key: str) -> bool:
+        return key in self._hot
+
+    @property
+    def hot_keys(self) -> frozenset[str]:
+        return self._hot
 
 
 def _ring_point(data: bytes) -> int:
@@ -234,6 +380,15 @@ class BackendMember:
             eject_threshold, cooldown_s, clock=clock
         )
         self.requests_total = 0
+        # round 16: the backend itself said "I am going away NOW"
+        # (POST /v1/internal/register action=drain, or the membership
+        # file's drain flag) — authoritative and faster than the next
+        # probe tick, so round-robin AND the jobs collection fan-out
+        # skip it immediately.  Cleared when it re-registers or a probe
+        # that STARTED after the announcement answers healthy (the
+        # timestamp guards against an in-flight stale 200).
+        self.announced_drain = False
+        self.drain_announced_at = 0.0
 
     @property
     def in_ring(self) -> bool:
@@ -415,7 +570,7 @@ class FleetRouter:
 
     def __init__(
         self,
-        backends: list[str] | tuple[str, ...],
+        backends: list[str] | tuple[str, ...] = (),
         *,
         vnodes: int = 64,
         probe_interval_s: float = 2.0,
@@ -427,11 +582,21 @@ class FleetRouter:
         idle_timeout_s: float = 30.0,
         body_timeout_s: float = 20.0,
         max_connections: int = 1024,
+        membership_file: str = "",
+        fleet_token: str = "",
+        hot_key_top_k: int = 0,
+        hot_key_replicas: int = 2,
+        hot_key_min_rate: float = 8.0,
         metrics: Metrics | None = None,
         clock: Callable[[], float] = time.monotonic,
     ):
-        if not backends:
-            raise ValueError("fleet router needs at least one backend")
+        if not backends and not membership_file and not fleet_token:
+            # with neither a shared membership view nor self-registration
+            # there is no way for a backend to ever appear
+            raise ValueError(
+                "fleet router needs at least one backend (or a "
+                "--membership-file / --fleet-token so backends can join)"
+            )
         self.vnodes = int(vnodes)
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
@@ -439,9 +604,33 @@ class FleetRouter:
         self.cooldown_s = float(cooldown_s)
         self.peer_fill = bool(peer_fill)
         self.forward_timeout_s = float(forward_timeout_s)
+        self.membership_file = membership_file
+        self.fleet_token = fleet_token
+        self.hot_key_replicas = max(1, int(hot_key_replicas))
         self._clock = clock
         self.metrics = metrics or Metrics(prefix="router", core=False)
+        # zipf-head replication (round 16): 0 = off (every key has ONE
+        # owner, the classic PR 9 topology — the default)
+        self.hot_keys: HotKeyTracker | None = (
+            HotKeyTracker(
+                hot_key_top_k,
+                min_rate=hot_key_min_rate,
+                clock=clock,
+                metrics=self.metrics,
+            )
+            if hot_key_top_k > 0
+            else None
+        )
+        self._hot_rr = 0  # replica round-robin cursor for hot-key reads
+        # hot keys are the HIGHEST-QPS keys, so their replica list must
+        # not cost a full owners() ring walk per request (the walk the
+        # normal path reserves for retries): cached per (ring, hot-set)
+        # epoch — at most top_k entries, flushed on rebuild/recompute
+        self._replica_cache: dict[str, list[str]] = {}
+        self._replica_cache_epoch: tuple = ()
         self.members: dict[str, BackendMember] = {}
+        # where each member was learned from: static | file | announce
+        self._member_source: dict[str, str] = {}
         for name in backends:
             if name in self.members:
                 raise ValueError(f"duplicate backend {name!r}")
@@ -451,6 +640,7 @@ class FleetRouter:
                 cooldown_s=cooldown_s,
                 clock=clock,
             )
+            self._member_source[name] = "static"
         self.ring = HashRing((), vnodes)
         # previous topology, for rebalance accounting + peer-fill hints
         self._prev_ring: HashRing | None = None
@@ -467,6 +657,12 @@ class FleetRouter:
         self.draining = False
         self._probe_task: asyncio.Task | None = None
         self.bound: tuple[str, int] | None = None
+        self._mf_mtime_ns = -1  # membership-file watch state
+        # drains announced for members THIS router never knew (the
+        # announcement raced ahead of the registration relay): carried
+        # into the membership file so peers that DO know them converge.
+        # Bounded; token-authenticated callers only.
+        self._foreign_drains: OrderedDict[str, None] = OrderedDict()
 
         self.server = HttpServer(
             idle_timeout_s=idle_timeout_s,
@@ -478,11 +674,23 @@ class FleetRouter:
         self.server.route("GET", "/v1/config")(self._config)
         self.server.route("GET", "/metrics")(self._metrics_route)
         self.server.route("GET", "/v1/metrics")(self._metrics_route)
+        if self.fleet_token:
+            # self-registration surface (round 16): ONLY with a shared
+            # token configured — a tokenless router keeps the whole
+            # /v1/internal/ prefix as a 404, exactly like PR 9
+            self.server.route("POST", "/v1/internal/register")(
+                self._register
+            )
         for method in ("GET", "POST", "DELETE", "PUT"):
             # everything else proxies; exact routes above win
             self.server.route_prefix(method, "/")(self._proxy)
+        # a pre-existing membership file seeds the view at boot (new
+        # router joining a running fleet: same file => same members =>
+        # same ring once probes admit them)
+        self._load_membership_file()
         for m in self.members.values():
             self._publish_state(m)
+        self._publish_membership_sources()
 
     @property
     def walk_timeout_s(self) -> float:
@@ -504,6 +712,270 @@ class FleetRouter:
             "backends_in_ring",
             sum(1 for b in self.members.values() if b.in_ring),
         )
+
+    def _publish_membership_sources(self) -> None:
+        counts = {"static": 0, "file": 0, "announce": 0}
+        for src in self._member_source.values():
+            counts[src] = counts.get(src, 0) + 1
+        for kind, n in counts.items():
+            self.metrics.set_labeled_gauge(
+                "membership_source", "kind", kind, n
+            )
+
+    def _add_member(self, name: str, source: str) -> BackendMember:
+        """Dynamic membership (round 16): a member learned at runtime —
+        self-registration or the shared membership file.  It starts
+        ``joining`` and enters the ring only after its first healthy
+        probe, exactly like a static one."""
+        m = BackendMember(
+            name,
+            eject_threshold=self.eject_threshold,
+            cooldown_s=self.cooldown_s,
+            clock=self._clock,
+        )
+        self.members[name] = m
+        self._member_source[name] = source
+        slog.event(
+            _log, "member_added", level=logging.WARNING,
+            backend=name, source=source,
+        )
+        self._publish_state(m)
+        self._publish_membership_sources()
+        return m
+
+    def _mark_announced_drain(self, m: BackendMember, reason: str) -> None:
+        """A drain the backend ANNOUNCED (directly or relayed through
+        the membership file): authoritative — leave the ring now, and
+        the jobs fan-out walks stop asking it now.  No breaker state
+        accrues (the graceful-leave rule from the probe path)."""
+        if m.announced_drain:
+            return
+        m.announced_drain = True
+        m.drain_announced_at = self._clock()
+        m.breaker.record_success()
+        self._set_state(m, "draining", reason)
+        # _set_state no-ops when the probe already saw the readyz flip;
+        # the flag above is the part that must land either way
+
+    def _clear_announced_drain(self, m: BackendMember, reason: str) -> None:
+        if not m.announced_drain:
+            return
+        m.announced_drain = False
+        slog.event(
+            _log, "drain_cleared", level=logging.WARNING,
+            backend=m.name, reason=reason,
+        )
+
+    # ---------------------------------------------------- self-registration
+
+    async def _register(self, req: Request) -> Response:
+        """POST /v1/internal/register — backend self-registration
+        (round 16).  Authenticated by the shared fleet token; form
+        fields ``backend=host:port`` and ``action=register|drain``.
+        Register adds an unknown member in ``joining`` (the ring
+        admission stays probe-gated) and clears an announced drain on a
+        known one; drain marks the member gone NOW.  Either action
+        persists the shared membership file so peer routers converge on
+        their next watch tick."""
+        token = req.headers.get("x-fleet-token", "")
+        if not self.fleet_token or not hmac.compare_digest(
+            token, self.fleet_token
+        ):
+            slog.event(
+                _log, "register_rejected", level=logging.WARNING,
+                reason="bad_token",
+            )
+            return Response.json(
+                {"error": "bad_fleet_token", "request_id": req.id}, 403
+            )
+        try:
+            form = req.form()
+        except Exception:  # noqa: BLE001 — unparseable body
+            form = {}
+        name = (form.get("backend") or "").strip()
+        action = (form.get("action") or "register").strip()
+        if not BACKEND_RE.match(name):
+            return Response.json(
+                {
+                    "error": "bad_request",
+                    "message": "backend must be host:port",
+                    "request_id": req.id,
+                },
+                400,
+            )
+        if action not in ("register", "drain"):
+            return Response.json(
+                {
+                    "error": "bad_request",
+                    "message": "action must be register|drain",
+                    "request_id": req.id,
+                },
+                400,
+            )
+        m = self.members.get(name)
+        cleared = None
+        if action == "register":
+            self._foreign_drains.pop(name, None)
+            if m is None:
+                m = self._add_member(name, source="announce")
+            else:
+                self._clear_announced_drain(m, "re_registered")
+            cleared = name  # a register is the one signal that may
+            # DOWNGRADE a persisted draining flag to false
+        else:
+            if m is None:
+                # a drain for a member we never knew (the announcement
+                # raced ahead of the registration relay): record it so
+                # the membership file still carries the signal to peers
+                # that DO know it, but add nothing to our own view
+                slog.event(
+                    _log, "drain_unknown_member", level=logging.WARNING,
+                    backend=name,
+                )
+                self._foreign_drains[name] = None
+                while len(self._foreign_drains) > 1024:
+                    self._foreign_drains.popitem(last=False)
+                self._persist_membership()
+                return Response.json(
+                    {"ok": False, "known": False, "request_id": req.id}
+                )
+            self._mark_announced_drain(m, "self_announced")
+        self._persist_membership(clear_drain=cleared)
+        return Response.json(
+            {
+                "ok": True,
+                "backend": name,
+                "action": action,
+                "state": m.state,
+                "request_id": req.id,
+            }
+        )
+
+    # ------------------------------------------------------ membership file
+
+    def _load_membership_file(self) -> None:
+        """Converge on the shared membership view (round 16): mtime-poll
+        the file every probe tick; new members join (probe-gated, source
+        ``file``), drain flags relay announced drains, a cleared flag
+        relays a re-registration.  Members are never REMOVED by the file
+        — a dead one is ejected by its own probes, and keeping it costs
+        one probe per tick."""
+        path = self.membership_file
+        if not path:
+            return
+        try:
+            st = os.stat(path)
+        except OSError:
+            return  # not written yet
+        if st.st_mtime_ns == self._mf_mtime_ns:
+            return
+        self._mf_mtime_ns = st.st_mtime_ns
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.loads(f.read())
+        except (OSError, ValueError) as e:
+            slog.event(
+                _log, "membership_file_error", level=logging.ERROR,
+                path=path, error=f"{type(e).__name__}: {e}",
+            )
+            return
+        members = doc.get("members") if isinstance(doc, dict) else None
+        if not isinstance(members, dict):
+            slog.event(
+                _log, "membership_file_error", level=logging.ERROR,
+                path=path, error="no members object",
+            )
+            return
+        for name, info in members.items():
+            if not isinstance(name, str) or not BACKEND_RE.match(name):
+                continue
+            m = self.members.get(name)
+            if m is None:
+                m = self._add_member(name, source="file")
+            draining = isinstance(info, dict) and bool(info.get("draining"))
+            if draining:
+                self._mark_announced_drain(m, "membership_file")
+            else:
+                self._clear_announced_drain(m, "membership_file")
+
+    def _persist_membership(self, clear_drain: str | None = None) -> None:
+        """Write the shared membership view tmp-then-rename (the
+        SpillStore idiom — peers never observe a torn file), under an
+        exclusive flock on a sidecar lockfile so two router PROCESSES
+        persisting concurrently serialize their read-merge-write instead
+        of erasing each other's registrations.
+
+        Merge rules: membership only GROWS here (a dead member is a
+        probe-ejection concern, not a file edit); a ``draining`` flag is
+        sticky — it merges as (file OR own view OR foreign announce), so
+        a router that never saw the direct announcement cannot overwrite
+        a peer's fresher drain with its own stale false.  The ONE signal
+        allowed to downgrade the flag is an explicit re-registration
+        (``clear_drain`` names the member), because only the restarted
+        backend itself knows the drain is over."""
+        path = self.membership_file
+        if not path:
+            return
+        try:
+            import fcntl
+
+            lock = open(path + ".lock", "a")
+        except OSError:
+            lock = None
+        try:
+            if lock is not None:
+                try:
+                    fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
+                except OSError:
+                    pass
+            merged: dict[str, dict] = {}
+            try:
+                with open(path, encoding="utf-8") as f:
+                    current = json.loads(f.read()).get("members", {})
+                if isinstance(current, dict):
+                    for name, info in current.items():
+                        if isinstance(name, str) and BACKEND_RE.match(name):
+                            merged[name] = {
+                                "draining": bool(
+                                    isinstance(info, dict)
+                                    and info.get("draining")
+                                )
+                            }
+            except (OSError, ValueError):
+                pass
+            for m in self.members.values():
+                flag = merged.get(m.name, {}).get("draining", False)
+                merged[m.name] = {"draining": flag or m.announced_drain}
+            for name in self._foreign_drains:
+                if name in merged:
+                    merged[name] = {"draining": True}
+            if clear_drain is not None and clear_drain in merged:
+                merged[clear_drain] = {"draining": False}
+            data = json.dumps(
+                {"version": 1, "members": merged}, separators=(",", ":")
+            ).encode()
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                # inside the lock no peer write can interleave, so this
+                # mtime is OUR content — safe to skip on the next watch
+                self._mf_mtime_ns = os.stat(path).st_mtime_ns
+            except OSError as e:
+                slog.event(
+                    _log, "membership_file_error", level=logging.ERROR,
+                    path=path, error=f"{type(e).__name__}: {e}",
+                )
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        finally:
+            if lock is not None:
+                lock.close()  # closing drops the flock
 
     def _set_state(self, m: BackendMember, state: str, reason: str) -> None:
         if m.state == state:
@@ -568,9 +1040,15 @@ class FleetRouter:
 
     async def probe_once(self) -> None:
         """One health sweep over every backend (the prober loop's body;
-        tests drive it directly)."""
+        tests drive it directly).  Also the membership-file watch tick:
+        a peer router's registrations/drains converge here."""
+        self._load_membership_file()
+        if self.hot_keys is not None:
+            # demotion-on-cooldown must not wait for traffic on the
+            # cooled key: decay + re-rank on the probe cadence
+            self.hot_keys.recompute()
         await asyncio.gather(
-            *(self._probe(m) for m in self.members.values())
+            *(self._probe(m) for m in list(self.members.values()))
         )
 
     async def _probe(self, m: BackendMember) -> None:
@@ -578,6 +1056,7 @@ class FleetRouter:
             allowed, _retry = m.breaker.allow()
             if not allowed:
                 return  # still cooling; no half-open claim available
+        t_start = self._clock()
         try:
             status, _h, body = await raw_request(
                 m.host, m.port, "GET", "/readyz", {}, b"",
@@ -595,7 +1074,15 @@ class FleetRouter:
                 )
             return
         if status == 200:
+            if m.announced_drain and m.drain_announced_at >= t_start:
+                # the drain announcement landed WHILE this probe was in
+                # flight: its 200 observed the backend before the drain
+                # and must not override the fresher authoritative signal
+                return
             m.breaker.record_success()
+            # a healthy probe after an announced drain means the backend
+            # restarted (or withdrew the drain): the announcement is spent
+            self._clear_announced_drain(m, "probe_ok")
             if m.state != "healthy":
                 self._set_state(m, "healthy", "probe_ok")
             return
@@ -635,10 +1122,23 @@ class FleetRouter:
 
     # -------------------------------------------------------------- routing
 
-    def _pick(self, key: str | None, tried: set[str]) -> BackendMember | None:
+    def _pick(
+        self,
+        key: str | None,
+        tried: set[str],
+        replicas: list[str] | None = None,
+    ) -> BackendMember | None:
         """The ring owner for a keyed request (failover walks clockwise
-        past ``tried``); round-robin over ring members otherwise."""
+        past ``tried``); round-robin over ring members otherwise.  A
+        promoted hot key's READS (``replicas`` non-None) spread
+        round-robin over its R ring owners instead of hammering the
+        primary alone."""
         if key is not None:
+            if replicas and not tried:
+                self._hot_rr += 1
+                return self.members[
+                    replicas[self._hot_rr % len(replicas)]
+                ]
             if not tried:
                 # hot path: one bisect; the full owners() walk (scan
                 # until every distinct member is seen) is retry-only
@@ -669,18 +1169,32 @@ class FleetRouter:
             return None
         if key not in self._moved_seen:
             self._moved_seen[key] = None
-            while len(self._moved_seen) > 4096:
+            while len(self._moved_seen) > MOVED_SEEN_MAX:
                 self._moved_seen.popitem(last=False)
+                # the clip is visible (round 16 satellite): a clipped
+                # key double-counts at worst, but an operator watching
+                # this climb knows the keyspace outgrew the window
+                self.metrics.inc_counter("rebalance_seen_clipped_total")
             self.metrics.inc_counter("rebalanced_keys_total")
         pm = self.members.get(prev)
-        if not self.peer_fill or pm is None or pm.state in ("ejected",):
-            # a crashed previous owner cannot serve a fill; a DRAINING
-            # one still can (its listener lives until the grace lapses)
+        if (
+            not self.peer_fill
+            or pm is None
+            or pm.state in ("ejected",)
+            or pm.announced_drain
+        ):
+            # a crashed previous owner cannot serve a fill, and one that
+            # ANNOUNCED drain is going away now; a probe-observed
+            # DRAINING one still can (its listener lives out the grace)
             return None
         return pm.name
 
     def _forward_headers(
-        self, req: Request, key: str | None, owner: str
+        self,
+        req: Request,
+        key: str | None,
+        owner: str,
+        hint: str | None = None,
     ) -> dict[str, str]:
         # x-peer-fill is router-authoritative: a client-supplied hint
         # would point a trusting backend at an arbitrary host:port
@@ -695,7 +1209,10 @@ class FleetRouter:
         # all join on one key (satellite: cross-tier trace continuity)
         fwd_headers["x-request-id"] = req.id
         if key is not None:
-            hint = self._peer_hint(key, owner)
+            if hint is None:
+                # an explicit hint (a hot-key replica's primary) wins
+                # over the rebalance-window previous-owner hint
+                hint = self._peer_hint(key, owner)
             if hint is not None:
                 fwd_headers["x-peer-fill"] = hint
         return fwd_headers
@@ -803,6 +1320,40 @@ class FleetRouter:
                 req.body,
                 req=req,
             )
+        # hot-key replication (round 16): a promoted zipf-head key's
+        # READS spread over its R ring owners; forced recomputes
+        # ("writes" — cache-control no-cache/no-store) stay on the
+        # primary ALONE, where the backend's singleflight dedups them,
+        # so replication never multiplies device work.
+        replicas: list[str] | None = None
+        if key is not None and self.hot_keys is not None:
+            self.hot_keys.observe(key)
+            cc = req.headers.get("cache-control", "").lower()
+            if (
+                "no-cache" not in cc
+                and "no-store" not in cc
+                # a job submit is NOT a read: identical submissions must
+                # keep landing on ONE backend or the per-backend
+                # idempotency index stops deduping them fleet-wide
+                and req.path != "/v1/jobs"
+                and self.hot_keys.is_hot(key)
+            ):
+                epoch = (id(self.ring), self.hot_keys.hot_keys)
+                if epoch != self._replica_cache_epoch:
+                    self._replica_cache_epoch = epoch
+                    self._replica_cache = {}
+                owners = self._replica_cache.get(key)
+                if owners is None:
+                    owners = [
+                        n
+                        for n in self.ring.owners(key)[
+                            : self.hot_key_replicas
+                        ]
+                        if self.members[n].in_ring
+                    ]
+                    self._replica_cache[key] = owners
+                if len(owners) > 1:
+                    replicas = owners
         tried: set[str] = set()
         last_err = ""
         target = self._forward_target(req)
@@ -815,13 +1366,29 @@ class FleetRouter:
             1 if req.method == "POST" and req.path == "/v1/jobs" else 2
         )
         for _attempt in range(attempts):
-            m = self._pick(key, tried)
+            m = self._pick(key, tried, replicas)
             if m is None:
                 break
+            hint = None
+            # replica accounting/hints apply to the INITIAL spread pick
+            # only: a failover retry (tried non-empty) is a plain
+            # owners-walk hop — counting it as a replica read would lie,
+            # and hinting at replicas[0] could point the new pick's
+            # peer-fill at the very member that just infra-failed
+            was_replica = (
+                replicas is not None
+                and not tried
+                and m.name != replicas[0]
+            )
+            if was_replica and self.peer_fill:
+                # the replica's first miss fills from the primary's
+                # cache instead of recomputing — the "write" lives on
+                # the primary, the replica serves a copy of its bytes
+                hint = replicas[0]
             try:
                 status, headers, body = await raw_request(
                     m.host, m.port, req.method, target,
-                    self._forward_headers(req, key, m.name),
+                    self._forward_headers(req, key, m.name, hint=hint),
                     req.body, self.forward_timeout_s,
                 )
             except _BackendError as e:
@@ -838,6 +1405,10 @@ class FleetRouter:
             # designed backpressure (sheds, breakers, deadlines): they
             # pass through with their Retry-After and never eject.
             self._note_forward_result(m, ok=status not in (500, 502))
+            if was_replica:
+                self.metrics.inc_labeled(
+                    "replica_reads_total", "backend", m.name
+                )
             if (
                 status == 202
                 and req.method == "POST"
@@ -865,10 +1436,21 @@ class FleetRouter:
         would feed the ejection breaker and evict a healthy backend."""
         sticky = self._job_owners.get(job_id)
         sm = self.members.get(sticky) if sticky is not None else None
+
+        def _askable(m: BackendMember) -> bool:
+            # a DRAINING owner still answers (its listener lives out
+            # the grace window) and is the only holder of its jobs'
+            # state — the ENTITY walk asks it whether the drain was
+            # probe-observed or self-announced (skipping a live
+            # grace-window listener would fail every poll for a job
+            # only it holds); an announced member that is ALREADY dead
+            # costs one bounded infra failure and the walk moves on.
+            # Round-robin and the collection fan-out DO skip announced
+            # drains — no single job depends on them.
+            return m.in_ring or m.state == "draining"
+
         cands: list[BackendMember] = []
-        if sm is not None and sm.state in ("healthy", "draining"):
-            # a DRAINING owner still answers (its listener lives out the
-            # grace window) and is the only holder of its jobs' state
+        if sm is not None and _askable(sm):
             cands.append(sm)
         cands += [
             m
@@ -876,7 +1458,7 @@ class FleetRouter:
             # draining members are asked too: after a router restart (or
             # an evicted pin) the walk is the only way back to a job held
             # by a backend mid-rolling-restart
-            if (m.in_ring or m.state == "draining") and m is not sm
+            if _askable(m) and m is not sm
         ]
         is_stream = req.method == "GET" and req.path.endswith("/events")
         target = self._forward_target(req)
@@ -889,9 +1471,14 @@ class FleetRouter:
             # the pinned owner gets the full forward timeout (a /result
             # body may be large); blind-walk candidates get a short
             # bound, else one wedged member stalls an unknown-id poll
-            # for forward_timeout_s (330s default) PER candidate
+            # for forward_timeout_s (330s default) PER candidate.  An
+            # owner that ANNOUNCED drain gets the short bound too — it
+            # may already be dead, and the announcement promised it
+            # would not be around for a 330s answer anyway.
             timeout = (
-                self.forward_timeout_s if m is sm else self.walk_timeout_s
+                self.forward_timeout_s
+                if m is sm and not m.announced_drain
+                else self.walk_timeout_s
             )
             try:
                 if is_stream:
@@ -991,7 +1578,11 @@ class FleetRouter:
         members = [
             m
             for m in self.members.values()
-            if m.in_ring or m.state == "draining"
+            # self-announced drains are skipped immediately (round 16):
+            # the announcement says the listener is about to die, and a
+            # fan-out that barriers on it would stall the fleet view
+            if m.in_ring
+            or (m.state == "draining" and not m.announced_drain)
         ]
         if not members:
             return self._unavailable(req, t0, "")
@@ -1134,6 +1725,18 @@ class FleetRouter:
                     "rebalanced_keys_total"
                 ),
                 "draining": self.draining,
+                # round 16: the shared-membership + replication picture
+                "membership_file": self.membership_file or None,
+                "fleet_token_set": bool(self.fleet_token),
+                "hot_key_top_k": (
+                    self.hot_keys.top_k if self.hot_keys is not None else 0
+                ),
+                "hot_key_replicas": self.hot_key_replicas,
+                "hot_keys_active": (
+                    len(self.hot_keys.hot_keys)
+                    if self.hot_keys is not None
+                    else 0
+                ),
                 "members": {
                     m.name: {
                         "state": m.state,
@@ -1141,6 +1744,10 @@ class FleetRouter:
                         "vnodes": self.vnodes if m.in_ring else 0,
                         "requests_total": m.requests_total,
                         "breaker": m.breaker.state_name,
+                        "source": self._member_source.get(
+                            m.name, "static"
+                        ),
+                        "announced_drain": m.announced_drain,
                     }
                     for m in self.members.values()
                 },
@@ -1214,8 +1821,34 @@ def main(argv: list[str] | None = None) -> int:
 
     p = argparse.ArgumentParser(description="deconv fleet router")
     p.add_argument(
-        "--backends", required=True,
-        help="comma-separated host:port backend list",
+        "--backends", default="",
+        help="comma-separated host:port backend list (optional when "
+        "--membership-file or --fleet-token lets backends join "
+        "dynamically)",
+    )
+    p.add_argument(
+        "--membership-file", default="", metavar="PATH",
+        help="shared membership view (JSON, watched every probe tick "
+        "and persisted tmp-then-rename on registrations/drains): N "
+        "routers over one file converge on one member set — same ring "
+        "seed, same key ownership, interchangeable behind any TCP LB",
+    )
+    p.add_argument(
+        "--fleet-token", default="",
+        help="shared secret authenticating POST /v1/internal/register "
+        "(backend self-registration + drain announcements); empty "
+        "disables the registration surface entirely",
+    )
+    p.add_argument(
+        "--hot-key-top-k", type=int, default=0,
+        help="replicate the K hottest keys (by EWMA request rate) to "
+        "--hot-key-replicas ring owners, spreading their reads; 0 "
+        "(default) keeps the classic one-owner-per-key topology",
+    )
+    p.add_argument(
+        "--hot-key-replicas", type=int, default=2,
+        help="ring owners a promoted hot key spreads reads over "
+        "(default 2)",
     )
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8100)
@@ -1250,6 +1883,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = p.parse_args(argv)
     backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    if not backends and not args.membership_file and not args.fleet_token:
+        p.error(
+            "--backends is required unless --membership-file or "
+            "--fleet-token lets backends join dynamically"
+        )
     router = FleetRouter(
         backends,
         vnodes=args.vnodes,
@@ -1259,6 +1897,10 @@ def main(argv: list[str] | None = None) -> int:
         cooldown_s=args.cooldown_s,
         peer_fill=not args.no_peer_fill,
         forward_timeout_s=args.forward_timeout_s,
+        membership_file=args.membership_file,
+        fleet_token=args.fleet_token,
+        hot_key_top_k=args.hot_key_top_k,
+        hot_key_replicas=args.hot_key_replicas,
     )
     asyncio.run(_serve_forever(router, args.host, args.port))
     return 0

@@ -493,3 +493,124 @@ def test_dream_negative_knobs_negative_cached(server):
     assert r2.status_code == 400
     assert r2.headers["x-cache"] == "hit-negative"
     assert r2.content == r1.content
+
+
+# ------------------------------------------------------- durable L2 tier
+
+
+def _l2(tmp_path, max_bytes=0, metrics=None):
+    from deconv_api_tpu.serving.cache import L2Store
+
+    return L2Store(str(tmp_path / "l2"), max_bytes, metrics=metrics)
+
+
+def _k(i: int) -> str:
+    return f"{i:040x}"
+
+
+def test_l2_write_through_read_back_byte_parity(tmp_path):
+    m = Metrics()
+    l2 = _l2(tmp_path, metrics=m)
+    body = bytes(range(256)) * 11  # binary payload, not text
+    assert l2.put(_k(1), 200, body, "image/jpeg")
+    got = l2.get(_k(1))
+    assert got == (200, body, "image/jpeg")
+    assert m.counter("cache_l2_stores_total") == 1
+    assert m.counter("cache_l2_hits_total") == 1
+    assert l2.get(_k(2)) is None
+    assert m.counter("cache_l2_misses_total") == 1
+    # non-200 and malformed keys are never stored
+    assert not l2.put(_k(3), 404, b"nope", "application/json")
+    assert not l2.put("../../etc/passwd", 200, b"x", "text/plain")
+    l2.close()
+
+
+def test_l2_survives_rescan_with_lru_order(tmp_path):
+    import os
+
+    l2 = _l2(tmp_path, max_bytes=100_000)
+    for i in range(3):
+        assert l2.put(_k(i), 200, b"x" * 100, "t")
+    # make key 0 the most recently READ (mtime touch), with distinct
+    # mtimes so the rescan's ordering is deterministic
+    root = l2.root
+    for i, age in ((1, 300), (2, 200), (0, 100)):
+        path = os.path.join(root, _k(i) + ".l2")
+        st = os.stat(path)
+        os.utime(path, (st.st_atime - age, st.st_mtime - age))
+    l2.close()
+    # a stale writer .tmp from a "crash" is swept at boot
+    open(os.path.join(root, _k(9) + ".l2.tmp"), "wb").write(b"junk")
+    from deconv_api_tpu.serving.cache import L2Store
+
+    l2b = L2Store(root, 100_000)
+    assert l2b.entry_count == 3
+    assert l2b.resident_bytes == l2.resident_bytes
+    assert not any(f.endswith(".tmp") for f in os.listdir(root))
+    # budget pressure now evicts the OLDEST-read entry first: key 1
+    big = b"y" * (100_000 - l2b.resident_bytes - 60)
+    assert l2b.put(_k(5), 200, big, "t")
+    assert l2b.get(_k(1)) is None  # swept
+    assert l2b.get(_k(0)) is not None  # recent read survived
+    l2b.close()
+
+
+def test_l2_corrupt_and_truncated_read_as_miss(tmp_path):
+    import os
+
+    m = Metrics()
+    l2 = _l2(tmp_path, metrics=m)
+    body = b"payload-bytes" * 50
+    for i in range(3):
+        assert l2.put(_k(i), 200, body, "t")
+    root = l2.root
+    # flipped body byte -> digest mismatch
+    p0 = os.path.join(root, _k(0) + ".l2")
+    raw = bytearray(open(p0, "rb").read())
+    raw[-1] ^= 0xFF
+    open(p0, "wb").write(bytes(raw))
+    # truncated body -> length mismatch
+    p1 = os.path.join(root, _k(1) + ".l2")
+    raw = open(p1, "rb").read()
+    open(p1, "wb").write(raw[: len(raw) // 2])
+    # garbage header -> parse failure
+    p2 = os.path.join(root, _k(2) + ".l2")
+    open(p2, "wb").write(b"not json at all\n" + body)
+    for i in range(3):
+        assert l2.get(_k(i)) is None  # a miss, never an exception
+        assert not os.path.exists(
+            os.path.join(root, _k(i) + ".l2")
+        )  # the defective file is deleted
+    assert m.counter("cache_l2_corrupt_total") == 3
+    assert l2.entry_count == 0
+    l2.close()
+
+
+def test_l2_byte_budget_sweeps_oldest(tmp_path):
+    m = Metrics()
+    l2 = _l2(tmp_path, max_bytes=1000, metrics=m)
+    entry = b"z" * 200  # ~300B with header
+    for i in range(6):
+        assert l2.put(_k(i), 200, entry, "t")
+    assert l2.resident_bytes <= 1000
+    assert m.counter("cache_l2_sweeps_total") >= 2
+    assert l2.get(_k(5)) is not None  # newest survives
+    assert l2.get(_k(0)) is None  # oldest swept
+    # an entry bigger than the whole budget is refused outright
+    assert not l2.put(_k(9), 200, b"w" * 2000, "t")
+    snap = m.snapshot()["gauges"]
+    assert snap["cache_l2_resident_bytes"] == l2.resident_bytes
+    l2.close()
+
+
+def test_l2_async_writer_flushes_on_close(tmp_path):
+    l2 = _l2(tmp_path)
+    for i in range(8):
+        l2.put_async(_k(i), 200, b"async-%d" % i, "t")
+    l2.close()  # drains the queue before the writer exits
+    from deconv_api_tpu.serving.cache import L2Store
+
+    l2b = L2Store(l2.root, 0)
+    for i in range(8):
+        assert l2b.get(_k(i)) == (200, b"async-%d" % i, "t")
+    l2b.close()

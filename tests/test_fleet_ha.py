@@ -1,0 +1,772 @@
+"""Zero-SPOF fleet tests (round 16).
+
+Covers the HA-router tier: shared-membership convergence across two
+routers (file-watch AND announce paths), registration auth, the
+self-announced-drain immediate skip, hot-key replica read spread with
+primary-only writes (plus demotion on cooldown), the durable L2 tier's
+restart recovery, and an e2e two-router kill-one-router drill over real
+backends.  The L2Store unit contract (byte parity, corruption-as-miss,
+budget sweep) lives in tests/test_cache.py next to the memory tier.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+import urllib.parse
+
+import numpy as np
+import pytest
+
+import jax
+
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.models.spec import init_params
+from deconv_api_tpu.serving import fleet
+from deconv_api_tpu.serving.fleet import FleetRouter, HotKeyTracker
+from deconv_api_tpu.serving.http import Request
+from tests.test_engine_parity import TINY
+from tests.test_metrics_exposition import lint_exposition
+
+TOKEN = "ha-fleet-token-1"
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _ready_200():
+    return 200, {}, json.dumps({"ready": True}).encode()
+
+
+def _probe_script(monkeypatch, responses):
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        return responses[f"{host}:{port}"]()
+
+    monkeypatch.setattr(fleet, "raw_request", fake)
+
+
+def _register_req(body: str, token: str = TOKEN) -> Request:
+    return Request(
+        method="POST", path="/v1/internal/register", query={},
+        headers={
+            "content-type": "application/x-www-form-urlencoded",
+            "x-fleet-token": token,
+        },
+        body=body.encode(), id="rid-register",
+    )
+
+
+# ------------------------------------------------------------ hot tracker
+
+
+def test_hot_tracker_promotes_top_k_and_demotes_on_cooldown():
+    clock = _FakeClock()
+    hk = HotKeyTracker(2, min_rate=4.0, halflife_s=30.0, clock=clock)
+    for _ in range(40):
+        hk.observe("hot-a")
+    for _ in range(20):
+        hk.observe("hot-b")
+    for _ in range(2):
+        hk.observe("cold-c")  # below the rate floor: never promoted
+    hk.recompute()
+    assert hk.is_hot("hot-a") and hk.is_hot("hot-b")
+    assert not hk.is_hot("cold-c")
+    # top-K is a CAP: a third key over the floor displaces nothing
+    # hotter, and only K keys are ever hot at once
+    for _ in range(10):
+        hk.observe("warm-d")
+    hk.recompute()
+    assert sum(hk.is_hot(k) for k in ("hot-a", "hot-b", "warm-d")) == 2
+    assert hk.is_hot("hot-a")  # the hottest never displaced
+    # demotion on cooldown: no traffic, scores decay below the floor —
+    # recompute alone (the probe tick drives it) demotes
+    clock.t += 600.0
+    hk.recompute()
+    assert not hk.hot_keys
+
+
+def test_hot_tracker_entry_cap_clips_with_counter():
+    from deconv_api_tpu.serving.metrics import Metrics
+
+    m = Metrics(prefix="router", core=False)
+    clock = _FakeClock()
+    hk = HotKeyTracker(
+        2, max_entries=16, min_rate=2.0, clock=clock, metrics=m
+    )
+    for _ in range(50):
+        hk.observe("the-hot-one")
+    # attacker-chosen unique keys: state stays bounded, the clip is
+    # counted, and the genuinely hot key SURVIVES the clip
+    for i in range(200):
+        hk.observe(f"unique-{i}")
+    assert len(hk._scores) <= 16
+    assert m.counter("hot_tracker_clipped_total") > 0
+    hk.recompute()
+    assert hk.is_hot("the-hot-one")
+
+
+def test_moved_seen_cap_clips_with_counter(monkeypatch):
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], eject_threshold=2, clock=clock
+    )
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    monkeypatch.setattr(fleet, "MOVED_SEEN_MAX", 16)
+
+    async def go():
+        await router.probe_once()
+        router.members["b0:8000"].requests_total += 1  # ring has served
+        m = router.members["b1:8001"]
+        router._note_forward_result(m, ok=False)
+        router._note_forward_result(m, ok=False)  # eject -> rebalance
+        assert router._prev_ring is not None
+        for i in range(200):
+            router._peer_hint(f"{i:040x}", "b0:8000")
+        assert len(router._moved_seen) <= 16
+        assert router.metrics.counter("rebalance_seen_clipped_total") > 0
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------- registration + membership
+
+
+def test_register_requires_token_and_validates(monkeypatch):
+    router = FleetRouter(["b0:8000"], fleet_token=TOKEN)
+
+    async def go():
+        r = await router._register(_register_req(
+            "backend=127.0.0.1:9001&action=register", token="wrong"
+        ))
+        assert r.status == 403
+        assert json.loads(r.body)["error"] == "bad_fleet_token"
+        assert "127.0.0.1:9001" not in router.members
+        r = await router._register(_register_req(
+            "backend=not a host&action=register"
+        ))
+        assert r.status == 400
+        r = await router._register(_register_req(
+            "backend=127.0.0.1:9001&action=explode"
+        ))
+        assert r.status == 400
+        r = await router._register(_register_req(
+            "backend=127.0.0.1:9001&action=register"
+        ))
+        assert r.status == 200
+        m = router.members["127.0.0.1:9001"]
+        # probe-gated admission: registered != in the ring
+        assert m.state == "joining" and not m.in_ring
+        assert router._member_source["127.0.0.1:9001"] == "announce"
+
+    asyncio.run(go())
+
+
+def test_tokenless_router_has_no_registration_surface():
+    router = FleetRouter(["b0:8000"])
+
+    async def go():
+        req = _register_req("backend=127.0.0.1:9001&action=register")
+        # no token configured -> the route was never registered; the
+        # proxy answers the whole /v1/internal/ prefix with 404 (PR 9)
+        resp = await router._proxy(req)
+        assert resp.status == 404
+
+    asyncio.run(go())
+
+
+def test_router_needs_some_membership_source():
+    with pytest.raises(ValueError):
+        FleetRouter([])
+    # any of: static list, watched file, registration token
+    FleetRouter([], membership_file="/tmp/whatever.json")
+    FleetRouter([], fleet_token=TOKEN)
+
+
+def test_membership_converges_across_two_routers(tmp_path, monkeypatch):
+    """The satellite pin: router A learns a backend by ANNOUNCE, router
+    B learns it from the watched FILE; a drain announced at A is skipped
+    at B before B's next probe could observe anything."""
+    mf = str(tmp_path / "members.json")
+    ra = FleetRouter([], membership_file=mf, fleet_token=TOKEN)
+    rb = FleetRouter([], membership_file=mf)
+
+    async def go():
+        r = await ra._register(_register_req(
+            "backend=127.0.0.1:9001&action=register"
+        ))
+        assert r.status == 200
+        # B's watch tick (the probe loop drives _load_membership_file)
+        rb._load_membership_file()
+        mb = rb.members["127.0.0.1:9001"]
+        assert mb.state == "joining"
+        assert rb._member_source["127.0.0.1:9001"] == "file"
+        # drain announced at A relays through the file to B
+        r = await ra._register(_register_req(
+            "backend=127.0.0.1:9001&action=drain"
+        ))
+        assert r.status == 200
+        assert ra.members["127.0.0.1:9001"].announced_drain
+        rb._load_membership_file()
+        assert mb.announced_drain
+        # re-registration (the restarted backend) clears the flag fleet-wide
+        await ra._register(_register_req(
+            "backend=127.0.0.1:9001&action=register"
+        ))
+        rb._load_membership_file()
+        assert not mb.announced_drain
+        # a THIRD router booting later seeds its whole view from the file
+        rc = FleetRouter([], membership_file=mf)
+        assert "127.0.0.1:9001" in rc.members
+
+    asyncio.run(go())
+
+
+def test_self_announced_drain_skipped_immediately(monkeypatch):
+    """Round-robin GETs and both jobs fan-outs must skip a
+    self-announced drain NOW — not at the next probe tick — while a
+    probe-observed draining member keeps answering the jobs walks."""
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000", "b1:8001", "b2:8002"],
+        eject_threshold=2, clock=clock, fleet_token=TOKEN,
+    )
+    script = {
+        "b0:8000": _ready_200, "b1:8001": _ready_200, "b2:8002": _ready_200,
+    }
+    _probe_script(monkeypatch, script)
+    asked: list[str] = []
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        asked.append(f"{host}:{port}")
+        if target.rstrip("/") == "/v1/jobs":
+            return 200, {}, json.dumps(
+                {"jobs": [], "counts": {}, "queue_depth": 0}
+            ).encode()
+        if target.startswith("/v1/jobs/"):
+            # "not mine, next" — so the entity walk visits EVERY candidate
+            return 404, {}, json.dumps({"error": "job_not_found"}).encode()
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        assert len(router.ring.members) == 3
+        r = await router._register(_register_req(
+            "backend=b1:8001&action=drain"
+        ))
+        assert r.status == 200
+        m = router.members["b1:8001"]
+        # the flag and the ring exit land at the ANNOUNCEMENT — no probe
+        # has observed b1's readyz flip yet (the script still says 200)
+        assert m.announced_drain and not m.in_ring
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        # GET round-robin: never lands on the announced member
+        for _ in range(6):
+            req = Request(
+                method="GET", path="/v1/models", query={}, headers={},
+                body=b"", id="rid-rr",
+            )
+            resp = await router._proxy(req)
+            assert resp.status == 200
+            assert resp.headers["x-backend"] != "b1:8001"
+        # jobs collection fan-out: b1 is not asked
+        asked.clear()
+        req = Request(
+            method="GET", path="/v1/jobs", query={}, headers={},
+            body=b"", id="rid-jobs",
+        )
+        resp = await router._proxy(req)
+        assert resp.status == 200
+        assert "b1:8001" not in asked
+        # the jobs ENTITY walk still asks the announced member: its
+        # listener lives out the drain grace window and it may be the
+        # only holder of the polled job's state (review finding) — but
+        # it is bounded by the short walk timeout, never the 330s one
+        asked.clear()
+        req = Request(
+            method="GET", path="/v1/jobs/job-xyz", query={}, headers={},
+            body=b"", id="rid-entity",
+        )
+        await router._proxy(req)
+        assert "b1:8001" in asked
+        # contrast: a PROBE-observed drain (no announcement) still
+        # answers the jobs walks — it holds its jobs' state through the
+        # grace window (the PR 9 rolling-restart contract)
+        m2 = router.members["b2:8002"]
+        router._set_state(m2, "draining", "probe_observed")
+        assert not m2.announced_drain
+        asked.clear()
+        req = Request(
+            method="GET", path="/v1/jobs", query={}, headers={},
+            body=b"", id="rid-jobs-2",
+        )
+        await router._proxy(req)
+        assert "b2:8002" in asked and "b1:8001" not in asked
+
+    asyncio.run(go())
+
+
+def test_drain_for_unknown_member_relays_through_file(tmp_path):
+    """Review finding: a drain announcement landing at a router that
+    never learned the member (the announcement raced ahead of the
+    registration relay) must still reach peers through the file."""
+    mf = str(tmp_path / "members.json")
+    ra = FleetRouter([], membership_file=mf, fleet_token=TOKEN)
+    rb = FleetRouter([], membership_file=mf, fleet_token=TOKEN)
+
+    async def go():
+        # the backend registered at A (file now knows it) ...
+        await ra._register(_register_req(
+            "backend=127.0.0.1:9001&action=register"
+        ))
+        # ... but B (which HAS loaded the file) gets the drain first —
+        # wait, keep B ignorant: B never ticked, so the member is
+        # unknown to it when the drain lands
+        assert "127.0.0.1:9001" not in rb.members
+        r = await rb._register(_register_req(
+            "backend=127.0.0.1:9001&action=drain"
+        ))
+        assert r.status == 200 and not json.loads(r.body)["ok"]
+        # the file carries the drain even though B never knew the member
+        doc = json.loads(open(mf).read())
+        assert doc["members"]["127.0.0.1:9001"]["draining"] is True
+        # A converges from the file
+        ra._load_membership_file()
+        assert ra.members["127.0.0.1:9001"].announced_drain
+        # and a peer persisting its own (stale) view cannot downgrade
+        # the sticky flag — only an explicit re-registration can
+        ra.members["127.0.0.1:9001"].announced_drain = False
+        ra._persist_membership()
+        doc = json.loads(open(mf).read())
+        assert doc["members"]["127.0.0.1:9001"]["draining"] is True
+        await ra._register(_register_req(
+            "backend=127.0.0.1:9001&action=register"
+        ))
+        doc = json.loads(open(mf).read())
+        assert doc["members"]["127.0.0.1:9001"]["draining"] is False
+
+    asyncio.run(go())
+
+
+def test_stale_inflight_probe_cannot_clear_announced_drain(monkeypatch):
+    """Review finding: a probe that STARTED before the drain
+    announcement may answer 200 after it lands — that stale observation
+    must not re-admit the dying backend."""
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000"], eject_threshold=2, clock=clock, fleet_token=TOKEN
+    )
+    m = router.members["b0:8000"]
+
+    async def race_200(host, port, method, target, headers, body, timeout_s):
+        # the announcement lands WHILE the probe is in flight
+        if not m.announced_drain:
+            router._mark_announced_drain(m, "self_announced")
+        return 200, {}, json.dumps({"ready": True}).encode()
+
+    monkeypatch.setattr(fleet, "raw_request", race_200)
+
+    async def go():
+        await router.probe_once()
+        # the stale 200 did NOT clear the fresher drain signal
+        assert m.announced_drain and m.state == "draining"
+        # a probe that starts AFTER the announcement does clear it
+        clock.t += 1.0
+        await router.probe_once()
+        assert not m.announced_drain and m.state == "healthy"
+
+    asyncio.run(go())
+
+
+# --------------------------------------------------- hot-key replication
+
+
+def test_replica_read_spread_primary_writes_and_demotion(monkeypatch):
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000", "b1:8001", "b2:8002"],
+        eject_threshold=2, clock=clock,
+        hot_key_top_k=1, hot_key_replicas=2, hot_key_min_rate=2.0,
+    )
+    script = {
+        "b0:8000": _ready_200, "b1:8001": _ready_200, "b2:8002": _ready_200,
+    }
+    _probe_script(monkeypatch, script)
+    forwards: list[tuple[str, str | None]] = []  # (backend, peer hint)
+    fail_next: set[str] = set()
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        forwards.append((name, headers.get("x-peer-fill")))
+        if name in fail_next:
+            fail_next.discard(name)
+            raise fleet._BackendError(f"{name}: connection refused")
+        return 200, {}, b"{}"
+
+    body = b"layer=block1_conv1&file=hot"
+
+    def post(headers=None):
+        req = Request(
+            method="POST", path="/v1/deconv", query={},
+            headers={
+                "content-type": "application/x-www-form-urlencoded",
+                **(headers or {}),
+            },
+            body=body, id="rid-hot",
+        )
+        return router._proxy(req)
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        # pre-promotion: every request lands on the ONE ring owner
+        for _ in range(5):
+            assert (await post()).status == 200
+        primary = forwards[0][0]
+        assert {b for b, _h in forwards} == {primary}
+        assert all(h is None for _b, h in forwards)
+        router.hot_keys.recompute()  # the probe tick would do this
+        key = next(iter(router.hot_keys.hot_keys))
+        assert router.ring.owner(key) == primary
+        replica = router.ring.owners(key)[1]
+        # post-promotion READS: round-robin over primary + replica, and
+        # every replica forward carries the PRIMARY as its fill hint
+        forwards.clear()
+        for _ in range(8):
+            assert (await post()).status == 200
+        by_backend = {b for b, _h in forwards}
+        assert by_backend == {primary, replica}
+        assert sum(1 for b, _h in forwards if b == replica) == 4
+        assert all(
+            h == primary for b, h in forwards if b == replica
+        )
+        assert all(h is None for b, h in forwards if b == primary)
+        reads = router.metrics.labeled("replica_reads_total")
+        assert reads.get(replica) == 4 and primary not in reads
+        assert (
+            router.metrics.snapshot()["gauges"]["hot_keys_active"] == 1
+        )
+        # WRITES (forced recomputes) stay on the primary alone, where
+        # the backend's singleflight dedups them
+        forwards.clear()
+        for cc in ("no-cache", "no-store"):
+            assert (await post({"cache-control": cc})).status == 200
+        assert {b for b, _h in forwards} == {primary}
+        # a failover retry off a DEAD primary is a plain owners-walk
+        # hop (review finding): no replica-read credit, and no
+        # x-peer-fill hint pointing at the member that just failed
+        forwards.clear()
+        reads_before = dict(router.metrics.labeled("replica_reads_total"))
+        router._hot_rr = 1  # next spread pick = replicas[0] = primary
+        fail_next.add(primary)
+        assert (await post()).status == 200
+        assert forwards[0][0] == primary  # first pick failed...
+        retry_backend, retry_hint = forwards[1]
+        assert retry_backend != primary  # ...retry walked past it
+        assert retry_hint is None
+        assert (
+            dict(router.metrics.labeled("replica_reads_total"))
+            == reads_before
+        )
+        # a hot JOB-SUBMIT body never spreads: the idempotency index is
+        # per-backend, so identical submissions must keep landing on
+        # one owner even when their key is promoted
+        def post_job():
+            req = Request(
+                method="POST", path="/v1/jobs", query={},
+                headers={
+                    "content-type": "application/x-www-form-urlencoded"
+                },
+                body=body, id="rid-job",
+            )
+            return router._proxy(req)
+
+        for _ in range(8):
+            await post_job()
+        router.hot_keys.recompute()
+        forwards.clear()
+        for _ in range(6):
+            assert (await post_job()).status == 200
+        assert len({b for b, _h in forwards}) == 1
+        # demotion on cooldown: decay below the floor -> one owner again
+        clock.t += 600.0
+        router.hot_keys.recompute()
+        assert not router.hot_keys.hot_keys
+        forwards.clear()
+        assert (await post()).status == 200
+        assert {b for b, _h in forwards} == {primary}
+
+    asyncio.run(go())
+
+
+def test_replication_off_by_default():
+    router = FleetRouter(["b0:8000"])
+    assert router.hot_keys is None
+
+
+# ----------------------------------------------------- exposition lint
+
+
+def test_new_metric_families_lint():
+    """Round-16 families render typed and parseable:
+    router_membership_source{kind=}, router_hot_keys_active,
+    router_replica_reads_total{backend=}, the clip counters, and the
+    cache_l2_* families on the core registry."""
+    from deconv_api_tpu.serving.metrics import Metrics
+
+    r = Metrics(prefix="router", core=False)
+    for kind, n in (("static", 2), ("file", 1), ("announce", 1)):
+        r.set_labeled_gauge("membership_source", "kind", kind, n)
+    r.set_gauge("hot_keys_active", 3)
+    r.inc_labeled("replica_reads_total", "backend", "b1:8001", 4)
+    r.inc_counter("hot_tracker_clipped_total", 7)
+    r.inc_counter("rebalance_seen_clipped_total", 1)
+    families, samples = lint_exposition(r.prometheus())
+    assert families["router_membership_source"] == "gauge"
+    assert families["router_hot_keys_active"] == "gauge"
+    assert families["router_replica_reads_total"] == "counter"
+    assert families["router_hot_tracker_clipped_total"] == "counter"
+    assert families["router_rebalance_seen_clipped_total"] == "counter"
+    assert samples[("router_membership_source", 'kind="static"')] == 2.0
+    assert (
+        samples[("router_replica_reads_total", 'backend="b1:8001"')] == 4.0
+    )
+
+    c = Metrics()
+    for name, n in (
+        ("cache_l2_hits_total", 5),
+        ("cache_l2_misses_total", 2),
+        ("cache_l2_stores_total", 6),
+        ("cache_l2_sweeps_total", 1),
+        ("cache_l2_corrupt_total", 1),
+    ):
+        c.inc_counter(name, n)
+    c.set_gauge("cache_l2_resident_bytes", 4096)
+    families, samples = lint_exposition(c.prometheus())
+    for name in (
+        "deconv_cache_l2_hits_total", "deconv_cache_l2_misses_total",
+        "deconv_cache_l2_stores_total", "deconv_cache_l2_sweeps_total",
+        "deconv_cache_l2_corrupt_total",
+    ):
+        assert families[name] == "counter"
+    assert families["deconv_cache_l2_resident_bytes"] == "gauge"
+
+
+# ----------------------------------------------------------------- e2e
+
+_E2E_PARAMS = None
+
+
+def _tiny_params():
+    global _E2E_PARAMS
+    if _E2E_PARAMS is None:
+        _E2E_PARAMS = init_params(TINY, jax.random.PRNGKey(3))
+    return _E2E_PARAMS
+
+
+def _ha_cfg(**overrides) -> ServerConfig:
+    base = dict(
+        image_size=16, max_batch=4, batch_window_ms=1.0,
+        compilation_cache_dir="",
+    )
+    base.update(overrides)
+    return ServerConfig(**base)
+
+
+async def _boot_backend(cfg):
+    from deconv_api_tpu.serving.app import DeconvService
+
+    svc = DeconvService(cfg, spec=TINY, params=_tiny_params())
+    port = await svc.start("127.0.0.1", 0)
+    svc.ready = True
+    return svc, port
+
+
+def _form_body(seed: int) -> bytes:
+    import cv2
+
+    rng = np.random.default_rng(seed)
+    img = (rng.random((16, 16, 3)) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".png", img)
+    assert ok
+    uri = "data:image/png;base64," + base64.b64encode(
+        buf.tobytes()
+    ).decode()
+    return urllib.parse.urlencode({"file": uri, "layer": "b2c1"}).encode()
+
+
+async def _post(port: int, body: bytes, headers=None):
+    return await fleet.raw_request(
+        "127.0.0.1", port, "POST", "/",
+        {
+            "content-type": "application/x-www-form-urlencoded",
+            **(headers or {}),
+        },
+        body, 60.0,
+    )
+
+
+def test_e2e_l2_survives_backend_restart(tmp_path):
+    """The durable-tier contract end to end: compute once, restart the
+    whole process (fresh memory cache), and the SAME bytes come back
+    from disk (x-cache: l2) without device compute — then promote into
+    the memory tier (x-cache: hit).  A corrupted entry reads as a miss
+    and recomputes, byte-identically."""
+    l2_dir = str(tmp_path / "l2")
+    body = _form_body(21)
+
+    async def go():
+        svc1, port1 = await _boot_backend(_ha_cfg(l2_dir=l2_dir))
+        status, h1, payload1 = await _post(port1, body)
+        assert status == 200 and h1.get("x-cache") == "miss"
+        await svc1.stop()  # closes the L2: queued write-through flushed
+        assert svc1.metrics.counter("cache_l2_stores_total") == 1
+
+        svc2, port2 = await _boot_backend(_ha_cfg(l2_dir=l2_dir))
+        status, h2, payload2 = await _post(port2, body)
+        assert status == 200
+        assert h2.get("x-cache") == "l2", h2
+        assert payload2 == payload1  # byte parity through the disk tier
+        status, h3, payload3 = await _post(port2, body)
+        assert h3.get("x-cache") == "hit" and payload3 == payload1
+        assert svc2.metrics.counter("cache_l2_hits_total") == 1
+        # a no-cache bypass is a forced RECOMPUTE: the L2 must not
+        # satisfy it either
+        status, h4, payload4 = await _post(
+            port2, body, {"cache-control": "no-cache"}
+        )
+        assert h4.get("x-cache") == "bypass" and payload4 == payload1
+        await svc2.stop()
+
+        # corrupt the stored entry: flip one byte in the body tail
+        fn = [f for f in os.listdir(l2_dir) if f.endswith(".l2")]
+        assert len(fn) == 1
+        path = os.path.join(l2_dir, fn[0])
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+        svc3, port3 = await _boot_backend(_ha_cfg(l2_dir=l2_dir))
+        status, h5, payload5 = await _post(port3, body)
+        assert status == 200
+        assert h5.get("x-cache") == "miss"  # corruption = miss, never 500
+        assert payload5 == payload1
+        assert svc3.metrics.counter("cache_l2_corrupt_total") == 1
+        await svc3.stop()
+
+    asyncio.run(go())
+
+
+def test_e2e_default_boot_unchanged(tmp_path):
+    """The acceptance pin: a bare single-process boot carries NONE of
+    the round-16 machinery — no L2, no disk writes, no announcements —
+    and serves byte-identically to an L2-enabled twin."""
+    cfg = _ha_cfg()
+    assert ServerConfig().l2_dir == ""
+    assert ServerConfig().fleet_routers == ""
+    assert ServerConfig().fleet_token == ""
+    body = _form_body(22)
+
+    async def go():
+        svc, port = await _boot_backend(cfg)
+        assert svc.l2 is None
+        # no routers configured: announcing is a no-op, not an error
+        assert await svc.announce_to_routers("register") == 0
+        status, h, payload = await _post(port, body)
+        assert status == 200 and h.get("x-cache") == "miss"
+        assert svc.metrics.counter("cache_l2_stores_total") == 0
+        await svc.stop()
+
+        svc2, port2 = await _boot_backend(
+            _ha_cfg(l2_dir=str(tmp_path / "l2"))
+        )
+        status, _h, payload2 = await _post(port2, body)
+        assert payload2 == payload  # the L2 tier never changes bytes
+        await svc2.stop()
+
+    asyncio.run(go())
+
+
+def test_e2e_two_router_kill_one_over_real_backends(tmp_path):
+    """The satellite drill in miniature: two routers share membership
+    (announce at A, file-watch at B), backends self-register — no
+    static list anywhere — and killing router A loses nothing because
+    router B makes the identical placement."""
+    mf = str(tmp_path / "members.json")
+    body = _form_body(23)
+
+    async def go():
+        ra = FleetRouter(
+            [], membership_file=mf, fleet_token=TOKEN,
+            probe_interval_s=0.2, eject_threshold=2, cooldown_s=1.0,
+        )
+        rb = FleetRouter(
+            [], membership_file=mf, fleet_token=TOKEN,
+            probe_interval_s=0.2, eject_threshold=2, cooldown_s=1.0,
+        )
+        pa = await ra.start("127.0.0.1", 0)
+        pb = await rb.start("127.0.0.1", 0)
+        backends = []
+        for _ in range(2):
+            cfg = _ha_cfg(
+                fleet_token=TOKEN,
+                fleet_routers=f"127.0.0.1:{pa}",  # announce to A ONLY
+            )
+            svc, port = await _boot_backend(cfg)
+            svc.cfg.fleet_advertise = f"127.0.0.1:{port}"
+            assert await svc.announce_to_routers("register") == 1
+            backends.append((svc, port))
+        names = {f"127.0.0.1:{p}" for _s, p in backends}
+
+        async def converged(router):
+            for _ in range(60):
+                if {
+                    m.name
+                    for m in router.members.values()
+                    if m.in_ring
+                } == names:
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        # A learned both by announce; B must converge via the FILE
+        assert await converged(ra)
+        assert await converged(rb)
+        assert {
+            rb._member_source[n] for n in names
+        } == {"file"}
+        # identical placement: the same request routes to the same
+        # backend through EITHER router (same members -> same ring)
+        s1, h1, payload1 = await _post(pa, body)
+        assert s1 == 200
+        s2, h2, payload2 = await _post(pb, body)
+        assert s2 == 200 and h2.get("x-cache") == "hit"
+        assert h1["x-backend"] == h2["x-backend"]
+        assert payload2 == payload1
+        # kill router A: the fleet keeps serving through B
+        await ra.stop()
+        s3, h3, payload3 = await _post(pb, body)
+        assert s3 == 200 and payload3 == payload1
+        # graceful backend drain: announced to BOTH routers — the dead
+        # one fails silently (best effort), the live one marks the
+        # member gone IMMEDIATELY, before any probe tick
+        victim, vport = backends[0]
+        victim.cfg.fleet_routers = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+        await victim.stop()
+        assert rb.members[f"127.0.0.1:{vport}"].announced_drain
+        survivor_name = f"127.0.0.1:{backends[1][1]}"
+        for _ in range(40):
+            s4, h4, _p = await _post(pb, _form_body(24))
+            assert s4 == 200
+            assert h4["x-backend"] == survivor_name
+        await rb.stop()
+        await backends[1][0].stop()
+
+    asyncio.run(go())

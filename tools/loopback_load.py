@@ -19,7 +19,20 @@ Usage: python tools/loopback_load.py [--passes N] [--no-donate]
            [--chaos site=spec,...] [--pool-decode] [--lanes N]
            [--compile-cache-dir DIR] [--heavy] [--jobs]
            [--jobs-dir DIR] [--qos] [--tenants default|SPEC]
-           [--fleet N] [depth ...]
+           [--fleet N] [--fleet-ha] [depth ...]
+
+Round 16 added `--fleet-ha` — the zero-SPOF drill (run_fleet_ha_drill):
+TWO HA routers share one watched membership file, three backends
+self-register (no static --backends anywhere) and carry durable L2
+caches.  Phase 1 kills every process — each router, each backend, one
+at a time — under live zipf load with a ZERO-request-loss budget (the
+client fails over between routers; the router retries once across ring
+owners).  Phase 2 rolling-restarts the whole backend fleet and pins
+that the hit ratio recovers to >= 80% of its pre-restart value from
+the L2 tier (x-cache: l2 / peer-fill / hit — anything but device
+compute), with the time-to-recovery measured and ZERO L2 hits flagged
+loudly as a vacuous cold start.  `tools/run_bench_suite.py`'s
+`fleet-ha` token records the row.
 
 Round 14 added `--fleet N` — the fleet-tier drill (run_fleet_drill):
 one cache-affine consistent-hash router (serving/fleet.py) over N
@@ -1306,6 +1319,403 @@ def run_fleet_drill(
     return asyncio.run(drive())
 
 
+def run_fleet_ha_drill(
+    n_backends: int = 3,
+    n_routers: int = 2,
+    n_requests: int = 288,
+    concurrency: int = 16,
+    key_dist: str = "zipf:1.1",
+) -> dict:
+    """The round-16 zero-SPOF drill: N self-registering backends (each
+    with a durable L2 cache) behind TWO HA routers sharing one watched
+    membership file — no static backend list anywhere.
+
+    Phase 1 — **kill ANY single process with zero request loss**: under
+    live zipf load, each router and each backend is killed ABRUPTLY
+    (one at a time, then restarted and re-admitted before the next
+    kill).  The client fails over between routers and honours one
+    retry; the budget is ZERO requests with no successful response.
+
+    Phase 2 — **full-fleet rolling restart recovers the hitset from
+    the L2**: every backend is drained (self-announced), stopped, and
+    restarted with its memory cache cold but its L2 directory intact.
+    The same keystream is then replayed: responses served without
+    device compute (memory hit / L2 hit / peer fill) must recover to
+    >= 80% of the pre-restart hit ratio, and the time-to-recovery is
+    measured.  Zero L2 hits = the restart was a cold start = loud
+    error.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import shutil
+    import tempfile
+    import urllib.parse
+
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving.app import DeconvService
+    from deconv_api_tpu.serving.fleet import FleetRouter
+
+    RECOVERY_FRAC = 0.8
+    # client-side kinds that prove no device compute ran
+    RECOVERED = ("hit", "hit-negative", "l2", "peer-fill")
+    token = "fleet-ha-drill-token"
+    tmp = tempfile.mkdtemp(prefix="fleet_ha_")
+    mf = os.path.join(tmp, "members.json")
+
+    spec = _tiny_spec()
+    size = spec.input_shape[0]
+    params = init_params(spec, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    streams = _key_streams(key_dist, n_requests, 2, rng)
+    kill_slice = streams[1][: max(48, n_requests // 3)]
+    uris: dict[int, str] = {}
+    for idx in sorted({i for stream in streams for i in stream}):
+        img = Image.fromarray(
+            np.random.default_rng(idx).integers(
+                0, 255, (size, size, 3), np.uint8
+            ),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        uris[idx] = (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+    bodies = {
+        idx: urllib.parse.urlencode({"file": uri, "layer": "c3"}).encode()
+        for idx, uri in uris.items()
+    }
+
+    router_kw = dict(
+        membership_file=mf,
+        fleet_token=token,
+        probe_interval_s=0.2,
+        probe_timeout_s=1.0,
+        eject_threshold=2,
+        cooldown_s=1.0,
+        forward_timeout_s=60.0,
+        hot_key_top_k=8,
+        hot_key_replicas=2,
+    )
+
+    async def drive() -> dict:
+        routers: list[FleetRouter | None] = []
+        router_ports: list[int] = []
+        for _ in range(n_routers):
+            r = FleetRouter([], **router_kw)
+            routers.append(r)
+            router_ports.append(await r.start("127.0.0.1", 0))
+
+        def backend_cfg() -> ServerConfig:
+            return ServerConfig(
+                image_size=size,
+                max_batch=16,
+                batch_window_ms=3.0,
+                compilation_cache_dir="",
+                platform="cpu",
+                warmup_all_buckets=False,
+                cache_bytes=cfg_cache_bytes(),
+                fleet_peer_fill=True,
+                fleet_token=token,
+                fleet_routers=",".join(
+                    f"127.0.0.1:{p}" for p in router_ports
+                ),
+            )
+
+        services: dict[str, DeconvService] = {}
+
+        async def boot_backend(port: int = 0) -> tuple[str, int]:
+            cfg = backend_cfg()
+            cfg.l2_dir = ""  # set after the port is known
+            svc = DeconvService(cfg, spec=spec, params=params)
+            bound = await svc.start("127.0.0.1", port)
+            name = f"127.0.0.1:{bound}"
+            # the L2 directory is PER MEMBER and must survive restarts
+            svc.cfg.l2_dir = os.path.join(tmp, "l2", name.replace(":", "_"))
+            from deconv_api_tpu.serving.cache import L2Store
+
+            svc.l2 = L2Store(
+                svc.cfg.l2_dir, svc.cfg.l2_bytes, metrics=svc.metrics
+            )
+            svc.cfg.fleet_advertise = name
+            await asyncio.to_thread(svc.warmup, "c3")
+            await svc.announce_to_routers("register")
+            services[name] = svc
+            return name, bound
+
+        async def in_ring_everywhere(name: str, timeout_s=30.0) -> bool:
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout_s:
+                live = [r for r in routers if r is not None]
+                if live and all(
+                    name in r.members and r.members[name].in_ring
+                    for r in live
+                ):
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        for _ in range(n_backends):
+            await boot_backend()
+        for name in list(services):
+            assert await in_ring_everywhere(name), (
+                f"{name} never admitted by every router"
+            )
+        converged = all(
+            len(r.ring.members) == n_backends
+            for r in routers
+            if r is not None
+        )
+
+        lost_log: list[dict] = []
+
+        async def post_ha(idx: int) -> tuple[str, str, int]:
+            """(kind, backend, attempts); router failover + one retry —
+            a request is LOST only when every attempt fails."""
+            body = bodies[idx]
+            last = (0, "none", "")
+            for attempt in range(4):
+                port = router_ports[attempt % len(router_ports)]
+                try:
+                    reader, writer = await asyncio.open_connection(
+                        "127.0.0.1", port
+                    )
+                    writer.write(
+                        b"POST / HTTP/1.1\r\nHost: x\r\nContent-Type: "
+                        b"application/x-www-form-urlencoded\r\n"
+                        b"Content-Length: " + str(len(body)).encode()
+                        + b"\r\nConnection: close\r\n\r\n" + body
+                    )
+                    await writer.drain()
+                    raw = await asyncio.wait_for(reader.read(), 60.0)
+                    writer.close()
+                except (OSError, asyncio.TimeoutError, TimeoutError):
+                    continue  # router down: fail over to the other one
+                status, code = _resp_status_code(raw)
+                kind, _rid = _resp_meta(raw)
+                backend = ""
+                for line in raw.split(b"\r\n\r\n", 1)[0].split(b"\r\n"):
+                    hname, _, value = line.partition(b":")
+                    if hname.strip().lower() == b"x-backend":
+                        backend = value.strip().decode()
+                if status == 200:
+                    return kind, backend, attempt + 1
+                last = (status, code or "none", backend)
+                await asyncio.sleep(0.05)
+            lost_log.append(
+                {"idx": idx, "status": last[0], "code": last[1]}
+            )
+            return "lost", last[2], 4
+
+        async def drive_stream(stream, on_done=None):
+            sem = asyncio.Semaphore(concurrency)
+            out: list[tuple[str, str, int, float]] = []
+            t0 = time.perf_counter()
+
+            async def one(idx: int):
+                async with sem:
+                    kind, backend, attempts = await post_ha(idx)
+                out.append(
+                    (kind, backend, attempts, time.perf_counter() - t0)
+                )
+                if on_done is not None:
+                    await on_done(len(out))
+
+            await asyncio.gather(*(one(i) for i in stream))
+            return out
+
+        def split(samples) -> dict:
+            kinds: dict[str, int] = {}
+            for kind, _b, _a, _t in samples:
+                kinds[kind] = kinds.get(kind, 0) + 1
+            rec = sum(kinds.get(k, 0) for k in RECOVERED)
+            return {
+                "kinds": kinds,
+                "recovered_ratio": round(rec / max(1, len(samples)), 4),
+                "lost": kinds.get("lost", 0),
+                "retried": sum(1 for _k, _b, a, _t in samples if a > 1),
+            }
+
+        # ---- warm + reference ratio -------------------------------------
+        await drive_stream(streams[0])
+        ref = split(await drive_stream(streams[0]))
+        pre_ratio = ref["recovered_ratio"]
+
+        # ---- phase 1: kill ANY single process under live load -----------
+        kills: list[dict] = []
+
+        async def restart_router(i: int) -> float:
+            t0 = time.perf_counter()
+            r = FleetRouter([], **router_kw)
+            routers[i] = r
+            router_ports[i] = await r.start("127.0.0.1", 0)
+            # membership comes back from the FILE; wait for full ring
+            while len(r.ring.members) < n_backends:
+                await asyncio.sleep(0.1)
+                if time.perf_counter() - t0 > 30:
+                    break
+            return time.perf_counter() - t0
+
+        async def restart_backend(name: str) -> float:
+            t0 = time.perf_counter()
+            port = int(name.rpartition(":")[2])
+            _name, _port = await boot_backend(port)
+            assert _name == name
+            assert await in_ring_everywhere(name)
+            return time.perf_counter() - t0
+
+        targets = [("router", i) for i in range(n_routers)] + [
+            ("backend", name) for name in list(services)
+        ]
+        for tkind, tid in targets:
+            killed = asyncio.Event()
+            kill_at = max(1, len(kill_slice) // 3)
+
+            async def on_done(done: int):
+                if done >= kill_at and not killed.is_set():
+                    killed.set()
+                    if tkind == "router":
+                        r = routers[tid]
+                        routers[tid] = None
+                        await r.stop(grace_s=0.0)
+                    else:
+                        svc = services.pop(tid)
+                        # ABRUPT: suppress the drain announcement — the
+                        # routers must discover the death passively
+                        svc.cfg.fleet_routers = ""
+                        await svc.stop()
+
+            samples = await drive_stream(kill_slice, on_done=on_done)
+            s = split(samples)
+            restart_s = (
+                await restart_router(tid)
+                if tkind == "router"
+                else await restart_backend(tid)
+            )
+            kills.append(
+                {
+                    "target": f"{tkind}-{tid}",
+                    "requests": len(samples),
+                    "lost": s["lost"],
+                    "retried": s["retried"],
+                    "restart_s": round(restart_s, 2),
+                }
+            )
+
+        # ---- phase 2: full-fleet rolling restart, L2 recovery -----------
+        pre2 = split(await drive_stream(streams[0]))
+        for name in list(services):
+            svc = services.pop(name)
+            # graceful: stop() self-announces drain to every router
+            await svc.stop()
+            await restart_backend(name)
+        l2_entries = {
+            n: s.l2.entry_count for n, s in services.items()
+        }
+        rec_samples = await drive_stream(streams[0])
+        rec = split(rec_samples)
+        need = RECOVERY_FRAC * pre2["recovered_ratio"]
+        recovery_s = None
+        done_rec = 0
+        for i, (kind, _b, _a, t) in enumerate(rec_samples, 1):
+            done_rec += kind in RECOVERED
+            if i >= 24 and done_rec / i >= need and recovery_s is None:
+                recovery_s = round(t, 2)
+        l2_hits = sum(
+            s.metrics.counter("cache_l2_hits_total")
+            for s in services.values()
+        )
+        hot_active = 0
+        replica_reads: dict[str, float] = {}
+        sources: dict[str, float] = {}
+        for r in routers:
+            if r is None:
+                continue
+            snap = r.metrics.snapshot()
+            hot_active = max(
+                hot_active, int(snap["gauges"].get("hot_keys_active", 0))
+            )
+            for b, n in r.metrics.labeled("replica_reads_total").items():
+                replica_reads[b] = replica_reads.get(b, 0) + n
+            for k, v in r.metrics.labeled_gauge(
+                "membership_source"
+            ).items():
+                sources[k] = max(sources.get(k, 0), v)
+
+        for r in routers:
+            if r is not None:
+                await r.stop(grace_s=0.0)
+        for svc in services.values():
+            svc.cfg.fleet_routers = ""
+            await svc.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+        lost_total = sum(k["lost"] for k in kills)
+        row = {
+            "which": f"loopback_fleet_ha{n_backends}x{n_routers}",
+            "platform": "cpu-loopback",
+            "n_backends": n_backends,
+            "n_routers": n_routers,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "key_dist": key_dist,
+            "unique_keys": len(bodies),
+            "membership": {"converged": converged, "sources": sources},
+            "pre_hit_ratio": pre_ratio,
+            "kills": kills,
+            "lost_total": lost_total,
+            "lost_detail": lost_log[:16],
+            "rolling_restart": {
+                "pre_hit_ratio": pre2["recovered_ratio"],
+                "recovered_ratio": rec["recovered_ratio"],
+                "recovery_frac_needed": RECOVERY_FRAC,
+                "recovery_s": recovery_s,
+                "l2_hits": l2_hits,
+                "l2_entries_by_backend": l2_entries,
+                "kinds": rec["kinds"],
+            },
+            "hot": {
+                "hot_keys_active": hot_active,
+                "replica_reads": replica_reads,
+            },
+        }
+        problems = []
+        if not converged:
+            problems.append(
+                "routers never converged on one membership view"
+            )
+        if lost_total:
+            problems.append(
+                f"{lost_total} requests LOST across the kill phases "
+                "(zero-loss budget)"
+            )
+        if l2_hits == 0:
+            problems.append(
+                "0 L2 hits after the rolling restart — recovery was a "
+                "cold start, the durable tier is vacuous"
+            )
+        if rec["recovered_ratio"] < need:
+            problems.append(
+                f"post-restart recovered ratio {rec['recovered_ratio']} "
+                f"< {RECOVERY_FRAC} x pre-restart "
+                f"{pre2['recovered_ratio']} (cold-start recovery)"
+            )
+        if recovery_s is None:
+            problems.append("recovery threshold never reached")
+        if problems:
+            row["error"] = "; ".join(problems)
+        return row
+
+    return asyncio.run(drive())
+
+
 def run_model_mix_drill(
     n_models: int = 3,
     n_requests: int = 360,
@@ -2192,6 +2602,7 @@ def main() -> int:
     qos_on = False
     model_mix = False
     fleet_n: int | None = None
+    fleet_ha = False
     tenants_drill: str | None = None
     concurrency = 64
     depths: list[int] = []
@@ -2254,6 +2665,13 @@ def main() -> int:
             # mid-run backend kill with collateral accounting
             fleet_n = int(args[i + 1])
             i += 2
+        elif args[i] == "--fleet-ha":
+            # the round-16 zero-SPOF drill: 2 HA routers + 3
+            # self-registering L2-backed backends; kill-any-single-
+            # process under load (zero-loss budget) + full rolling
+            # restart with L2 hit-ratio recovery
+            fleet_ha = True
+            i += 1
         elif args[i] == "--tenants":
             # the multi-tenant noisy-neighbor drill (round 13):
             # 'default' = the built-in victim/abuser pair with the
@@ -2302,6 +2720,14 @@ def main() -> int:
         row = run_model_mix_drill(
             n_requests=n_requests or 360,
             concurrency=min(concurrency, 16),
+        )
+        print(json.dumps(row), flush=True)
+        return 0
+    if fleet_ha:
+        row = run_fleet_ha_drill(
+            n_requests=n_requests or 288,
+            concurrency=min(concurrency, 24),
+            key_dist=key_dist or "zipf:1.1",
         )
         print(json.dumps(row), flush=True)
         return 0

@@ -73,6 +73,13 @@ QOS_SYNC_OVERHEAD_BUDGET_PCT = 3.0
 # key; 5% absorbs coalescing-vs-hit timing jitter, not fragmentation.)
 FLEET_HIT_RATIO_BUDGET_PCT = 5.0
 
+# Zero-SPOF fleet budget (round 16): a full-fleet rolling restart must
+# recover at least this fraction of the pre-restart hit ratio WITHOUT
+# device compute (memory hit / L2 hit / peer fill) — anything less
+# means the durable L2 tier is not actually carrying the hitset across
+# restarts.  The kill phase's budget is exactly zero lost requests.
+FLEET_HA_RECOVERY_FRAC = 0.8
+
 # Multi-model paging budget (round 15): the weight-manager machinery
 # engaged for a SINGLE model (budget set, no second model) may cost the
 # hot path at most this much throughput versus the inert pre-round-15
@@ -506,6 +513,54 @@ def run_fleet_guard(timeout_s: float = 1800.0) -> dict:
     return row
 
 
+def run_fleet_ha_guard(timeout_s: float = 1800.0) -> dict:
+    """Zero-SPOF drill guard (round 16): tools/loopback_load.py
+    --fleet-ha — two HA routers over one watched membership file, three
+    self-registering backends with durable L2 caches.
+
+    The row fails LOUDLY (`error` field) when:
+    - ANY request is lost while killing any single process (each
+      router and each backend, one at a time, under live zipf load);
+    - the routers never converge on one membership view;
+    - the full-fleet rolling restart recovers less than
+      FLEET_HA_RECOVERY_FRAC of the pre-restart hit ratio without
+      device compute, or the recovery threshold is never reached;
+    - the recovery shows ZERO L2 hits (a cold start dressed up as
+      recovery — the durable tier did nothing)."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--fleet-ha"], timeout_s, env=env
+    )
+    row = {"config": "fleet-ha", "which": "loopback_fleet_ha_drill"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    rr = drill.get("rolling_restart", {})
+    row.update(
+        n_backends=drill.get("n_backends"),
+        n_routers=drill.get("n_routers"),
+        requests=drill.get("requests"),
+        key_dist=drill.get("key_dist"),
+        membership=drill.get("membership"),
+        pre_hit_ratio=drill.get("pre_hit_ratio"),
+        kills=drill.get("kills"),
+        lost_total=drill.get("lost_total"),
+        restart_pre_hit_ratio=rr.get("pre_hit_ratio"),
+        recovered_ratio=rr.get("recovered_ratio"),
+        recovery_frac_needed=FLEET_HA_RECOVERY_FRAC,
+        recovery_s=rr.get("recovery_s"),
+        l2_hits=rr.get("l2_hits"),
+        recovery_kinds=rr.get("kinds"),
+        hot=drill.get("hot"),
+    )
+    # the drill already assembles its own violation list; carry it
+    # verbatim — the guard's job is the recorded row, not re-deriving
+    if "error" in drill:
+        row["error"] = drill["error"]
+    return row
+
+
 def run_models_guard(timeout_s: float = 1800.0) -> dict:
     """Multi-model serving drill guard (round 15):
     tools/loopback_load.py --model-mix — zipf traffic over three
@@ -920,6 +975,12 @@ def main() -> int:
             # collateral on the mid-run kill
             result = run_fleet_guard()
             result["date"] = date
+        elif tok == "fleet-ha":
+            # zero-SPOF drill (round 16): kill-any-single-process under
+            # load with a zero-loss budget, then a full rolling restart
+            # recovering the hitset from the durable L2
+            result = run_fleet_ha_guard()
+            result["date"] = date
         elif tok == "models":
             # multi-model paging drill (round 15): three backbones from
             # one pool under a budget that forces paging + the
@@ -947,7 +1008,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'models'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'fleet-ha', 'models'])}",
             }
         else:
             n = int(tok)

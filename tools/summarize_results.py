@@ -63,6 +63,18 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "recovered_ratio" in row:
+        # zero-SPOF fleet-ha rows (round 16): the kill-phase loss count
+        # and the rolling-restart L2 recovery in one line, error visible
+        line = (
+            f"HA kill-any lost={row.get('lost_total')} "
+            f"({len(row.get('kills') or [])} kills), restart recovered "
+            f"{row.get('recovered_ratio')} of {row.get('restart_pre_hit_ratio')} "
+            f"in {row.get('recovery_s')}s (l2_hits={row.get('l2_hits')})"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "aggregate_hit_ratio" in row:
         # fleet-tier rows (round 14): the one-logical-cache claim plus
         # the kill phase's collateral in one line, error kept visible
