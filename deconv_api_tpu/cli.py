@@ -73,12 +73,21 @@ def cmd_fleet_router(args: argparse.Namespace) -> int:
         "eject_threshold", "cooldown_s", "forward_timeout_s",
         "membership_file", "fleet_token", "hot_key_top_k",
         "hot_key_replicas",
+        # round 17 tail tolerance + router-side fault injection
+        "tail_tolerance", "slow_eject_k", "slow_restore_k",
+        "slow_min_samples", "slow_hold_s", "slow_floor_ms",
+        "slow_canary_every", "latency_window_s", "hedge_budget_pct",
+        "hedge_min_delay_ms", "fault_seed",
     ):
         val = getattr(args, flag, None)
         if val is not None:
             argv += [f"--{flag.replace('_', '-')}", str(val)]
     if args.no_peer_fill:
         argv += ["--no-peer-fill"]
+    if getattr(args, "fault_injection", False):
+        argv += ["--fault-injection"]
+    for spec in getattr(args, "fault", None) or []:
+        argv += ["--fault", spec]
     return fleet_main(argv)
 
 
@@ -506,6 +515,74 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument(
         "--no-peer-fill", action="store_true", dest="no_peer_fill",
         help="never attach x-peer-fill hints on rebalanced keys",
+    )
+    s.add_argument(
+        "--tail-tolerance", choices=("on", "off"), default=None,
+        dest="tail_tolerance",
+        help="gray-failure outlier ejection + hedged requests (round "
+        "17); 'off' pins routing byte-identical to the round-16 tier",
+    )
+    s.add_argument(
+        "--slow-eject-k", type=float, default=None, dest="slow_eject_k",
+        help="demote a member whose windowed p95 exceeds K x its "
+        "peers' median p95 (default 4)",
+    )
+    s.add_argument(
+        "--slow-restore-k", type=float, default=None,
+        dest="slow_restore_k",
+        help="restore below K x the peer median (hysteresis; default 2)",
+    )
+    s.add_argument(
+        "--slow-min-samples", type=int, default=None,
+        dest="slow_min_samples",
+        help="windowed samples before a member can be judged slow "
+        "(default 20)",
+    )
+    s.add_argument(
+        "--slow-hold-s", type=float, default=None, dest="slow_hold_s",
+        help="minimum seconds in 'slow' before restoration (default 10)",
+    )
+    s.add_argument(
+        "--slow-floor-ms", type=float, default=None,
+        dest="slow_floor_ms",
+        help="absolute p95 floor under which nobody is judged slow "
+        "(default 25)",
+    )
+    s.add_argument(
+        "--slow-canary-every", type=int, default=None,
+        dest="slow_canary_every",
+        help="every Nth demoted keyed pick probes the slow primary "
+        "(restore evidence; 0 off, default 64)",
+    )
+    s.add_argument(
+        "--latency-window-s", type=float, default=None,
+        dest="latency_window_s",
+        help="sliding window for the latency digests (default 30)",
+    )
+    s.add_argument(
+        "--hedge-budget-pct", type=float, default=None,
+        dest="hedge_budget_pct",
+        help="hedge at most this percent of eligible requests "
+        "(0 disables; default 5)",
+    )
+    s.add_argument(
+        "--hedge-min-delay-ms", type=float, default=None,
+        dest="hedge_min_delay_ms",
+        help="floor under the p95-derived hedge delay (default 30)",
+    )
+    s.add_argument(
+        "--fault-injection", action="store_true", dest="fault_injection",
+        help="enable the router's fleet.* network-fault sites + the "
+        "POST /v1/debug/faults arming endpoint",
+    )
+    s.add_argument(
+        "--fault", action="append", default=None, metavar="SITE=SPEC",
+        help="arm a fleet.* site at boot "
+        "(p<prob>|n<count>[:<param>][@<backend>]); repeatable",
+    )
+    s.add_argument(
+        "--fault-seed", type=int, default=None, dest="fault_seed",
+        help="seed for probabilistic fault specs (chaos replays)",
     )
     s.set_defaults(fn=cmd_fleet_router)
 

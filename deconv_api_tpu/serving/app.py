@@ -744,10 +744,21 @@ class DeconvService:
         # delay never blocks the event loop.  dispatch_error passes the
         # consulting LANE (round 10): a spec armed with :<lane> bursts
         # one chip and leaves the rest of the pool untouched.
-        act = faults_mod.check("device.dispatch_delay_ms")
+        # Both sites consult with who=<advertise name> (round 17): a
+        # spec armed with an ``@host:port`` target grays exactly one
+        # backend of an in-process fleet drill and leaves its peers'
+        # dispatch untouched (the module hook is process-global).  The
+        # name is only derived while a registry is installed — the
+        # default path keeps the zero-cost disabled-hook contract.
+        who = (
+            self._advertise_name()
+            if faults_mod.installed() is not None
+            else None
+        )
+        act = faults_mod.check("device.dispatch_delay_ms", who=who)
         if act is not None:
             time.sleep((act.param or 100.0) / 1e3)
-        faults_mod.raise_if_armed("device.dispatch_error", where=lane)
+        faults_mod.raise_if_armed("device.dispatch_error", where=lane, who=who)
         # Per-request model routing (round 15): a non-default model rides
         # as the key's HEAD (so batches only ever group within one
         # model); bare keys — every pre-round-15 caller, warmup, tests —

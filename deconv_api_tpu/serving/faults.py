@@ -19,7 +19,10 @@ such path a named, armable injection site:
   ``p<prob>``/``<prob>`` (fire with that probability per consultation),
   or ``n<count>`` (fire on the next <count> consultations, then
   self-disarm — the "burst" form), optionally ``:<param>`` for
-  parameterized sites (milliseconds for the delay/hang/slow-write sites).
+  parameterized sites (milliseconds for the delay/hang/slow-write
+  sites), optionally ``@<target>`` (round 17) restricting the spec to
+  one consulting identity — a backend name for the router-side
+  ``fleet.*`` sites, a ``fleet_advertise`` name for the device sites.
   Multiple ``site=spec`` pairs join with commas.
 
 - ``FaultRegistry``: lock-protected armed-spec table with a SEEDED
@@ -60,22 +63,41 @@ SITES = (
     "qos.admission_raise",     # QoS admission layer crashes (fails OPEN
                                # to the default tenant — availability
                                # over accounting; serving/qos.py)
+    # Router-side NETWORK fault sites (round 17, serving/fleet.py): the
+    # gray failures the backend-side device sites cannot manufacture —
+    # a sick NIC, a congested cross-rack path, a half-dead conntrack
+    # entry — live between the router and one backend, not inside the
+    # backend's dispatch.  All are armable per backend via the
+    # ``@<host:port>`` target selector below.
+    "fleet.connect_delay_ms",  # router->backend connect stalls :param ms
+    "fleet.head_delay_ms",     # response head arrives :param ms late
+    "fleet.body_trickle",      # body trickles (:param ms per 64 KiB)
+    "fleet.torn_body",         # response torn mid-body (infra failure)
+    "fleet.blackhole",         # backend accepts, never answers (timeout)
 )
 
 
 @dataclass
 class FaultSpec:
     """One armed site: probability per consultation, optional one-shot
-    remaining count (None = until disarmed), optional site parameter."""
+    remaining count (None = until disarmed), optional site parameter,
+    optional ``@<target>`` selector (round 17) restricting the spec to
+    one consulting identity — the fleet router consults its sites with
+    ``who=<backend host:port>``, so ``fleet.head_delay_ms=p1:150@b0:8000``
+    grays exactly one backend's network path and leaves its peers
+    untouched (the per-backend analogue of the lane-targeted ``where``)."""
 
     p: float = 1.0
     n: int | None = None
     param: float | None = None
+    target: str | None = None
 
     def __str__(self) -> str:
         s = f"n{self.n}" if self.n is not None else f"p{self.p:g}"
         if self.param is not None:
             s += f":{self.param:g}"
+        if self.target is not None:
+            s += f"@{self.target}"
         return s
 
 
@@ -89,10 +111,18 @@ class FaultAction:
 
 
 def parse_spec(raw: str) -> FaultSpec:
-    """``p0.05`` / ``0.05`` / ``n3`` with an optional ``:<param>``."""
-    head, _, param_s = raw.partition(":")
+    """``p0.05`` / ``0.05`` / ``n3`` with an optional ``:<param>`` and an
+    optional ``@<target>`` selector.  The target splits FIRST (it may
+    itself contain ``:`` — backend targets are ``host:port``)."""
+    head, at, target = raw.partition("@")
+    head, _, param_s = head.partition(":")
     head = head.strip()
     spec = FaultSpec()
+    if at:
+        target = target.strip()
+        if not target:
+            raise ValueError(f"bad fault spec {raw!r}: empty @target")
+        spec.target = target
     try:
         if head.startswith("n"):
             spec.n = int(head[1:])
@@ -107,7 +137,7 @@ def parse_spec(raw: str) -> FaultSpec:
     except ValueError:
         raise ValueError(
             f"bad fault spec {raw!r}: want p<0..1], n<count>, or <0..1], "
-            "optionally :<param>"
+            "optionally :<param>, optionally @<target>"
         ) from None
     return spec
 
@@ -174,17 +204,28 @@ class FaultRegistry:
         slog.event(_log, "fault_disarmed", site=site or "all")
         self._publish()
 
-    def check(self, site: str, where: int | None = None) -> FaultAction | None:
+    def check(
+        self,
+        site: str,
+        where: int | None = None,
+        who: str | None = None,
+    ) -> FaultAction | None:
         """``where`` is the call site's locality (round 10: the executor
         LANE consulting a device site).  A spec armed with a ``:<param>``
         on a lane-targetable site fires only when the param matches —
         ``device.dispatch_error=n8:1`` bursts lane 1 and leaves the rest
         of the pool untouched; non-matching consultations don't consume
-        one-shot counts."""
+        one-shot counts.  ``who`` (round 17) is the call site's string
+        identity — the fleet router's backend name, or a backend's own
+        fleet-advertise name — matched against the spec's ``@<target>``
+        selector the same way: a targeted spec never fires (and never
+        consumes one-shot counts) for anyone else."""
         disarmed = False
         with self._lock:
             spec = self._armed.get(site)
             if spec is None:
+                return None
+            if spec.target is not None and who != spec.target:
                 return None
             if (
                 where is not None
@@ -249,15 +290,19 @@ def installed() -> FaultRegistry | None:
     return _REGISTRY
 
 
-def check(site: str, where: int | None = None) -> FaultAction | None:
+def check(
+    site: str, where: int | None = None, who: str | None = None
+) -> FaultAction | None:
     reg = _REGISTRY
     if reg is None:
         return None
-    return reg.check(site, where)
+    return reg.check(site, where, who)
 
 
-def raise_if_armed(site: str, where: int | None = None) -> None:
+def raise_if_armed(
+    site: str, where: int | None = None, who: str | None = None
+) -> None:
     """Shared raise-form consultation: the site fires -> FaultInjected."""
-    act = check(site, where)
+    act = check(site, where, who)
     if act is not None:
         raise errors.FaultInjected(f"injected fault at {site}")

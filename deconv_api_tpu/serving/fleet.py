@@ -114,13 +114,62 @@ every process in the fleet becomes killable with zero request loss:
   ``cache-control``) still route to the primary only, where the
   backend's singleflight dedups them.
 
+Round 17 closes the gap between "no process is a SPOF" (round 16) and
+"no process can hurt p99" — the tail-tolerance layer.  The round-16
+health gate is BINARY (probe 200/non-200, consecutive-failure
+ejection), so a **gray-failed** backend — one that answers ``/readyz``
+200 while serving 10-100x slow (HBM thrash under the paging budget, a
+compile storm, a sick NIC) — kept its whole key range and held clients
+against the full forward timeout.  Four pieces fix that:
+
+- **Per-backend latency digests**: every buffered forward's head
+  latency AND every probe RTT feed small windowed samples per member
+  (``LatencyDigest``) — so an idle fleet still observes slowness —
+  on SEPARATE channels (a forward carries compute + queue wait, a
+  probe RTT carries neither), while long-lived SSE/job-stream heads
+  are excluded (their lifetime belongs to the job, not the network
+  path).
+
+- **Gray-failure outlier ejection**: a member whose windowed p95
+  exceeds ``slow_eject_k`` x the median of its PEERS' p95s on the
+  SAME channel (min-sample floor + an absolute ms floor + restore
+  hysteresis + a min-hold so it cannot flap) enters a new ``slow``
+  state: it KEEPS its ring
+  placement (cache affinity is the whole point of the ring) but
+  round-robin skips it and keyed traffic demotes it from primary to
+  last-resort — the stand-in owner gets an ``x-peer-fill`` hint naming
+  the slow primary, so the keyspace moves as bytes, not recomputes.
+  Probes keep running; recovery restores it automatically.
+
+- **Hedged requests**: keyed idempotent traffic (cacheable POSTs and
+  plain proxied GETs; job submits, forced recomputes and SSE streams
+  are NEVER hedged) fires one duplicate to the next distinct ring
+  owner after a delay derived from the live fleet p95 — first response
+  wins, the loser's connection is closed — governed by a token-bucket
+  budget (``hedge_budget_pct`` of requests, default 5%) so hedging can
+  never double device load.
+
+- **Network-fault injection**: the ``fleet.*`` sites (faults.py) arm
+  router-side per-backend network failures — connect delay, late
+  heads, body trickle, torn bodies, blackholes — via the standard spec
+  grammar's ``@<host:port>`` target selector and the router's own
+  ``POST /v1/debug/faults`` (only with ``--fault-injection``), so gray
+  failure is a drillable input, not a production surprise.
+
+``--tail-tolerance off`` pins the whole layer inert: topology and
+routing byte-identical to round 16 (the hot-key-replication escape-
+hatch precedent).
+
 Observability rides the existing machinery: a ``Metrics`` registry in
 non-core mode (prefix ``router``) carries
 ``router_requests_total{backend=}`` / ``router_backend_state{backend=}``
-(0 healthy / 1 joining / 2 ejected / 3 draining) /
+(0 healthy / 1 joining / 2 ejected / 3 draining / 4 slow) /
 ``router_rebalanced_keys_total`` /
 ``router_membership_source{kind=}`` (members by static/file/announce) /
-``router_hot_keys_active`` / ``router_replica_reads_total{backend=}``
+``router_hot_keys_active`` / ``router_replica_reads_total{backend=}`` /
+``router_slow_ejections_total{backend=}`` /
+``router_backend_latency_p{50,95}_ms{backend=}`` /
+``router_hedges_{fired,won,budget_denied}_total``
 plus forward-latency stages, and the router serves its own
 ``/healthz``, ``/readyz`` (ready while ANY backend is in the ring),
 ``/v1/config`` (full ring snapshot) and ``/metrics``.
@@ -138,10 +187,11 @@ import re
 import time
 import urllib.parse
 from bisect import bisect_left
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Callable
 
 from deconv_api_tpu import errors
+from deconv_api_tpu.serving import faults as faults_mod
 from deconv_api_tpu.serving.batcher import CircuitBreaker
 from deconv_api_tpu.serving.cache import canonical_digest
 from deconv_api_tpu.serving.http import HttpServer, Request, Response
@@ -173,14 +223,115 @@ PEER_FILL_WINDOW_S = 60.0
 _JOBS_ENTITY_RE = re.compile(r"^/v1/jobs/([A-Za-z0-9_\-]+)(/[A-Za-z0-9_\-/]*)?$")
 _JOB_OWNERS_MAX = 4096
 
-# router_backend_state gauge values, one line per backend
-_STATE_GAUGE = {"healthy": 0, "joining": 1, "ejected": 2, "draining": 3}
+# router_backend_state gauge values, one line per backend.  ``slow``
+# (round 17) is IN the ring for placement but demoted for picks — a
+# gray-failed member keeps its keyspace assignment while traffic routes
+# around it, so recovery restores affinity with zero rebalance.
+_STATE_GAUGE = {
+    "healthy": 0, "joining": 1, "ejected": 2, "draining": 3, "slow": 4,
+}
 
 # Explicit cap on the rebalance `seen`-set (round 16 satellite: the same
 # attacker-chosen-cardinality rule PR 8 applied to tenants — unbounded
 # unique keys must never grow router memory; a clipped key double-counts
 # at worst, and the clip itself is counted).
 MOVED_SEEN_MAX = 4096
+
+
+class LatencyDigest:
+    """Bounded sliding-window latency sample in MILLISECONDS (round 17).
+
+    One per backend (head latency of every buffered forward + every
+    probe RTT) plus one fleet-wide instance (the hedge-delay source).
+    Samples older than ``window_s`` age out, so a recovered backend's
+    p95 converges to its new reality within one window — the digest is
+    a rate-of-now, not a lifetime average.  ``cap`` bounds memory and
+    the per-quantile sort (512 floats, microseconds to sort, consulted
+    once per probe tick per member — not per request).
+
+    Single-consumer by contract: the router event loop feeds and reads
+    it; no lock."""
+
+    def __init__(
+        self,
+        window_s: float = 30.0,
+        cap: int = 512,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.window_s = float(window_s)
+        self.cap = max(8, int(cap))
+        self._clock = clock
+        self._samples: deque[tuple[float, float]] = deque()
+
+    def _prune(self, now: float) -> None:
+        cut = now - self.window_s
+        while self._samples and self._samples[0][0] < cut:
+            self._samples.popleft()
+
+    def add(self, ms: float) -> None:
+        now = self._clock()
+        self._samples.append((now, float(ms)))
+        while len(self._samples) > self.cap:
+            self._samples.popleft()
+        self._prune(now)
+
+    def clear(self) -> None:
+        self._samples.clear()
+
+    def __len__(self) -> int:
+        self._prune(self._clock())
+        return len(self._samples)
+
+    def quantile(self, q: float) -> float:
+        """q-quantile of the live window in ms; 0.0 when empty."""
+        self._prune(self._clock())
+        if not self._samples:
+            return 0.0
+        vals = sorted(v for _t, v in self._samples)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    def snapshot(self) -> dict:
+        self._prune(self._clock())
+        if not self._samples:
+            return {"n": 0, "p50_ms": 0.0, "p95_ms": 0.0}
+        vals = sorted(v for _t, v in self._samples)
+        n = len(vals)
+        return {
+            "n": n,
+            "p50_ms": round(vals[min(n - 1, int(0.50 * n))], 3),
+            "p95_ms": round(vals[min(n - 1, int(0.95 * n))], 3),
+        }
+
+
+class HedgeBudget:
+    """Token bucket denominated in REQUESTS (round 17 hedging).
+
+    Every hedge-eligible request deposits ``pct/100`` tokens (capped at
+    ``burst``); firing one hedge spends a whole token.  Hedges are
+    therefore bounded at ~pct% of eligible traffic over any window
+    longer than the burst — a fleet-wide latency storm (every backend
+    slow, every request hedge-eligible past its delay) cannot double
+    device load, it drains the bucket and the rest are budget-denied.
+    Request-count denomination (not wall clock) keeps the bound exact
+    and the arithmetic deterministic for tests."""
+
+    def __init__(self, pct: float = 5.0, burst: float = 8.0):
+        self.pct = float(pct)
+        self.burst = max(1.0, float(burst))
+        self._tokens = self.burst
+
+    def on_request(self) -> None:
+        self._tokens = min(self.burst, self._tokens + self.pct / 100.0)
+
+    def try_spend(self) -> bool:
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
 
 
 class HotKeyTracker:
@@ -359,6 +510,7 @@ class BackendMember:
         *,
         eject_threshold: int = 3,
         cooldown_s: float = 5.0,
+        latency_window_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
     ):
         if not BACKEND_RE.match(name):
@@ -389,16 +541,49 @@ class BackendMember:
         # timestamp guards against an in-flight stale 200).
         self.announced_drain = False
         self.drain_announced_at = 0.0
+        # round 17 tail tolerance: windowed latency samples (ms) and
+        # the slow-state bookkeeping — when the member entered ``slow``
+        # (the min-hold anchor).  ``latency`` is the combined surface
+        # digest (/readyz, /v1/config, gauges); judgment uses the two
+        # CHANNEL digests so forwards (compute + queue wait) are only
+        # ever compared against peers' forwards and probe RTTs against
+        # probe RTTs — a busy member must not look like an outlier
+        # against an idle peer's probe-dominated window.
+        self.latency = LatencyDigest(latency_window_s, clock=clock)
+        self.fwd_latency = LatencyDigest(latency_window_s, clock=clock)
+        self.probe_latency = LatencyDigest(latency_window_s, clock=clock)
+        self.slow_since = 0.0
 
     @property
     def in_ring(self) -> bool:
-        return self.state == "healthy"
+        # ``slow`` keeps its RING placement (so the keyspace assignment
+        # — and with it cache affinity on recovery — never moves); picks
+        # demote it instead (round 17).
+        return self.state in ("healthy", "slow")
 
 
 class _BackendError(Exception):
     """Infra-level forward failure: connect refused/reset, timeout, torn
     response.  The ONLY failure kind that retries on the next owner and
     feeds the ejection breaker from the forward path."""
+
+
+class _HedgeExhausted(_BackendError):
+    """Both sides of a hedged forward infra-failed (round 17).  The
+    hedge helper has ALREADY noted both failures and extended ``tried``
+    — the caller's normal _BackendError bookkeeping must not run again
+    or the breaker would double-count one wire failure."""
+
+
+def _swallow_task_result(t: asyncio.Task) -> None:
+    """Done-callback for cancelled hedge losers: retrieve the result so
+    the event loop never logs an un-retrieved exception."""
+    if not t.cancelled():
+        t.exception()
+
+
+def _is_timeout(e: _BackendError) -> bool:
+    return isinstance(e.__cause__, (asyncio.TimeoutError, TimeoutError))
 
 
 async def _read_all(chunks) -> bytes:
@@ -587,6 +772,19 @@ class FleetRouter:
         hot_key_top_k: int = 0,
         hot_key_replicas: int = 2,
         hot_key_min_rate: float = 8.0,
+        tail_tolerance: bool = True,
+        slow_eject_k: float = 4.0,
+        slow_restore_k: float = 2.0,
+        slow_min_samples: int = 20,
+        slow_hold_s: float = 10.0,
+        slow_floor_ms: float = 25.0,
+        slow_canary_every: int = 64,
+        latency_window_s: float = 30.0,
+        hedge_budget_pct: float = 5.0,
+        hedge_min_delay_ms: float = 30.0,
+        fault_injection: bool = False,
+        faults_spec: str = "",
+        fault_seed: int = 0,
         metrics: Metrics | None = None,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -609,6 +807,67 @@ class FleetRouter:
         self.hot_key_replicas = max(1, int(hot_key_replicas))
         self._clock = clock
         self.metrics = metrics or Metrics(prefix="router", core=False)
+        # round 17 tail tolerance: OFF pins topology and routing
+        # byte-identical to the round-16 router (the escape hatch the
+        # hot-key-replication precedent set) — no digests fed, no slow
+        # transitions, no hedges.
+        self.tail_tolerance = bool(tail_tolerance)
+        self.slow_eject_k = max(1.0, float(slow_eject_k))
+        self.slow_restore_k = min(
+            self.slow_eject_k, max(1.0, float(slow_restore_k))
+        )
+        self.slow_min_samples = max(2, int(slow_min_samples))
+        # the probe CHANNEL's floor must be reachable by probes alone
+        # (window/interval samples per window): an idle fleet detects
+        # network-level grays on this channel, and a demoted member —
+        # round-robin skips it, canaries are 1/64 — is fed mostly by
+        # probes, so this is also its guaranteed restore-evidence
+        # channel.  A floor above the supply would strand it in `slow`
+        # forever.
+        probe_cap = int(
+            float(latency_window_s) / max(float(probe_interval_s), 1e-3)
+        )
+        self._min_probe_samples = max(
+            2, min(self.slow_min_samples, probe_cap - 1)
+        )
+        self.slow_hold_s = max(0.0, float(slow_hold_s))
+        self.slow_floor_ms = max(0.0, float(slow_floor_ms))
+        # restore evidence for DEVICE-level gray failures: a demoted
+        # member's probes may be fast (the slowness lives behind its
+        # dispatch, not on the wire), so without fresh forward samples
+        # it would restore sick and flap.  Every Nth demoted keyed pick
+        # is a CANARY that still goes to the slow primary (unhedged, so
+        # the observation is real) — bounded honest tail cost, honest
+        # recovery signal.  0 disables.
+        self.slow_canary_every = max(0, int(slow_canary_every))
+        self._canary = 0
+        self.latency_window_s = float(latency_window_s)
+        self.hedge_min_delay_ms = max(1.0, float(hedge_min_delay_ms))
+        # fleet-wide digest: the hedge delay's p95 source (union of
+        # every member's samples, so one slow member RAISES the delay —
+        # hedging backs off exactly when the fleet can least afford
+        # duplicate work)
+        self._fleet_latency = LatencyDigest(latency_window_s, clock=clock)
+        self.hedge_budget: HedgeBudget | None = (
+            HedgeBudget(hedge_budget_pct)
+            if self.tail_tolerance and hedge_budget_pct > 0
+            else None
+        )
+        # epoch stamp folded into the replica-list cache key: a slow
+        # transition changes which owners a hot key may spread over
+        self._slow_epoch = 0
+        # router-side network-fault registry (round 17): owned DIRECTLY
+        # (never module-installed) so an in-process drill can arm
+        # fleet.* sites here and device.* sites on the backends' global
+        # hook without cross-talk.
+        self.faults: faults_mod.FaultRegistry | None = None
+        if fault_injection or faults_spec:
+            self.faults = faults_mod.FaultRegistry(
+                seed=fault_seed, metrics=self.metrics
+            )
+            if faults_spec:
+                self.faults.arm_string(faults_spec)
+        self._fault_injection = bool(fault_injection)
         # zipf-head replication (round 16): 0 = off (every key has ONE
         # owner, the classic PR 9 topology — the default)
         self.hot_keys: HotKeyTracker | None = (
@@ -638,6 +897,7 @@ class FleetRouter:
                 name,
                 eject_threshold=eject_threshold,
                 cooldown_s=cooldown_s,
+                latency_window_s=latency_window_s,
                 clock=clock,
             )
             self._member_source[name] = "static"
@@ -680,6 +940,14 @@ class FleetRouter:
             # /v1/internal/ prefix as a 404, exactly like PR 9
             self.server.route("POST", "/v1/internal/register")(
                 self._register
+            )
+        if self._fault_injection:
+            # router-side fault arming surface (round 17) — only with
+            # --fault-injection, matching the backend's contract.  Note
+            # the exact route SHADOWS proxying of this one path: arm a
+            # BACKEND's sites by POSTing to the backend directly.
+            self.server.route("POST", "/v1/debug/faults")(
+                self._debug_faults
             )
         for method in ("GET", "POST", "DELETE", "PUT"):
             # everything else proxies; exact routes above win
@@ -731,6 +999,7 @@ class FleetRouter:
             name,
             eject_threshold=self.eject_threshold,
             cooldown_s=self.cooldown_s,
+            latency_window_s=self.latency_window_s,
             clock=self._clock,
         )
         self.members[name] = m
@@ -982,6 +1251,18 @@ class FleetRouter:
             return
         old = m.state
         m.state = state
+        if old == "slow" or state == "slow":
+            # the hot-key replica lists filter slow members; their cache
+            # must not serve a list computed under the old slow set
+            self._slow_epoch += 1
+        if state not in ("healthy", "slow"):
+            # leaving the ring: the window's samples describe a life
+            # that ended (pre-crash, pre-drain) — a rejoin starts with
+            # empty digests and earns its way past the min-sample
+            # floors before it can be judged slow again
+            m.latency.clear()
+            m.fwd_latency.clear()
+            m.probe_latency.clear()
         slog.event(
             _log, "backend_state", level=logging.WARNING,
             backend=m.name, state=state, was=old, reason=reason,
@@ -1013,10 +1294,42 @@ class FleetRouter:
             members=sorted(live), vnodes=self.vnodes, reason=reason,
         )
 
-    def _note_forward_result(self, m: BackendMember, ok: bool) -> None:
+    def _observe_latency(
+        self, m: BackendMember, ms: float, probe: bool = False
+    ) -> None:
+        """Feed one head-latency/RTT sample (ms) into the member's
+        digests (round 17): the combined surface digest always, plus
+        the sample's CHANNEL digest — probes and forwards are judged
+        separately, because a forward carries compute + queue wait and
+        a probe RTT carries neither; mixing them would demote a busy
+        member against an idle peer's ~1ms probe window.  The
+        fleet-wide hedge-delay digest takes forwards only: probe RTTs
+        would collapse the "fleet p95" to ~1ms on any lightly loaded
+        fleet and fire hedges at perfectly healthy compute requests.
+        Inert with tail tolerance off — the escape hatch leaves zero
+        new state."""
+        if not self.tail_tolerance:
+            return
+        m.latency.add(ms)
+        if probe:
+            m.probe_latency.add(ms)
+        else:
+            m.fwd_latency.add(ms)
+            self._fleet_latency.add(ms)
+
+    def _note_forward_result(
+        self,
+        m: BackendMember,
+        ok: bool,
+        latency_ms: float | None = None,
+    ) -> None:
         """Passive health: forward outcomes feed the same breaker the
         probes do, so a dead backend is ejected by its own traffic
-        between probe ticks."""
+        between probe ticks.  Round 17: outcomes carry their HEAD
+        latency too (``latency_ms``; None for failures and stream
+        heads) — the gray-failure digest rides the same call."""
+        if latency_ms is not None:
+            self._observe_latency(m, latency_ms)
         if ok:
             m.breaker.record_success()
             if (
@@ -1036,6 +1349,236 @@ class FleetRouter:
         if m.breaker.state == CircuitBreaker.OPEN and m.state != "ejected":
             self._set_state(m, "ejected", "consecutive_forward_failures")
 
+    # ------------------------------------------------------ tail tolerance
+
+    async def _backend_request(
+        self,
+        m: BackendMember,
+        method: str,
+        target: str,
+        headers: dict[str, str],
+        body: bytes,
+        timeout_s: float,
+    ) -> tuple[int, dict[str, str], bytes]:
+        """``raw_request`` + the router-side ``fleet.*`` network-fault
+        sites (round 17), consulted with ``who=<backend name>`` so a
+        spec's ``@host:port`` target grays exactly one path.  The sites
+        model the failures the backend-side device sites cannot: they
+        hit PROBES too (this wrapper is the probe transport), so a
+        blackholed backend ejects by probe while a late-head one stays
+        probe-200 and is caught only by the latency digest — the gray
+        case this round exists for."""
+        reg = self.faults
+        if reg is not None:
+            if reg.check("fleet.blackhole", who=m.name) is not None:
+                # accepts the connection, never answers: indistinguishable
+                # from a wedged peer — burn the caller's timeout honestly
+                await asyncio.sleep(timeout_s)
+                raise _BackendError(f"{m.name}: blackhole (injected)")
+            act = reg.check("fleet.connect_delay_ms", who=m.name)
+            if act is not None:
+                delay = min((act.param or 100.0) / 1e3, timeout_s)
+                await asyncio.sleep(delay)
+                timeout_s = max(0.001, timeout_s - delay)
+        status, resp_headers, payload = await raw_request(
+            m.host, m.port, method, target, headers, body, timeout_s
+        )
+        if reg is not None:
+            act = reg.check("fleet.head_delay_ms", who=m.name)
+            if act is not None:
+                await asyncio.sleep((act.param or 100.0) / 1e3)
+            act = reg.check("fleet.body_trickle", who=m.name)
+            if act is not None:
+                # trickle scales with payload size: param ms per 64 KiB,
+                # so big result bodies hurt and probe bodies barely do —
+                # the asymmetric NIC-sickness shape
+                chunks = max(1, (len(payload) + 65535) // 65536)
+                await asyncio.sleep((act.param or 20.0) / 1e3 * chunks)
+            if reg.check("fleet.torn_body", who=m.name) is not None:
+                raise _BackendError(f"{m.name}: torn body (injected)")
+        return status, resp_headers, payload
+
+    def _update_slow_states(self) -> None:
+        """Gray-failure outlier ejection (round 17), run every probe
+        tick: a member whose windowed p95 exceeds ``slow_eject_k`` x the
+        median of its PEERS' p95s is demoted to ``slow``; one back under
+        ``slow_restore_k`` x (after ``slow_hold_s``) is restored.
+
+        Comparison is PER CHANNEL — a member's forward p95 against its
+        peers' forward p95s (device-level grays under traffic), its
+        probe-RTT p95 against their probe p95s (network-level grays,
+        idle fleets) — with forwards preferred when both sides qualify.
+        A skewed workload therefore cannot demote the merely-busy
+        member: its 80ms compute forwards are never held against an
+        idle peer's 1ms probe window (the probe channel, where both
+        sides are symmetric, shows no outlier).
+
+        Peer-median (self excluded) rather than fleet-median: with the
+        member's own inflated tail inside the reference, a 2-member
+        fleet could never trip (slow > k x (slow+fast)/2 has no
+        solution past k=2), and a uniformly slow fleet (overload, not
+        gray failure) compares ~1x everywhere and ejects nobody —
+        exactly right, routing around EVERYONE routes to no one.  The
+        same safety shows up as an explicit valve: the last non-slow
+        member can never be demoted.  Flap control is three-layered:
+        the min-sample floors (a trickle can't convict on 3 points; the
+        probe channel's floor is clamped to the probe supply so a
+        demoted member always stays judgeable), the absolute
+        ``slow_floor_ms`` (sub-ms jitter ratios are noise, not
+        signal), and enter/exit hysteresis with a ``slow_hold_s``
+        min-hold."""
+        if not self.tail_tolerance:
+            return
+        now = self._clock()
+        cands = [m for m in self.members.values() if m.in_ring]
+        fwd95: dict[str, float] = {}
+        prb95: dict[str, float] = {}
+        for m in self.members.values():
+            # per-backend latency gauges: the operator's "who is slow"
+            # surface (combined channels), published for EVERY member
+            # every tick — an emptied/cleared window reads 0, never a
+            # frozen pre-crash value an alerting rule would mistake
+            # for a live one
+            snap = m.latency.snapshot()
+            self.metrics.set_labeled_gauge(
+                "backend_latency_p50_ms", "backend", m.name,
+                snap["p50_ms"],
+            )
+            self.metrics.set_labeled_gauge(
+                "backend_latency_p95_ms", "backend", m.name,
+                snap["p95_ms"],
+            )
+            if not m.in_ring:
+                continue
+            fs = m.fwd_latency.snapshot()
+            if fs["n"] >= self.slow_min_samples:
+                fwd95[m.name] = fs["p95_ms"]
+            ps = m.probe_latency.snapshot()
+            if ps["n"] >= self._min_probe_samples:
+                prb95[m.name] = ps["p95_ms"]
+        for m in cands:
+            if m.state == "healthy":
+                mine = ref = None
+                for chan in (fwd95, prb95):
+                    if m.name in chan:
+                        others = sorted(
+                            v for n, v in chan.items() if n != m.name
+                        )
+                        if others:
+                            mine = chan[m.name]
+                            ref = max(others[len(others) // 2], 0.001)
+                            break
+                if mine is None:
+                    continue  # no peer comparison -> no conviction
+                if (
+                    mine > self.slow_eject_k * ref
+                    and mine > self.slow_floor_ms
+                ):
+                    fast = [
+                        c for c in cands
+                        if c.state == "healthy" and c is not m
+                    ]
+                    if not fast:
+                        continue  # never demote the last fast member
+                    m.slow_since = now
+                    self.metrics.inc_labeled(
+                        "slow_ejections_total", "backend", m.name
+                    )
+                    self._set_state(
+                        m, "slow",
+                        f"p95 {mine:.1f}ms > {self.slow_eject_k:g}x "
+                        f"peer median {ref:.1f}ms",
+                    )
+            elif m.state == "slow":
+                # restore gates on the window MAX per channel, not p95:
+                # a demoted member's window is mostly fast probe RTTs,
+                # and one sick 150ms canary among 25 sub-ms probes
+                # dilutes right past a p95 check — max cannot be
+                # diluted, and a single canary forward counts however
+                # few there are.  Each channel's evidence is held to
+                # ITS OWN peer bar (a canary forward carries compute +
+                # queue wait and must be judged against peers'
+                # forwards, never against a ~1ms probe reference) and
+                # floored by the same absolute slow_floor_ms as
+                # conviction — a max that could never convict must not
+                # block restoration.  A channel with NO peer reference
+                # is skipped, exactly as conviction skips it: judging
+                # a canary's legitimate 60ms compute against the bare
+                # absolute floor would pin a recovered member forever.
+                # When no channel offers a comparison at all (solo
+                # survivor, degenerate cadence), the member restores
+                # once the hold elapses — demotion without any peer to
+                # route to is meaningless, and conviction was equally
+                # impossible.  Cost of the max: one honest blip delays
+                # restore by at most one window.
+                if now - m.slow_since < self.slow_hold_s:
+                    continue
+                clean = True
+                worst_seen = 0.0
+                for digest, chan in (
+                    (m.fwd_latency, fwd95),
+                    (m.probe_latency, prb95),
+                ):
+                    if len(digest) == 0:
+                        continue
+                    others = sorted(
+                        v for n, v in chan.items() if n != m.name
+                    )
+                    if not others:
+                        continue  # no peer reference on this channel
+                    bar = max(
+                        self.slow_restore_k
+                        * others[len(others) // 2],
+                        self.slow_floor_ms,
+                    )
+                    worst = digest.quantile(1.0)
+                    worst_seen = max(worst_seen, worst)
+                    if worst >= bar:
+                        clean = False
+                if clean:
+                    self._set_state(
+                        m, "healthy",
+                        f"window max {worst_seen:.1f}ms back under the "
+                        f"{self.slow_restore_k:g}x per-channel peer bars",
+                    )
+
+    def _hedge_delay_s(self) -> float | None:
+        """The hedge trigger: fire the duplicate once the primary has
+        been out longer than the live fleet p95 (floored at
+        ``hedge_min_delay_ms``).  None until the fleet digest has
+        enough samples to mean anything — a cold router must not hedge
+        on a delay it invented."""
+        if not self.tail_tolerance or self.hedge_budget is None:
+            return None
+        if len(self._fleet_latency) < self.slow_min_samples:
+            return None
+        p95 = self._fleet_latency.quantile(0.95)
+        return max(self.hedge_min_delay_ms, p95) / 1e3
+
+    def _hedge_candidate(
+        self, key: str | None, primary: BackendMember
+    ) -> BackendMember | None:
+        """Where the duplicate goes: the next DISTINCT ring owner for
+        keyed traffic, the next live member for round-robin GETs —
+        never the primary again, never a slow member (hedging INTO the
+        outlier defeats the point)."""
+        if key is not None:
+            for name in self.ring.owners(key):
+                if name == primary.name:
+                    continue
+                c = self.members[name]
+                if c.in_ring and c.state != "slow":
+                    return c
+            return None
+        cands = [
+            m for m in self.members.values()
+            if m.in_ring and m.state != "slow" and m is not primary
+        ]
+        if not cands:
+            return None
+        self._rr += 1
+        return cands[self._rr % len(cands)]
+
     # --------------------------------------------------------------- probing
 
     async def probe_once(self) -> None:
@@ -1050,6 +1593,10 @@ class FleetRouter:
         await asyncio.gather(
             *(self._probe(m) for m in list(self.members.values()))
         )
+        # gray-failure evaluation rides the probe cadence (round 17):
+        # probe RTTs just landed in the digests, so an IDLE fleet still
+        # detects — and restores — a slow member within a few ticks
+        self._update_slow_states()
 
     async def _probe(self, m: BackendMember) -> None:
         if m.state == "ejected":
@@ -1057,10 +1604,10 @@ class FleetRouter:
             if not allowed:
                 return  # still cooling; no half-open claim available
         t_start = self._clock()
+        t0 = time.perf_counter()
         try:
-            status, _h, body = await raw_request(
-                m.host, m.port, "GET", "/readyz", {}, b"",
-                self.probe_timeout_s,
+            status, _h, body = await self._backend_request(
+                m, "GET", "/readyz", {}, b"", self.probe_timeout_s
             )
         except _BackendError as e:
             m.breaker.record_failure()
@@ -1073,6 +1620,13 @@ class FleetRouter:
                     backend=m.name, error=str(e),
                 )
             return
+        # the probe RTT is a latency observation for the MEMBER digest
+        # (round 17): an IDLE fleet still sees a backend go gray — and,
+        # just as important, sees it recover.  probe=True keeps it out
+        # of the fleet-wide hedge-delay digest.
+        self._observe_latency(
+            m, (time.perf_counter() - t0) * 1e3, probe=True
+        )
         if status == 200:
             if m.announced_drain and m.drain_announced_at >= t_start:
                 # the drain announcement landed WHILE this probe was in
@@ -1083,7 +1637,10 @@ class FleetRouter:
             # a healthy probe after an announced drain means the backend
             # restarted (or withdrew the drain): the announcement is spent
             self._clear_announced_drain(m, "probe_ok")
-            if m.state != "healthy":
+            if m.state not in ("healthy", "slow"):
+                # a 200 readmits the ejected/joining/draining — but a
+                # SLOW member's probe-200 is exactly the gray-failure
+                # signature; only the latency machinery restores it
                 self._set_state(m, "healthy", "probe_ok")
             return
         checks = {}
@@ -1132,7 +1689,14 @@ class FleetRouter:
         past ``tried``); round-robin over ring members otherwise.  A
         promoted hot key's READS (``replicas`` non-None) spread
         round-robin over its R ring owners instead of hammering the
-        primary alone."""
+        primary alone.
+
+        Round 17 demotion: a ``slow`` member keeps its ring placement
+        but is LAST-RESORT — keyed picks walk past it to the next fast
+        owner (the caller attaches an x-peer-fill hint back at it, so
+        the stand-in copies bytes instead of recomputing), round-robin
+        skips it outright.  When every candidate is slow the pick falls
+        back to the slow set: a uniformly slow fleet still serves."""
         if key is not None:
             if replicas and not tried:
                 self._hot_rr += 1
@@ -1143,17 +1707,48 @@ class FleetRouter:
                 # hot path: one bisect; the full owners() walk (scan
                 # until every distinct member is seen) is retry-only
                 name = self.ring.owner(key)
-                return None if name is None else self.members[name]
-            for name in self.ring.owners(key):
-                if name not in tried:
-                    return self.members[name]
-            return None
+                if name is None:
+                    return None
+                m = self.members[name]
+                if m.state == "slow":
+                    self._canary += 1
+                    if (
+                        self.slow_canary_every
+                        and self._canary % self.slow_canary_every == 0
+                    ):
+                        # canary: the restore-evidence channel — a
+                        # device-level gray's probes are FAST, so only
+                        # real forwards can testify to recovery
+                        self.metrics.inc_counter(
+                            "slow_canary_forwards_total"
+                        )
+                        return m
+                    # demote the gray primary: first fast owner in the
+                    # clockwise walk stands in (deterministic, so the
+                    # stand-in's cache warms for the whole slow window)
+                    for n in self.ring.owners(key):
+                        c = self.members[n]
+                        if c.in_ring and c.state != "slow":
+                            self.metrics.inc_counter(
+                                "slow_routed_around_total"
+                            )
+                            return c
+                return m
+            cands = [
+                n for n in self.ring.owners(key) if n not in tried
+            ]
+            for n in cands:
+                if self.members[n].state != "slow":
+                    return self.members[n]
+            return self.members[cands[0]] if cands else None
         live = [m for m in self.members.values() if m.in_ring
                 and m.name not in tried]
-        if not live:
+        fast = [m for m in live if m.state != "slow"]
+        pool = fast or live
+        if not pool:
             return None
         self._rr += 1
-        return live[self._rr % len(live)]
+        return pool[self._rr % len(pool)]
 
     def _peer_hint(self, key: str, owner: str) -> str | None:
         """Previous ring owner for a key whose placement moved in the
@@ -1290,6 +1885,144 @@ class FleetRouter:
         while len(self._job_owners) > _JOB_OWNERS_MAX:
             self._job_owners.popitem(last=False)
 
+    def _deadline_expired(
+        self, req: Request, t0: float, during: str | None = None
+    ) -> Response:
+        """Round 17 satellite: a request whose ``x-deadline-ms`` budget
+        is spent 504s AT THE ROUTER — before consuming a backend
+        (``during`` None), or the moment its deadline-capped forward
+        times out mid-flight (``during`` names the backend; that
+        timeout is the CALLER's budget lapsing, not backend death, so
+        it never feeds the ejection breaker)."""
+        e = errors.DeadlineExpired(
+            "x-deadline-ms budget exhausted at the router"
+            + (f" (forward to {during} cut short)" if during else "")
+        )
+        self.metrics.inc_counter("deadline_expired_total")
+        dt = time.perf_counter() - t0
+        self.metrics.observe_request(dt, e.code)
+        slog.event(
+            _log, "router_request", level=logging.WARNING,
+            method=req.method, path=req.path, status=e.status,
+            backend=during, id=req.id, ms=round(dt * 1e3, 1),
+            error=e.code,
+        )
+        return Response.json(errors.to_payload(e, req.id), e.status)
+
+    def _effective_timeout(self, req: Request, base: float) -> float:
+        """min(per-forward timeout, the request's remaining deadline
+        budget): a deadline-carrying interactive request can never be
+        pinned to a dying socket for the full 330 s default."""
+        if req.deadline is None:
+            return base
+        return min(base, max(0.001, req.deadline - time.perf_counter()))
+
+    async def _forward_hedged(
+        self,
+        req: Request,
+        m: BackendMember,
+        key: str | None,
+        target: str,
+        fwd_headers: dict[str, str],
+        timeout_s: float,
+        tried: set[str],
+        deadline_capped: bool = False,
+    ) -> tuple[BackendMember, int, dict[str, str], bytes, float]:
+        """One forward with a tail hedge (round 17): the primary fires
+        immediately; once it has been out longer than the live fleet
+        p95 (and the token-bucket budget allows), ONE duplicate fires
+        to the next distinct ring owner.  First response wins; the
+        loser's in-flight connection is closed via task cancellation.
+        Returns ``(serving member, status, headers, body, head dt)``;
+        raises ``_HedgeExhausted`` after noting BOTH members' failures
+        (the caller must not re-note them).  A ``deadline_capped`` leg
+        timing out is the CALLER's budget lapsing, not backend death:
+        it is never noted, and when it is all that remains the plain
+        ``_BackendError`` propagates so the caller's deadline guard
+        answers 504."""
+
+        async def timed(mm: BackendMember, hdrs: dict, to: float):
+            ts = time.perf_counter()
+            s, h, b = await self._backend_request(
+                mm, req.method, target, hdrs, req.body, to
+            )
+            return s, h, b, time.perf_counter() - ts
+
+        prim_task = asyncio.ensure_future(timed(m, fwd_headers, timeout_s))
+        delay = self._hedge_delay_s()
+        if delay is None or delay >= timeout_s:
+            s, h, b, dt = await prim_task
+            return m, s, h, b, dt
+        done, _ = await asyncio.wait({prim_task}, timeout=delay)
+        if done:
+            # on time: no hedge, no budget touched (the common case —
+            # result() re-raises a fast infra failure for the caller's
+            # normal retry path)
+            s, h, b, dt = prim_task.result()
+            return m, s, h, b, dt
+        hm = self.hedge_budget and self._hedge_candidate(key, m)
+        if not hm:
+            s, h, b, dt = await prim_task
+            return m, s, h, b, dt
+        if not self.hedge_budget.try_spend():
+            self.metrics.inc_counter("hedges_budget_denied_total")
+            s, h, b, dt = await prim_task
+            return m, s, h, b, dt
+        self.metrics.inc_counter("hedges_fired_total")
+        remaining = max(0.001, self._effective_timeout(req, timeout_s))
+        # no x-peer-fill hint on the duplicate: the obvious fill source
+        # is the very primary being raced
+        hedge_task = asyncio.ensure_future(
+            timed(hm, self._forward_headers(req, key, hm.name), remaining)
+        )
+        by_task = {prim_task: m, hedge_task: hm}
+        pending = set(by_task)
+        last_err: _BackendError | None = None
+        deadline_err: _BackendError | None = None
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                # deterministic preference inside one wake-up batch:
+                # primary first (its bytes are no worse, and the win
+                # counter must not lie about a dead-heat)
+                for t in sorted(done, key=lambda t: t is hedge_task):
+                    mm = by_task[t]
+                    try:
+                        s, h, b, dt = t.result()
+                    except _BackendError as e:
+                        if deadline_capped and _is_timeout(e):
+                            # the caller's budget lapsed on this leg:
+                            # no breaker state, no tried entry
+                            deadline_err = e
+                            continue
+                        last_err = e
+                        self._note_forward_result(mm, ok=False)
+                        tried.add(mm.name)
+                        continue
+                    if t is hedge_task:
+                        self.metrics.inc_counter("hedges_won_total")
+                        slog.event(
+                            _log, "hedge_won", level=logging.INFO,
+                            backend=mm.name, id=req.id,
+                            ms=round(dt * 1e3, 1),
+                        )
+                    return mm, s, h, b, dt
+            if deadline_err is not None:
+                # plain _BackendError (NOT _HedgeExhausted): the
+                # caller's deadline guard turns it into the 504
+                raise deadline_err
+            raise _HedgeExhausted(str(last_err))
+        finally:
+            # close the loser's (or, on exhaustion, nobody's) in-flight
+            # connection; the swallow callback retrieves the
+            # CancelledError so the loop never logs an orphan
+            for t in by_task:
+                if not t.done():
+                    t.cancel()
+                    t.add_done_callback(_swallow_task_result)
+
     async def _proxy(self, req: Request) -> Response:
         t0 = time.perf_counter()
         if req.path.startswith("/v1/internal/"):
@@ -1300,6 +2033,14 @@ class FleetRouter:
             return Response.json(
                 {"error": f"no route for {req.path}"}, 404
             )
+        if req.deadline is not None and (
+            req.deadline - time.perf_counter() <= 0.01
+        ):
+            # already expired at the router (round 17 satellite): 504
+            # without consuming a backend — forwarding work whose
+            # caller has given up is the router-tier version of
+            # dispatching dead work to the device
+            return self._deadline_expired(req, t0)
         if req.method in ("GET", "DELETE"):
             if req.method == "GET" and req.path.rstrip("/") == "/v1/jobs":
                 return await self._proxy_jobs_collection(req, t0)
@@ -1338,7 +2079,14 @@ class FleetRouter:
                 and req.path != "/v1/jobs"
                 and self.hot_keys.is_hot(key)
             ):
-                epoch = (id(self.ring), self.hot_keys.hot_keys)
+                # the slow epoch is part of the key: a healthy<->slow
+                # transition changes WHICH owners may serve a hot key
+                # without changing ring identity or the hot set
+                epoch = (
+                    id(self.ring),
+                    self.hot_keys.hot_keys,
+                    self._slow_epoch,
+                )
                 if epoch != self._replica_cache_epoch:
                     self._replica_cache_epoch = epoch
                     self._replica_cache = {}
@@ -1350,6 +2098,13 @@ class FleetRouter:
                             : self.hot_key_replicas
                         ]
                         if self.members[n].in_ring
+                        # a slow member in the spread would make the
+                        # hottest keys the WORST served in the fleet —
+                        # filtered uniformly; a slow PRIMARY collapses
+                        # the list to one entry, which disables the
+                        # spread and hands the key to the normal keyed
+                        # demotion path (stand-in + peer-fill hint)
+                        and self.members[n].state != "slow"
                     ]
                     self._replica_cache[key] = owners
                 if len(owners) > 1:
@@ -1365,10 +2120,43 @@ class FleetRouter:
         attempts = (
             1 if req.method == "POST" and req.path == "/v1/jobs" else 2
         )
+        # hedge eligibility (round 17): keyed idempotent traffic only.
+        # Job submits are excluded by the same per-backend-idempotency
+        # rule as retries (attempts==1); forced recomputes (no-cache /
+        # no-store) are WRITES — a duplicate write is double device
+        # work by definition; SSE/job streams never reach this loop
+        # (_proxy_job owns them).  DELETE/PUT are not hedged.
+        cc_hdr = req.headers.get("cache-control", "").lower()
+        hedgeable = (
+            attempts > 1
+            and req.method in ("GET", "POST")
+            and "no-cache" not in cc_hdr
+            and "no-store" not in cc_hdr
+            # the backend debug surface MUTATES (fault arming consumes
+            # one-shot counts): a hedge would replay it onto a second
+            # process on mere slowness of a request that succeeds
+            and not req.path.startswith("/v1/debug/")
+        )
+        if hedgeable and self.hedge_budget is not None:
+            # every eligible request deposits its fraction of a hedge
+            # token — the <=pct% bound is against this stream
+            self.hedge_budget.on_request()
         for _attempt in range(attempts):
             m = self._pick(key, tried, replicas)
             if m is None:
                 break
+            # round 17 satellite: effective timeout = min(forward
+            # timeout, remaining deadline budget), re-derived per
+            # attempt; a spent budget 504s without consuming a backend
+            timeout_s = self.forward_timeout_s
+            deadline_capped = False
+            if req.deadline is not None:
+                remaining = req.deadline - time.perf_counter()
+                if remaining <= 0.01:
+                    return self._deadline_expired(req, t0)
+                if remaining < timeout_s:
+                    timeout_s = remaining
+                    deadline_capped = True
             hint = None
             # replica accounting/hints apply to the INITIAL spread pick
             # only: a failover retry (tried non-empty) is a plain
@@ -1385,13 +2173,55 @@ class FleetRouter:
                 # cache instead of recomputing — the "write" lives on
                 # the primary, the replica serves a copy of its bytes
                 hint = replicas[0]
+            elif (
+                key is not None
+                and not tried
+                and replicas is None
+                and self.peer_fill
+            ):
+                owner = self.ring.owner(key)
+                if (
+                    owner is not None
+                    and owner != m.name
+                    and self.members[owner].state == "slow"
+                    and not self.members[owner].announced_drain
+                ):
+                    # demoted gray primary (round 17): slow, not dead —
+                    # its cache is warm, so the stand-in's first miss
+                    # copies bytes from it instead of recomputing the
+                    # whole demoted keyspace
+                    hint = owner
+            fwd_headers = self._forward_headers(req, key, m.name, hint=hint)
+            t_att = time.perf_counter()
             try:
-                status, headers, body = await raw_request(
-                    m.host, m.port, req.method, target,
-                    self._forward_headers(req, key, m.name, hint=hint),
-                    req.body, self.forward_timeout_s,
-                )
+                if hedgeable and not tried and m.state != "slow":
+                    # a SLOW pick (canary, or the all-slow fallback) is
+                    # never hedged: a winning hedge would cancel the
+                    # canary's observation — the whole point is to let
+                    # the slow path testify, at a bounded tail cost
+                    m, status, headers, body, dt = (
+                        await self._forward_hedged(
+                            req, m, key, target, fwd_headers,
+                            timeout_s, tried,
+                            deadline_capped=deadline_capped,
+                        )
+                    )
+                else:
+                    status, headers, body = await self._backend_request(
+                        m, req.method, target, fwd_headers,
+                        req.body, timeout_s,
+                    )
+                    dt = time.perf_counter() - t_att
+            except _HedgeExhausted as e:
+                # both race legs already noted/`tried` inside the
+                # helper — just move the walk along
+                last_err = str(e)
+                continue
             except _BackendError as e:
+                if deadline_capped and _is_timeout(e):
+                    # the CALLER's budget lapsed mid-forward — not
+                    # backend death; 504, and the breaker stays clean
+                    return self._deadline_expired(req, t0, during=m.name)
                 last_err = str(e)
                 self._note_forward_result(m, ok=False)
                 tried.add(m.name)
@@ -1404,8 +2234,17 @@ class FleetRouter:
             # passive-ejection signal like a timeout.  503/504 are
             # designed backpressure (sheds, breakers, deadlines): they
             # pass through with their Retry-After and never eject.
-            self._note_forward_result(m, ok=status not in (500, 502))
-            if was_replica:
+            self._note_forward_result(
+                m, ok=status not in (500, 502), latency_ms=dt * 1e3
+            )
+            if (
+                was_replica
+                and m.name in replicas
+                and m.name != replicas[0]
+            ):
+                # m may have become the hedge WINNER above: the spread
+                # credit only applies while the server is actually one
+                # of the key's replicas
                 self.metrics.inc_labeled(
                     "replica_reads_total", "backend", m.name
                 )
@@ -1475,11 +2314,19 @@ class FleetRouter:
             # owner that ANNOUNCED drain gets the short bound too — it
             # may already be dead, and the announcement promised it
             # would not be around for a 330s answer anyway.
-            timeout = (
+            base_timeout = (
                 self.forward_timeout_s
                 if m is sm and not m.announced_drain
                 else self.walk_timeout_s
             )
+            if req.deadline is not None and (
+                req.deadline - time.perf_counter() <= 0.01
+            ):
+                # the budget ran out mid-walk: stop consuming members
+                return self._deadline_expired(req, t0)
+            timeout = self._effective_timeout(req, base_timeout)
+            deadline_capped = timeout < base_timeout
+            t_att = time.perf_counter()
             try:
                 if is_stream:
                     status, headers, stream = await raw_request_stream(
@@ -1504,11 +2351,16 @@ class FleetRouter:
                             ) from te
                         stream = None
                 else:
-                    status, headers, body = await raw_request(
-                        m.host, m.port, req.method, target, fwd_headers,
+                    status, headers, body = await self._backend_request(
+                        m, req.method, target, fwd_headers,
                         req.body, timeout,
                     )
             except _BackendError as e:
+                if deadline_capped and _is_timeout(e):
+                    # the caller's budget lapsed mid-forward — not this
+                    # member's failure, and no point walking on with an
+                    # already-spent budget
+                    return self._deadline_expired(req, t0, during=m.name)
                 last_err = str(e)
                 self._note_forward_result(m, ok=False)
                 slog.event(
@@ -1516,7 +2368,18 @@ class FleetRouter:
                     backend=m.name, id=req.id, error=last_err,
                 )
                 continue
-            self._note_forward_result(m, ok=status not in (500, 502))
+            # stream heads are EXCLUDED from the latency digest (round
+            # 17): an SSE head's timing is dominated by the job's own
+            # state, not the network path
+            self._note_forward_result(
+                m,
+                ok=status not in (500, 502),
+                latency_ms=(
+                    None
+                    if stream is not None
+                    else (time.perf_counter() - t_att) * 1e3
+                ),
+            )
             if status == 404:
                 # neither 404 form is an authoritative answer about the
                 # job: job_not_found is "not MY job, next", and a
@@ -1589,30 +2452,44 @@ class FleetRouter:
         target = self._forward_target(req)
 
         async def one(m: BackendMember):
+            t_att = time.perf_counter()
+            eff = self._effective_timeout(req, self.walk_timeout_s)
             try:
                 # walk bound, not the forward timeout: the gather below
                 # barriers on the slowest member, so one wedged listing
                 # must cost seconds, not stall every fleet view for
                 # minutes (no member is "pinned" for a listing)
-                return m, await raw_request(
-                    m.host, m.port, "GET", target,
+                got = await self._backend_request(
+                    m, "GET", target,
                     self._forward_headers(req, None, m.name), b"",
-                    self.walk_timeout_s,
+                    eff,
                 )
+                return m, got, (time.perf_counter() - t_att) * 1e3, False
             except _BackendError as e:
-                return m, e
+                # a deadline-capped leg timing out is the CALLER's
+                # budget, not this member's failure (partial view, but
+                # no breaker state)
+                return (
+                    m, e, None,
+                    eff < self.walk_timeout_s and _is_timeout(e),
+                )
 
         jobs: list = []
         counts: dict[str, int] = {}
         queue_depth = 0
         partial = False
-        for m, got in await asyncio.gather(*(one(m) for m in members)):
+        for m, got, ms, deadline_to in await asyncio.gather(
+            *(one(m) for m in members)
+        ):
             if isinstance(got, _BackendError):
-                self._note_forward_result(m, ok=False)
+                if not deadline_to:
+                    self._note_forward_result(m, ok=False)
                 partial = True
                 continue
             status, _headers, body = got
-            self._note_forward_result(m, ok=status not in (500, 502))
+            self._note_forward_result(
+                m, ok=status not in (500, 502), latency_ms=ms
+            )
             doc = None
             if status == 200:
                 try:
@@ -1688,7 +2565,9 @@ class FleetRouter:
         by_state: dict[str, int] = {}
         for m in self.members.values():
             by_state[m.state] = by_state.get(m.state, 0) + 1
-        in_ring = by_state.get("healthy", 0)
+        # a slow member still serves (last-resort) — it counts as ring
+        # capacity for the LB gate exactly as it does for placement
+        in_ring = by_state.get("healthy", 0) + by_state.get("slow", 0)
         checks = {
             # the router is USEFUL while any backend accepts; a
             # zero-member ring is the one condition an LB must route
@@ -1697,14 +2576,27 @@ class FleetRouter:
             "not_draining": not self.draining,
         }
         ok = all(checks.values())
-        return Response.json(
-            {
-                "ready": ok,
-                "checks": checks,
-                "backends": {"total": len(self.members), **by_state},
-            },
-            status=200 if ok else 503,
-        )
+        body = {
+            "ready": ok,
+            "checks": checks,
+            "backends": {"total": len(self.members), **by_state},
+        }
+        if self.tail_tolerance:
+            # the operator's one-glance gray-failure surface (round 17
+            # satellite): who is slow NOW, and each member's live
+            # window — visible BEFORE anyone ejects
+            body["tail"] = {
+                "slow": sorted(
+                    m.name for m in self.members.values()
+                    if m.state == "slow"
+                ),
+                "fleet": self._fleet_latency.snapshot(),
+                "backends": {
+                    m.name: m.latency.snapshot()
+                    for m in self.members.values()
+                },
+            }
+        return Response.json(body, status=200 if ok else 503)
 
     async def _config(self, _req: Request) -> Response:
         """GET /v1/config — the live ring snapshot: members, per-backend
@@ -1737,6 +2629,43 @@ class FleetRouter:
                     if self.hot_keys is not None
                     else 0
                 ),
+                # round 17: the tail-tolerance picture — knobs, the
+                # live hedge state, and (per member, below) the
+                # windowed latency an operator reads to see a member
+                # going gray BEFORE it ejects
+                "tail_tolerance": {
+                    "enabled": self.tail_tolerance,
+                    "slow_eject_k": self.slow_eject_k,
+                    "slow_restore_k": self.slow_restore_k,
+                    "slow_min_samples": self.slow_min_samples,
+                    "slow_hold_s": self.slow_hold_s,
+                    "slow_floor_ms": self.slow_floor_ms,
+                    "slow_canary_every": self.slow_canary_every,
+                    "latency_window_s": self.latency_window_s,
+                    "hedge_budget_pct": (
+                        self.hedge_budget.pct
+                        if self.hedge_budget is not None
+                        else 0.0
+                    ),
+                    "hedge_min_delay_ms": self.hedge_min_delay_ms,
+                    "hedge_tokens": (
+                        round(self.hedge_budget.tokens, 3)
+                        if self.hedge_budget is not None
+                        else 0.0
+                    ),
+                    "hedge_delay_ms": (
+                        round(d * 1e3, 1)
+                        if (d := self._hedge_delay_s()) is not None
+                        else None
+                    ),
+                    "fleet_latency": self._fleet_latency.snapshot(),
+                },
+                "fault_injection_active": self.faults is not None,
+                **(
+                    {"faults_state": self.faults.snapshot()}
+                    if self.faults is not None
+                    else {}
+                ),
                 "members": {
                     m.name: {
                         "state": m.state,
@@ -1748,12 +2677,41 @@ class FleetRouter:
                             m.name, "static"
                         ),
                         "announced_drain": m.announced_drain,
+                        "latency": m.latency.snapshot(),
                     }
                     for m in self.members.values()
                 },
                 "bound_host": self.bound[0] if self.bound else None,
                 "bound_port": self.bound[1] if self.bound else None,
             }
+        )
+
+    async def _debug_faults(self, req: Request) -> Response:
+        """POST /v1/debug/faults — runtime arm/disarm of the router's
+        ``fleet.*`` network-fault sites (round 17; only routed with
+        ``--fault-injection``, mirroring the backend contract).  Form:
+        ``arm=site=spec[,...]`` and/or ``disarm=<site>|all``."""
+        try:
+            form = req.form()
+        except Exception:  # noqa: BLE001 — unparseable body = empty form
+            form = {}
+        disarm = form.get("disarm")
+        if disarm:
+            self.faults.disarm(None if disarm == "all" else disarm)
+        if form.get("arm"):
+            try:
+                self.faults.arm_string(form["arm"])
+            except ValueError as e:
+                return Response.json(
+                    {
+                        "error": "bad_request",
+                        "message": str(e),
+                        "request_id": req.id,
+                    },
+                    400,
+                )
+        return Response.json(
+            {"faults": self.faults.snapshot(), "request_id": req.id}
         )
 
     async def _metrics_route(self, _req: Request) -> Response:
@@ -1881,6 +2839,72 @@ def main(argv: list[str] | None = None) -> int:
         "--no-peer-fill", action="store_true",
         help="never attach x-peer-fill hints on rebalanced keys",
     )
+    p.add_argument(
+        "--tail-tolerance", choices=("on", "off"), default="on",
+        help="gray-failure outlier ejection + hedged requests (round "
+        "17); 'off' pins topology and routing byte-identical to the "
+        "round-16 router",
+    )
+    p.add_argument(
+        "--slow-eject-k", type=float, default=4.0,
+        help="a member whose windowed p95 exceeds K x its peers' "
+        "median p95 is demoted to 'slow' (default 4.0)",
+    )
+    p.add_argument(
+        "--slow-restore-k", type=float, default=2.0,
+        help="a slow member back under K x the peer median is restored "
+        "(hysteresis; default 2.0, clamped <= --slow-eject-k)",
+    )
+    p.add_argument(
+        "--slow-min-samples", type=int, default=20,
+        help="windowed samples required before a member can be judged "
+        "slow (default 20; clamped so probe RTTs alone can sustain it "
+        "— an idle or demoted member must stay judgeable)",
+    )
+    p.add_argument(
+        "--slow-hold-s", type=float, default=10.0,
+        help="minimum seconds in 'slow' before restoration is even "
+        "considered (anti-flap; default 10)",
+    )
+    p.add_argument(
+        "--slow-floor-ms", type=float, default=25.0,
+        help="absolute p95 floor below which no member is ever judged "
+        "slow (sub-ms jitter is noise, not gray failure; default 25)",
+    )
+    p.add_argument(
+        "--slow-canary-every", type=int, default=64,
+        help="every Nth demoted keyed pick still goes to the slow "
+        "primary (unhedged) as restore evidence for device-level gray "
+        "failures whose probes stay fast; 0 disables (default 64)",
+    )
+    p.add_argument(
+        "--latency-window-s", type=float, default=30.0,
+        help="sliding window for the per-backend latency digests "
+        "(default 30)",
+    )
+    p.add_argument(
+        "--hedge-budget-pct", type=float, default=5.0,
+        help="hedge at most this percent of eligible requests (token "
+        "bucket; 0 disables hedging; default 5)",
+    )
+    p.add_argument(
+        "--hedge-min-delay-ms", type=float, default=30.0,
+        help="floor under the p95-derived hedge delay (default 30)",
+    )
+    p.add_argument(
+        "--fault-injection", action="store_true",
+        help="enable the router's fleet.* network-fault sites and the "
+        "POST /v1/debug/faults arming endpoint",
+    )
+    p.add_argument(
+        "--fault", action="append", default=[], metavar="SITE=SPEC",
+        help="arm a fleet.* fault site at boot (spec grammar: p<prob>|"
+        "n<count>[:<param>][@<backend host:port>]); repeatable",
+    )
+    p.add_argument(
+        "--fault-seed", type=int, default=0,
+        help="seed for probabilistic fault specs (chaos replays)",
+    )
     args = p.parse_args(argv)
     backends = [b.strip() for b in args.backends.split(",") if b.strip()]
     if not backends and not args.membership_file and not args.fleet_token:
@@ -1888,6 +2912,15 @@ def main(argv: list[str] | None = None) -> int:
             "--backends is required unless --membership-file or "
             "--fleet-token lets backends join dynamically"
         )
+    faults_spec = ",".join(args.fault)
+    if faults_spec:
+        from deconv_api_tpu.serving.faults import parse_fault_specs
+
+        try:
+            # validate BEFORE binding a listener on a typo'd site
+            parse_fault_specs(faults_spec)
+        except ValueError as e:
+            p.error(str(e))
     router = FleetRouter(
         backends,
         vnodes=args.vnodes,
@@ -1901,6 +2934,19 @@ def main(argv: list[str] | None = None) -> int:
         fleet_token=args.fleet_token,
         hot_key_top_k=args.hot_key_top_k,
         hot_key_replicas=args.hot_key_replicas,
+        tail_tolerance=args.tail_tolerance == "on",
+        slow_eject_k=args.slow_eject_k,
+        slow_restore_k=args.slow_restore_k,
+        slow_min_samples=args.slow_min_samples,
+        slow_hold_s=args.slow_hold_s,
+        slow_floor_ms=args.slow_floor_ms,
+        slow_canary_every=args.slow_canary_every,
+        latency_window_s=args.latency_window_s,
+        hedge_budget_pct=args.hedge_budget_pct,
+        hedge_min_delay_ms=args.hedge_min_delay_ms,
+        fault_injection=args.fault_injection,
+        faults_spec=faults_spec,
+        fault_seed=args.fault_seed,
     )
     asyncio.run(_serve_forever(router, args.host, args.port))
     return 0

@@ -546,16 +546,27 @@ def test_e2e_cache_control_passthrough(fleet2):
 
 
 def test_e2e_deadline_header_passthrough(fleet2):
+    before = fleet2.router.metrics.counter("deadline_expired_total")
     r = httpx.post(
         fleet2.router_url + "/",
         data={"file": _data_url(16), "layer": "b2c1"},
         headers={"x-deadline-ms": "1"}, timeout=60,
     )
-    # the 1 ms budget lapses inside the backend pipeline: its 504
-    # deadline_expired crosses back through the router unchanged
+    # round 17: a budget already spent at the router 504s THERE —
+    # no backend is consumed (no x-backend stamp), and the router's
+    # own counter records it
     assert r.status_code == 504, r.text
     assert r.json()["error"] == "deadline_expired"
-    assert "x-backend" in r.headers
+    assert "x-backend" not in r.headers
+    assert fleet2.router.metrics.counter("deadline_expired_total") > before
+    # a sane budget still passes through to the backend untouched
+    r2 = httpx.post(
+        fleet2.router_url + "/",
+        data={"file": _data_url(16), "layer": "b2c1"},
+        headers={"x-deadline-ms": "30000"}, timeout=60,
+    )
+    assert r2.status_code == 200, r2.text
+    assert "x-backend" in r2.headers
 
 
 def test_e2e_peer_cache_fill(fleet2):

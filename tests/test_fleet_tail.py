@@ -1,0 +1,1109 @@
+"""Tail-tolerance tests (round 17).
+
+Covers the gray-failure layer in serving/fleet.py: the windowed
+latency digests (forwards + probe RTTs in, SSE heads excluded), the
+``slow`` outlier state (peer-median comparison, min-sample/absolute
+floors, hysteresis + min-hold, last-fast-member valve), routing
+demotion (round-robin skip, keyed last-resort with the peer-fill hint
+back at the warm primary, jobs walks still answered), hedged requests
+(delay-gated, first-wins, loser closed, token-bucket budget, the
+never-hedged pins), the deadline-derived per-forward timeout, the
+``fleet.*`` network-fault sites with the ``@target`` grammar, the
+exposition lint for every new family, the ``tail_tolerance=False``
+round-16 pin, and an e2e gray-backend drill over real backends.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import time
+import urllib.parse
+
+import httpx
+import numpy as np
+import pytest
+
+from deconv_api_tpu.serving import faults as faults_mod
+from deconv_api_tpu.serving import fleet
+from deconv_api_tpu.serving.cache import canonical_digest
+from deconv_api_tpu.serving.fleet import (
+    FleetRouter,
+    HedgeBudget,
+    LatencyDigest,
+)
+from deconv_api_tpu.serving.http import Request
+from deconv_api_tpu.serving.metrics import Metrics
+from tests.test_metrics_exposition import lint_exposition
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _ready_200():
+    return 200, {}, json.dumps({"ready": True}).encode()
+
+
+def _probe_script(monkeypatch, responses):
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        return responses[f"{host}:{port}"]()
+
+    monkeypatch.setattr(fleet, "raw_request", fake)
+
+
+def _post_req(body: bytes, path="/v1/deconv", headers=None, **kw) -> Request:
+    return Request(
+        method="POST", path=path, query={},
+        headers={
+            "content-type": "application/x-www-form-urlencoded",
+            **(headers or {}),
+        },
+        body=body, id="rid-tail", **kw,
+    )
+
+
+# ------------------------------------------------------------- digests
+
+
+def test_latency_digest_window_cap_and_quantiles():
+    clock = _FakeClock()
+    d = LatencyDigest(window_s=10.0, cap=16, clock=clock)
+    assert d.quantile(0.95) == 0.0 and len(d) == 0
+    for v in range(1, 11):
+        d.add(float(v))
+    assert len(d) == 10
+    assert d.quantile(0.50) == 6.0  # index int(0.5*10)=5 -> value 6
+    assert d.quantile(0.95) == 10.0
+    # cap: oldest evicted first
+    for v in range(11, 31):
+        d.add(float(v))
+    assert len(d) == 16
+    assert d.quantile(0.0) == 15.0
+    # window: everything ages out
+    clock.t += 10.1
+    assert len(d) == 0 and d.quantile(0.95) == 0.0
+    d.add(5.0)
+    snap = d.snapshot()
+    assert snap == {"n": 1, "p50_ms": 5.0, "p95_ms": 5.0}
+
+
+def test_hedge_budget_is_a_request_fraction():
+    b = HedgeBudget(pct=5.0, burst=2.0)
+    assert b.try_spend() and b.try_spend()  # burst
+    assert not b.try_spend()  # empty
+    # 5% of 20 requests = 1 token
+    for _ in range(20):
+        b.on_request()
+    assert b.try_spend()
+    assert not b.try_spend()
+    # deposits cap at burst
+    for _ in range(10_000):
+        b.on_request()
+    assert b.tokens == 2.0
+
+
+# ---------------------------------------------------- slow state machine
+
+
+def _router3(clock, monkeypatch, **kw):
+    kw.setdefault("eject_threshold", 2)
+    kw.setdefault("slow_min_samples", 10)
+    kw.setdefault("slow_hold_s", 10.0)
+    kw.setdefault("slow_floor_ms", 10.0)
+    kw.setdefault("latency_window_s", 2.0)
+    router = FleetRouter(
+        ["b0:8000", "b1:8001", "b2:8002"], clock=clock, **kw
+    )
+    _probe_script(
+        monkeypatch,
+        {n: _ready_200 for n in ("b0:8000", "b1:8001", "b2:8002")},
+    )
+    return router
+
+
+def _feed(router, name, ms, n=20):
+    m = router.members[name]
+    for _ in range(n):
+        router._observe_latency(m, ms)
+
+
+def test_slow_promote_demote_hysteresis_and_min_hold(monkeypatch):
+    clock = _FakeClock()
+    router = _router3(clock, monkeypatch)
+
+    async def go():
+        await router.probe_once()
+        ring_before = router.ring.members
+        _feed(router, "b0:8000", 5.0)
+        _feed(router, "b1:8001", 6.0)
+        _feed(router, "b2:8002", 300.0)
+        router._update_slow_states()
+        gray = router.members["b2:8002"]
+        assert gray.state == "slow" and gray.in_ring
+        # placement NEVER moves on a slow transition: recovery restores
+        # cache affinity with zero rebalance
+        assert router.ring.members == ring_before
+        assert router.metrics.labeled("slow_ejections_total") == {
+            "b2:8002": 1
+        }
+        gauges = router.metrics.labeled_gauge("backend_latency_p95_ms")
+        assert gauges["b2:8002"] == pytest.approx(300.0)
+        # hysteresis: p95 recovered into the band (between restore_k
+        # and eject_k x ref) does NOT restore...
+        clock.t += 2.1  # age the 300ms samples out of the window
+        _feed(router, "b0:8000", 5.0)
+        _feed(router, "b1:8001", 6.0)
+        _feed(router, "b2:8002", 15.0)  # ~2.7x the peer median of 5.5
+        router._update_slow_states()
+        assert gray.state == "slow"
+        # ...and a FULL recovery inside the min-hold stays slow too
+        clock.t += 2.1
+        _feed(router, "b0:8000", 5.0)
+        _feed(router, "b1:8001", 6.0)
+        _feed(router, "b2:8002", 6.0)
+        assert clock.t - gray.slow_since < router.slow_hold_s
+        router._update_slow_states()
+        assert gray.state == "slow"  # no flap
+        # past the hold with a recovered p95: restored
+        clock.t += 8.0
+        _feed(router, "b0:8000", 5.0)
+        _feed(router, "b1:8001", 6.0)
+        _feed(router, "b2:8002", 6.0)
+        router._update_slow_states()
+        assert gray.state == "healthy"
+        assert router.ring.members == ring_before
+
+    asyncio.run(go())
+
+
+def test_slow_needs_floors_and_never_demotes_last_fast(monkeypatch):
+    clock = _FakeClock()
+    router = _router3(clock, monkeypatch)
+
+    async def go():
+        await router.probe_once()
+        # absolute floor: a 40x ratio under slow_floor_ms is jitter
+        _feed(router, "b0:8000", 0.1)
+        _feed(router, "b1:8001", 0.1)
+        _feed(router, "b2:8002", 4.0)
+        router._update_slow_states()
+        assert router.members["b2:8002"].state == "healthy"
+        # min-sample floor: 3 huge samples convict nobody
+        clock.t += 2.1
+        _feed(router, "b0:8000", 5.0)
+        _feed(router, "b1:8001", 5.0)
+        _feed(router, "b2:8002", 500.0, n=3)
+        router._update_slow_states()
+        assert router.members["b2:8002"].state == "healthy"
+        # last-fast-member valve (2-member fleet): with b1 already
+        # slow, b0 can never be demoted no matter its ratio
+        r2 = FleetRouter(
+            ["b0:8000", "b1:8001"], clock=clock,
+            slow_min_samples=10, slow_floor_ms=10.0,
+            latency_window_s=2.0,
+        )
+        await r2.probe_once()
+        _feed(r2, "b0:8000", 5.0)
+        _feed(r2, "b1:8001", 300.0)
+        r2._update_slow_states()
+        assert r2.members["b1:8001"].state == "slow"
+        clock.t += 2.1
+        _feed(r2, "b0:8000", 3000.0)
+        _feed(r2, "b1:8001", 300.0)
+        r2._update_slow_states()
+        assert r2.members["b0:8000"].state == "healthy"
+
+    asyncio.run(go())
+
+
+def test_restore_liveness_without_peer_references(monkeypatch):
+    """Review fixes: a channel with no peer reference is SKIPPED in
+    restore (judging a canary's legitimate compute against the bare
+    absolute floor would pin a recovered member forever), and a slow
+    member with no possible comparison at all (solo survivor) restores
+    once the hold elapses."""
+    clock = _FakeClock()
+    router = _router3(clock, monkeypatch)
+
+    async def go():
+        # probe channel qualified everywhere (min_probe=2 here)
+        for _ in range(4):
+            await router.probe_once()
+        gray = router.members["b2:8002"]
+        gray.slow_since = clock.t - 60.0  # hold long elapsed
+        router._set_state(gray, "slow", "test")
+        # one legitimate 60ms canary forward, NO peer forward
+        # reference: the fwd channel is skipped, the probe channel is
+        # clean -> restored (pre-fix: 60 >= bare floor 10 pinned it)
+        router._observe_latency(gray, 60.0)
+        router._update_slow_states()
+        assert gray.state == "healthy"
+        # solo survivor: no peers in the ring at all -> no channel
+        # offers a comparison -> restore after hold (demotion with
+        # nobody to route to is meaningless)
+        router._set_state(gray, "slow", "test2")
+        gray.slow_since = clock.t - 60.0
+        for n in ("b0:8000", "b1:8001"):
+            router._set_state(router.members[n], "ejected", "test2")
+        router._update_slow_states()
+        assert gray.state == "healthy"
+
+    asyncio.run(go())
+
+
+def test_latency_gauges_zero_when_windows_empty(monkeypatch):
+    """Review fix: an emptied (or cleared-on-ejection) window must
+    publish 0, not freeze the last pre-crash value under an alerting
+    rule's nose."""
+    clock = _FakeClock()
+    router = _router3(clock, monkeypatch)
+
+    async def go():
+        await router.probe_once()
+        _feed(router, "b0:8000", 50.0)
+        router._update_slow_states()
+        g = router.metrics.labeled_gauge("backend_latency_p95_ms")
+        assert g["b0:8000"] == pytest.approx(50.0)
+        clock.t += 10.0  # everything ages out of the 2s window
+        router._update_slow_states()
+        g = router.metrics.labeled_gauge("backend_latency_p95_ms")
+        assert g["b0:8000"] == 0.0
+
+    asyncio.run(go())
+
+
+def test_slow_skipped_by_rr_keyed_last_resort_and_jobs_walk(monkeypatch):
+    clock = _FakeClock()
+    router = _router3(clock, monkeypatch)
+    forwards: list[tuple[str, str | None]] = []
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        forwards.append((f"{host}:{port}", headers.get("x-peer-fill")))
+        if target.startswith("/v1/jobs/"):
+            return 200, {}, json.dumps({"id": "j1", "state": "done"}).encode()
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        _feed(router, "b0:8000", 5.0)
+        _feed(router, "b1:8001", 6.0)
+        _feed(router, "b2:8002", 300.0)
+        router._update_slow_states()
+        assert router.members["b2:8002"].state == "slow"
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        # round-robin (unkeyed GET) never lands on the slow member
+        for _ in range(8):
+            req = Request(
+                method="GET", path="/v1/models", query={}, headers={},
+                body=b"", id="rid-rr",
+            )
+            assert (await router._proxy(req)).status == 200
+        assert "b2:8002" not in {b for b, _h in forwards}
+        # keyed: a body owned by the slow member demotes to the next
+        # fast owner, with an x-peer-fill hint back at the warm primary
+        body = None
+        for i in range(200):
+            cand = f"layer=c3&file=probe{i}".encode()
+            key = canonical_digest(
+                "fleet|/v1/deconv",
+                "application/x-www-form-urlencoded", cand,
+            )
+            if router.ring.owner(key) == "b2:8002":
+                body = cand
+                key_owned = key
+                break
+        assert body is not None
+        routed_before = router.metrics.counter("slow_routed_around_total")
+        forwards.clear()
+        resp = await router._proxy(_post_req(body))
+        assert resp.status == 200
+        served, hint = forwards[0]
+        assert served != "b2:8002"
+        assert served == next(
+            n for n in router.ring.owners(key_owned) if n != "b2:8002"
+        )
+        assert hint == "b2:8002"
+        assert (
+            router.metrics.counter("slow_routed_around_total")
+            == routed_before + 1
+        )
+        # every Nth demoted pick is a CANARY back to the slow primary
+        # — the restore-evidence channel for device-level grays whose
+        # probes stay fast (and it is never hedged: a winning hedge
+        # would cancel the very observation it exists to collect)
+        canary_router = _router3(
+            clock, monkeypatch, slow_canary_every=4
+        )
+        await canary_router.probe_once()
+        canary_router.members["b2:8002"].state = "slow"
+        canary_router._slow_epoch += 1
+        hedge_before = canary_router.metrics.counter("hedges_fired_total")
+        picks = [
+            canary_router._pick(key_owned, set()).name for _ in range(8)
+        ]
+        assert picks.count("b2:8002") == 2  # every 4th
+        assert (
+            canary_router.metrics.counter("slow_canary_forwards_total")
+            == 2
+        )
+        assert (
+            canary_router.metrics.counter("hedges_fired_total")
+            == hedge_before
+        )
+        # (_router3 re-pointed the transport at its probe script)
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        # ALL slow: the fleet still serves — primary is last resort
+        for n in ("b0:8000", "b1:8001"):
+            router.members[n].state = "slow"
+        router._slow_epoch += 1
+        forwards.clear()
+        resp = await router._proxy(_post_req(body))
+        assert resp.status == 200
+        assert forwards[0][0] == "b2:8002"
+        for n in ("b0:8000", "b1:8001"):
+            router.members[n].state = "healthy"
+        router._slow_epoch += 1
+        # the jobs ENTITY walk still asks a slow member — it may be the
+        # only holder of the job's durable state
+        router._learn_job_owner("j1", "b2:8002")
+        forwards.clear()
+        req = Request(
+            method="GET", path="/v1/jobs/j1", query={}, headers={},
+            body=b"", id="rid-job",
+        )
+        resp = await router._proxy(req)
+        assert resp.status == 200
+        assert forwards[0][0] == "b2:8002"
+        # and the collection fan-out includes it (it is in the ring)
+        forwards.clear()
+        req = Request(
+            method="GET", path="/v1/jobs", query={}, headers={},
+            body=b"", id="rid-coll",
+        )
+        await router._proxy(req)
+        assert "b2:8002" in {b for b, _h in forwards}
+
+    asyncio.run(go())
+
+
+# -------------------------------------------------------------- hedging
+
+
+def _seed_fleet_latency(router, ms=10.0, n=4):
+    m = next(iter(router.members.values()))
+    for _ in range(n):
+        router._observe_latency(m, ms)
+
+
+def test_hedge_fires_after_delay_first_wins_loser_closed(monkeypatch):
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], eject_threshold=2,
+        slow_min_samples=2, hedge_min_delay_ms=20.0,
+    )
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    body = b"layer=c3&file=hedge-me"
+    key = canonical_digest(
+        "fleet|/v1/deconv", "application/x-www-form-urlencoded", body
+    )
+    calls: list[str] = []
+    cancelled: dict[str, bool] = {}
+    stall: set[str] = set()
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        calls.append(name)
+        if name in stall:
+            try:
+                await asyncio.sleep(30.0)
+            except asyncio.CancelledError:
+                cancelled[name] = True
+                raise
+        return 200, {}, name.encode()
+
+    async def go():
+        await router.probe_once()
+        owner = router.ring.owner(key)
+        other = next(n for n in router.members if n != owner)
+        _seed_fleet_latency(router)
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        # a primary answering WITHIN the delay never hedges
+        resp = await router._proxy(_post_req(body))
+        assert resp.status == 200 and calls == [owner]
+        assert router.metrics.counter("hedges_fired_total") == 0
+        # a stalled primary: the duplicate fires to the next distinct
+        # owner, its response wins, the loser's connection is closed
+        calls.clear()
+        stall.add(owner)
+        t0 = time.perf_counter()
+        resp = await router._proxy(_post_req(body))
+        dt = time.perf_counter() - t0
+        assert resp.status == 200
+        assert resp.body == other.encode()
+        assert resp.headers["x-backend"] == other
+        assert calls == [owner, other]
+        assert dt < 5.0  # the 30s stall never held the client
+        assert router.metrics.counter("hedges_fired_total") == 1
+        assert router.metrics.counter("hedges_won_total") == 1
+        await asyncio.sleep(0.05)  # let the cancel land
+        assert cancelled.get(owner) is True
+        # the hedge cost a whole token
+        assert router.hedge_budget.tokens < router.hedge_budget.burst
+
+    asyncio.run(go())
+
+
+def test_hedge_budget_exhaustion_denies(monkeypatch):
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], slow_min_samples=2,
+        hedge_min_delay_ms=10.0,
+    )
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    body = b"layer=c3&file=deny-me"
+    slow_everyone = {"delay": 0.05}
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        await asyncio.sleep(slow_everyone["delay"])
+        return 200, {}, f"{host}:{port}".encode()
+
+    async def go():
+        await router.probe_once()
+        _seed_fleet_latency(router, ms=1.0)
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        router.hedge_budget._tokens = 0.0  # drained bucket
+        resp = await router._proxy(_post_req(body))
+        assert resp.status == 200
+        assert router.metrics.counter("hedges_fired_total") == 0
+        assert (
+            router.metrics.counter("hedges_budget_denied_total") == 1
+        )
+
+    asyncio.run(go())
+
+
+def test_job_submit_sse_and_no_cache_never_hedged(monkeypatch):
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], slow_min_samples=2,
+        hedge_min_delay_ms=10.0,
+    )
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    calls: list[str] = []
+    stream_calls: list[str] = []
+
+    async def slow_ok(host, port, method, target, headers, body, timeout_s):
+        calls.append(f"{host}:{port}")
+        await asyncio.sleep(0.05)  # well past the hedge delay
+        if target == "/v1/jobs":
+            return 202, {"location": "/v1/jobs/j9"}, b"{}"
+        return 200, {}, b"{}"
+
+    async def fake_stream(
+        host, port, method, target, headers, body, head_timeout_s
+    ):
+        stream_calls.append(f"{host}:{port}")
+
+        async def chunks():
+            yield b"data: x\n\n"
+
+        return 200, {"content-type": "text/event-stream"}, chunks()
+
+    async def go():
+        await router.probe_once()
+        _seed_fleet_latency(router, ms=1.0)
+        monkeypatch.setattr(fleet, "raw_request", slow_ok)
+        monkeypatch.setattr(fleet, "raw_request_stream", fake_stream)
+        # job submit: one attempt, one backend, zero hedges
+        resp = await router._proxy(_post_req(b"type=dream", path="/v1/jobs"))
+        assert resp.status == 202 and len(calls) == 1
+        # forced recompute: a WRITE is never duplicated
+        calls.clear()
+        resp = await router._proxy(
+            _post_req(
+                b"layer=c3&file=x", headers={"cache-control": "no-cache"}
+            )
+        )
+        assert resp.status == 200 and len(calls) == 1
+        # SSE: the stream path never races, and its head is EXCLUDED
+        # from the latency digest
+        router._learn_job_owner("j9", "b0:8000")
+        digest_before = len(router._fleet_latency)
+        req = Request(
+            method="GET", path="/v1/jobs/j9/events", query={},
+            headers={}, body=b"", id="rid-sse",
+        )
+        resp = await router._proxy(req)
+        assert resp.stream is not None and len(stream_calls) == 1
+        assert len(router._fleet_latency) == digest_before
+        assert router.metrics.counter("hedges_fired_total") == 0
+
+    asyncio.run(go())
+
+
+def test_probe_channel_floor_clamped_to_probe_supply():
+    """Review fix: the probe CHANNEL's sample floor must be reachable
+    by probes alone (window/interval per window), or an idle fleet
+    could never detect a network gray and a demoted member — fed
+    almost only by probes — could never testify to its own recovery.
+    The forward channel keeps the honest slow_min_samples floor."""
+    r = FleetRouter(["b0:8000"])  # defaults: 30s window / 2s probes
+    assert r.slow_min_samples == 20  # forwards: unclamped
+    assert r._min_probe_samples == 14  # 15 probe samples/window - 1
+    r = FleetRouter(
+        ["b0:8000"], probe_interval_s=0.25, latency_window_s=6.0,
+        slow_min_samples=8,
+    )
+    assert r._min_probe_samples == 8  # supply (24) exceeds the floor
+    # even a degenerate cadence keeps the member judgeable
+    r = FleetRouter(
+        ["b0:8000"], probe_interval_s=10.0, latency_window_s=30.0,
+        slow_min_samples=20,
+    )
+    assert r._min_probe_samples == 2
+
+
+def test_busy_member_not_demoted_against_idle_probe_windows(monkeypatch):
+    """Review fix: forwards carry compute + queue wait, probe RTTs
+    carry neither — judged per channel, a skewed workload (all compute
+    on one member, peers idle) shows no outlier: the forward channel
+    has no peer reference and the probe channel is symmetric."""
+    clock = _FakeClock()
+    router = _router3(clock, monkeypatch)
+
+    async def go():
+        for _ in range(4):  # probe channel qualified on all members
+            await router.probe_once()
+        # b0 alone carries real traffic at a legitimate 80ms
+        _feed(router, "b0:8000", 80.0)
+        router._update_slow_states()
+        assert all(
+            m.state == "healthy" for m in router.members.values()
+        )
+
+    asyncio.run(go())
+
+
+def test_restore_not_blocked_by_sub_floor_jitter(monkeypatch):
+    """Review fix: restore gates on the window MAX, but a max under
+    slow_floor_ms could never have convicted anyone — on a sub-ms
+    fleet one small blip per window must not pin `slow` forever."""
+    clock = _FakeClock()
+    router = _router3(clock, monkeypatch)
+
+    async def go():
+        await router.probe_once()
+        _feed(router, "b0:8000", 1.0)
+        _feed(router, "b1:8001", 1.0)
+        _feed(router, "b2:8002", 300.0)
+        router._update_slow_states()
+        gray = router.members["b2:8002"]
+        assert gray.state == "slow"
+        clock.t += 12.0  # past hold, old samples aged out
+        _feed(router, "b0:8000", 1.0)
+        _feed(router, "b1:8001", 1.0)
+        # recovered, but one 3ms blip: 3 > restore_k(2) x ref(1) —
+        # yet 3 < slow_floor_ms(10), so it restores
+        _feed(router, "b2:8002", 1.0)
+        router._observe_latency(gray, 3.0)
+        router._update_slow_states()
+        assert gray.state == "healthy"
+
+    asyncio.run(go())
+
+
+def test_probe_rtts_stay_out_of_the_hedge_delay_digest(monkeypatch):
+    """Review fix: probe RTTs (~1ms, always flowing) must not define
+    the "live fleet p95" the hedge delay derives from — a lightly
+    loaded fleet would otherwise hedge healthy compute requests."""
+    router = FleetRouter(["b0:8000", "b1:8001"], slow_min_samples=2)
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+
+    async def go():
+        for _ in range(4):
+            await router.probe_once()
+        m = router.members["b0:8000"]
+        assert len(m.latency) >= 4  # member digest: probes counted
+        assert len(router._fleet_latency) == 0  # hedge source: not
+        assert router._hedge_delay_s() is None  # no forwards, no hedge
+
+    asyncio.run(go())
+
+
+def test_hot_key_replica_cache_invalidated_by_slow_transition(monkeypatch):
+    """Review fix: a healthy<->slow transition changes WHICH owners may
+    serve a hot key without changing ring identity or the hot set — the
+    cached replica list must not keep spreading reads onto the demoted
+    member."""
+    router = FleetRouter(
+        ["b0:8000", "b1:8001", "b2:8002"],
+        hot_key_top_k=1, hot_key_replicas=2, hot_key_min_rate=2.0,
+        slow_min_samples=2,
+    )
+    _probe_script(
+        monkeypatch,
+        {n: _ready_200 for n in ("b0:8000", "b1:8001", "b2:8002")},
+    )
+    forwards: list[str] = []
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        forwards.append(f"{host}:{port}")
+        return 200, {}, b"{}"
+
+    body = b"layer=c3&file=hot-slow"
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        for _ in range(6):
+            await router._proxy(_post_req(body))
+        router.hot_keys.recompute()
+        key = next(iter(router.hot_keys.hot_keys))
+        primary = router.ring.owner(key)
+        replica = router.ring.owners(key)[1]
+        # warm the replica cache with the healthy spread
+        forwards.clear()
+        for _ in range(4):
+            await router._proxy(_post_req(body))
+        assert set(forwards) == {primary, replica}
+        # the replica goes slow THROUGH the real transition: reads
+        # must stop spreading onto it immediately
+        router._set_state(
+            router.members[replica], "slow", "test_slow"
+        )
+        forwards.clear()
+        for _ in range(6):
+            await router._proxy(_post_req(body))
+        assert set(forwards) == {primary}
+        # restore: the spread resumes
+        router._set_state(
+            router.members[replica], "healthy", "test_restore"
+        )
+        forwards.clear()
+        for _ in range(6):
+            await router._proxy(_post_req(body))
+        assert set(forwards) == {primary, replica}
+        # a slow PRIMARY collapses the spread entirely: the key falls
+        # to the normal keyed demotion path — stand-in serves, with
+        # the x-peer-fill hint back at the warm primary
+        router._set_state(
+            router.members[primary], "slow", "test_slow_primary"
+        )
+        forwards.clear()
+        for _ in range(6):
+            await router._proxy(_post_req(body))
+        assert primary not in set(forwards)
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------- deadlines
+
+
+def test_deadline_expired_at_router_and_capped_timeout(monkeypatch):
+    router = FleetRouter(["b0:8000", "b1:8001"])
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    seen_timeouts: list[float] = []
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        seen_timeouts.append(timeout_s)
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        # already expired: 504 at the router, NO backend consumed
+        resp = await router._proxy(
+            _post_req(b"layer=c3", deadline=time.perf_counter() - 1.0)
+        )
+        assert resp.status == 504
+        assert json.loads(resp.body)["error"] == "deadline_expired"
+        assert "x-backend" not in resp.headers
+        assert seen_timeouts == []
+        assert router.metrics.counter("deadline_expired_total") == 1
+        # live budget: the per-forward timeout is min(forward timeout,
+        # remaining budget) — never the flat 330 s
+        resp = await router._proxy(
+            _post_req(b"layer=c3", deadline=time.perf_counter() + 0.2)
+        )
+        assert resp.status == 200
+        assert 0.0 < seen_timeouts[0] <= 0.2
+
+        # a deadline-capped forward that TIMES OUT is the caller's
+        # budget lapsing, not backend death: 504 deadline_expired, no
+        # breaker/ejection state, no blind retry against the budget
+        async def timeout_raise(
+            host, port, method, target, headers, body, timeout_s
+        ):
+            seen_timeouts.append(timeout_s)
+            try:
+                raise asyncio.TimeoutError()
+            except asyncio.TimeoutError as te:
+                raise fleet._BackendError(
+                    f"{host}:{port}: TimeoutError"
+                ) from te
+
+        monkeypatch.setattr(fleet, "raw_request", timeout_raise)
+        n_before = len(seen_timeouts)
+        resp = await router._proxy(
+            _post_req(b"layer=c3", deadline=time.perf_counter() + 0.05)
+        )
+        assert resp.status == 504
+        assert json.loads(resp.body)["error"] == "deadline_expired"
+        assert len(seen_timeouts) == n_before + 1  # exactly one attempt
+        for m in router.members.values():
+            assert m.in_ring and m.breaker.state_name == "closed"
+
+    asyncio.run(go())
+
+
+def test_deadline_capped_timeouts_stay_clean_in_hedge_and_job_walk(
+    monkeypatch,
+):
+    """Review fixes: a deadline-capped timeout is the CALLER's budget
+    lapsing everywhere it can happen — inside the hedge race and on
+    the jobs walks too, not just the plain keyed forward.  504
+    deadline_expired, breakers untouched."""
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], eject_threshold=2,
+        slow_min_samples=2, hedge_min_delay_ms=5.0,
+    )
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+
+    async def slow_then_timeout(
+        host, port, method, target, headers, body, timeout_s
+    ):
+        await asyncio.sleep(0.03)
+        try:
+            raise asyncio.TimeoutError()
+        except asyncio.TimeoutError as te:
+            raise fleet._BackendError(
+                f"{host}:{port}: TimeoutError"
+            ) from te
+
+    async def go():
+        await router.probe_once()
+        _seed_fleet_latency(router, ms=1.0)
+        monkeypatch.setattr(fleet, "raw_request", slow_then_timeout)
+        # hedged: both legs fire (delay 5ms < the 30ms stall), both
+        # time out under the deadline cap -> 504, no breaker state
+        resp = await router._proxy(
+            _post_req(b"layer=c3", deadline=time.perf_counter() + 0.08)
+        )
+        assert resp.status == 504
+        assert json.loads(resp.body)["error"] == "deadline_expired"
+        for m in router.members.values():
+            assert m.in_ring and m.breaker.state_name == "closed"
+        # jobs entity walk: pinned owner times out under the cap
+        router._learn_job_owner("jd", "b0:8000")
+        req = Request(
+            method="GET", path="/v1/jobs/jd", query={}, headers={},
+            body=b"", id="rid-jd",
+            deadline=time.perf_counter() + 0.05,
+        )
+        resp = await router._proxy(req)
+        assert resp.status == 504
+        assert json.loads(resp.body)["error"] == "deadline_expired"
+        for m in router.members.values():
+            assert m.in_ring and m.breaker.state_name == "closed"
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------ fleet.* fault sites
+
+
+def test_fault_spec_target_grammar_and_targeted_firing():
+    spec = faults_mod.parse_spec("p0.5:150@b0:8000")
+    assert (spec.p, spec.param, spec.target) == (0.5, 150.0, "b0:8000")
+    assert str(spec) == "p0.5:150@b0:8000"
+    spec = faults_mod.parse_spec("n2@10.0.0.1:9999")
+    assert (spec.n, spec.param, spec.target) == (2, None, "10.0.0.1:9999")
+    with pytest.raises(ValueError):
+        faults_mod.parse_spec("p0.5@")
+    # a targeted one-shot never fires — or burns its count — for
+    # anyone but its target
+    reg = faults_mod.FaultRegistry()
+    reg.arm("fleet.torn_body", "n1@b0:8000")
+    assert reg.check("fleet.torn_body", who="b1:8001") is None
+    assert reg.check("fleet.torn_body", who=None) is None
+    assert reg.snapshot()["armed"] == {"fleet.torn_body": "n1@b0:8000"}
+    assert reg.check("fleet.torn_body", who="b0:8000") is not None
+    assert reg.check("fleet.torn_body", who="b0:8000") is None  # spent
+
+
+def test_fleet_fault_sites_shape_the_transport(monkeypatch):
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], eject_threshold=2, cooldown_s=5.0,
+        probe_timeout_s=0.05, fault_injection=True, clock=clock,
+    )
+    _probe_script(
+        monkeypatch, {"b0:8000": _ready_200, "b1:8001": _ready_200}
+    )
+    forwards: list[str] = []
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        forwards.append(f"{host}:{port}")
+        return 200, {}, b"{}"
+
+    body = None
+
+    async def go():
+        nonlocal body
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        # torn body on b0: the keyed forward fails over to b1 with zero
+        # client-visible error
+        for i in range(200):
+            cand = f"layer=c3&file=torn{i}".encode()
+            key = canonical_digest(
+                "fleet|/v1/deconv",
+                "application/x-www-form-urlencoded", cand,
+            )
+            if router.ring.owner(key) == "b0:8000":
+                body = cand
+                break
+        router.faults.arm("fleet.torn_body", "n1@b0:8000")
+        resp = await router._proxy(_post_req(body))
+        assert resp.status == 200
+        assert resp.headers["x-backend"] == "b1:8001"
+        assert forwards == ["b0:8000", "b1:8001"]
+        assert router.metrics.labeled("faults_injected_total") == {
+            "fleet.torn_body": 1
+        }
+        # head delay on b0: probe-200 survives but the RTT lands in the
+        # digest — the gray signature the slow machinery reads
+        router.faults.arm("fleet.head_delay_ms", "p1:80@b0:8000")
+        resp = await router._proxy(_post_req(body))
+        assert resp.status == 200
+        assert (
+            router.members["b0:8000"].latency.quantile(0.95) >= 80.0
+        )
+        router.faults.disarm("fleet.head_delay_ms")
+        # blackhole on b1: probes burn their timeout and fail — two
+        # consecutive ticks eject it through the NORMAL breaker path
+        router.faults.arm("fleet.blackhole", "p1@b1:8001")
+        await router.probe_once()
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "ejected"
+        router.faults.disarm("fleet.blackhole")
+        clock.t += 5.1
+        await router.probe_once()
+        assert router.members["b1:8001"].state == "healthy"
+
+    asyncio.run(go())
+
+
+# -------------------------------------------------- escape hatch + lint
+
+
+def test_tail_off_pins_round16_topology(monkeypatch):
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000", "b1:8001", "b2:8002"], tail_tolerance=False,
+        clock=clock,
+    )
+    _probe_script(
+        monkeypatch,
+        {n: _ready_200 for n in ("b0:8000", "b1:8001", "b2:8002")},
+    )
+    forwards: list[str] = []
+
+    async def capture(host, port, method, target, headers, body, timeout_s):
+        forwards.append(f"{host}:{port}")
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        assert router.hedge_budget is None
+        assert router._hedge_delay_s() is None
+        # digests are never fed — the layer leaves ZERO state
+        m = router.members["b0:8000"]
+        router._observe_latency(m, 500.0)
+        assert len(m.latency) == 0
+        monkeypatch.setattr(fleet, "raw_request", capture)
+        for i in range(32):
+            cand = f"layer=c3&file=off{i}".encode()
+            key = canonical_digest(
+                "fleet|/v1/deconv",
+                "application/x-www-form-urlencoded", cand,
+            )
+            resp = await router._proxy(_post_req(cand))
+            assert resp.status == 200
+            # placement is EXACTLY the round-16 pure ring function
+            assert forwards[-1] == router.ring.owner(key)
+        # forwards fed nothing, judged nothing
+        assert all(len(m.latency) == 0 for m in router.members.values())
+        router._update_slow_states()
+        assert all(
+            m.state == "healthy" for m in router.members.values()
+        )
+        assert router.metrics.counter("hedges_fired_total") == 0
+        cfg = json.loads(
+            (await router._config(None)).body
+        )
+        assert cfg["tail_tolerance"]["enabled"] is False
+
+    asyncio.run(go())
+
+
+def test_new_metric_families_lint():
+    r = Metrics(prefix="router", core=False)
+    r.inc_labeled("slow_ejections_total", "backend", "b0:8000")
+    r.set_labeled_gauge("backend_latency_p50_ms", "backend", "b0:8000", 4.2)
+    r.set_labeled_gauge("backend_latency_p95_ms", "backend", "b0:8000", 9.9)
+    for c in (
+        "hedges_fired_total",
+        "hedges_won_total",
+        "hedges_budget_denied_total",
+        "slow_routed_around_total",
+        "slow_canary_forwards_total",
+        "deadline_expired_total",
+    ):
+        r.inc_counter(c, 2)
+    reg = faults_mod.FaultRegistry(metrics=r)
+    reg.arm("fleet.blackhole", "n1@b0:8000")
+    assert reg.check("fleet.blackhole", who="b0:8000") is not None
+    families, samples = lint_exposition(r.prometheus())
+    assert families["router_slow_ejections_total"] == "counter"
+    assert families["router_backend_latency_p50_ms"] == "gauge"
+    assert families["router_backend_latency_p95_ms"] == "gauge"
+    assert families["router_hedges_fired_total"] == "counter"
+    assert families["router_hedges_won_total"] == "counter"
+    assert families["router_hedges_budget_denied_total"] == "counter"
+    assert families["router_slow_routed_around_total"] == "counter"
+    assert families["router_slow_canary_forwards_total"] == "counter"
+    assert families["router_deadline_expired_total"] == "counter"
+    assert families["router_faults_injected_total"] == "counter"
+    assert (
+        samples[("router_slow_ejections_total", 'backend="b0:8000"')]
+        == 1.0
+    )
+    assert (
+        samples[("router_backend_latency_p95_ms", 'backend="b0:8000"')]
+        == 9.9
+    )
+
+
+# ----------------------------------------------------------------- e2e
+
+
+@pytest.mark.parametrize("n", [2])
+def test_e2e_gray_backend_detected_routed_around_and_restored(n):
+    """The whole round in one drill: a REAL backend made gray through
+    the router-side ``fleet.head_delay_ms`` site (its /readyz stays
+    200 — only the network path is slow), detected by probe RTTs
+    alone, demoted from routing with zero client errors, and restored
+    after disarm."""
+    from tests.test_fleet import FleetFixture, _data_url
+
+    with FleetFixture(
+        n_backends=n,
+        router_kw=dict(
+            probe_interval_s=0.1,
+            probe_timeout_s=2.0,
+            slow_min_samples=4,
+            latency_window_s=4.0,
+            slow_hold_s=0.3,
+            slow_floor_ms=5.0,
+            # narrow the restore band: the test's own compute traffic
+            # jitters the healthy peer's p95, and a 2-member fleet's
+            # reference is exactly that one peer — 1.5 keeps the slow
+            # dwell stable under host-load noise without blocking the
+            # post-disarm restore
+            slow_restore_k=1.5,
+            fault_injection=True,
+        ),
+    ) as f:
+        gray = f"127.0.0.1:{f.ports[0]}"
+        healthy = f"127.0.0.1:{f.ports[1]}"
+        # pre-warm BOTH backends (first-request XLA compiles cost
+        # seconds; a compile-era forward sample would inflate the
+        # healthy peer's p95 and let the gray member restore early),
+        # then let the compile-era samples age out of the window
+        for i in range(6):
+            resp = httpx.post(
+                f.router_url + "/",
+                data={"file": _data_url(200 + i), "layer": "b2c1"},
+                timeout=120,
+            )
+            assert resp.status_code == 200, resp.text
+        time.sleep(4.5)
+        # arm through the router's own debug surface
+        r = httpx.post(
+            f.router_url + "/v1/debug/faults",
+            data={"arm": f"fleet.head_delay_ms=p1:250@{gray}"},
+            timeout=10,
+        )
+        assert r.status_code == 200, r.text
+        assert "fleet.head_delay_ms" in r.json()["faults"]["armed"]
+
+        def slow_set():
+            rz = httpx.get(f.router_url + "/readyz", timeout=10)
+            return (rz.json().get("tail") or {}).get("slow", [])
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and gray not in slow_set():
+            time.sleep(0.2)
+        assert gray in slow_set(), "gray backend never detected"
+        assert (
+            f.router.members[gray].breaker.state_name == "closed"
+        ), "latency must never feed the ejection breaker"
+        # /v1/config shows the state + per-member windows (read NOW,
+        # before any compute traffic can jitter the peer reference)
+        cfg = httpx.get(f.router_url + "/v1/config", timeout=10).json()
+        assert cfg["members"][gray]["state"] == "slow"
+        assert cfg["members"][gray]["latency"]["p95_ms"] >= 100.0
+        assert cfg["tail_tolerance"]["enabled"] is True
+        # traffic routes around the gray member with zero errors WHILE
+        # it is slow.  The member may legitimately restore mid-phase
+        # (host-load noise inflates the 2-member peer reference; the
+        # 250ms probes re-convict it within ticks) — only posts made
+        # while demoted count toward the routed-around pin.
+        routed = 0
+        for i in range(20):
+            if gray not in slow_set():
+                time.sleep(0.3)
+                continue
+            resp = httpx.post(
+                f.router_url + "/",
+                data={"file": _data_url(100 + i), "layer": "b2c1"},
+                timeout=60,
+            )
+            assert resp.status_code == 200, resp.text
+            assert resp.headers["x-backend"] == healthy
+            routed += 1
+            if routed >= 4:
+                break
+        assert routed >= 4, "never observed demoted routing while slow"
+        # disarm: probe RTTs recover, the member is restored
+        r = httpx.post(
+            f.router_url + "/v1/debug/faults",
+            data={"disarm": "fleet.head_delay_ms"},
+            timeout=10,
+        )
+        assert r.status_code == 200
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and gray in slow_set():
+            time.sleep(0.2)
+        assert gray not in slow_set(), "gray backend never restored"
+        assert f.router.members[gray].state == "healthy"
+        assert (
+            f.router.metrics.labeled("slow_ejections_total")[gray] >= 1
+        )

@@ -19,7 +19,19 @@ Usage: python tools/loopback_load.py [--passes N] [--no-donate]
            [--chaos site=spec,...] [--pool-decode] [--lanes N]
            [--compile-cache-dir DIR] [--heavy] [--jobs]
            [--jobs-dir DIR] [--qos] [--tenants default|SPEC]
-           [--fleet N] [--fleet-ha] [depth ...]
+           [--fleet N] [--fleet-ha] [--fleet-tail] [depth ...]
+
+Round 17 added `--fleet-tail` — the tail-tolerance drill
+(run_fleet_tail_drill): three warmed cache-off backends behind one
+tail-aware router under live zipf load; mid-stream one backend turns
+GRAY via `device.dispatch_delay_ms=p1:150@<backend>` (its /readyz
+keeps answering 200 — only the latency digests can see it).  The row
+pins detection < 5 s with the ejection breaker still closed,
+post-detection fleet p99 <= 1.5x the all-healthy baseline, zero
+non-200s anywhere, hedges within the token-bucket bound, restoration
+after disarm, and a `--tail-tolerance off` router placing every
+sampled key on its pure ring owner byte-identically (the round-16
+pin).  `tools/run_bench_suite.py`'s `fleet-tail` token records it.
 
 Round 16 added `--fleet-ha` — the zero-SPOF drill (run_fleet_ha_drill):
 TWO HA routers share one watched membership file, three backends
@@ -1716,6 +1728,438 @@ def run_fleet_ha_drill(
     return asyncio.run(drive())
 
 
+def run_fleet_tail_drill(
+    n_backends: int = 3,
+    n_requests: int = 480,
+    concurrency: int = 16,
+    key_dist: str = "zipf:1.1",
+    gray_delay_ms: float = 400.0,
+) -> dict:
+    """The round-17 tail-tolerance drill: one tail-aware router over N
+    in-process backends under live zipf load, with one backend turned
+    GRAY mid-run via ``device.dispatch_delay_ms`` armed per-backend
+    (``@host:port`` target on the shared module registry) — its
+    ``/readyz`` keeps answering 200 the whole time, so the binary
+    health gate sees nothing and only the latency digests can.
+
+    What the row pins:
+
+    - **Detection**: the gray backend must enter the ``slow`` state in
+      under FLEET_TAIL_DETECT budget (5 s) from the moment the fault
+      arms, with its breaker still CLOSED (latency is not a failure).
+    - **Containment**: steady-state fleet p99 AFTER detection must stay
+      within 1.5x the all-healthy baseline p99 (vs ~gray_delay_ms
+      unbounded before this round), with ZERO request loss and zero
+      non-200s in every phase.
+    - **Hedging stays budgeted**: fired hedges <= budget pct of
+      eligible requests + the burst, never more.
+    - **Restoration**: after the fault disarms, canary forwards + probe
+      RTTs must restore the backend to ``healthy`` within 30 s.
+    - **The escape hatch**: a second router with ``--tail-tolerance
+      off`` over the same backends places every sampled key on its
+      pure ring owner (round-16 topology) and serves byte-identical
+      payloads — the layer really is inert when off.
+
+    Cache is OFF on the backends: every request dispatches, so the
+    device-level delay is visible on every gray-bound forward and the
+    A/B measures routing, not cache luck.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving import faults as faults_mod
+    from deconv_api_tpu.serving.app import DeconvService
+    from deconv_api_tpu.serving.cache import canonical_digest
+    from deconv_api_tpu.serving.fleet import FleetRouter
+
+    spec = _tiny_spec()
+    size = spec.input_shape[0]
+    params = init_params(spec, jax.random.PRNGKey(0))
+    def backend_cfg() -> ServerConfig:
+        # one cfg PER backend: fleet_advertise is stamped per process
+        # (the @target selector keys on it), so sharing one dataclass
+        # would gray every backend at once
+        return ServerConfig(
+            image_size=size,
+            max_batch=16,
+            batch_window_ms=3.0,
+            compilation_cache_dir="",
+            platform="cpu",
+            warmup_all_buckets=False,
+            cache_bytes=0,  # every request computes: the delay shows
+            singleflight=False,
+        )
+
+    rng = np.random.default_rng(0)
+    streams = _key_streams(key_dist, n_requests, 2, rng)
+    uris: dict[int, str] = {}
+    for idx in sorted({i for stream in streams for i in stream}):
+        img = Image.fromarray(
+            np.random.default_rng(idx).integers(
+                0, 255, (size, size, 3), np.uint8
+            ),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        uris[idx] = (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+    import urllib.parse
+
+    bodies = {
+        idx: urllib.parse.urlencode({"file": uri, "layer": "c3"}).encode()
+        for idx, uri in uris.items()
+    }
+    keys = {
+        idx: canonical_digest(
+            "fleet|/", "application/x-www-form-urlencoded", body
+        )
+        for idx, body in bodies.items()
+    }
+
+    registry = faults_mod.FaultRegistry(seed=0)
+    faults_mod.install(registry)
+
+    async def boot_backend():
+        svc = DeconvService(backend_cfg(), spec=spec, params=params)
+        port = await svc.start("127.0.0.1", 0)
+        svc.cfg.fleet_advertise = f"127.0.0.1:{port}"
+        await asyncio.to_thread(svc.warmup, "c3")
+        return svc, port
+
+    async def post_raw(port: int, body: bytes):
+        t0 = time.perf_counter()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        req = (
+            b"POST / HTTP/1.1\r\nHost: x\r\nContent-Type: "
+            b"application/x-www-form-urlencoded\r\nContent-Length: "
+            + str(len(body)).encode()
+            + b"\r\nConnection: close\r\n\r\n"
+            + body
+        )
+        writer.write(req)
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        status, _code = _resp_status_code(raw)
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        backend = ""
+        for line in head.split(b"\r\n"):
+            name, _, value = line.partition(b":")
+            if name.strip().lower() == b"x-backend":
+                backend = value.strip().decode()
+        return time.perf_counter() - t0, status, backend, payload
+
+    def pcts(samples: list[float]) -> dict:
+        if not samples:
+            return {"p50_ms": None, "p99_ms": None}
+        xs = sorted(samples)
+        return {
+            "p50_ms": round(xs[int(0.50 * (len(xs) - 1))] * 1e3, 2),
+            "p99_ms": round(xs[int(0.99 * (len(xs) - 1))] * 1e3, 2),
+        }
+
+    async def drive() -> dict:
+        backends = [await boot_backend() for _ in range(n_backends)]
+        names = [f"127.0.0.1:{port}" for _svc, port in backends]
+        router = FleetRouter(
+            names,
+            probe_interval_s=0.25,
+            probe_timeout_s=2.0,
+            eject_threshold=3,
+            cooldown_s=2.0,
+            # drill-speed tail knobs: small window + low floors so the
+            # <5s detection budget is meaningful at CPU latencies.
+            # eject_k=3 (vs the production 4): loopback queueing under
+            # concurrency inflates the healthy peers' p95 with queue
+            # wait, compressing the gray/healthy contrast the
+            # device-level delay creates
+            slow_min_samples=8,
+            slow_eject_k=3.0,
+            latency_window_s=6.0,
+            slow_hold_s=1.0,
+            slow_floor_ms=10.0,
+            # 128 keeps the canary fraction (~gray share / 128 ~ 0.3%
+            # of requests) safely below the p99 cut, so the honest
+            # all-requests p99 measures the ROUTING, not the bounded
+            # evidence channel
+            slow_canary_every=128,
+            hedge_min_delay_ms=20.0,
+        )
+        rport = await router.start("127.0.0.1", 0)
+
+        async def drive_stream(stream, on_done=None):
+            sem = asyncio.Semaphore(concurrency)
+            out = []
+
+            async def one(idx: int):
+                async with sem:
+                    t_start = time.perf_counter()
+                    dt, status, backend, _p = await post_raw(
+                        rport, bodies[idx]
+                    )
+                out.append((idx, dt, status, backend, t_start))
+                if on_done is not None:
+                    await on_done(len(out))
+
+            await asyncio.gather(*(one(i) for i in stream))
+            return out
+
+        # ---- phase 1: all-healthy baseline ---------------------------
+        t0 = time.perf_counter()
+        base_samples = await drive_stream(streams[0])
+        base_wall = time.perf_counter() - t0
+        base_errors = sum(
+            1 for _i, _d, s, _b, _t in base_samples if s != 200
+        )
+        baseline = {
+            "req_s": round(len(base_samples) / base_wall, 1),
+            "errors": base_errors,
+            **pcts([d for _i, d, _s, _b, _t in base_samples]),
+        }
+
+        # ---- phase 2: one backend goes gray under live load ----------
+        from collections import Counter
+
+        owned = Counter(
+            router.ring.owner(keys[i]) for i in bodies
+        )
+        gray_name = owned.most_common(1)[0][0]
+        # arm early: most of the phase-2 stream must land AFTER
+        # detection or the steady-state p99 has nothing to stand on
+        arm_at = max(1, len(streams[1]) // 6)
+        armed = {}
+        detected = {}
+
+        async def on_done(done: int):
+            if done >= arm_at and "t" not in armed:
+                armed["t"] = time.perf_counter()
+                registry.arm(
+                    "device.dispatch_delay_ms",
+                    f"p1:{gray_delay_ms:g}@{gray_name}",
+                )
+                asyncio.ensure_future(watch_detection())
+
+        async def watch_detection():
+            while time.perf_counter() - armed["t"] < 30.0:
+                if router.members[gray_name].state == "slow":
+                    detected["t"] = time.perf_counter()
+                    detected["s"] = round(detected["t"] - armed["t"], 2)
+                    return
+                await asyncio.sleep(0.02)
+
+        gray_samples = await drive_stream(streams[1], on_done=on_done)
+        # give the watcher a beat if detection landed near stream end
+        for _ in range(100):
+            if "s" in detected or (
+                "t" in armed
+                and time.perf_counter() - armed["t"] > 30.0
+            ):
+                break
+            await asyncio.sleep(0.1)
+        # steady-state = requests that STARTED after detection: a
+        # request picked pre-detection but completing after it still
+        # paid the gray member's queue and would smear the measurement
+        post_detect = [
+            (i, d, s, b)
+            for i, d, s, b, t in gray_samples
+            if "t" in detected and t >= detected["t"]
+        ]
+        if "t" in detected and len(post_detect) < 60:
+            # a slow (but within-budget) detection can land near the
+            # stream's end: top up with another pass of the same zipf
+            # process so the steady-state p99 has a real sample mass
+            extra = await drive_stream(streams[0])
+            gray_samples += extra
+            post_detect += [
+                (i, d, s, b) for i, d, s, b, _t in extra
+            ]
+        gray_errors = sum(
+            1 for _i, _d, s, _b, _t in gray_samples if s != 200
+        )
+        post_pcts = pcts([d for _i, d, _s, _b in post_detect])
+        served_by_gray = sum(
+            1 for _i, _d, _s, b in post_detect if b == gray_name
+        )
+        rsnap = router.metrics.snapshot()
+        counters = rsnap["counters"]
+        hedges_fired = counters.get("hedges_fired_total", 0)
+        eligible = len(base_samples) + len(gray_samples)
+        hedge_bound = int(
+            0.05 * eligible + (router.hedge_budget.burst if
+                               router.hedge_budget else 0)
+        ) + 1
+        gray = {
+            "backend": gray_name,
+            "delay_ms": gray_delay_ms,
+            "requests": len(gray_samples),
+            "errors": gray_errors,
+            "detection_s": detected.get("s"),
+            "breaker_still_closed": (
+                router.members[gray_name].breaker.state_name == "closed"
+            ),
+            "post_detection_requests": len(post_detect),
+            "served_by_gray_after_detection": served_by_gray,
+            **{f"post_{k}": v for k, v in post_pcts.items()},
+            "p99_ratio": (
+                round(post_pcts["p99_ms"] / baseline["p99_ms"], 3)
+                if post_pcts["p99_ms"] and baseline["p99_ms"]
+                else None
+            ),
+            "hedges_fired": hedges_fired,
+            "hedges_won": counters.get("hedges_won_total", 0),
+            "hedges_budget_denied": counters.get(
+                "hedges_budget_denied_total", 0
+            ),
+            "hedge_bound": hedge_bound,
+            "slow_routed_around": counters.get(
+                "slow_routed_around_total", 0
+            ),
+            "slow_canary_forwards": counters.get(
+                "slow_canary_forwards_total", 0
+            ),
+            "slow_ejections": rsnap["labeled"]
+            .get("slow_ejections_total", ("", {}))[1],
+        }
+
+        # ---- phase 3: disarm, light load, restoration ----------------
+        registry.disarm("device.dispatch_delay_ms")
+        t_disarm = time.perf_counter()
+        restore_s = None
+        sample_iter = iter(streams[0] * 4)
+        while time.perf_counter() - t_disarm < 30.0:
+            if router.members[gray_name].state == "healthy":
+                restore_s = round(time.perf_counter() - t_disarm, 2)
+                break
+            # keep a trickle of real traffic flowing so canary picks
+            # exist (probes alone also recover, window permitting)
+            try:
+                idx = next(sample_iter)
+            except StopIteration:
+                sample_iter = iter(streams[0] * 4)
+                idx = next(sample_iter)
+            await post_raw(rport, bodies[idx])
+            await asyncio.sleep(0.05)
+        restore = {
+            "restored": restore_s is not None,
+            "restore_s": restore_s,
+        }
+
+        # ---- phase 4: --tail-tolerance off topology pin --------------
+        router_off = FleetRouter(
+            names,
+            probe_interval_s=0.25,
+            probe_timeout_s=2.0,
+            eject_threshold=3,
+            cooldown_s=2.0,
+            tail_tolerance=False,
+        )
+        rport_off = await router_off.start("127.0.0.1", 0)
+        sample = sorted(bodies)[: min(16, len(bodies))]
+        placement_ok = 0
+        parity_ok = 0
+        off_errors = 0
+        for idx in sample:
+            _d, s_on, b_on, p_on = await post_raw(rport, bodies[idx])
+            _d, s_off, b_off, p_off = await post_raw(
+                rport_off, bodies[idx]
+            )
+            if s_on != 200 or s_off != 200:
+                off_errors += 1
+                continue
+            if b_off == router_off.ring.owner(keys[idx]):
+                placement_ok += 1
+            if p_on == p_off:
+                parity_ok += 1
+        tail_off = {
+            "sampled": len(sample),
+            "placement_matches_ring": placement_ok,
+            "byte_identical": parity_ok,
+            "errors": off_errors,
+            "hedges_fired": router_off.metrics.counter(
+                "hedges_fired_total"
+            ),
+        }
+        await router_off.stop()
+        await router.stop()
+        for svc, _port in backends:
+            await svc.stop()
+        faults_mod.uninstall(registry)
+
+        problems = []
+        if base_errors or gray_errors or off_errors:
+            problems.append(
+                f"non-200s: baseline={base_errors} gray={gray_errors} "
+                f"tail_off={off_errors} (zero-loss budget)"
+            )
+        if detected.get("s") is None:
+            problems.append(
+                "gray backend never detected (drill vacuous)"
+            )
+        elif detected["s"] > 5.0:
+            problems.append(
+                f"detection took {detected['s']}s (> 5s budget)"
+            )
+        if not gray["breaker_still_closed"]:
+            problems.append(
+                "latency fed the ejection breaker (gray != dead)"
+            )
+        ratio = gray.get("p99_ratio")
+        if ratio is None or ratio > 1.5:
+            problems.append(
+                f"post-detection p99 ratio {ratio} vs 1.5x budget"
+            )
+        if hedges_fired > hedge_bound:
+            problems.append(
+                f"{hedges_fired} hedges fired > bound {hedge_bound} "
+                "(budget leak)"
+            )
+        if not restore["restored"]:
+            problems.append("backend never restored after disarm")
+        if tail_off["placement_matches_ring"] != len(sample):
+            problems.append(
+                "tail-off placement diverged from the pure ring "
+                f"({tail_off['placement_matches_ring']}/{len(sample)})"
+            )
+        if tail_off["byte_identical"] != len(sample) - off_errors:
+            problems.append(
+                "tail-off payloads not byte-identical "
+                f"({tail_off['byte_identical']}/{len(sample)})"
+            )
+        if tail_off["hedges_fired"]:
+            problems.append("tail-off router fired hedges (not inert)")
+
+        row = {
+            "which": f"loopback_fleet_tail{n_backends}_"
+            f"{key_dist.replace(':', '')}",
+            "platform": "cpu-loopback",
+            "n_backends": n_backends,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "key_dist": key_dist,
+            "unique_keys": len(bodies),
+            "baseline": baseline,
+            "gray": gray,
+            "restore": restore,
+            "tail_off": tail_off,
+        }
+        if problems:
+            row["error"] = "; ".join(problems)
+        return row
+
+    try:
+        return asyncio.run(drive())
+    finally:
+        faults_mod.uninstall(registry)
+
+
 def run_model_mix_drill(
     n_models: int = 3,
     n_requests: int = 360,
@@ -2603,6 +3047,7 @@ def main() -> int:
     model_mix = False
     fleet_n: int | None = None
     fleet_ha = False
+    fleet_tail = False
     tenants_drill: str | None = None
     concurrency = 64
     depths: list[int] = []
@@ -2672,6 +3117,14 @@ def main() -> int:
             # restart with L2 hit-ratio recovery
             fleet_ha = True
             i += 1
+        elif args[i] == "--fleet-tail":
+            # the round-17 tail-tolerance drill: 3 backends under live
+            # zipf load, one turned gray (probe-200, 10-100x slow) via
+            # device.dispatch_delay_ms@backend — detection time, p99
+            # containment, hedge budget, restoration, and the
+            # --tail-tolerance off topology pin
+            fleet_tail = True
+            i += 1
         elif args[i] == "--tenants":
             # the multi-tenant noisy-neighbor drill (round 13):
             # 'default' = the built-in victim/abuser pair with the
@@ -2720,6 +3173,14 @@ def main() -> int:
         row = run_model_mix_drill(
             n_requests=n_requests or 360,
             concurrency=min(concurrency, 16),
+        )
+        print(json.dumps(row), flush=True)
+        return 0
+    if fleet_tail:
+        row = run_fleet_tail_drill(
+            n_requests=n_requests or 480,
+            concurrency=min(concurrency, 16),
+            key_dist=key_dist or "zipf:1.1",
         )
         print(json.dumps(row), flush=True)
         return 0

@@ -80,6 +80,16 @@ FLEET_HIT_RATIO_BUDGET_PCT = 5.0
 # restarts.  The kill phase's budget is exactly zero lost requests.
 FLEET_HA_RECOVERY_FRAC = 0.8
 
+# Tail-tolerance budgets (round 17): a gray backend (probe-200,
+# 10-100x slow) must be detected and demoted within the detection
+# budget, and steady-state fleet p99 while gray must stay within the
+# factor of the all-healthy baseline — versus UNBOUNDED before this
+# round (a gray member held its whole key range against the 330 s
+# forward timeout).  Hedges must stay within their token-bucket bound
+# and every phase must be lossless.
+FLEET_TAIL_DETECT_BUDGET_S = 5.0
+FLEET_TAIL_P99_FACTOR = 1.5
+
 # Multi-model paging budget (round 15): the weight-manager machinery
 # engaged for a SINGLE model (budget set, no second model) may cost the
 # hot path at most this much throughput versus the inert pre-round-15
@@ -561,6 +571,73 @@ def run_fleet_ha_guard(timeout_s: float = 1800.0) -> dict:
     return row
 
 
+def run_fleet_tail_guard(timeout_s: float = 1800.0) -> dict:
+    """Tail-tolerance drill guard (round 17): tools/loopback_load.py
+    --fleet-tail — three backends under live zipf load, one turned
+    gray via ``device.dispatch_delay_ms`` armed per-backend (its
+    /readyz stays 200 throughout).
+
+    The row fails LOUDLY (`error` field) when:
+    - the gray backend is never detected, or detection takes more than
+      FLEET_TAIL_DETECT_BUDGET_S;
+    - latency fed the ejection breaker (gray must never read as dead);
+    - steady-state p99 after detection exceeds FLEET_TAIL_P99_FACTOR x
+      the all-healthy baseline;
+    - ANY request in any phase came back non-200 (zero loss / zero
+      collateral budget);
+    - hedges fired past the token-bucket bound;
+    - the backend is not restored after the fault disarms;
+    - the --tail-tolerance off router's placement diverges from the
+      pure ring or its payloads drift (the escape hatch must pin the
+      round-16 topology byte-identically)."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--fleet-tail"], timeout_s, env=env
+    )
+    row = {"config": "fleet-tail", "which": "loopback_fleet_tail_drill"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    gray = drill.get("gray", {})
+    base = drill.get("baseline", {})
+    restore = drill.get("restore", {})
+    tail_off = drill.get("tail_off", {})
+    row.update(
+        n_backends=drill.get("n_backends"),
+        requests=drill.get("requests"),
+        key_dist=drill.get("key_dist"),
+        baseline_req_s=base.get("req_s"),
+        baseline_p99_ms=base.get("p99_ms"),
+        gray_backend=gray.get("backend"),
+        gray_delay_ms=gray.get("delay_ms"),
+        detection_s=gray.get("detection_s"),
+        detect_budget_s=FLEET_TAIL_DETECT_BUDGET_S,
+        breaker_still_closed=gray.get("breaker_still_closed"),
+        post_p99_ms=gray.get("post_p99_ms"),
+        p99_ratio=gray.get("p99_ratio"),
+        p99_factor_budget=FLEET_TAIL_P99_FACTOR,
+        errors_total=(
+            (base.get("errors") or 0)
+            + (gray.get("errors") or 0)
+            + (tail_off.get("errors") or 0)
+        ),
+        hedges_fired=gray.get("hedges_fired"),
+        hedges_won=gray.get("hedges_won"),
+        hedges_budget_denied=gray.get("hedges_budget_denied"),
+        hedge_bound=gray.get("hedge_bound"),
+        slow_routed_around=gray.get("slow_routed_around"),
+        restored=restore.get("restored"),
+        restore_s=restore.get("restore_s"),
+        tail_off=tail_off,
+    )
+    # the drill assembles its own violation list against the same
+    # budgets; carry it verbatim — the guard's job is the recorded row
+    if "error" in drill:
+        row["error"] = drill["error"]
+    return row
+
+
 def run_models_guard(timeout_s: float = 1800.0) -> dict:
     """Multi-model serving drill guard (round 15):
     tools/loopback_load.py --model-mix — zipf traffic over three
@@ -981,6 +1058,12 @@ def main() -> int:
             # recovering the hitset from the durable L2
             result = run_fleet_ha_guard()
             result["date"] = date
+        elif tok == "fleet-tail":
+            # tail-tolerance drill (round 17): gray backend detected
+            # and demoted in <5s, p99 contained within 1.5x baseline,
+            # hedges budgeted, restoration after disarm, tail-off pin
+            result = run_fleet_tail_guard()
+            result["date"] = date
         elif tok == "models":
             # multi-model paging drill (round 15): three backbones from
             # one pool under a budget that forces paging + the
@@ -1008,7 +1091,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'fleet-ha', 'models'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'models'])}",
             }
         else:
             n = int(tok)

@@ -63,6 +63,22 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "detection_s" in row or "p99_ratio" in row:
+        # tail-tolerance rows (round 17): gray detection time, the p99
+        # containment ratio, the hedge ledger and restoration in one
+        # line; error kept visible
+        line = (
+            f"gray detected {row.get('detection_s')}s "
+            f"(budget {row.get('detect_budget_s')}s), p99 x"
+            f"{row.get('p99_ratio')} of healthy "
+            f"(budget {row.get('p99_factor_budget')}), hedges "
+            f"{row.get('hedges_fired')}/{row.get('hedge_bound')} "
+            f"won={row.get('hedges_won')}, restored "
+            f"{row.get('restore_s')}s, errors={row.get('errors_total')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "recovered_ratio" in row:
         # zero-SPOF fleet-ha rows (round 16): the kill-phase loss count
         # and the rolling-restart L2 recovery in one line, error visible
