@@ -40,6 +40,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "jobs_dir", "jobs_workers", "jobs_queue_depth",
         "tenants", "qos_default_class",
         "serve_models", "pinned_models", "hbm_budget_bytes", "weight_dtype",
+        "quality_default", "quality_by_class", "calibration_dir",
+        "aot_dir", "aot_bytes",
         "l2_dir", "l2_bytes", "fleet_routers", "fleet_token",
         "fleet_advertise",
     ):
@@ -419,6 +421,33 @@ def main(argv: list[str] | None = None) -> int:
         metavar="f32|bf16|int8",
         help="stored weight precision in HBM (quantized tiers trade "
         "PSNR-bounded fidelity for resident models)",
+    )
+    s.add_argument(
+        "--quality-default", default=None, dest="quality_default",
+        metavar="full|bf16|int8",
+        help="precision tier for requests that name none via "
+        "quality=/x-quality (default full; see docs/API.md)",
+    )
+    s.add_argument(
+        "--quality-by-class", default=None, dest="quality_by_class",
+        metavar="CLASS=TIER,...",
+        help="per-QoS-class default tiers (default 'bulk=int8'; empty "
+        "disables class defaults)",
+    )
+    s.add_argument(
+        "--calibration-dir", default=None, dest="calibration_dir",
+        metavar="DIR",
+        help="per-model int8 calibration artifacts "
+        "(tools/calibrate.py; absent models use dynamic ranges)",
+    )
+    s.add_argument(
+        "--aot-dir", default=None, dest="aot_dir", metavar="DIR",
+        help="AOT compiled-artifact store: boot deserializes stored "
+        "executables instead of recompiling (default off)",
+    )
+    s.add_argument(
+        "--aot-bytes", type=int, default=None, dest="aot_bytes",
+        help="artifact-store byte budget (default 0 = unbounded)",
     )
     s.add_argument(
         "--l2-dir", default=None, dest="l2_dir", metavar="DIR",

@@ -234,6 +234,49 @@ class ServerConfig:
     # models per budget; the knob folds into the response-cache prefix
     # so a precision change invalidates every cached payload.
     weight_dtype: str = "f32"
+    # --- int8 execution tier + per-request quality (round 18) ---
+    # Server-default precision tier for requests that name none
+    # (``quality=`` form field wins, then the ``x-quality`` header,
+    # then the requester's QoS-class default below, then this):
+    # 'full' = the server's configured fidelity (byte-identical to the
+    # pre-round-18 path), 'bf16' = bfloat16 forward staging, 'int8' =
+    # int8 activations+kernels with int32 accumulation through the
+    # forward walk (sequential backbones; DAG models and dreams
+    # normalize down — docs/API.md "Quality tiers").  The RESOLVED tier
+    # folds into the response-cache key prefix, so an int8 body can
+    # never serve a full-fidelity request.
+    quality_default: str = "full"
+    # Per-QoS-class default tiers, 'class=tier,...' — applied only when
+    # QoS is on and the request names no tier itself.  The default maps
+    # the bulk class to int8: batch audits trade bounded fidelity
+    # (PSNR-floored, tests/test_quant_exec.py) for ~2x MXU throughput
+    # while interactive traffic keeps full fidelity.  Empty disables
+    # class-based defaults entirely.
+    quality_by_class: str = "bulk=int8"
+    # Directory of per-model calibration artifacts
+    # (<model>.calib.json, written by tools/calibrate.py): per-layer
+    # activation ranges snapshotted from representative traffic.  With
+    # an artifact, quality=int8 uses its static scales (the artifact
+    # digest rides the cache prefix — recalibration invalidates exactly
+    # the int8 entries); without one, ranges are computed in-graph per
+    # example ('dynamic').  Corrupt artifacts read as absent, never as
+    # an error.
+    calibration_dir: str = ""
+    # --- AOT compiled-artifact distribution (round 18: serving/aot.py) ---
+    # Directory for serialized compiled executables keyed by (model,
+    # program, quality, shape bucket, platform, jax version).  A warmup
+    # or first dispatch consults the store BEFORE compiling and
+    # deserializes on a hit, so a freshly autoscaled backend booting
+    # against a populated store (shared disk, or rsync'd from a peer —
+    # the L2 idiom) skips the compile storm.  Empty = DISABLED: no disk
+    # is touched and dispatch is byte-identical to the pre-round-18
+    # path.  Artifacts are digest-verified; corruption reads as a miss
+    # and recompiles, never an error.
+    aot_dir: str = ""
+    # Artifact-store byte budget; oldest entries (by last-use mtime)
+    # sweep when exceeded.  0 = unbounded (the executables are tens of
+    # MB each; see docs/OPERATIONS.md "Artifact store sizing").
+    aot_bytes: int = 0
     # --- fleet tier (round 14: serving/fleet.py) ---
     # Peer cache fill: honor the router's ``x-peer-fill: host:port``
     # hint on a cache miss — ask the key's PREVIOUS ring owner for the

@@ -35,6 +35,8 @@ from jax import lax
 
 from deconv_api_tpu import ops
 from deconv_api_tpu.models.spec import Entry, ModelSpec, entry_chain
+# the ONE symmetric-int8 convention, shared with the weight-at-rest tier
+from deconv_api_tpu.utils.quantize import Q8_LEVELS
 
 
 def _up_step(e: Entry, params, x, switches):
@@ -62,6 +64,57 @@ def _up_step(e: Entry, params, x, switches):
         b = params[l.name]["b"].astype(x.dtype)
         return ops.apply_activation(ops.dense(x, w, b), l.activation)
     raise AssertionError(l.kind)
+
+
+def _up_step_q8(e: Entry, params, x, amax):
+    """One int8-quantized forward step for a conv/dense entry (round 18,
+    quality=int8).
+
+    ``amax`` is the layer's input range — a static calibrated constant
+    (engine/quant.py artifact) or a traced per-example scalar (dynamic
+    fallback).  The input quantizes to symmetric int8 at
+    ``sx = amax/127``, the kernel in-graph per-tensor at
+    ``sw = max|w|/127`` (the weight-manager's scale convention, so a
+    weight_dtype=int8 archive and this walk agree), the contraction runs
+    int8×int8→int32 on the MXU (ops.conv2d_q8/dense_q8), and the bias
+    folds into the accumulator at the combined ``sx*sw`` scale.  For
+    relu/linear the activation applies ON the int32 accumulator
+    (ops.int8_safe_activation: relu commutes with the positive dequant
+    scale) so the layer pays exactly one dequant multiply; other
+    activations dequantise first."""
+    l = e.layer
+    w = params[l.name]["w"].astype(jnp.float32)
+    b = params[l.name]["b"].astype(jnp.float32)
+    # the utils/quantize.py convention, in traced form: a dead signal /
+    # all-zero kernel keeps scale 1.0 — flooring at an epsilon instead
+    # would make the scales (and the folded bias below) explode
+    aw = jnp.max(jnp.abs(w))
+    sx = jnp.where(amax > 0, amax, Q8_LEVELS) / Q8_LEVELS
+    sw = jnp.where(aw > 0, aw, Q8_LEVELS) / Q8_LEVELS
+    xq = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / sx), -Q8_LEVELS, Q8_LEVELS
+    ).astype(jnp.int8)
+    wq = jnp.clip(jnp.round(w / sw), -Q8_LEVELS, Q8_LEVELS).astype(jnp.int8)
+    if l.kind == "conv":
+        acc = ops.conv2d_q8(xq, wq, strides=l.strides, padding=l.padding)
+    else:
+        acc = ops.dense_q8(xq, wq)
+    scale = sx * sw
+    # Bias folds at the combined scale, CLAMPED so the int32 add can
+    # never overflow: |acc| <= 127*127*reduction < 2^28 for any real
+    # layer here, so ±2^30 leaves the add in range.  A bias that large
+    # relative to the scale (a near-dead layer's tiny amax under a real
+    # bias) saturates the layer either way — clamping degrades
+    # gracefully where a wrapped int32 would serve (and cache) garbage.
+    bq = jnp.clip(jnp.round(b / scale), -(2.0**30), 2.0**30).astype(
+        jnp.int32
+    )
+    acc = acc + bq
+    if ops.int8_safe_activation(l.activation):
+        if l.activation == "relu":
+            acc = jnp.maximum(acc, 0)
+        return acc.astype(jnp.float32) * scale
+    return ops.apply_activation(acc.astype(jnp.float32) * scale, l.activation)
 
 
 def _unpool_nchw(y, idx_nhwc, pool_size, out_hw, fuse_relu=False):
@@ -314,7 +367,9 @@ def _lowc_is_active(entries, fwd_lowc_bf16: int) -> bool:
     )
 
 
-def _forward_chain(entries, params, image, switches, lowc_active, lowc_thresh):
+def _forward_chain(
+    entries, params, image, switches, lowc_active, lowc_thresh, quant=None
+):
     """The forward walk shared by the visualizer and the forward-only
     prober (the probed forward must never drift from the measured
     program).  With ``lowc_active`` the signal runs bfloat16 while at most
@@ -322,10 +377,21 @@ def _forward_chain(entries, params, image, switches, lowc_active, lowc_thresh):
     conv/dense; after the walk any activation still bf16 (shallow chains,
     the sweep's block1/2 entries) is upcast so the prefix can never leak
     into selection seeds or outputs — free for deep layers, where unused
-    ups are dead code and XLA drops the casts with them."""
+    ups are dead code and XLA drops the casts with them.
+
+    ``quant`` (round 18, quality=int8) runs every conv/dense entry
+    through the int8 walk (``_up_step_q8``): None = off (the exact
+    pre-round-18 program), ``"dynamic"`` = per-EXAMPLE in-graph ranges
+    (per-example, never per-batch — the walk runs under vmap, so a
+    request's bytes can never depend on what it co-batched with), or a
+    tuple of (entry name, amax) calibrated static scales
+    (engine/quant.py artifacts; entries the artifact misses fall back
+    to dynamic).  Mutually exclusive with the bf16 prefix — the caller
+    resolves quant before lowc and passes at most one."""
     x = image[None]
     if lowc_active:
         x = x.astype(jnp.bfloat16)
+    calibrated = dict(quant) if isinstance(quant, tuple) else {}
     ups = []
     for e in entries:
         if (
@@ -338,7 +404,17 @@ def _forward_chain(entries, params, image, switches, lowc_active, lowc_thresh):
             # First layer wider than the threshold: the bf16 prefix ends
             # here.  No-op when the input itself is bf16 (DECONV_DTYPE).
             x = x.astype(image.dtype)
-        x = _up_step(e, params, x, switches)
+        if (
+            quant is not None
+            and not e.is_companion_act
+            and e.layer.kind in ("conv", "dense")
+        ):
+            amax = calibrated.get(e.name)
+            if amax is None:
+                amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+            x = _up_step_q8(e, params, x, amax)
+        else:
+            x = _up_step(e, params, x, switches)
         ups.append(x)
     if lowc_active and image.dtype != jnp.bfloat16:
         ups = [
@@ -568,6 +644,7 @@ def get_visualizer(
     sweep_chunk: int | None = None,
     fwd_lowc_bf16: int | None = None,
     donate: bool = False,
+    quant=None,
 ):
     """Build (and cache) the jitted visualizer for a static configuration.
 
@@ -596,6 +673,13 @@ def get_visualizer(
     input's memory, so the CALLER'S array is invalidated by the call —
     numerically inert (tests/test_donation_parity.py), and the serving
     dispatcher always passes freshly staged batches.
+    ``quant`` (round 18, quality=int8) runs the FORWARD walk with int8
+    activations/kernels and int32 accumulation: None = off (the default;
+    byte-identical program), ``"dynamic"`` = per-example in-graph
+    ranges, or a tuple of (entry, amax) calibrated scales
+    (engine/quant.py).  Selection and the backward projection keep their
+    existing dtypes; a quant request disables the fwd_lowc_bf16 prefix
+    (the two forward rewrites are mutually exclusive).
     """
     import os
 
@@ -650,10 +734,17 @@ def get_visualizer(
         # DECONV_DTYPE=bfloat16 (35.3 dB), so 0 (exact) stays the
         # default; see BASELINE.md round-4c.
         fwd_lowc_bf16 = _fwd_lowc_default()
+    if quant is not None:
+        if quant != "dynamic" and not isinstance(quant, tuple):
+            raise ValueError(
+                f"illegal quant spec {quant!r}; expected None, 'dynamic' "
+                "or a tuple of (entry, amax) pairs"
+            )
+        fwd_lowc_bf16 = 0  # mutually exclusive forward rewrites
     return _get_visualizer_cached(
         spec, layer_name, top_k, mode, bug_compat, sweep, batched,
         backward_dtype, kpack_chan, bool(sweep_merged), nchw_chan,
-        sweep_chunk, fwd_lowc_bf16, donate,
+        sweep_chunk, fwd_lowc_bf16, donate, quant,
     )
 
 
@@ -673,6 +764,7 @@ def _get_visualizer_cached(
     sweep_chunk: int = 0,
     fwd_lowc_bf16: int = 0,
     donate: bool = False,
+    quant=None,
 ):
     if donate:
         allow_unusable_donation()
@@ -710,7 +802,8 @@ def _get_visualizer_cached(
     def single(params, image):
         switches: dict[str, jnp.ndarray] = {}
         ups = _forward_chain(
-            entries, params, image, switches, lowc_active, fwd_lowc_bf16
+            entries, params, image, switches, lowc_active, fwd_lowc_bf16,
+            quant=quant,
         )
         if merged_active:
             return _sweep_merged(

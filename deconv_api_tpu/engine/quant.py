@@ -1,0 +1,206 @@
+"""Int8 execution-tier calibration: per-layer activation ranges as a
+digest-addressed artifact (round 18).
+
+PR 10 quantized the weights *at rest* (serving/weight_manager.py) but
+every program still ran f32/bf16 arithmetic.  This module is the
+calibration half of true int8 *execution* (quality=int8): the forward
+walk quantizes each conv/dense layer's input activations to symmetric
+int8 with a per-layer scale, runs the contraction int8×int8→int32 on
+the MXU (ops.conv2d_q8 / ops.dense_q8 — the ~2x-MACs serving lever the
+Gemma-on-Cloud-TPU comparison in PAPERS.md names as primary), folds the
+bias into the accumulator, and dequantises once per layer.
+
+The per-layer activation scales come from one of two places:
+
+- **A calibration artifact** — per-layer input max-abs ("ranges")
+  snapshotted from representative traffic by ``tools/calibrate.py``
+  (the flight recorder tells you WHICH layers/models live traffic
+  exercises; the golden-probe fixtures and any image directory feed the
+  range collection).  Stored one JSON file per model under a
+  calibration dir, tmp-then-rename, with a content digest that is
+  verified on load (corruption reads as absent, never an error) and
+  that rides the response-cache key prefix — recalibration invalidates
+  exactly the int8 entries.
+- **Dynamic per-example ranges** — with no artifact, each example's own
+  max-abs is computed in-graph per layer.  Deliberately per-EXAMPLE
+  (the walk runs under vmap), never per-batch: a batch-wide scale would
+  make a request's bytes depend on what it co-batched with, poisoning
+  the content-addressed cache.
+
+Both forms are deterministic per request; the serving layer tags the
+cache prefix with the artifact digest or ``dynamic`` so the two can
+never serve each other's bytes.
+
+Kernel scales are always per-tensor symmetric, computed in-graph from
+the (possibly dequantised) f32 weights with the SAME amax→scale rule as
+the weight-at-rest tier (serving/weight_manager.py ``int8_scale``), so
+``weight_dtype=int8`` storage and ``quality=int8`` execution agree on
+what a quantized kernel means.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from deconv_api_tpu.utils.quantize import Q8_LEVELS, int8_scale
+
+__all__ = [
+    "DYNAMIC",
+    "Q8_LEVELS",
+    "QUALITY_TIERS",
+    "collect_ranges",
+    "int8_scale",
+    "load_calibration",
+    "quant_spec",
+    "ranges_digest",
+    "save_calibration",
+]
+
+# The per-request quality vocabulary: the serving knob (``quality=``
+# form field / ``x-quality`` header, config quality_default /
+# quality_by_class) and the engine agree on it here.  'full' is the
+# server's configured fidelity (byte-identical to the pre-round-18
+# path), 'bf16' stages the forward in bfloat16, 'int8' runs the
+# quantized walk.
+QUALITY_TIERS = ("full", "bf16", "int8")
+
+# Sentinel quant spec: no calibration artifact — scales are computed
+# in-graph per example.  Hashable (it keys the visualizer cache).
+DYNAMIC = "dynamic"
+
+_CALIB_VERSION = 1
+
+
+def _canonical_ranges(ranges: dict) -> dict[str, float]:
+    """Ranges in their canonical serialized form: sorted keys, float32
+    values round-tripped through repr so the artifact's bytes — and
+    therefore its digest — are identical across runs and hosts."""
+    return {
+        str(k): float(np.float32(v)) for k, v in sorted(ranges.items())
+    }
+
+
+def ranges_digest(ranges: dict) -> str:
+    """Content digest of a calibration range set — what addresses the
+    artifact and rides the response-cache key prefix for quality=int8."""
+    blob = json.dumps(
+        _canonical_ranges(ranges), sort_keys=True, separators=(",", ":")
+    ).encode()
+    return hashlib.blake2b(blob, digest_size=12).hexdigest()
+
+
+def collect_ranges(spec, params, images, *, layer: str | None = None) -> dict:
+    """Per-layer input max-abs for every conv/dense entry of ``spec``'s
+    forward walk over ``images`` (an iterable of (H, W, C) preprocessed
+    float arrays) — the calibration set's range snapshot.
+
+    Built from the SAME entry chain and ``_up_step`` the visualizer
+    traces (engine/deconv.py), so a calibrated entry name always matches
+    the entry the quantized walk looks up — the two cannot drift.  The
+    observation forward runs full precision: ranges describe the exact
+    activations, not a quantized approximation of them.  Reduction over
+    images is max, so adding images only ever widens a range and a
+    fixed image set yields byte-identical artifacts (the round-trip
+    determinism test pins this)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deconv_api_tpu.engine.deconv import _up_step
+    from deconv_api_tpu.models.spec import entry_chain
+
+    target = layer or spec.layers[-1].name
+    entries = entry_chain(spec.truncated(target))
+
+    def observe(p, image):
+        switches: dict = {}
+        x = image[None].astype(jnp.float32)
+        out = {}
+        for e in entries:
+            if not e.is_companion_act and e.layer.kind in ("conv", "dense"):
+                out[e.name] = jnp.max(jnp.abs(x))
+            x = _up_step(e, p, x, switches)
+        return out
+
+    fn = jax.jit(observe)
+    ranges: dict[str, float] = {}
+    for img in images:
+        got = jax.device_get(fn(params, jnp.asarray(img, jnp.float32)))
+        for name, amax in got.items():
+            a = float(amax)
+            if name not in ranges or a > ranges[name]:
+                ranges[name] = a
+    return _canonical_ranges(ranges)
+
+
+def save_calibration(
+    calib_dir: str,
+    model: str,
+    ranges: dict,
+    *,
+    image_size: int = 0,
+    n_images: int = 0,
+    source: str = "",
+) -> tuple[str, str]:
+    """Write one model's calibration artifact (tmp-then-rename — the
+    SpillStore idiom; a crash leaves either the old complete file or a
+    stale ``.tmp``) and return ``(path, digest)``.  The file lives at
+    ``<calib_dir>/<model>.calib.json`` so the server finds it by model
+    name; the content digest inside addresses the range set and is
+    verified on every load."""
+    os.makedirs(calib_dir, exist_ok=True)
+    canon = _canonical_ranges(ranges)
+    digest = ranges_digest(canon)
+    payload = {
+        "v": _CALIB_VERSION,
+        "model": model,
+        "image_size": int(image_size),
+        "n_images": int(n_images),
+        "source": source,
+        "ranges": canon,
+        "digest": digest,
+    }
+    path = os.path.join(calib_dir, f"{model}.calib.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True, separators=(",", ":"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path, digest
+
+
+def load_calibration(calib_dir: str, model: str) -> dict | None:
+    """One model's verified calibration artifact, or None — a missing,
+    torn, or digest-mismatched file reads as ABSENT (the server then
+    falls back to dynamic ranges), never as an error: calibration is an
+    accuracy optimization, it must not be able to fail requests."""
+    path = os.path.join(calib_dir, f"{model}.calib.json")
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (
+        not isinstance(payload, dict)
+        or payload.get("v") != _CALIB_VERSION
+        or not isinstance(payload.get("ranges"), dict)
+        or not payload.get("ranges")
+    ):
+        return None
+    try:
+        if ranges_digest(payload["ranges"]) != payload.get("digest"):
+            return None
+    except (TypeError, ValueError):
+        return None
+    return payload
+
+
+def quant_spec(ranges: dict) -> tuple:
+    """A calibration range set as the hashable static-scale spec the
+    visualizer cache keys on (engine/deconv.py ``quant=``): sorted
+    (entry name, amax) pairs."""
+    return tuple(sorted(_canonical_ranges(ranges).items()))

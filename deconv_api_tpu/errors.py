@@ -43,6 +43,15 @@ class IllegalMode(DeconvError):
     code = "illegal_visualize_mode"
 
 
+class IllegalQuality(DeconvError):
+    """The per-request precision tier (``quality=`` form field /
+    ``x-quality`` header, round 18) named something outside
+    full|bf16|int8 — deterministic, negative-cacheable."""
+
+    status = 422
+    code = "illegal_quality"
+
+
 class NoActiveFilters(DeconvError):
     """Fewer filters fired than requested; the reference IndexErrors into a
     500 here (SURVEY §2.2.4).  Serving pads the grid instead; this error is

@@ -10,6 +10,7 @@ from deconv_api_tpu.ops.activations import (
     apply_activation,
     deconv_relu,
     deconv_relu6,
+    int8_safe_activation,
     relu,
     relu6,
     softmax,
@@ -18,12 +19,14 @@ from deconv_api_tpu.ops.conv import (
     conv2d,
     conv2d_input_backward,
     conv2d_input_backward_grouped,
+    conv2d_q8,
     flip_kernel,
     tile_kernel_groups,
 )
 from deconv_api_tpu.ops.linear import (
     dense,
     dense_input_backward,
+    dense_q8,
     flatten,
     unflatten,
 )
@@ -40,11 +43,14 @@ __all__ = [
     "conv2d",
     "conv2d_input_backward",
     "conv2d_input_backward_grouped",
+    "conv2d_q8",
     "deconv_relu",
     "deconv_relu6",
     "dense",
     "dense_input_backward",
+    "dense_q8",
     "flatten",
+    "int8_safe_activation",
     "flip_kernel",
     "maxpool_with_argmax",
     "maxpool_with_switches",

@@ -29,6 +29,23 @@ def relu6(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.minimum(jnp.maximum(x, 0), 6.0).astype(x.dtype)
 
 
+# Activations the int8 execution tier (round 18, engine/quant.py) may
+# apply directly on the int32 accumulator BEFORE the dequant multiply:
+# with the bias folded into the accumulator at the combined
+# input*kernel scale, relu commutes with the (strictly positive) scale
+# — max(s*a, 0) == s*max(a, 0) — and linear is the identity.  relu6's
+# cap and softmax's normalisation do NOT commute with an arbitrary
+# scale; layers carrying them dequantise first and apply the f32
+# activation (apply_activation) like the unquantized walk.
+INT8_SAFE_ACTIVATIONS = ("linear", "relu")
+
+
+def int8_safe_activation(name: str) -> bool:
+    """Whether the named activation may run on the int32 accumulator
+    (see INT8_SAFE_ACTIVATIONS)."""
+    return name in INT8_SAFE_ACTIVATIONS
+
+
 _ACTIVATIONS = {
     "linear": lambda x: x,
     "relu": relu,

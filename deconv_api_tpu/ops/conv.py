@@ -52,6 +52,38 @@ def conv2d(
     return y
 
 
+def conv2d_q8(
+    x_q: jnp.ndarray,
+    w_q: jnp.ndarray,
+    *,
+    strides: Sequence[int] = (1, 1),
+    padding: str | Sequence[tuple[int, int]] = "SAME",
+    feature_group_count: int = 1,
+) -> jnp.ndarray:
+    """Int8 forward convolution with int32 accumulation (round 18).
+
+    ``x_q``/``w_q`` are int8 NHWC / HWIO tensors already quantized by the
+    caller (per-layer symmetric activation scales, per-tensor symmetric
+    kernel scales — engine/quant.py owns the scale bookkeeping); the
+    result is the raw int32 accumulator.  ``preferred_element_type=int32``
+    is what lets XLA:TPU issue the 8-bit MXU form at ~2x the f32 MACs —
+    an f32 accumulator would silently upcast the whole contraction.  Bias
+    add, activation and dequantisation are the caller's: the bias folds
+    into the accumulator at the combined input*kernel scale so ReLU can
+    run on int32 before the single dequant multiply (ops/activations.py
+    ``int8_safe_activation``).
+    """
+    return lax.conv_general_dilated(
+        x_q,
+        w_q,
+        window_strides=tuple(strides),
+        padding=padding if isinstance(padding, str) else tuple(padding),
+        dimension_numbers=DIMENSION_NUMBERS,
+        feature_group_count=feature_group_count,
+        preferred_element_type=jnp.int32,
+    )
+
+
 def flip_kernel(w: jnp.ndarray) -> jnp.ndarray:
     """Spatially flip an HWIO kernel and swap its in/out channels.
 

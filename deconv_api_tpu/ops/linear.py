@@ -13,6 +13,7 @@ import math
 from typing import Sequence
 
 import jax.numpy as jnp
+from jax import lax
 
 
 def dense(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray | None = None) -> jnp.ndarray:
@@ -21,6 +22,23 @@ def dense(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray | None = None) -> jnp.n
     if b is not None:
         y = y + b
     return y
+
+
+def dense_q8(x_q: jnp.ndarray, w_q: jnp.ndarray) -> jnp.ndarray:
+    """Int8 forward dense with int32 accumulation (round 18): the
+    ``lax.dot_general`` twin of ops.conv.conv2d_q8.  Inputs are int8
+    (caller-quantized, engine/quant.py owns the scales); the result is
+    the raw int32 accumulator — bias fold, activation and the dequant
+    multiply happen at the caller's combined scale.  A plain ``x @ w``
+    on int8 would overflow at int8 precision or upcast to f32; the
+    explicit ``preferred_element_type`` keeps the contraction on the
+    8-bit MXU form with a 32-bit accumulator."""
+    return lax.dot_general(
+        x_q,
+        w_q,
+        (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
 
 
 def dense_input_backward(y: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,358 @@
+"""AOT compiled-artifact distribution: serialize compiled executables to
+a digest-verified store so a fleet compiles once and boots warm (round 18).
+
+The persistent XLA compilation cache (config.compilation_cache_dir) is
+per-HOST state keyed by internals we don't control; a freshly autoscaled
+backend still pays the full compile storm before serving its first byte.
+TVM's framing (PAPERS.md) treats ahead-of-time compilation and artifact
+*distribution* as a first-class serving concern — this module is that
+tier for the visualizer programs:
+
+- ``ArtifactStore``: one file per artifact under ``aot_dir``, the
+  L2/SpillStore idiom end to end — tmp-then-rename with fsync (a crash
+  leaves a complete entry or a swept ``.tmp``), a JSON header line
+  carrying the payload's blake2b digest (ANY defect — torn header,
+  short body, digest mismatch — deletes the file and reads as a miss,
+  never an error), an mtime-LRU byte budget, and
+  ``aot_cache_{hits,misses,stores,corrupt,errors}_total`` counters plus
+  resident-bytes/entries gauges through the injected Metrics registry.
+
+- ``AotExecutor``: the dispatch-side resolver.  Keyed by the canonical
+  program metadata — (model, program tuple, quality/calibration tag,
+  shape bucket, dtypes, weight tier, platform, jax version) — it
+  deserializes a stored executable instead of compiling
+  (``jax.experimental.serialize_executable``), or compiles via the
+  jitted fn's AOT path (``.lower(...).compile()``), serializes, and
+  stores.  Every failure mode falls back to the plain jitted fn: the
+  artifact tier may only ever SAVE work.
+
+Artifacts embed pickled jax pytree metadata, so the store trusts its
+directory exactly like the XLA compile cache trusts its own — point
+``aot_dir`` at operator-controlled storage (a shared volume is the
+compile-once-run-fleet-wide deployment; docs/OPERATIONS.md), never at a
+world-writable path.  Executables are platform- and version-bound; both
+ride the key, so a mixed-version fleet simply misses instead of loading
+an incompatible artifact.
+
+Single-stream scope: executables deserialize onto the default device,
+so the service engages this tier only without a mesh and with one
+executor lane (the autoscale cold-boot shape the warm-boot drill pins);
+multi-lane pools keep the per-lane jit path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import re
+import threading
+
+from deconv_api_tpu.utils import slog
+
+_log = slog.get_logger("deconv.aot")
+
+_KEY_RE = re.compile(r"^[0-9a-f]{16,128}$")
+_HEADER_MAX = 4096
+_VERSION = 1
+
+
+def artifact_digest(meta: dict) -> str:
+    """Canonical digest of a program's identity metadata — the artifact
+    address.  Everything execution-determining must ride ``meta``
+    (model, program key, quality/calibration tag, shape bucket, dtypes,
+    platform, jax version): two programs that could compile differently
+    must never share an address."""
+    blob = json.dumps(meta, sort_keys=True, separators=(",", ":"), default=str)
+    return hashlib.blake2b(blob.encode(), digest_size=16).hexdigest()
+
+
+class ArtifactStore:
+    """Durable compiled-artifact files under ``root`` (see module
+    docstring).  Thread-safe: dispatch workers read and write it."""
+
+    def __init__(self, root: str, max_bytes: int = 0, *, metrics=None):
+        self.root = root
+        self.max_bytes = int(max_bytes)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+        # sweep stale .tmp from a crashed writer; size the ledger
+        self._resident = 0
+        self._entries = 0
+        for fn in self._listdir():
+            path = os.path.join(self.root, fn)
+            if fn.endswith(".tmp"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            if fn.endswith(".aot"):
+                try:
+                    self._resident += os.stat(path).st_size
+                    self._entries += 1
+                except OSError:
+                    pass
+        self._publish()
+
+    def _listdir(self) -> list[str]:
+        try:
+            return os.listdir(self.root)
+        except OSError:
+            return []
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self._metrics is not None:
+            self._metrics.inc_counter(name, n)
+
+    def _publish(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge("aot_store_resident_bytes", self._resident)
+            self._metrics.set_gauge("aot_store_entries", self._entries)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + ".aot")
+
+    @property
+    def entry_count(self) -> int:
+        with self._lock:
+            return self._entries
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident
+
+    def get(self, key: str) -> bytes | None:
+        """The verified artifact payload, or None.  Corruption in any
+        form deletes the file and counts ``aot_cache_corrupt_total`` on
+        top of the miss — the tier degrades to a recompile, it can never
+        raise or load wrong bytes."""
+        if not _KEY_RE.match(key):
+            return None
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            # absent: the RESOLVER counts the miss (one miss per
+            # program resolution, not per probe)
+            return None
+        head, sep, body = raw.partition(b"\n")
+        ok = bool(sep) and len(head) <= _HEADER_MAX
+        meta = None
+        if ok:
+            try:
+                meta = json.loads(head)
+            except ValueError:
+                ok = False
+        if ok:
+            ok = (
+                isinstance(meta, dict)
+                and meta.get("v") == _VERSION
+                and meta.get("len") == len(body)
+                and meta.get("digest")
+                == hashlib.blake2b(body, digest_size=16).hexdigest()
+            )
+        if not ok:
+            slog.event(
+                _log, "aot_corrupt_artifact", level=logging.WARNING, key=key
+            )
+            self.invalidate(key)
+            self._count("aot_cache_corrupt_total")
+            return None
+        try:
+            # recency survives restarts: the budget sweep is mtime-LRU
+            os.utime(path)
+        except OSError:
+            pass
+        # NOT counted as a hit here: a digest-valid payload can still
+        # fail to deserialize (a pickle from an incompatible wheel) —
+        # the RESOLVER counts the hit only once the executable loads,
+        # so hits+misses sums to resolutions and the autoscaler gate
+        # ("hits == warmed programs, 0 misses") stays truthful.
+        return body
+
+    def put(self, key: str, payload: bytes) -> bool:
+        """Store one artifact (tmp-then-rename + fsync); sweeps
+        oldest-mtime entries past the byte budget.  Returns whether
+        stored (an artifact larger than the whole budget is not)."""
+        if not _KEY_RE.match(key):
+            return False
+        head = json.dumps(
+            {
+                "v": _VERSION,
+                "len": len(payload),
+                "digest": hashlib.blake2b(payload, digest_size=16).hexdigest(),
+            },
+            separators=(",", ":"),
+        ).encode()
+        data = head + b"\n" + payload
+        if self.max_bytes and len(data) > self.max_bytes:
+            return False
+        path = self._path(key)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            slog.event(
+                _log, "aot_write_error", level=logging.ERROR,
+                key=key, error=f"{type(e).__name__}: {e}",
+            )
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        self._count("aot_cache_stores_total")
+        self._resweep()
+        return True
+
+    def invalidate(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+        self._resweep(count_sweeps=False)
+
+    def _resweep(self, count_sweeps: bool = True) -> None:
+        """Re-derive the ledger from the directory and enforce the byte
+        budget oldest-mtime-first.  Stat-walking per put is fine at this
+        tier's write rate (one write per program per process LIFETIME)."""
+        entries: list[tuple[float, str, int]] = []
+        for fn in self._listdir():
+            if not fn.endswith(".aot"):
+                continue
+            path = os.path.join(self.root, fn)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, fn, st.st_size))
+        entries.sort()
+        total = sum(size for _mt, _fn, size in entries)
+        swept = 0
+        while self.max_bytes and total > self.max_bytes and len(entries) > 1:
+            _mt, fn, size = entries.pop(0)
+            try:
+                os.unlink(os.path.join(self.root, fn))
+            except OSError:
+                pass
+            total -= size
+            swept += 1
+        if swept and count_sweeps:
+            self._count("aot_cache_sweeps_total", swept)
+        with self._lock:
+            self._resident = total
+            self._entries = len(entries)
+        self._publish()
+
+
+class AotExecutor:
+    """Resolve a program's compiled executable through the artifact
+    store (see module docstring).  One in-memory executable per artifact
+    digest; resolution is locked so concurrent dispatches compile a
+    cold program once."""
+
+    def __init__(self, store: ArtifactStore, *, metrics=None):
+        self.store = store
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._loaded: dict[str, object] = {}
+        # digests that failed to serialize/compile through the AOT path:
+        # fall back to the plain jit fn WITHOUT re-attempting per batch
+        self._broken: set[str] = set()
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self._metrics is not None:
+            self._metrics.inc_counter(name, n)
+
+    def resolve(self, meta: dict, jitfn, params, batch_spec):
+        """The callable one dispatch should run: a stored/loaded
+        compiled executable when possible, else ``jitfn`` itself.
+
+        ``meta`` is the program's identity (artifact_digest); ``params``
+        the concrete device tree (its leaves' shapes/dtypes abstract the
+        first argument); ``batch_spec`` a jax.ShapeDtypeStruct for the
+        staged batch.  NEVER raises — any failure returns ``jitfn`` and
+        counts ``aot_cache_errors_total``."""
+        try:
+            digest = artifact_digest(meta)
+        except Exception:  # noqa: BLE001 — unkeyable program: plain jit
+            self._count("aot_cache_errors_total")
+            return jitfn
+        fn = self._loaded.get(digest)
+        if fn is not None:
+            return fn
+        if digest in self._broken:
+            return jitfn
+        with self._lock:
+            fn = self._loaded.get(digest)
+            if fn is not None:
+                return fn
+            if digest in self._broken:
+                return jitfn
+            payload = self.store.get(digest)
+            if payload is not None:
+                fn = self._load(digest, payload)
+                if fn is not None:
+                    self._count("aot_cache_hits_total")
+                    self._loaded[digest] = fn
+                    return fn
+                # corrupt-but-verified payloads (e.g. a different jax
+                # wheel's pickle) already invalidated in _load
+            self._count("aot_cache_misses_total")
+            fn = self._compile_store(digest, jitfn, params, batch_spec)
+            if fn is None:
+                self._broken.add(digest)
+                return jitfn
+            self._loaded[digest] = fn
+            return fn
+
+    def _load(self, digest: str, payload: bytes):
+        import jax  # noqa: F401 — deserialization needs a live backend
+
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        try:
+            serialized, in_tree, out_tree = pickle.loads(payload)
+            return deserialize_and_load(serialized, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 — any defect = miss
+            slog.event(
+                _log, "aot_load_error", level=logging.WARNING,
+                key=digest, error=f"{type(e).__name__}: {e}",
+            )
+            self.store.invalidate(digest)
+            self._count("aot_cache_corrupt_total")
+            return None
+
+    def _compile_store(self, digest: str, jitfn, params, batch_spec):
+        import jax
+        from jax.experimental.serialize_executable import serialize
+
+        try:
+            abstract = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params
+            )
+            compiled = jitfn.lower(abstract, batch_spec).compile()
+            serialized, in_tree, out_tree = serialize(compiled)
+            self.store.put(
+                digest, pickle.dumps((serialized, in_tree, out_tree))
+            )
+            return compiled
+        except Exception as e:  # noqa: BLE001 — AOT is an optimization
+            slog.event(
+                _log, "aot_compile_error", level=logging.WARNING,
+                key=digest, error=f"{type(e).__name__}: {e}",
+            )
+            self._count("aot_cache_errors_total")
+            return None

@@ -90,6 +90,41 @@ class DeconvService:
                 f"weight_dtype must be one of {WEIGHT_DTYPES}, got "
                 f"{self.cfg.weight_dtype!r}"
             )
+        # Per-request quality tiers (round 18): validate the vocabulary
+        # and the class map at BOOT — a typo'd tier must fail the
+        # process, not the first bulk request.
+        from deconv_api_tpu.engine.quant import QUALITY_TIERS
+
+        if self.cfg.quality_default not in QUALITY_TIERS:
+            raise ValueError(
+                f"quality_default must be one of {QUALITY_TIERS}, got "
+                f"{self.cfg.quality_default!r}"
+            )
+        self._class_quality: dict[str, str] = {}
+        for part in (self.cfg.quality_by_class or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            cls, _, tier = part.partition("=")
+            cls, tier = cls.strip(), tier.strip()
+            if cls not in ("interactive", "standard", "bulk"):
+                raise ValueError(
+                    f"quality_by_class: unknown class {cls!r} (expected "
+                    "interactive|standard|bulk)"
+                )
+            if tier not in QUALITY_TIERS:
+                raise ValueError(
+                    f"quality_by_class: tier for {cls!r} must be one of "
+                    f"{QUALITY_TIERS}, got {tier!r}"
+                )
+            self._class_quality[cls] = tier
+        # per-model calibration artifacts (engine/quant.py): (quant spec
+        # for the visualizer cache, the digest tag that rides the
+        # response-cache prefix).  Lazily consulted per model; with a
+        # calibration_dir the served set loads EAGERLY below so /readyz
+        # reports the calibrated set from the first probe and the first
+        # int8 dispatch never does file I/O on a worker thread.
+        self._calib_cache: dict[str, tuple] = {}
         # ``registry`` (round 15): the model-builder map this process
         # serves from — defaults to the real REGISTRY; tests and drills
         # inject small spec families to exercise paging without 224²
@@ -224,6 +259,12 @@ class DeconvService:
             metrics=self.metrics,
             weights_loader=self._load_weights,
         )
+        if self.cfg.calibration_dir:
+            # eager calibration load (round 18): pure file reads — no
+            # weights, no device — so boot stays cheap and the /readyz
+            # quality block is truthful from the first probe
+            for name in sorted(self.weights.served):
+                self._quant_spec(name)
         # warmup() records its wall time here; /v1/config reports it so
         # the compile-cache A/B (cold vs warm restart) is observable on
         # a live server
@@ -392,6 +433,68 @@ class DeconvService:
             else None
         )
         self.flights = Singleflight() if self.cfg.singleflight else None
+        # AOT compiled-artifact distribution (round 18, serving/aot.py):
+        # visualizer executables serialize into a digest-verified store
+        # keyed by (model, program, quality, bucket, platform, jax
+        # version), so a second process booting against the same (or
+        # synced) aot_dir DESERIALIZES instead of recompiling — the
+        # autoscale warm-boot path the `aot-boot` bench token pins.
+        # Single-stream scope: executables bind to the default device,
+        # so a mesh or multi-lane pool keeps the per-lane jit path.
+        self.aot = None
+        if self.cfg.aot_dir:
+            if self.mesh is None and self.lane_count == 1:
+                from deconv_api_tpu.serving.aot import (
+                    AotExecutor,
+                    ArtifactStore,
+                )
+
+                self.aot = AotExecutor(
+                    ArtifactStore(
+                        self.cfg.aot_dir,
+                        self.cfg.aot_bytes,
+                        metrics=self.metrics,
+                    ),
+                    metrics=self.metrics,
+                )
+                # the process-constant slice of every artifact key,
+                # built ONCE: per-dispatch meta only adds the program-
+                # shaped fields (env knobs included — a stored
+                # executable compiled under one setting must never
+                # serve a process running another)
+                import jax as _jax
+
+                self._aot_static = {
+                    "bug_compat": self.cfg.bug_compat,
+                    "strict_compat": self.cfg.strict_compat,
+                    "backward_dtype": self.cfg.backward_dtype,
+                    "lowc_kpack": self.cfg.lowc_kpack,
+                    "fwd_lowc_bf16": os.environ.get(
+                        "DECONV_FWD_LOWC_BF16", "0"
+                    ),
+                    "kpack_env": os.environ.get("DECONV_KPACK_CHAN", ""),
+                    "tail_nchw": os.environ.get("DECONV_TAIL_NCHW", "0"),
+                    "sweep_merged": os.environ.get(
+                        "DECONV_SWEEP_MERGED", "0"
+                    ),
+                    "sweep_chunk": os.environ.get(
+                        "DECONV_SWEEP_CHUNK", "2"
+                    ),
+                    "weight_dtype": self.cfg.weight_dtype,
+                    "donate": self.cfg.donate_inputs,
+                    "platform": _jax.default_backend(),
+                    "jax": _jax.__version__,
+                }
+            else:
+                from deconv_api_tpu.utils import slog as _slog
+
+                _slog.event(
+                    _slog.get_logger("deconv.app"), "aot_disabled",
+                    level=30, mesh=self.mesh is not None,
+                    lanes=self.lane_count,
+                    note="AOT artifacts are single-stream only; "
+                    "mesh/multi-lane pools keep the jit path",
+                )
         # drain announcement sent at most once per process lifetime
         # (round 16 self-registration; both serve_forever and stop()
         # announce, whichever runs first wins)
@@ -660,6 +763,101 @@ class DeconvService:
             tr.annotate(model=name)
         return name
 
+    def _resolve_quality(self, req: Request, form: dict | None = None) -> str:
+        """Resolve and validate the request's precision tier (round 18):
+        ``quality=`` form field (wins), then ``x-quality`` header, then
+        the requester's QoS-class default (quality_by_class — bulk maps
+        to int8 out of the box), then the server's quality_default.
+        Memoized on the request so the cache wrap, route handler, and
+        jobs tier agree on ONE resolution.  Garbage raises
+        IllegalQuality (422, deterministic → negative-cacheable)."""
+        from deconv_api_tpu.engine.quant import QUALITY_TIERS
+
+        if req.quality:
+            return req.quality
+        if form is None:
+            try:
+                form = req.form()
+            except Exception:  # noqa: BLE001 — unparseable body: the
+                form = {}  # handler 400s it; quality defaults
+        raw = (
+            form.get("quality") or req.headers.get("x-quality", "")
+        ).strip().lower()
+        if not raw:
+            raw = (
+                self._class_quality.get(req.tclass, "")
+                or self.cfg.quality_default
+            )
+        if raw not in QUALITY_TIERS:
+            raise errors.IllegalQuality(
+                f"quality must be one of {QUALITY_TIERS}, got {raw!r}"
+            )
+        req.quality = raw
+        tr = trace_mod.current_trace()
+        if tr is not None and raw != "full":
+            tr.annotate(quality=raw)
+        return raw
+
+    def _effective_quality(
+        self, quality: str, bundle, route: str = ""
+    ) -> str:
+        """The tier a (model, route) pair actually EXECUTES — and the
+        one that rides cache keys, so spellings that compile the same
+        program can never fragment the hot set (the backward_dtype
+        normalization rule):
+
+        - dreams are a true-gradient ascent with no quantized form:
+          every tier normalizes to full;
+        - DAG backbones (vjp walk — no int8 forward) normalize int8
+          down to bf16;
+        - a server already running bfloat16 forwards (cfg.dtype)
+          normalizes bf16 to full (the tiers are identical programs).
+        """
+        if quality == "full" or route == "/v1/dream":
+            return "full"
+        if quality == "int8" and bundle is not None and bundle.spec is None:
+            quality = "bf16"
+        if quality == "bf16" and self.cfg.dtype == "bfloat16":
+            return "full"
+        return quality
+
+    def _quality_prefix(self, eq: str, model: str) -> str:
+        """The cache-key prefix suffix one EFFECTIVE quality tier
+        contributes — '' for full (keys stay byte-identical to
+        pre-round-18), the tier name for bf16, and the tier plus the
+        model's calibration digest for int8 (recalibration invalidates
+        exactly the int8 entries).  Shared by the response-cache wrap
+        and the jobs idempotency digest so the two can never disagree."""
+        if eq == "int8":
+            return f"|q=int8:{self._quant_spec(model)[1]}"
+        if eq != "full":
+            return f"|q={eq}"
+        return ""
+
+    def _quant_spec(self, model: str) -> tuple:
+        """The int8 walk's scale source for one model: ``(quant, tag)``
+        where ``quant`` is the calibrated (entry, amax) tuple from the
+        model's artifact — whose digest ``tag`` rides the cache prefix,
+        so recalibration invalidates exactly the int8 entries — or
+        ``("dynamic", "dynamic")`` when no (valid) artifact exists.
+        Cached per model; a corrupt artifact reads as absent."""
+        got = self._calib_cache.get(model)
+        if got is not None:
+            return got
+        quant: object = "dynamic"
+        tag = "dynamic"
+        if self.cfg.calibration_dir:
+            from deconv_api_tpu.engine import quant as quant_mod
+
+            payload = quant_mod.load_calibration(
+                self.cfg.calibration_dir, model
+            )
+            if payload is not None:
+                quant = quant_mod.quant_spec(payload["ranges"])
+                tag = payload["digest"]
+        self._calib_cache[model] = (quant, tag)
+        return quant, tag
+
     async def _bundle_async(self, model: str):
         """The model's bundle without blocking the event loop: a dict
         hit when built, else the (possibly expensive — weight init +
@@ -676,6 +874,20 @@ class DeconvService:
         keep their shapes — and _dispatch_inner strips a leading served
         model name back off."""
         return key if model == self.weights.default else (model, *key)
+
+    @staticmethod
+    def _quality_key(key: tuple, quality: str) -> tuple:
+        """Dispatcher keys gain the quality dimension (round 18):
+        batches only group within one precision tier (an int8 batch must
+        never share a device program with a full-fidelity request).
+        Full-quality keys stay EXACTLY the pre-round-18 tuples; other
+        tiers append (sweep, quality) so _dispatch_inner's
+        ``*rest`` parse reads ``(sweep,)`` or ``(sweep, quality)``."""
+        if quality == "full":
+            return key
+        layer, mode, top_k, post, *rest = key
+        sweep = bool(rest[0]) if rest else False
+        return (layer, mode, top_k, post, sweep, quality)
 
     # ---------------------------------------------------------- device side
 
@@ -773,9 +985,21 @@ class DeconvService:
             return self._dispatch_dream(model, bundle, key, images, lane)
         if key[0] == "__dream_octave__":
             return self._dispatch_dream_octave(model, bundle, key, images, lane)
-        # 4-tuple: single-layer (the default); 5-tuple adds sweep=True
+        # 4-tuple: single-layer (the default); 5-tuple adds sweep=True;
+        # 6-tuple (round 18) adds the non-full quality tier
         layer_name, mode, top_k, post, *rest = key
         sweep = bool(rest[0]) if rest else False
+        quality = rest[1] if len(rest) > 1 else "full"
+        # quality=int8 (round 18): the forward walk runs int8
+        # arithmetic against the model's calibrated (or dynamic)
+        # per-layer scales; a distinct program per (scales, tier), a
+        # distinct batch group per tier by key construction
+        quant = None
+        if quality == "int8":
+            quant = self._quant_spec(model)[0]
+            self.metrics.inc_counter("quant_int8_batches_total")
+        elif quality == "bf16":
+            self.metrics.inc_counter("quant_bf16_batches_total")
         # The device postprocess (stitch/deprocess to uint8) is FUSED into
         # the visualizer program: one device dispatch per batch instead of
         # two, the fp32 projections never round-trip HBM between programs,
@@ -784,7 +1008,7 @@ class DeconvService:
             layer_name, mode, top_k, self.cfg.bug_compat,
             self.cfg.backward_dtype or None, post, sweep,
             donate=self.cfg.donate_inputs, lane=lane,
-            lowc_kpack=self.cfg.lowc_kpack,
+            lowc_kpack=self.cfg.lowc_kpack, quant=quant,
         )
         bucket = self._bucket_for(len(images))
         # cfg.dtype is the forward/selection dtype (the engine follows the
@@ -793,14 +1017,48 @@ class DeconvService:
         # and is an explicit opt-in — full-depth bf16-forward parity is
         # 35.3 dB deprocessed vs the fp64 oracle, under the 40 dB bar
         # (BASELINE.md round-4c; floors in tests/test_full_depth_parity.py).
+        # quality=bf16 stages THIS batch bfloat16 (the per-request form
+        # of the same trade); quality=int8 stages f32 — the walk
+        # quantizes per layer from the exact input.
         fwd_dtype = (
-            jnp.bfloat16 if self.cfg.dtype == "bfloat16" else jnp.float32
+            jnp.bfloat16
+            if (self.cfg.dtype == "bfloat16" or quality == "bf16")
+            else jnp.float32
         )
         # checkout pages the model's weights into this lane's HBM if
         # cold (one coalesced transfer per (model, lane)) and PINS them
         # against eviction until the results are materialised — BEFORE
         # the ring slot is claimed, so a failed page-in leaks nothing
         params, page_s = self.weights.checkout(model, lane)
+        if self.aot is not None:
+            # AOT artifact resolution (round 18): swap the jitted fn for
+            # a stored/compiled executable.  Keyed by everything that
+            # changes the compiled program — the process-constant slice
+            # was built once at boot (_aot_static); resolve() never
+            # raises — any failure falls back to the jit path.
+            import jax
+
+            fn = self.aot.resolve(
+                {
+                    **self._aot_static,
+                    "model": model, "layer": layer_name, "mode": mode,
+                    "k": top_k, "post": post, "sweep": sweep,
+                    "quality": quality,
+                    "calib": (
+                        self._quant_spec(model)[1]
+                        if quality == "int8"
+                        else ""
+                    ),
+                    "dtype": jnp.dtype(fwd_dtype).name,
+                    "bucket": bucket,
+                    "hw": list(images[0].shape),
+                },
+                fn,
+                params,
+                jax.ShapeDtypeStruct(
+                    (bucket, *images[0].shape), fwd_dtype
+                ),
+            )
         # Assemble the padded batch into a reusable input-ring buffer
         # (released after materialise — device execution complete), and
         # DONATE the device copy into the program: the device reuses the
@@ -1205,6 +1463,10 @@ class DeconvService:
         self.warmup_wall_s = round(
             (self.warmup_wall_s or 0.0) + time.perf_counter() - t_start, 3
         )
+        # the exposition twin of /v1/config's warmup_wall_s (round 18):
+        # the number the AOT artifact store attacks — a warm-boot
+        # dashboard reads compile-tax-per-boot straight off /metrics
+        self.metrics.set_gauge("warmup_seconds", self.warmup_wall_s)
         self.ready = True
 
     # ----------------------------------------------------------- pipeline
@@ -1234,6 +1496,7 @@ class DeconvService:
         tenant: str = "",
         tclass: str = "",
         model: str | None = None,
+        quality: str = "full",
     ):
         if not self.ready:
             # Pre-warmup requests would silently pay a full XLA compile
@@ -1245,6 +1508,9 @@ class DeconvService:
             )
         model = model or self.weights.default
         bundle = await self._bundle_async(model)
+        # the EFFECTIVE tier (round 18): DAG int8 normalizes to bf16,
+        # bf16-on-a-bf16-server to full — same rule the cache wrap keyed
+        quality = self._effective_quality(quality, bundle)
         file_uri = form.get("file")
         layer = form.get("layer")
         if not file_uri or not layer:
@@ -1272,14 +1538,22 @@ class DeconvService:
             with stage(self.sweep_metrics, "compute"):
                 return await self.sweep_dispatcher.submit(
                     x,
-                    self._model_key(model, (layer, mode, top_k, post, True)),
+                    self._model_key(
+                        model,
+                        self._quality_key(
+                            (layer, mode, top_k, post, True), quality
+                        ),
+                    ),
                     deadline=deadline,
                     tenant=tenant, tclass=tclass,
                 )
         with stage(self.metrics, "compute"):
             return await self.dispatcher.submit(
                 x,
-                self._model_key(model, (layer, mode, top_k, post)),
+                self._model_key(
+                    model,
+                    self._quality_key((layer, mode, top_k, post), quality),
+                ),
                 deadline=deadline,
                 tenant=tenant, tclass=tclass,
             )
@@ -1561,6 +1835,7 @@ class DeconvService:
             # key.  An unknown name 422s here, before any flight/decode.
             try:
                 model = self._resolve_model(req)
+                quality = self._resolve_quality(req)
             except errors.DeconvError as e:
                 metrics.observe_request(time.perf_counter() - t0, e.code)
                 return _error_response(e, req.id)
@@ -1570,11 +1845,25 @@ class DeconvService:
                 # (weight init + checkpoint) runs off the event loop
                 mprefix = await asyncio.to_thread(self._model_prefix, model)
             prefix = f"{mprefix}|{route}"
+            # Per-request quality (round 18): the RESOLVED, NORMALIZED
+            # tier rides the key's prefix and the raw `quality` field is
+            # excluded from the field digest — quality=full explicit,
+            # x-quality: full, and a bare request all hash to ONE key
+            # (the `model` rule), while an int8 body can never serve a
+            # full-fidelity request.  int8 keys also carry the
+            # calibration digest, so recalibration invalidates exactly
+            # the int8 entries.
+            prefix += self._quality_prefix(
+                self._effective_quality(
+                    quality, self.weights.peek_bundle(model), route
+                ),
+                model,
+            )
             # passing req shares the memoized form parse with the handler:
             # one parse per request, key derivation included
             key = canonical_digest(
                 prefix, req.headers.get("content-type", ""), req.body,
-                req=req, exclude=("model",),
+                req=req, exclude=("model", "quality"),
             )
             if self.cache is not None and not bypass:
                 charge = None
@@ -1858,6 +2147,27 @@ class DeconvService:
             # right now, straight off the probe — a router/pin dashboard
             # reads residency without /v1/config
             body["models"] = self.weights.ready_block()
+        # quality tier state (round 18): the default/class-mapped tiers
+        # and which served models carry a calibration artifact — fleet
+        # drills (and an autoscaler's gate) read it off the probe
+        # snapshot first: worker threads insert lazily (see /v1/config)
+        calib = dict(self._calib_cache)
+        body["quality"] = {
+            "default": self.cfg.quality_default,
+            "by_class": dict(self._class_quality),
+            "calibrated": sorted(
+                m for m, (_q, tag) in calib.items() if tag != "dynamic"
+            ),
+        }
+        if self.aot is not None:
+            # artifact-store state on the probe (round 18): an
+            # autoscaler's warm-boot gate reads "did this boot hit the
+            # store" without /v1/config
+            body["aot"] = {
+                "entries": self.aot.store.entry_count,
+                "hits": self.metrics.counter("aot_cache_hits_total"),
+                "misses": self.metrics.counter("aot_cache_misses_total"),
+            }
         if self.jobs is not None:
             # operators (and the drain runbook) read the park/queue
             # picture straight off the readiness probe
@@ -1917,7 +2227,7 @@ class DeconvService:
         cfg = dataclasses.asdict(self.cfg)
         for key in (
             "weights_path", "compilation_cache_dir", "profile_dir",
-            "jobs_dir",
+            "jobs_dir", "calibration_dir", "aot_dir",
         ):
             cfg[key] = bool(cfg[key])
         cfg["mesh_active"] = self.mesh is not None
@@ -1927,6 +2237,35 @@ class DeconvService:
         # the one place an operator confirms "which models does this
         # process answer, which are warm, how full is the budget"
         cfg["weights"] = self.weights.snapshot()
+        # per-request quality tiers (round 18): the effective default /
+        # class map and, per model whose int8 path has been consulted,
+        # WHICH calibration (artifact digest, or 'dynamic' in-graph
+        # ranges) its int8 keys are bound to — the fleet drills gate on
+        # this block
+        # snapshot first: dispatch worker threads lazily insert into
+        # _calib_cache (first int8 consult per model) and iterating the
+        # live dict could raise mid-probe
+        calib = dict(self._calib_cache)
+        cfg["quality"] = {
+            "default": self.cfg.quality_default,
+            "by_class": dict(self._class_quality),
+            "calibration": {
+                m: tag for m, (_q, tag) in sorted(calib.items())
+            },
+        }
+        # AOT artifact store (round 18): live entry/byte state plus the
+        # hit/miss/store ledger — "did this boot deserialize or compile"
+        # without scraping /metrics
+        cfg["aot_active"] = self.aot is not None
+        if self.aot is not None:
+            cfg["aot"] = {
+                "entries": self.aot.store.entry_count,
+                "resident_bytes": self.aot.store.resident_bytes,
+                "hits": self.metrics.counter("aot_cache_hits_total"),
+                "misses": self.metrics.counter("aot_cache_misses_total"),
+                "stores": self.metrics.counter("aot_cache_stores_total"),
+                "corrupt": self.metrics.counter("aot_cache_corrupt_total"),
+            }
         # Low-channel backward-tail packing (round 12): the channel
         # threshold the POLICY resolves to — 0 when the policy is off OR
         # the active model is a DAG backbone (the vjp walk has no packed
@@ -2085,8 +2424,10 @@ class DeconvService:
                 # model resolution (round 15): memoized on the request —
                 # the cache wrap usually resolved it already; with the
                 # cache off this worker-side call does (a cold bundle
-                # build then rides this codec worker, off the loop)
+                # build then rides this codec worker, off the loop).
+                # quality (round 18) rides the same memoization.
                 model = self._resolve_model(req, form)
+                self._resolve_quality(req, form)
                 bundle = self.weights.bundle(model)
                 try:
                     bundle.check_layer(layer)
@@ -2111,13 +2452,19 @@ class DeconvService:
             # §2.2.3/§2.2.4): the top-4 of 8 ARE the top-4, so computing
             # stitch_k projections halves the backward work; the grid is
             # stitched and deprocessed on device (reference order).
+            eq = self._effective_quality(
+                self._resolve_quality(req), self.weights.peek_bundle(model)
+            )
             with stage(self.metrics, "compute"):
                 result = await self.dispatcher.submit(
                     x,
                     self._model_key(
                         model,
-                        (layer, self.cfg.visualize_mode,
-                         self.cfg.stitch_k, "grid"),
+                        self._quality_key(
+                            (layer, self.cfg.visualize_mode,
+                             self.cfg.stitch_k, "grid"),
+                            eq,
+                        ),
                     ),
                     deadline=req.deadline,
                     tenant=req.tenant, tclass=req.tclass,
@@ -2169,6 +2516,7 @@ class DeconvService:
         try:
             form = _parse_form(req)
             model = self._resolve_model(req, form)
+            quality = self._resolve_quality(req, form)
             mode, top_k = self._deconv_params(form)
             sweep = form.get("sweep", "").lower() in ("1", "true", "yes", "on")
             if sweep:
@@ -2180,6 +2528,7 @@ class DeconvService:
                     form, mode, top_k, "tiles", sweep=True,
                     deadline=req.deadline,
                     tenant=req.tenant, tclass=req.tclass, model=model,
+                    quality=quality,
                 )
                 with stage(self.metrics, "encode"):
                     names = list(result)
@@ -2198,6 +2547,7 @@ class DeconvService:
             result = await self._project(
                 form, mode, top_k, "tiles", deadline=req.deadline,
                 tenant=req.tenant, tclass=req.tclass, model=model,
+                quality=quality,
             )
             with stage(self.metrics, "encode"):
                 payload = await self._encode_tiles_pooled(result)
@@ -2256,6 +2606,10 @@ class DeconvService:
                 )
             form = _parse_form(req)
             model = self._resolve_model(req, form)
+            # validated for the 422 contract, then normalized to full:
+            # the dream ascent has no quantized/bf16-staged form
+            # (_effective_quality) — the cache wrap keyed it the same way
+            self._resolve_quality(req, form)
             bundle = await self._bundle_async(model)
             file_uri = form.get("file")
             if not file_uri:
@@ -2540,6 +2894,7 @@ class DeconvService:
                 payload = load(rec)
                 if payload is not None and "name" in payload:
                     done[payload["name"]] = payload["entry"]
+        quality = p.get("quality", "full")
         names = bundle.sweep_layers(layer)
         for i, name in enumerate(names):
             if name in done:
@@ -2547,7 +2902,10 @@ class DeconvService:
             faults_mod.raise_if_armed("jobs.runner_crash")
             result = await self._job_dispatch(
                 job, self.sweep_dispatcher, np.asarray(x),
-                self._model_key(model, (name, mode, top_k, "tiles")),
+                self._model_key(
+                    model,
+                    self._quality_key((name, mode, top_k, "tiles"), quality),
+                ),
             )
             entry = await self._encode_tiles_pooled(result)
             done[name] = entry
@@ -2580,7 +2938,12 @@ class DeconvService:
         faults_mod.raise_if_armed("jobs.runner_crash")
         result = await self._job_dispatch(
             job, self.dispatcher, np.asarray(x),
-            self._model_key(model, (layer, mode, top_k, "tiles")),
+            self._model_key(
+                model,
+                self._quality_key(
+                    (layer, mode, top_k, "tiles"), p.get("quality", "full")
+                ),
+            ),
         )
         payload = await self._encode_tiles_pooled(result)
         body = json.dumps({"layer": layer, "mode": mode, **payload}).encode()
@@ -2610,6 +2973,26 @@ class DeconvService:
             # backbone regardless of the process's default
             model = self._resolve_model(req, form)
             bundle = await self._bundle_async(model)
+            # per-request quality (round 18): the EFFECTIVE tier is
+            # journaled with the job, so a resume after restart runs the
+            # same precision regardless of the process's config — and
+            # rides the idempotency digest below, so an int8 submit can
+            # never dedup onto a full-fidelity job.  Dreams normalize to
+            # full like the synchronous route.  The jobs route has no
+            # QoS admission wrap (tenancy is budgeted per-queue below),
+            # so the class default needs the tenant's class resolved
+            # HERE — a bulk tenant's batch submits ride quality_by_class
+            # exactly like its synchronous requests.
+            if self.qos is not None and not req.tclass:
+                req.tclass = self.qos.class_of(
+                    self.qos.tenant_of(req.headers)
+                )
+            quality = self._resolve_quality(req, form)
+            eq = (
+                "full"
+                if kind == "dream"
+                else self._effective_quality(quality, bundle)
+            )
             file_uri = form.get("file")
             if not file_uri:
                 raise errors.BadRequest("form field 'file' is required")
@@ -2633,6 +3016,8 @@ class DeconvService:
                     "layer": layer, "mode": mode, "top_k": str(top_k),
                     "model": model,
                 }
+                if eq != "full":
+                    params["quality"] = eq
             idem = req.headers.get("x-idempotency-key", "")
             if idem and not trace_mod.RID_RE.match(idem):
                 raise errors.BadRequest(
@@ -2643,12 +3028,16 @@ class DeconvService:
                     # the model's OWN prefix (round 15): identical bodies
                     # targeting different models must never dedup onto
                     # one job; the raw `model` field is excluded exactly
-                    # like the response-cache key
-                    f"{self._model_prefix(model)}|jobs",
+                    # like the response-cache key.  The resolved quality
+                    # tier rides the prefix the same way (round 18):
+                    # default-quality, explicit quality=full and bare
+                    # submits dedup onto ONE job, int8 never onto full.
+                    f"{self._model_prefix(model)}|jobs"
+                    f"{self._quality_prefix(eq, model)}",
                     req.headers.get("content-type", ""),
                     req.body,
                     req=req,
-                    exclude=("model",),
+                    exclude=("model", "quality"),
                 )
             tenant = ""
             if self.qos is not None:
@@ -3165,6 +3554,35 @@ def main(argv: list[str] | None = None) -> None:
         "fidelity — see docs/API.md)",
     )
     p.add_argument(
+        "--quality-default", default=None, metavar="full|bf16|int8",
+        help="precision tier for requests that name none via quality= / "
+        "x-quality (default full; int8 runs the quantized forward walk "
+        "on sequential backbones — PSNR-bounded, see docs/API.md)",
+    )
+    p.add_argument(
+        "--quality-by-class", default=None, metavar="CLASS=TIER,...",
+        help="per-QoS-class default tiers when the request names none "
+        "(default 'bulk=int8'; empty string disables class defaults)",
+    )
+    p.add_argument(
+        "--calibration-dir", default=None, metavar="DIR",
+        help="per-model int8 calibration artifacts (<model>.calib.json, "
+        "written by tools/calibrate.py); absent models fall back to "
+        "dynamic per-example ranges",
+    )
+    p.add_argument(
+        "--aot-dir", default=None, metavar="DIR",
+        help="AOT compiled-artifact store: warmup/first-dispatch "
+        "deserializes stored executables instead of recompiling — point "
+        "a fleet at shared storage to compile once, boot warm "
+        "everywhere (default off)",
+    )
+    p.add_argument(
+        "--aot-bytes", type=int, default=None,
+        help="artifact-store byte budget; oldest entries sweep above it "
+        "(default 0 = unbounded)",
+    )
+    p.add_argument(
         "--peer-fill", action="store_true", default=None,
         help="fleet tier (round 14): honor the router's x-peer-fill "
         "hint on cache misses and serve GET /v1/internal/cache/{digest} "
@@ -3250,6 +3668,16 @@ def main(argv: list[str] | None = None) -> None:
         overrides["hbm_budget_bytes"] = args.hbm_budget_bytes
     if args.weight_dtype is not None:
         overrides["weight_dtype"] = args.weight_dtype
+    if args.quality_default is not None:
+        overrides["quality_default"] = args.quality_default
+    if args.quality_by_class is not None:
+        overrides["quality_by_class"] = args.quality_by_class
+    if args.calibration_dir is not None:
+        overrides["calibration_dir"] = args.calibration_dir
+    if args.aot_dir is not None:
+        overrides["aot_dir"] = args.aot_dir
+    if args.aot_bytes is not None:
+        overrides["aot_bytes"] = args.aot_bytes
     if args.peer_fill:
         overrides["fleet_peer_fill"] = True
     if args.l2_dir is not None:

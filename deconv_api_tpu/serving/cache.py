@@ -113,8 +113,12 @@ def canonical_digest(
     the ``model`` field — its RESOLVED value already rides the prefix,
     so ``model=vgg16`` explicit, ``x-model: vgg16``, and a bare default
     request all hash to ONE key instead of fragmenting the hot set
-    three ways).  Only applies to parseable bodies; raw-bytes fallbacks
-    hash everything (they 400 deterministically anyway).
+    three ways; round 18 gives ``quality`` the same treatment — the
+    resolved, normalized tier rides the prefix, so default-quality,
+    explicit ``quality=full`` and bare requests share one key while an
+    int8 body can never serve a full-fidelity request).  Only applies
+    to parseable bodies; raw-bytes fallbacks hash everything (they 400
+    deterministically anyway).
     """
     h = hashlib.blake2b(digest_size=20)
     h.update(prefix.encode())

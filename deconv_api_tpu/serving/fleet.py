@@ -2054,12 +2054,32 @@ class FleetRouter:
             # encoding choice collapse, so every spelling of one logical
             # request lands on one backend.  The prefix differs from the
             # backend's (the router knows no model config) — irrelevant
-            # for affinity, which only needs determinism per body.
+            # for affinity, which only needs determinism per body.  The
+            # quality tier (round 18) is resolved the way the BACKEND
+            # resolves it — `quality=` form field wins over the
+            # x-quality header — then rides the PREFIX with the raw
+            # field excluded from the body digest: the backend hashes
+            # every spelling of one (body, tier) to one cache key, so
+            # the ring must too, or the identical payload computes and
+            # caches on two owners.  An explicit `full` normalizes to
+            # bare, and tier-less requests keep the EXACT round-14
+            # digest — a mixed-version router fleet mid-rollout never
+            # disagrees on placement for plain traffic.
+            try:
+                xq = req.form().get("quality", "")
+            except Exception:  # noqa: BLE001 — unparseable: header only
+                xq = ""
+            xq = (
+                xq or req.headers.get("x-quality", "")
+            ).strip().lower()
+            if xq == "full":
+                xq = ""
             key = canonical_digest(
-                f"fleet|{req.path}",
+                f"fleet|{req.path}" + (f"|q={xq}" if xq else ""),
                 req.headers.get("content-type", ""),
                 req.body,
                 req=req,
+                exclude=("quality",),
             )
         # hot-key replication (round 16): a promoted zipf-head key's
         # READS spread over its R ring owners; forced recomputes

@@ -85,6 +85,12 @@ class Request:
     # cache wrap, the route handler, and the trace annotation all agree
     # on one resolution per request.  Empty = not resolved yet.
     model: str = ""
+    # Resolved per-request quality tier (round 18 int8 execution):
+    # ``quality=`` form field / ``x-quality`` header / QoS-class
+    # default / server default, validated against full|bf16|int8.
+    # Memoized by DeconvService._resolve_quality — same one-resolution
+    # contract as ``model``.  Empty = not resolved yet.
+    quality: str = ""
     # the admission Grant (accounting handle) the QoS wrap stashes so
     # the cache wrap can refund a hit's provisional device debit
     _qos_grant: object = field(default=None, repr=False, compare=False)

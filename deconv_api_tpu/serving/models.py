@@ -198,6 +198,7 @@ class ModelBundle:
         donate: bool = False,
         lane: int = 0,
         lowc_kpack: str = "off",
+        quant=None,
     ):
         """fn(params, batch) -> {layer: {..., indices, sums, valid}} —
         jitted once per static configuration and cached.  ``bug_compat``
@@ -246,7 +247,14 @@ class ModelBundle:
         threshold; DAG models normalise it to "off" BEFORE the cache key
         (same rule as backward_dtype — their vjp walk has no packed
         layout, so distinct policy values must not compile duplicate
-        identical executables)."""
+        identical executables).
+
+        ``quant`` (round 18, quality=int8) runs the forward walk with
+        int8 arithmetic: ``"dynamic"`` or a tuple of calibrated
+        (entry, amax) scales (engine/quant.py) — sequential specs only;
+        the serving layer normalises DAG requests down to bf16 before
+        this call, and the None default keeps the exact pre-round-18
+        program and cache keys."""
         lane_pl = self.lane_placement(lane)
         lane_mesh = None
         if lane_pl is not None:
@@ -263,6 +271,8 @@ class ModelBundle:
         if self.spec is None:
             backward_dtype = None
             kpack_chan = 0
+            quant = None  # DAG walks have no quantized form (normalized
+            # to bf16 upstream); None keeps the key from fragmenting
         if mesh is not None:
             donate = False  # sharded jit boundary; donation not threaded
         if donate:
@@ -272,7 +282,7 @@ class ModelBundle:
         # lane stays the key's TAIL — test_lanes and the warmup loop read
         # k[-1] as the lane a cached program is pinned to
         key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep,
-               donate, kpack_chan, lane)
+               donate, kpack_chan, quant, lane)
         if key not in self._vis_cache:
             if self.spec is not None:
                 # On a dp mesh the merged-sweep batch chunking must stay
@@ -286,6 +296,7 @@ class ModelBundle:
                     backward_dtype=backward_dtype or None,
                     kpack_chan=kpack_chan,
                     sweep_chunk=0 if mesh is not None else None,
+                    quant=quant,
                 )
             else:
                 sweep_names = self.sweep_layers(layer) if sweep else None

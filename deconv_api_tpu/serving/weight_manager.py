@@ -81,6 +81,13 @@ WEIGHT_DTYPES = ("f32", "bf16", "int8")
 _Q8_KEY = "__q8__"
 _Q8_SCALE = "__q8_scale__"
 
+# The symmetric-int8 convention shared with the int8 EXECUTION tier
+# (round 18): it lives in the utils layer (utils/quantize.py) beneath
+# both engine and serving, re-exported here for this module's callers —
+# weight-at-rest int8 (this module) and arithmetic-in-int8
+# (quality=int8) agree on what a quantized tensor means.
+from deconv_api_tpu.utils.quantize import Q8_LEVELS, int8_scale  # noqa: E402
+
 
 def _is_q8_leaf(node: Any) -> bool:
     return isinstance(node, dict) and _Q8_KEY in node
@@ -114,13 +121,12 @@ def quantize_params(tree: Any, weight_dtype: str) -> Any:
         if weight_dtype == "bf16":
             return arr.astype(ml_dtypes.bfloat16)
         if arr.ndim >= 2:
-            # per-tensor symmetric: scale maps the widest weight onto
-            # ±127; an all-zero tensor keeps scale 1.0 (no div-by-zero,
-            # dequantises back to exact zeros)
+            # per-tensor symmetric (int8_scale owns the amax→scale rule)
             amax = float(np.max(np.abs(arr))) if arr.size else 0.0
-            scale = np.float32(amax / 127.0) if amax > 0 else np.float32(1.0)
+            scale = np.float32(int8_scale(amax))
             qarr = np.clip(
-                np.round(arr.astype(np.float32) / scale), -127, 127
+                np.round(arr.astype(np.float32) / scale),
+                -Q8_LEVELS, Q8_LEVELS,
             ).astype(np.int8)
             return {_Q8_KEY: qarr, _Q8_SCALE: scale}
         return arr.astype(np.float32)

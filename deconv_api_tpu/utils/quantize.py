@@ -1,0 +1,21 @@
+"""The symmetric-int8 convention, in one dependency-free place.
+
+Both quantization tiers — weights at rest (serving/weight_manager.py,
+round 15) and arithmetic in int8 (engine/deconv.py quality=int8, round
+18) — must agree on what a quantized tensor means.  The convention
+lives HERE, in the utils layer beneath both, so neither engine nor
+serving has to reach into the other for it: the widest value maps onto
+±127 (never -128 — the asymmetric extra level would break w == -w
+symmetry for the flipped backward kernels), and an all-zero tensor
+keeps scale 1.0 (no div-by-zero; dequantises back to exact zeros).
+"""
+
+from __future__ import annotations
+
+Q8_LEVELS = 127.0
+
+
+def int8_scale(amax: float) -> float:
+    """The symmetric-int8 scale for a tensor with max-abs ``amax`` — the
+    ONE place the amax→scale rule lives."""
+    return float(amax) / Q8_LEVELS if amax > 0 else 1.0

@@ -1,0 +1,591 @@
+"""Int8 execution tier + AOT artifact distribution (round 18).
+
+Covers: the int8 conv/dense kernels against their f32 references (PSNR
+floors), the quantized visualizer walk per backbone shape (conv-only and
+dense-head, calibrated and dynamic), calibration artifact round-trip
+determinism and corruption behavior, quality routing end to end
+(precedence, 422 taxonomy, cache-key non-fragmentation, QoS-class
+defaults), AOT export/import byte parity with corrupt-reads-as-miss, and
+the exposition lint over every new metric family.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from urllib.parse import unquote
+
+import httpx
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deconv_api_tpu import errors, ops
+from deconv_api_tpu.config import ServerConfig
+from deconv_api_tpu.engine import quant as quant_mod
+from deconv_api_tpu.engine.deconv import get_visualizer
+from deconv_api_tpu.models.spec import Layer, ModelSpec, init_params
+from deconv_api_tpu.serving.aot import AotExecutor, ArtifactStore, artifact_digest
+from deconv_api_tpu.serving.app import DeconvService
+from deconv_api_tpu.serving.cache import canonical_digest
+from deconv_api_tpu.serving.http import Request
+from deconv_api_tpu.serving.metrics import Metrics
+from tests.test_engine_parity import TINY
+from tests.test_metrics_exposition import lint_exposition
+from tests.test_serving import ServiceFixture, _data_url
+
+
+def _psnr(ref, got) -> float:
+    ref = np.asarray(ref, np.float64)
+    got = np.asarray(got, np.float64)
+    mse = float(np.mean((ref - got) ** 2))
+    peak = max(float(np.abs(ref).max()), 1e-12)
+    return 10.0 * np.log10(peak**2 / mse) if mse > 0 else 999.0
+
+
+# A dense-head backbone shape: exercises dense_q8, the flatten boundary,
+# and the non-int8-safe softmax head (dequant-then-activate path).
+QHEAD = ModelSpec(
+    name="qhead",
+    input_shape=(16, 16, 3),
+    layers=(
+        Layer("input_1", "input"),
+        Layer("c1", "conv", activation="relu", filters=8),
+        Layer("p1", "pool"),
+        Layer("f", "flatten"),
+        Layer("d1", "dense", activation="relu", filters=32),
+        Layer("pred", "dense", activation="softmax", filters=10),
+    ),
+)
+
+# Measured 2026-08-04 (CPU, random init): conv op 51.2 dB, dense op
+# 48.8 dB, tiny_vgg walk ~25 dB, qhead walk ~38 dB.  Floors leave
+# headroom for host jitter while catching a broken scale convention
+# (which lands in single digits).
+OP_PSNR_FLOOR_DB = 40.0
+BACKBONE_PSNR_FLOORS_DB = {"tiny_vgg": 18.0, "qhead": 28.0}
+
+
+# ------------------------------------------------------------- op kernels
+
+
+def test_conv2d_q8_matches_f32_reference():
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((2, 16, 16, 8)) * 3).astype(np.float32)
+    w = rng.standard_normal((3, 3, 8, 16)).astype(np.float32)
+    b = rng.standard_normal((16,)).astype(np.float32)
+    ref = np.asarray(ops.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+    sx = float(np.abs(x).max()) / 127.0
+    sw = float(np.abs(w).max()) / 127.0
+    xq = np.clip(np.round(x / sx), -127, 127).astype(np.int8)
+    wq = np.clip(np.round(w / sw), -127, 127).astype(np.int8)
+    acc = ops.conv2d_q8(jnp.asarray(xq), jnp.asarray(wq))
+    assert acc.dtype == jnp.int32  # int32 accumulation, not f32 upcast
+    got = np.asarray(acc).astype(np.float32) * (sx * sw) + b
+    assert _psnr(ref, got) >= OP_PSNR_FLOOR_DB
+
+
+def test_dense_q8_matches_f32_reference():
+    rng = np.random.default_rng(1)
+    x = (rng.standard_normal((4, 64)) * 2).astype(np.float32)
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    ref = np.asarray(ops.dense(jnp.asarray(x), jnp.asarray(w)))
+    sx = float(np.abs(x).max()) / 127.0
+    sw = float(np.abs(w).max()) / 127.0
+    xq = np.clip(np.round(x / sx), -127, 127).astype(np.int8)
+    wq = np.clip(np.round(w / sw), -127, 127).astype(np.int8)
+    acc = ops.dense_q8(jnp.asarray(xq), jnp.asarray(wq))
+    assert acc.dtype == jnp.int32
+    got = np.asarray(acc).astype(np.float32) * (sx * sw)
+    assert _psnr(ref, got) >= OP_PSNR_FLOOR_DB
+
+
+def test_int8_safe_activation_vocabulary():
+    assert ops.int8_safe_activation("relu")
+    assert ops.int8_safe_activation("linear")
+    # relu6's cap and softmax's normalisation do not commute with an
+    # arbitrary dequant scale — they must go through the f32 path
+    assert not ops.int8_safe_activation("relu6")
+    assert not ops.int8_safe_activation("softmax")
+
+
+# ------------------------------------------------- quantized forward walk
+
+
+@pytest.mark.parametrize(
+    "spec,layer",
+    [(TINY, "b2c1"), (QHEAD, "d1"), (QHEAD, "pred")],
+    ids=["tiny_vgg", "qhead_dense", "qhead_softmax"],
+)
+def test_int8_walk_psnr_floor_per_backbone(spec, layer):
+    params = init_params(spec, jax.random.PRNGKey(0))
+    img = (np.random.default_rng(2).standard_normal((16, 16, 3)) * 40).astype(
+        np.float32
+    )
+    floor = BACKBONE_PSNR_FLOORS_DB[spec.name]
+    full = get_visualizer(spec, layer, 4, "all", True)(params, img)[layer]
+    ranges = quant_mod.collect_ranges(spec, params, [img])
+    for quant in ("dynamic", quant_mod.quant_spec(ranges)):
+        got = get_visualizer(spec, layer, 4, "all", True, quant=quant)(
+            params, img
+        )[layer]
+        db = _psnr(full["images"], got["images"])
+        assert db >= floor, (
+            f"{spec.name}/{layer} quant={'dynamic' if quant == 'dynamic' else 'calibrated'}: "
+            f"{db:.1f} dB under the {floor} dB floor"
+        )
+        # the walk must actually have quantized something
+        assert not np.array_equal(
+            np.asarray(full["images"]), np.asarray(got["images"])
+        )
+
+
+def test_int8_walk_deterministic_per_example():
+    """A request's int8 bytes must not depend on co-batched data: the
+    dynamic ranges are per-example under vmap, so projecting the same
+    image alone and inside a batch gives identical results."""
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    imgs = (rng.standard_normal((3, 16, 16, 3)) * 30).astype(np.float32)
+    fn = get_visualizer(
+        TINY, "b2c1", 4, "all", True, batched=True, quant="dynamic"
+    )
+    solo = fn(params, imgs[:1])["b2c1"]
+    batched = fn(params, imgs)["b2c1"]
+    np.testing.assert_array_equal(
+        np.asarray(solo["images"][0]), np.asarray(batched["images"][0])
+    )
+
+
+# ------------------------------------------------------------ calibration
+
+
+def test_calibration_round_trip_determinism(tmp_path):
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    imgs = [
+        (np.random.default_rng(i).standard_normal((16, 16, 3)) * 25).astype(
+            np.float32
+        )
+        for i in range(4)
+    ]
+    r1 = quant_mod.collect_ranges(TINY, params, imgs)
+    r2 = quant_mod.collect_ranges(TINY, params, imgs)
+    assert r1 == r2
+    assert quant_mod.ranges_digest(r1) == quant_mod.ranges_digest(r2)
+    p1, d1 = quant_mod.save_calibration(
+        str(tmp_path), TINY.name, r1, image_size=16, n_images=4
+    )
+    b1 = open(p1, "rb").read()
+    _p2, d2 = quant_mod.save_calibration(
+        str(tmp_path), TINY.name, r2, image_size=16, n_images=4
+    )
+    assert d1 == d2 and open(p1, "rb").read() == b1  # byte-identical
+    loaded = quant_mod.load_calibration(str(tmp_path), TINY.name)
+    assert loaded is not None and loaded["digest"] == d1
+    assert quant_mod.quant_spec(loaded["ranges"]) == quant_mod.quant_spec(r1)
+    # a widened set only widens ranges (max reduction): superset images
+    wide = quant_mod.collect_ranges(TINY, params, imgs + [imgs[0] * 10])
+    assert all(wide[k] >= r1[k] for k in r1)
+
+
+def test_calibration_corruption_reads_as_absent(tmp_path):
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    imgs = [np.ones((16, 16, 3), np.float32)]
+    ranges = quant_mod.collect_ranges(TINY, params, imgs)
+    path, _d = quant_mod.save_calibration(
+        str(tmp_path), TINY.name, ranges, image_size=16, n_images=1
+    )
+    assert quant_mod.load_calibration(str(tmp_path), TINY.name) is not None
+    # appended garbage → unparseable → absent
+    with open(path, "ab") as f:
+        f.write(b"garbage")
+    assert quant_mod.load_calibration(str(tmp_path), TINY.name) is None
+    # digest mismatch (tampered range) → absent
+    payload = {
+        "v": 1, "model": TINY.name, "image_size": 16, "n_images": 1,
+        "source": "", "ranges": {"b1c1": 1.0}, "digest": "0" * 24,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    assert quant_mod.load_calibration(str(tmp_path), TINY.name) is None
+    # truncated file → absent
+    with open(path, "w") as f:
+        f.write('{"v": 1, "ranges": {"b1c')
+    assert quant_mod.load_calibration(str(tmp_path), TINY.name) is None
+    # missing file → absent
+    os.unlink(path)
+    assert quant_mod.load_calibration(str(tmp_path), TINY.name) is None
+
+
+# ------------------------------------------------------- quality routing
+
+
+@pytest.fixture(scope="module")
+def qserver(tmp_path_factory):
+    """One quality-enabled server: calibrated TINY, QoS with a bulk
+    tenant (class-default int8), an AOT artifact store, cache on."""
+    calib_dir = str(tmp_path_factory.mktemp("calib"))
+    aot_dir = str(tmp_path_factory.mktemp("aot"))
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    imgs = [
+        (np.random.default_rng(i).standard_normal((16, 16, 3)) * 25).astype(
+            np.float32
+        )
+        for i in range(3)
+    ]
+    ranges = quant_mod.collect_ranges(TINY, params, imgs)
+    quant_mod.save_calibration(
+        calib_dir, TINY.name, ranges, image_size=16, n_images=3
+    )
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        compilation_cache_dir="",
+        calibration_dir=calib_dir,
+        aot_dir=aot_dir,
+        # the conftest's 8 virtual devices would auto-resolve to 8
+        # lanes, and AOT artifacts are single-stream only
+        serve_lanes="off",
+        qos=True,
+        tenants=json.dumps(
+            {
+                "vip": {"class": "interactive"},
+                "batchy": {"class": "bulk"},
+            }
+        ),
+    )
+    service = DeconvService(cfg, spec=TINY, params=params)
+    with ServiceFixture(cfg, service=service) as s:
+        # real warmup (not just ready=True): populates the AOT store and
+        # the warmup_seconds gauge the surface tests read
+        service.warmup("b2c1")
+        yield s
+
+
+def _post(server, data, headers=None):
+    return httpx.post(
+        server.base_url + "/", data=data, headers=headers or {}, timeout=60
+    )
+
+
+def test_quality_spellings_share_one_key_and_bytes(qserver):
+    """Default-quality, explicit quality=full, and x-quality: full hash
+    to ONE cache key and identical bytes (the non-fragmentation pin)."""
+    uri = _data_url(rng_seed=11)
+    entries0 = qserver.service.cache.entry_count
+    r1 = _post(qserver, {"file": uri, "layer": "b2c1"})
+    r2 = _post(qserver, {"file": uri, "layer": "b2c1", "quality": "full"})
+    r3 = _post(
+        qserver, {"file": uri, "layer": "b2c1"}, {"x-quality": "full"}
+    )
+    assert r1.status_code == r2.status_code == r3.status_code == 200
+    assert r1.content == r2.content == r3.content
+    assert qserver.service.cache.entry_count == entries0 + 1
+    assert r2.headers["x-cache"] == "hit"
+    assert r3.headers["x-cache"] == "hit"
+
+
+def test_quality_int8_distinct_key_distinct_bytes(qserver):
+    uri = _data_url(rng_seed=12)
+    full = _post(qserver, {"file": uri, "layer": "b2c1"})
+    before = qserver.service.metrics.counter("quant_int8_batches_total")
+    q8 = _post(qserver, {"file": uri, "layer": "b2c1", "quality": "int8"})
+    assert full.status_code == q8.status_code == 200
+    assert q8.content != full.content
+    assert (
+        qserver.service.metrics.counter("quant_int8_batches_total") > before
+    )
+    # repeat serves the int8 entry from cache — never the full one
+    again = _post(
+        qserver, {"file": uri, "layer": "b2c1"}, {"x-quality": "int8"}
+    )
+    assert again.headers["x-cache"] == "hit"
+    assert again.content == q8.content
+
+
+def test_quality_field_wins_over_header(qserver):
+    uri = _data_url(rng_seed=13)
+    full = _post(qserver, {"file": uri, "layer": "b2c1"})
+    mixed = _post(
+        qserver,
+        {"file": uri, "layer": "b2c1", "quality": "int8"},
+        {"x-quality": "full"},
+    )
+    assert mixed.status_code == 200
+    assert mixed.content != full.content  # the field's int8 won
+
+
+def test_quality_garbage_is_422(qserver):
+    r = _post(
+        qserver,
+        {"file": _data_url(rng_seed=14), "layer": "b2c1", "quality": "fp4"},
+    )
+    assert r.status_code == 422
+    assert r.json()["error"] == "illegal_quality"
+
+
+def test_qos_bulk_class_defaults_to_int8(qserver):
+    """A bulk-class tenant naming NO quality rides the class default
+    (quality_by_class bulk=int8); interactive keeps full fidelity."""
+    uri = _data_url(rng_seed=15)
+    vip = _post(qserver, {"file": uri, "layer": "b2c1"}, {"x-tenant": "vip"})
+    bare = _post(qserver, {"file": uri, "layer": "b2c1"})
+    bulk = _post(
+        qserver, {"file": uri, "layer": "b2c1"}, {"x-tenant": "batchy"}
+    )
+    explicit = _post(
+        qserver, {"file": uri, "layer": "b2c1", "quality": "int8"}
+    )
+    assert vip.status_code == bare.status_code == bulk.status_code == 200
+    assert vip.content == bare.content  # interactive == full fidelity
+    assert bulk.content != bare.content  # bulk rode the int8 default
+    assert bulk.content == explicit.content  # same int8 key/bytes
+    # a bulk tenant may still pin full explicitly
+    pinned = _post(
+        qserver,
+        {"file": uri, "layer": "b2c1", "quality": "full"},
+        {"x-tenant": "batchy"},
+    )
+    assert pinned.content == bare.content
+
+
+def test_readyz_and_config_report_quality_and_aot(qserver):
+    ready = httpx.get(qserver.base_url + "/readyz", timeout=30).json()
+    assert ready["quality"]["by_class"] == {"bulk": "int8"}
+    assert TINY.name in ready["quality"]["calibrated"]
+    assert ready["aot"]["entries"] >= 1
+    cfg = httpx.get(qserver.base_url + "/v1/config", timeout=30).json()
+    assert cfg["aot_active"] is True
+    assert cfg["aot"]["stores"] >= 1
+    assert cfg["quality"]["calibration"][TINY.name] != "dynamic"
+    # paths never leak verbatim
+    assert cfg["calibration_dir"] is True and cfg["aot_dir"] is True
+
+
+def test_dream_normalizes_quality_and_422s_garbage(qserver):
+    uri = _data_url(rng_seed=16)
+    base = {"file": uri, "layers": "b1c2", "steps": 1, "octaves": 1}
+    full = httpx.post(
+        qserver.base_url + "/v1/dream", data=base, timeout=120
+    )
+    q8 = httpx.post(
+        qserver.base_url + "/v1/dream",
+        data={**base, "quality": "int8"},
+        timeout=120,
+    )
+    assert full.status_code == q8.status_code == 200
+    # dreams have no quantized form: int8 normalizes to full — same key,
+    # so the second call is a cache hit with identical bytes
+    assert q8.content == full.content
+    assert q8.headers["x-cache"] == "hit"
+    bad = httpx.post(
+        qserver.base_url + "/v1/dream",
+        data={**base, "quality": "fp4"},
+        timeout=30,
+    )
+    assert bad.status_code == 422
+    assert bad.json()["error"] == "illegal_quality"
+
+
+def test_effective_quality_normalization_rules(qserver):
+    svc = qserver.service
+
+    class _Dag:
+        spec = None
+
+    class _Seq:
+        spec = object()
+
+    assert svc._effective_quality("int8", _Dag()) == "bf16"
+    assert svc._effective_quality("int8", _Seq()) == "int8"
+    assert svc._effective_quality("bf16", _Seq(), "/v1/dream") == "full"
+    assert svc._effective_quality("int8", _Seq(), "/v1/dream") == "full"
+    old = svc.cfg.dtype
+    try:
+        svc.cfg.dtype = "bfloat16"
+        assert svc._effective_quality("bf16", _Seq()) == "full"
+        # a bf16-dtype server still runs int8 as a distinct tier
+        assert svc._effective_quality("int8", _Seq()) == "int8"
+    finally:
+        svc.cfg.dtype = old
+
+
+def test_metrics_exposition_lints_with_new_families(qserver):
+    """Every round-18 family — quant tier counters, aot store
+    counters/gauges, the warmup gauge — rides the standard exposition
+    with exactly one TYPE header (the round-8 lint contract)."""
+    # ensure at least one int8 dispatch exists regardless of test order
+    r = _post(
+        qserver,
+        {"file": _data_url(rng_seed=31), "layer": "b2c1", "quality": "int8"},
+    )
+    assert r.status_code == 200
+    text = httpx.get(qserver.base_url + "/metrics", timeout=30).text
+    families, samples = lint_exposition(text)
+    # hits/corrupt ride the same generic counter path as misses/stores
+    # (exercised + verified in the AOT unit tests above) — a fresh
+    # store's cold boot legitimately has neither
+    for family, kind in (
+        ("deconv_quant_int8_batches_total", "counter"),
+        ("deconv_aot_cache_misses_total", "counter"),
+        ("deconv_aot_cache_stores_total", "counter"),
+        ("deconv_aot_store_entries", "gauge"),
+        ("deconv_aot_store_resident_bytes", "gauge"),
+        ("deconv_warmup_seconds", "gauge"),
+    ):
+        assert families.get(family) == kind, f"missing/untyped {family}"
+
+
+def test_jobs_digest_excludes_quality_field():
+    """The jobs idempotency path hashes quality like model: the raw
+    field is excluded (the resolved tier rides the prefix), so explicit
+    quality=full and a bare body dedup onto one digest."""
+    bare = Request(
+        "POST", "/v1/jobs", {},
+        {"content-type": "application/x-www-form-urlencoded"},
+        b"file=abc&layer=c3",
+    )
+    explicit = Request(
+        "POST", "/v1/jobs", {},
+        {"content-type": "application/x-www-form-urlencoded"},
+        b"file=abc&layer=c3&quality=full&model=tiny_vgg",
+    )
+    kw = dict(exclude=("model", "quality"))
+    assert canonical_digest(
+        "p|jobs", bare.headers["content-type"], bare.body, req=bare, **kw
+    ) == canonical_digest(
+        "p|jobs", explicit.headers["content-type"], explicit.body,
+        req=explicit, **kw
+    )
+
+
+# ------------------------------------------------------------------- AOT
+
+
+def _toy_jit():
+    def f(params, batch):
+        return {"y": batch @ params["w"] + params["b"]}
+
+    return jax.jit(f)
+
+
+def _toy_args():
+    params = {
+        "w": np.arange(16, dtype=np.float32).reshape(4, 4),
+        "b": np.ones((4,), np.float32),
+    }
+    batch = np.linspace(-1, 1, 8, dtype=np.float32).reshape(2, 4)
+    return params, batch
+
+
+def test_aot_export_import_byte_parity(tmp_path):
+    params, batch = _toy_args()
+    spec = jax.ShapeDtypeStruct(batch.shape, batch.dtype)
+    meta = {"which": "toy", "v": 1}
+    m1 = Metrics()
+    ex1 = AotExecutor(ArtifactStore(str(tmp_path), metrics=m1), metrics=m1)
+    fn1 = ex1.resolve(meta, _toy_jit(), params, spec)
+    ref = np.asarray(fn1(params, batch)["y"])
+    assert m1.counter("aot_cache_misses_total") == 1
+    assert m1.counter("aot_cache_stores_total") == 1
+    # a second executor over the same store = a second process booting
+    m2 = Metrics()
+    ex2 = AotExecutor(ArtifactStore(str(tmp_path), metrics=m2), metrics=m2)
+    fn2 = ex2.resolve(meta, _toy_jit(), params, spec)
+    assert m2.counter("aot_cache_hits_total") == 1
+    assert m2.counter("aot_cache_misses_total") == 0
+    got = np.asarray(fn2(params, batch)["y"])
+    np.testing.assert_array_equal(ref, got)  # byte parity, not approx
+    # resolution is memoized: the second call never re-reads the store
+    assert ex2.resolve(meta, _toy_jit(), params, spec) is fn2
+
+
+def test_aot_corrupt_artifact_reads_as_miss_and_recompiles(tmp_path):
+    params, batch = _toy_args()
+    spec = jax.ShapeDtypeStruct(batch.shape, batch.dtype)
+    meta = {"which": "toy", "v": 2}
+    m1 = Metrics()
+    ex1 = AotExecutor(ArtifactStore(str(tmp_path), metrics=m1), metrics=m1)
+    ref = np.asarray(ex1.resolve(meta, _toy_jit(), params, spec)(params, batch)["y"])
+    (artifact,) = [f for f in os.listdir(tmp_path) if f.endswith(".aot")]
+    path = os.path.join(str(tmp_path), artifact)
+    for damage in ("flip", "truncate", "garbage-header"):
+        m1_bytes = open(path, "rb").read()
+        if damage == "flip":
+            body = bytearray(m1_bytes)
+            body[len(body) // 2] ^= 0xFF
+            open(path, "wb").write(bytes(body))
+        elif damage == "truncate":
+            open(path, "wb").write(m1_bytes[: len(m1_bytes) // 2])
+        else:
+            open(path, "wb").write(b"not json\n" + m1_bytes)
+        m = Metrics()
+        ex = AotExecutor(ArtifactStore(str(tmp_path), metrics=m), metrics=m)
+        fn = ex.resolve(meta, _toy_jit(), params, spec)
+        got = np.asarray(fn(params, batch)["y"])  # NEVER an error
+        np.testing.assert_array_equal(ref, got)
+        assert m.counter("aot_cache_corrupt_total") == 1
+        assert m.counter("aot_cache_hits_total") == 0
+        # the recompile re-stored a valid artifact
+        assert m.counter("aot_cache_stores_total") == 1
+        assert quant_is_valid_artifact(path)
+
+
+def quant_is_valid_artifact(path: str) -> bool:
+    import hashlib
+
+    raw = open(path, "rb").read()
+    head, _, body = raw.partition(b"\n")
+    meta = json.loads(head)
+    return (
+        meta["len"] == len(body)
+        and meta["digest"]
+        == hashlib.blake2b(body, digest_size=16).hexdigest()
+    )
+
+
+def test_aot_store_budget_sweeps_oldest(tmp_path):
+    store = ArtifactStore(str(tmp_path), max_bytes=4096)
+    big = b"x" * 1500
+    assert store.put("a" * 32, big)
+    os.utime(store._path("a" * 32), (1, 1))  # force oldest
+    assert store.put("b" * 32, big)
+    assert store.put("c" * 32, big)  # over budget: 'a' sweeps
+    assert store.get("a" * 32) is None
+    assert store.get("b" * 32) is not None
+    assert store.entry_count == 2
+    # an artifact larger than the whole budget is refused outright
+    assert not store.put("d" * 32, b"y" * 8192)
+
+
+def test_artifact_digest_is_order_insensitive_and_value_sensitive():
+    a = artifact_digest({"model": "m", "bucket": 4})
+    b = artifact_digest({"bucket": 4, "model": "m"})
+    c = artifact_digest({"bucket": 8, "model": "m"})
+    assert a == b and a != c
+
+
+def test_aot_service_responses_match_jit_path(qserver):
+    """The qserver fixture runs with an AOT store: its compiled-artifact
+    responses must be byte-identical to a plain jit-path server with the
+    same weights (the no-wrong-bytes contract at the service level)."""
+    uri = _data_url(rng_seed=21)
+    via_aot = _post(
+        qserver,
+        {"file": uri, "layer": "b2c1"},
+        {"cache-control": "no-store"},
+    )
+    params = init_params(TINY, jax.random.PRNGKey(3))
+    cfg = ServerConfig(
+        image_size=16, max_batch=4, batch_window_ms=1.0,
+        compilation_cache_dir="",
+    )
+    plain = DeconvService(cfg, spec=TINY, params=params)
+    with ServiceFixture(cfg, service=plain) as s:
+        via_jit = _post(s, {"file": uri, "layer": "b2c1"})
+    assert via_aot.status_code == via_jit.status_code == 200
+    assert via_aot.content == via_jit.content

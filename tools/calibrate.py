@@ -1,0 +1,161 @@
+"""Snapshot per-layer int8 calibration ranges into a digest-addressed
+artifact (round 18 — the quality=int8 execution tier's accuracy half).
+
+Runs a model's forward walk over a calibration image set, records each
+conv/dense layer's input max-abs (engine/quant.py collect_ranges — the
+SAME entry chain the serving visualizer traces, so calibrated names can
+never drift from the programs that consume them), and writes
+``<out>/<model>.calib.json`` tmp-then-rename with a content digest the
+server verifies on load and folds into its int8 cache keys.
+
+Calibration sets, in order of preference:
+
+- ``--images DIR`` — a directory of jpeg/png captures.  The intended
+  production loop: sample real request payloads (the flight recorder at
+  GET /v1/debug/requests tells you which models and layers live traffic
+  actually exercises; payload capture is an operator affair — see
+  docs/OPERATIONS.md "Calibration capture"), decode them to files, point
+  this tool at the directory.
+- default — ``--n-images`` seeded synthetic images (uniform noise
+  through the model's own preprocess).  A bootstrap so int8 works out
+  of the box; ranges from real traffic are strictly better and the
+  artifact records which source produced it.
+
+Determinism: a fixed image set yields byte-identical artifacts (the
+range reduction is max; tests/test_quant_exec.py pins the round trip),
+so re-running calibration against unchanged captures is a no-op for the
+fleet's cache keys.
+
+Usage:
+  python tools/calibrate.py --model vgg16 --out /srv/deconv/calib
+  python tools/calibrate.py --model vgg16 --images ./captures --out ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def _load_images(images_dir: str, size: int, preprocess) -> list:
+    from PIL import Image
+
+    out = []
+    for fn in sorted(os.listdir(images_dir)):
+        if not fn.lower().endswith((".jpg", ".jpeg", ".png")):
+            continue
+        try:
+            img = Image.open(os.path.join(images_dir, fn)).convert("RGB")
+        except Exception as e:  # noqa: BLE001 — skip unreadable, loudly
+            print(f"skipping {fn}: {type(e).__name__}: {e}", file=sys.stderr)
+            continue
+        arr = np.asarray(img.resize((size, size)), np.float32)
+        out.append(preprocess(arr))
+    return out
+
+
+def _synthetic_images(n: int, size: int, preprocess) -> list:
+    # seeded per-index so the default set — and therefore the artifact
+    # digest — is identical across runs and hosts
+    return [
+        preprocess(
+            np.random.default_rng(i)
+            .integers(0, 256, (size, size, 3))
+            .astype(np.float32)
+        )
+        for i in range(n)
+    ]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="vgg16", help="registry model name")
+    p.add_argument(
+        "--out", required=True, metavar="DIR",
+        help="calibration dir the server reads (--calibration-dir)",
+    )
+    p.add_argument(
+        "--images", default="", metavar="DIR",
+        help="directory of jpeg/png calibration captures (default: "
+        "seeded synthetic noise)",
+    )
+    p.add_argument(
+        "--n-images", type=int, default=16,
+        help="synthetic image count when --images is unset (default 16)",
+    )
+    p.add_argument(
+        "--weights", default="", metavar="PATH",
+        help="optional .h5/.npz checkpoint (ranges should describe the "
+        "weights the server actually runs)",
+    )
+    args = p.parse_args()
+
+    from deconv_api_tpu.engine import quant as quant_mod
+    from deconv_api_tpu.serving.models import REGISTRY
+
+    if args.model not in REGISTRY:
+        print(
+            f"unknown model {args.model!r}; available: {sorted(REGISTRY)}",
+            file=sys.stderr,
+        )
+        return 2
+    bundle = REGISTRY[args.model]()
+    if bundle.spec is None:
+        print(
+            f"model {args.model!r} is a DAG backbone — quality=int8 "
+            "normalizes to bf16 there and needs no calibration "
+            "(docs/API.md 'Quality tiers')",
+            file=sys.stderr,
+        )
+        return 2
+    if args.weights:
+        from deconv_api_tpu.models.weights import load_model_weights
+
+        bundle.params = load_model_weights(
+            args.model, bundle.spec, args.weights, bundle.params
+        )
+    size = bundle.image_size
+    if args.images:
+        images = _load_images(args.images, size, bundle.preprocess)
+        source = f"images:{os.path.abspath(args.images)}"
+        if not images:
+            print(f"no decodable images in {args.images}", file=sys.stderr)
+            return 2
+    else:
+        images = _synthetic_images(args.n_images, size, bundle.preprocess)
+        source = f"synthetic:{args.n_images}"
+
+    ranges = quant_mod.collect_ranges(bundle.spec, bundle.params, images)
+    path, digest = quant_mod.save_calibration(
+        args.out, args.model, ranges,
+        image_size=size, n_images=len(images), source=source,
+    )
+    print(
+        json.dumps(
+            {
+                "which": "calibrate",
+                "model": args.model,
+                "path": path,
+                "digest": digest,
+                "layers": len(ranges),
+                "n_images": len(images),
+                "source": source,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -2514,6 +2514,337 @@ def run_model_mix_drill(
     return asyncio.run(drive())
 
 
+# Measured 35.1 dB min / 36.6 dB mean on the tiny random-init spec
+# (2026-08-04); 20 dB leaves real headroom while still catching a
+# broken scale convention (which lands in single digits).
+QUANT_PSNR_FLOOR_DB = 20.0
+QUANT_OVERHEAD_BUDGET_PCT = 3.0
+
+
+def run_quant_drill(
+    n_requests: int = 240,
+    concurrency: int = 16,
+) -> dict:
+    """The round-18 int8 quality-tier drill: one tiny-spec server,
+    interactive-full vs bulk-int8 traffic through the real quality
+    resolution chain (QoS class defaults), against a calibrated
+    artifact.
+
+    What the row pins (each breach is a LOUD `error` field):
+
+    - **quality=full is byte-identical to the pre-round-18 path.**  A
+      plain server's response bytes are captured as the reference; the
+      QoS/quality-enabled server's interactive-class responses must
+      equal them byte for byte.
+    - **No key fragmentation.**  Bare, explicit ``quality=full`` and
+      ``x-quality: full`` spellings of one request produce ONE cache
+      entry (and identical bytes).
+    - **The quality machinery is ~free when unused.**  Hot cached
+      passes with explicit quality fields vs bare may differ by at most
+      QUANT_OVERHEAD_BUDGET_PCT throughput (best-of-2 each side).
+    - **int8 actually engages and stays within its PSNR floor.**  The
+      bulk class's decoded grids must differ from full (engagement is
+      also asserted via quant_int8_batches_total > 0 — a drill that
+      quantized nothing proves nothing) while scoring at least
+      QUANT_PSNR_FLOOR_DB against them, and /readyz must report the
+      model calibrated.
+    """
+    import tempfile
+    import urllib.parse
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.engine import quant as quant_mod
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving.app import DeconvService
+
+    spec = _tiny_spec()
+    size = spec.input_shape[0]
+    params = init_params(spec, jax.random.PRNGKey(0))
+
+    # calibration artifact from the drill's own image set — the capture→
+    # calibrate→serve loop in miniature
+    n_images = 12
+    rng = np.random.default_rng(0)
+    raw_images, uris = [], {}
+    for idx in range(n_images):
+        arr = np.random.default_rng(idx).integers(
+            0, 255, (size, size, 3), np.uint8
+        )
+        img = Image.fromarray(arr, "RGB")
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        uris[idx] = (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+        raw_images.append(arr.astype(np.float32))
+    from deconv_api_tpu.serving import codec
+
+    calib_dir = tempfile.mkdtemp(prefix="deconv-quant-calib-")
+    ranges = quant_mod.collect_ranges(
+        spec, params, [codec.preprocess_vgg(a) for a in raw_images]
+    )
+    _path, calib_digest = quant_mod.save_calibration(
+        calib_dir, spec.name, ranges, image_size=size, n_images=n_images
+    )
+
+    def cfg_for(**kw):
+        base = dict(
+            image_size=size,
+            max_batch=16,
+            batch_window_ms=3.0,
+            compilation_cache_dir="",
+            platform="cpu",
+            warmup_all_buckets=False,
+            calibration_dir=calib_dir,
+        )
+        base.update(kw)
+        return ServerConfig(**base)
+
+    async def post_raw(port, fields, headers=None):
+        body = urllib.parse.urlencode(fields).encode()
+        t0 = time.perf_counter()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        hdr = "".join(
+            f"{k}: {v}\r\n" for k, v in (headers or {}).items()
+        )
+        writer.write(
+            (
+                "POST / HTTP/1.1\r\nHost: x\r\nContent-Type: "
+                "application/x-www-form-urlencoded\r\nContent-Length: "
+                f"{len(body)}\r\n{hdr}Connection: close\r\n\r\n"
+            ).encode()
+            + body
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        status, _ = _resp_status_code(raw)
+        payload = raw.split(b"\r\n\r\n", 1)[1] if b"\r\n\r\n" in raw else b""
+        return time.perf_counter() - t0, status, payload
+
+    async def get_json(port, path):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n".encode()
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        return json.loads(raw.split(b"\r\n\r\n", 1)[1])
+
+    def grid_pixels(payload: bytes):
+        """Decoded uint8 grid out of a compat-route JSON data-url body
+        (the reference percent-quotes the base64 — unquote first)."""
+        import cv2
+
+        url = json.loads(payload)
+        arr = np.frombuffer(
+            base64.b64decode(urllib.parse.unquote(url.split(",", 1)[1])),
+            np.uint8,
+        )
+        img = cv2.imdecode(arr, cv2.IMREAD_COLOR)
+        if img is None:
+            raise ValueError("grid JPEG did not decode")
+        return img.astype(np.float64)
+
+    async def drive():
+        problems: list[str] = []
+        row: dict = {"which": "loopback_quant_drill", "n_images": n_images,
+                     "calib_digest": calib_digest}
+
+        # ---- phase A: plain server = the byte reference --------------
+        svc_ref = DeconvService(cfg_for(), spec=spec, params=params)
+        port = await svc_ref.start("127.0.0.1", 0)
+        await asyncio.to_thread(svc_ref.warmup, "c3")
+        ref_bytes: dict[int, bytes] = {}
+        for idx in range(n_images):
+            _dt, status, payload = await post_raw(
+                port, {"file": uris[idx], "layer": "c3"}
+            )
+            assert status == 200, payload[:120]
+            ref_bytes[idx] = payload
+
+        # non-fragmentation: three spellings of one request → one entry
+        entries0 = svc_ref.cache.entry_count
+        spellings = [
+            ({"file": uris[0], "layer": "c3"}, None),
+            ({"file": uris[0], "layer": "c3", "quality": "full"}, None),
+            ({"file": uris[0], "layer": "c3"}, {"x-quality": "full"}),
+        ]
+        spelled = []
+        for fields, headers in spellings:
+            _dt, status, payload = await post_raw(port, fields, headers)
+            assert status == 200, payload[:120]
+            spelled.append(payload)
+        row["key_fragmentation"] = svc_ref.cache.entry_count - entries0
+        if row["key_fragmentation"] != 0:
+            problems.append(
+                f"quality spellings fragmented the cache key "
+                f"(+{row['key_fragmentation']} entries)"
+            )
+        if not all(p == ref_bytes[0] for p in spelled):
+            problems.append("quality=full spelling changed response bytes")
+
+        # overhead A/B on the hot cached path: bare vs explicit quality
+        stream = [int(x) for x in rng.integers(0, n_images, n_requests)]
+
+        async def hot_pass(explicit: bool) -> float:
+            sem = asyncio.Semaphore(concurrency)
+
+            async def one(i):
+                fields = {"file": uris[stream[i]], "layer": "c3"}
+                headers = None
+                if explicit:
+                    # alternate the two explicit spellings — both must
+                    # ride the bare request's cache keys
+                    if i % 2:
+                        fields["quality"] = "full"
+                    else:
+                        headers = {"x-quality": "full"}
+                async with sem:
+                    _dt, status, _p = await post_raw(port, fields, headers)
+                assert status == 200
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(one(i) for i in range(n_requests)))
+            return n_requests / (time.perf_counter() - t0)
+
+        bare_rate = max([await hot_pass(False) for _ in range(2)])
+        explicit_rate = max([await hot_pass(True) for _ in range(2)])
+        overhead = (bare_rate - explicit_rate) / bare_rate * 100.0
+        row.update(
+            bare_req_s=round(bare_rate, 1),
+            explicit_req_s=round(explicit_rate, 1),
+            overhead_pct=round(overhead, 2),
+            overhead_budget_pct=QUANT_OVERHEAD_BUDGET_PCT,
+        )
+        if overhead > QUANT_OVERHEAD_BUDGET_PCT:
+            problems.append(
+                f"explicit-quality overhead {overhead:.1f}% over the "
+                f"{QUANT_OVERHEAD_BUDGET_PCT:.0f}% budget"
+            )
+        await svc_ref.stop()
+
+        # ---- phase B: interactive-full vs bulk-int8 mix --------------
+        tenants = json.dumps(
+            {
+                "vip": {"class": "interactive"},
+                "batch": {"class": "bulk"},
+            }
+        )
+        svc = DeconvService(
+            cfg_for(qos=True, tenants=tenants), spec=spec, params=params
+        )
+        port = await svc.start("127.0.0.1", 0)
+        await asyncio.to_thread(svc.warmup, "c3")
+        ready = await get_json(port, "/readyz")
+        if spec.name not in (ready.get("quality") or {}).get(
+            "calibrated", []
+        ):
+            problems.append(
+                "/readyz quality block does not report the model calibrated"
+            )
+        row["readyz_quality"] = ready.get("quality")
+
+        sem = asyncio.Semaphore(concurrency)
+        mix_t0 = time.perf_counter()
+        vip_bytes: dict[int, bytes] = {}
+        batch_bytes: dict[int, bytes] = {}
+        failures = 0
+
+        async def one_mix(i):
+            nonlocal failures
+            idx = stream[i]
+            tenant = "vip" if i % 3 else "batch"
+            async with sem:
+                _dt, status, payload = await post_raw(
+                    port,
+                    {"file": uris[idx], "layer": "c3"},
+                    {"x-tenant": tenant},
+                )
+            if status != 200:
+                failures += 1
+                return
+            (vip_bytes if tenant == "vip" else batch_bytes).setdefault(
+                idx, payload
+            )
+
+        await asyncio.gather(*(one_mix(i) for i in range(n_requests)))
+        mix_rate = n_requests / (time.perf_counter() - mix_t0)
+        int8_batches = svc.metrics.counter("quant_int8_batches_total")
+        row.update(
+            mix_req_s=round(mix_rate, 1),
+            failed_requests=failures,
+            int8_batches=int8_batches,
+            vip_keys=len(vip_bytes),
+            batch_keys=len(batch_bytes),
+        )
+        if failures:
+            problems.append(f"{failures} mixed-phase requests failed")
+        if int8_batches == 0:
+            problems.append(
+                "bulk class never dispatched an int8 batch (drill vacuous)"
+            )
+
+        # interactive fidelity: byte-identical to the plain server
+        drifted = [
+            idx for idx, p in vip_bytes.items() if p != ref_bytes[idx]
+        ]
+        row["full_byte_identical"] = not drifted
+        if drifted:
+            problems.append(
+                f"quality=full bytes drifted vs the plain server on "
+                f"{len(drifted)} keys"
+            )
+
+        # bulk fidelity: int8 grids differ from full (engagement) but
+        # score within the PSNR floor
+        psnrs = []
+        identical = 0
+        for idx, p in batch_bytes.items():
+            try:
+                a = grid_pixels(ref_bytes[idx])
+                b = grid_pixels(p)
+            except Exception:  # noqa: BLE001 — undecodable grid = breach
+                problems.append(f"undecodable int8 grid for key {idx}")
+                continue
+            if p == ref_bytes[idx]:
+                identical += 1
+                continue
+            mse = float(np.mean((a - b) ** 2))
+            psnrs.append(
+                10.0 * np.log10(255.0**2 / mse) if mse > 0 else 99.0
+            )
+        if identical == len(batch_bytes):
+            problems.append(
+                "every int8 response was byte-identical to full — the "
+                "tier never engaged"
+            )
+        if psnrs:
+            row["psnr_db"] = round(min(psnrs), 1)
+            row["psnr_mean_db"] = round(sum(psnrs) / len(psnrs), 1)
+            row["psnr_floor_db"] = QUANT_PSNR_FLOOR_DB
+            if min(psnrs) < QUANT_PSNR_FLOOR_DB:
+                problems.append(
+                    f"int8 grid PSNR {min(psnrs):.1f} dB under the "
+                    f"{QUANT_PSNR_FLOOR_DB:.0f} dB floor"
+                )
+        await svc.stop()
+
+        if problems:
+            row["error"] = "; ".join(problems)
+        return row
+
+    return asyncio.run(drive())
+
+
 def run_load(
     pipeline_depth: int,
     n_requests: int = 512,
@@ -2531,6 +2862,7 @@ def run_load(
     heavy: bool = False,
     jobs_dir: str = "",
     qos_on: bool = False,
+    aot_dir: str = "",
 ) -> dict:
     import jax
 
@@ -2614,6 +2946,9 @@ def run_load(
         # anonymous unmetered tenant — the `qos` token pins the 3%
         # budget for the machinery itself on the hot path
         qos=qos_on,
+        # AOT artifact store (round 18): the aot-boot token's cold/warm
+        # warmup A/B runs the same loopback twice against one dir
+        aot_dir=aot_dir,
         # legacy mode reuses 8 images; the cache would serve them and the
         # row would stop measuring the decode->dispatch->encode machinery
         cache_bytes=cfg_cache_bytes() if cache_on else 0,
@@ -2997,6 +3332,33 @@ def run_load(
         if chaos_report is not None:
             row["which"] += "_chaos"
             row["chaos"] = chaos_report
+        if aot_dir:
+            # the aot-boot guard reads the hit/store ledger off the row:
+            # a warm boot must show hits >= warmed programs, a cold one
+            # stores what it compiled.  A mesh/multi-lane run leaves the
+            # tier disabled (service.aot is None) — record that rather
+            # than crashing the row away.
+            row["which"] += "_aot"
+            if service.aot is None:
+                row["aot"] = {"disabled": True}
+            else:
+                row["aot"] = {
+                    "entries": service.aot.store.entry_count,
+                    "resident_bytes": service.aot.store.resident_bytes,
+                    "hits": service.metrics.counter("aot_cache_hits_total"),
+                    "misses": service.metrics.counter(
+                        "aot_cache_misses_total"
+                    ),
+                    "stores": service.metrics.counter(
+                        "aot_cache_stores_total"
+                    ),
+                    "corrupt": service.metrics.counter(
+                        "aot_cache_corrupt_total"
+                    ),
+                    "errors": service.metrics.counter(
+                        "aot_cache_errors_total"
+                    ),
+                }
         if not donate:
             row["which"] += "_nodonate"
             row["donate_inputs"] = False
@@ -3045,6 +3407,8 @@ def main() -> int:
     jobs_dir = ""
     qos_on = False
     model_mix = False
+    quant_drill = False
+    aot_dir = ""
     fleet_n: int | None = None
     fleet_ha = False
     fleet_tail = False
@@ -3086,6 +3450,15 @@ def main() -> int:
         elif args[i] == "--compile-cache-dir":
             compile_cache_dir = args[i + 1]
             i += 2
+        elif args[i] == "--aot-dir":
+            aot_dir = args[i + 1]
+            i += 2
+        elif args[i] == "--quant":
+            # the round-18 int8 quality-tier drill: interactive-full vs
+            # bulk-int8 mix, PSNR floor, byte-identity at quality=full,
+            # key non-fragmentation, and the quality-machinery overhead
+            quant_drill = True
+            i += 1
         elif args[i] == "--heavy":
             heavy = True
             i += 1
@@ -3169,6 +3542,13 @@ def main() -> int:
         except ValueError as e:
             print(e, file=sys.stderr)
             return 2
+    if quant_drill:
+        row = run_quant_drill(
+            n_requests=n_requests or 240,
+            concurrency=min(concurrency, 16),
+        )
+        print(json.dumps(row), flush=True)
+        return 0
     if model_mix:
         row = run_model_mix_drill(
             n_requests=n_requests or 360,
@@ -3230,6 +3610,7 @@ def main() -> int:
             dump_slow=dump_slow, chaos=chaos, pool_decode=pool_decode,
             lanes=lanes, compile_cache_dir=compile_cache_dir, heavy=heavy,
             concurrency=concurrency, jobs_dir=jobs_dir, qos_on=qos_on,
+            aot_dir=aot_dir,
         )
         print(json.dumps(row), flush=True)
     return 0

@@ -98,6 +98,23 @@ FLEET_TAIL_P99_FACTOR = 1.5
 # warm-path p50 regression under the three-model zipf mix.
 MODELS_OVERHEAD_BUDGET_PCT = 3.0
 
+# Int8 quality-tier budgets (round 18): the quality machinery may cost
+# the hot full-fidelity path at most this much (the drill also pins
+# quality=full byte-identity, key non-fragmentation, the PSNR floor and
+# actual int8 engagement itself — see tools/loopback_load.py
+# run_quant_drill).  NOTE: the ~2x-MACs int8 throughput headline is a
+# TPU number — the MXU's 8-bit path decides it, this CPU drill only
+# pins correctness/fidelity (the kpack-style "TPU decides the headline"
+# annotation rides the row).
+QUANT_OVERHEAD_BUDGET_PCT = 3.0
+
+# AOT warm-boot budget (round 18): a second process booting against a
+# populated artifact store must cut its compile-warmup wall by at least
+# this factor vs the cold-store boot, with >= 1 artifact hit per warmed
+# program and the corrupt-artifact path exercised (read as miss +
+# recompile, never an error).
+AOT_BOOT_SPEEDUP_BUDGET = 2.0
+
 # Channel-packed backward-tail budget (round 12): the packed path must
 # not run SLOWER than the vmapped path it would replace — a recorded
 # regression (like the r3 prototype's 280-vs-368 img/s) keeps the
@@ -730,6 +747,153 @@ def run_kpack_guard(timeout_s: float = 3600.0) -> dict:
     return row
 
 
+def run_quant_guard(timeout_s: float = 1800.0) -> dict:
+    """Int8 quality-tier drill guard (round 18):
+    tools/loopback_load.py --quant — interactive-full vs bulk-int8 mix
+    through the QoS class-default chain against a calibrated artifact.
+
+    The row fails LOUDLY (`error` field) when the drill's own
+    invariants broke (byte drift at quality=full, key fragmentation,
+    int8 never engaging, a PSNR-floor breach, failed requests) or when
+    the quality machinery costs the hot full path more than
+    QUANT_OVERHEAD_BUDGET_PCT."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--quant"], timeout_s,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    row = {"config": "quant", "which": "loopback_quant_drill"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    row.update(
+        {
+            k: drill.get(k)
+            for k in (
+                "calib_digest", "key_fragmentation", "bare_req_s",
+                "explicit_req_s", "overhead_pct", "overhead_budget_pct",
+                "mix_req_s", "failed_requests", "int8_batches",
+                "full_byte_identical", "psnr_db", "psnr_mean_db",
+                "psnr_floor_db",
+            )
+        }
+    )
+    # the kpack-token convention: the CPU row pins correctness, the TPU
+    # decides the throughput headline (the ~2x-MACs int8 claim)
+    row["headline_note"] = (
+        "CPU drill pins fidelity/overhead only; int8 throughput headline "
+        "is decided by the TPU MXU 8-bit path (ROADMAP item 5)"
+    )
+    problems = []
+    if drill.get("error"):
+        problems.append(drill["error"])
+    overhead = drill.get("overhead_pct")
+    if overhead is None or overhead > QUANT_OVERHEAD_BUDGET_PCT:
+        problems.append(
+            f"quality-machinery overhead {overhead}% over the "
+            f"{QUANT_OVERHEAD_BUDGET_PCT:.0f}% budget"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
+    return row
+
+
+def run_aot_boot_guard(timeout_s: float = 900.0) -> dict:
+    """AOT warm-boot A/B (round 18): the same loopback boots twice
+    against ONE artifact store — boot 1 compiles and stores every
+    warmup program, boot 2 deserializes them — then a third boot runs
+    with one artifact deliberately corrupted.  The persistent XLA
+    compile cache stays OFF throughout, so the delta is the artifact
+    store's alone.
+
+    Loud failures: warm warmup wall not at least AOT_BOOT_SPEEDUP_BUDGET
+    faster than cold, warm-boot artifact hits below the warmed program
+    count, any aot errors, or the corrupt boot failing to read the bad
+    artifact as a miss (corrupt counter + a clean 200 path)."""
+    import shutil
+    import tempfile
+
+    aot_dir = tempfile.mkdtemp(prefix="deconv-aot-boot-ab-")
+    base = ["--requests", "64", "--passes", "1", "--aot-dir", aot_dir, "2"]
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    cold = run_cmd_json([sys.executable, loopback, *base], timeout_s, env=env)
+    warm = run_cmd_json([sys.executable, loopback, *base], timeout_s, env=env)
+    row = {"config": "aot-boot", "which": "loopback_aot_boot_cold_warm"}
+    if "error" in cold or "error" in warm:
+        row["error"] = cold.get("error") or warm.get("error")
+        shutil.rmtree(aot_dir, ignore_errors=True)
+        return row
+    # corrupt one stored artifact in place: the third boot must read it
+    # as a miss (+1 corrupt), recompile it, and still serve cleanly
+    corrupted = False
+    for fn in sorted(os.listdir(aot_dir)):
+        if fn.endswith(".aot"):
+            path = os.path.join(aot_dir, fn)
+            with open(path, "r+b") as f:
+                f.seek(max(0, os.path.getsize(path) // 2))
+                f.write(b"\x00CORRUPT\x00")
+            corrupted = True
+            break
+    corrupt = (
+        run_cmd_json([sys.executable, loopback, *base], timeout_s, env=env)
+        if corrupted
+        else {"error": "no artifact file found to corrupt"}
+    )
+    cold_s, warm_s = cold.get("warmup_wall_s"), warm.get("warmup_wall_s")
+    cold_aot = cold.get("aot", {})
+    warm_aot = warm.get("aot", {})
+    corrupt_aot = corrupt.get("aot", {})
+    row.update(
+        cold_warmup_s=cold_s,
+        warm_warmup_s=warm_s,
+        aot_warm_speedup=(
+            round(cold_s / warm_s, 2) if cold_s and warm_s else None
+        ),
+        speedup_budget=AOT_BOOT_SPEEDUP_BUDGET,
+        cold_aot=cold_aot,
+        warm_aot=warm_aot,
+        corrupt_aot=corrupt_aot,
+    )
+    problems = []
+    if corrupt.get("error"):
+        problems.append(f"corrupt-artifact boot: {corrupt['error']}")
+    if not cold_aot.get("stores"):
+        problems.append("cold boot stored no artifacts (A/B vacuous)")
+    warmed = cold_aot.get("stores") or 0
+    if (warm_aot.get("hits") or 0) < warmed:
+        problems.append(
+            f"warm boot hit {warm_aot.get('hits')} artifacts for "
+            f"{warmed} warmed programs"
+        )
+    if warm_aot.get("misses"):
+        problems.append(
+            f"warm boot still missed {warm_aot['misses']} programs"
+        )
+    for tag, aot in (("cold", cold_aot), ("warm", warm_aot),
+                     ("corrupt", corrupt_aot)):
+        if aot.get("errors"):
+            problems.append(f"{tag} boot recorded {aot['errors']} aot errors")
+    if corrupted and not corrupt_aot.get("corrupt"):
+        problems.append(
+            "corrupted artifact was not detected (digest verification "
+            "did not fire)"
+        )
+    if (
+        row["aot_warm_speedup"] is None
+        or row["aot_warm_speedup"] < AOT_BOOT_SPEEDUP_BUDGET
+    ):
+        problems.append(
+            f"warm boot speedup {row['aot_warm_speedup']}x under the "
+            f"{AOT_BOOT_SPEEDUP_BUDGET:.0f}x budget "
+            f"({cold_s}s -> {warm_s}s)"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
+    shutil.rmtree(aot_dir, ignore_errors=True)
+    return row
+
+
 def run_compile_cache_guard(timeout_s: float = 900.0) -> dict:
     """Cold vs warm startup A/B (round 10 satellite): the same loopback
     boot twice against one persistent XLA compile-cache dir — run 1
@@ -1076,6 +1240,18 @@ def main() -> int:
             # never-engaged packed program
             result = run_kpack_guard()
             result["date"] = date
+        elif tok == "quant":
+            # int8 quality-tier drill (round 18): interactive-full vs
+            # bulk-int8 mix — byte-identity at full, PSNR floor, key
+            # non-fragmentation, <=3% machinery overhead
+            result = run_quant_guard()
+            result["date"] = date
+        elif tok == "aot-boot":
+            # AOT artifact-store warm-boot A/B (round 18): second boot
+            # against a populated store must cut warmup >=2x, with
+            # per-program hits and the corrupt-artifact path exercised
+            result = run_aot_boot_guard()
+            result["date"] = date
         elif tok == "compile-cache":
             # persistent-compile-cache A/B (round 10): cold vs warm
             # warmup wall against one cache dir
@@ -1091,7 +1267,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'models'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'models', 'quant', 'aot-boot'])}",
             }
         else:
             n = int(tok)

@@ -63,6 +63,38 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "psnr_floor_db" in row or "int8_batches" in row:
+        # int8 quality-tier rows (round 18): fidelity floor, byte pin,
+        # engagement and the machinery-overhead budget in one line;
+        # error kept visible next to the headline trajectory
+        line = (
+            f"int8 psnr {row.get('psnr_db')}dB "
+            f"(floor {row.get('psnr_floor_db')}), full bytes "
+            f"{'pinned' if row.get('full_byte_identical') else 'DRIFTED'}, "
+            f"frag={row.get('key_fragmentation')}, overhead "
+            f"{row.get('overhead_pct')}% "
+            f"(budget {row.get('overhead_budget_pct', 3)}%), "
+            f"int8_batches={row.get('int8_batches')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
+    if "aot_warm_speedup" in row:
+        # AOT warm-boot rows (round 18): the compile-once-boot-warm
+        # claim — cold vs warm warmup wall, the hit ledger, and the
+        # corrupt-artifact fallback in one line
+        warm = row.get("warm_aot") or {}
+        corrupt = row.get("corrupt_aot") or {}
+        line = (
+            f"aot warm boot x{row.get('aot_warm_speedup')} "
+            f"({row.get('cold_warmup_s')}s → {row.get('warm_warmup_s')}s, "
+            f"budget {row.get('speedup_budget', 2)}x), hits="
+            f"{warm.get('hits')}, corrupt fallback="
+            f"{corrupt.get('corrupt')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "detection_s" in row or "p99_ratio" in row:
         # tail-tolerance rows (round 17): gray detection time, the p99
         # containment ratio, the hedge ledger and restoration in one
