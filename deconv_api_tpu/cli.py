@@ -34,7 +34,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             argv += [f"--{flag}", str(val)]
     for flag in (
         "cache_bytes", "cache_ttl_s",
-        "trace_ring", "trace_slow_ms", "trace_sample",
+        "trace_ring", "trace_slow_ms", "trace_sample", "slo",
         "fault_seed", "breaker_threshold", "breaker_cooldown_s",
         "drain_grace_s", "lanes", "lowc_kpack", "compile_cache_dir",
         "jobs_dir", "jobs_workers", "jobs_queue_depth",
@@ -80,6 +80,8 @@ def cmd_fleet_router(args: argparse.Namespace) -> int:
         "slow_min_samples", "slow_hold_s", "slow_floor_ms",
         "slow_canary_every", "latency_window_s", "hedge_budget_pct",
         "hedge_min_delay_ms", "fault_seed",
+        # round 19 observability plane: router flight recorder + SLOs
+        "trace_ring", "trace_slow_ms", "trace_sample", "slo",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -318,6 +320,13 @@ def main(argv: list[str] | None = None) -> int:
         "--trace-sample", type=float, default=None, dest="trace_sample",
         help="head-sample rate for the recent-trace ring (0..1, default 1.0; "
         "slow/error traces are always kept)",
+    )
+    s.add_argument(
+        "--slo", default=None, dest="slo",
+        metavar="NAME=MS:PCT[:ROUTE],...",
+        help="latency SLO objects "
+        "('name=<threshold_ms>:<objective_pct>[:<route>]'): burn-rate "
+        "gauges on /metrics + an slo block on /readyz (default none)",
     )
     s.add_argument(
         "--fault", action="append", default=None, metavar="SITE=SPEC",
@@ -612,6 +621,27 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument(
         "--fault-seed", type=int, default=None, dest="fault_seed",
         help="seed for probabilistic fault specs (chaos replays)",
+    )
+    s.add_argument(
+        "--trace-ring", type=int, default=None, dest="trace_ring",
+        help="router flight-recorder ring size per class (0 disables "
+        "router tracing; default 256)",
+    )
+    s.add_argument(
+        "--trace-slow-ms", type=float, default=None, dest="trace_slow_ms",
+        help="latency threshold for the router's slow-trace ring "
+        "(default 100 ms)",
+    )
+    s.add_argument(
+        "--trace-sample", type=float, default=None, dest="trace_sample",
+        help="head-sample rate for the router's recent-trace ring "
+        "(0..1, default 1.0; slow/error traces always kept)",
+    )
+    s.add_argument(
+        "--slo", default=None, dest="slo",
+        metavar="NAME=MS:PCT[:ROUTE],...",
+        help="router-side latency SLO objects: burn-rate gauges on "
+        "/metrics + an slo block on /readyz (default none)",
     )
     s.set_defaults(fn=cmd_fleet_router)
 

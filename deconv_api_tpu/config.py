@@ -131,6 +131,17 @@ class ServerConfig:
     # one in four, 0 = only slow/error traces are retained).  Span
     # aggregates and counters always update; only ring retention thins.
     trace_sample: float = 1.0
+    # --- latency SLOs (round 19: serving/metrics.py SloTracker) ---
+    # Comma-separated latency SLO objects,
+    # 'name=<threshold_ms>:<objective_pct>[:<route>]' — e.g.
+    # 'api=250:99,deconv=100:99.9:/v1/deconv'.  Each tracks the
+    # fraction of its (optionally route-scoped) requests finishing
+    # under the threshold (5xx always breaches) and publishes
+    # multi-window burn-rate gauges (slo_burn_rate{slo=,window=}) plus
+    # an `slo` block on /readyz.  Requests feed the
+    # request_duration_seconds histogram either way; '' = no SLO
+    # objects (zero extra state).  Validated at boot.
+    slos: str = ""
     # --- robustness layer (round 9: serving/faults.py + supervision) ---
     # Fault injection master switch: enables the registry, the module
     # hook, and the POST /v1/debug/faults arm endpoint (404 while off).

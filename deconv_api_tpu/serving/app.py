@@ -49,7 +49,7 @@ from deconv_api_tpu.serving.cache import (
 )
 from deconv_api_tpu.serving.codec_pool import HostBufferRing, WorkerPool
 from deconv_api_tpu.serving.http import HttpServer, Request, Response
-from deconv_api_tpu.serving.metrics import Metrics
+from deconv_api_tpu.serving.metrics import Metrics, parse_slos, slo_prometheus
 from deconv_api_tpu.serving import trace as trace_mod
 from deconv_api_tpu.serving.trace import FlightRecorder, RequestTrace
 from deconv_api_tpu.utils.tracing import stage
@@ -515,6 +515,21 @@ class DeconvService:
             if self.cfg.trace_ring > 0
             else None
         )
+        # Latency SLOs (round 19, serving/metrics.py): configurable
+        # (threshold, objective) objects fed by the observation wrap
+        # below, publishing multi-window burn-rate gauges and a /readyz
+        # `slo` block.  Validated at BOOT — a malformed spec is a config
+        # error, not a silently dropped objective.  Empty spec = no
+        # trackers, zero per-request cost beyond the histogram.
+        try:
+            self.slos = parse_slos(
+                self.cfg.slos,
+                # the three compute routes the observation wrap covers:
+                # a route scope outside this set would never observe
+                observable_routes=frozenset(("/", "/v1/deconv", "/v1/dream")),
+            )
+        except ValueError as e:
+            raise ValueError(f"invalid slos spec: {e}") from e
         # Cache-key prefixes are PER MODEL since round 15: the model (and
         # its effective image size) moved from the one config prefix into
         # the per-request portion of the key.  A default-model request
@@ -556,34 +571,50 @@ class DeconvService:
         # trace), admission OUTSIDE the cache wrap (identity and budget
         # run before any decode, and a cache hit refunds the
         # provisional device debit down to the fixed hit cost)
+        # round 19: the observation wrap is OUTERMOST — its histogram
+        # and SLO reading must cover the whole server-side life of the
+        # request (trace bookkeeping, admission, cache, compute), and
+        # it must see every outcome including the 4xx/5xx the inner
+        # wraps synthesize
         self.server.route("POST", "/")(
-            self._trace_wrap(
+            self._obs_wrap(
                 "/",
-                self._qos_wrap(
-                    self._cache_wrap("/", self._deconv_compat, self.metrics),
-                    self.metrics,
+                self._trace_wrap(
+                    "/",
+                    self._qos_wrap(
+                        self._cache_wrap(
+                            "/", self._deconv_compat, self.metrics
+                        ),
+                        self.metrics,
+                    ),
                 ),
             )
         )
         self.server.route("POST", "/v1/deconv")(
-            self._trace_wrap(
+            self._obs_wrap(
                 "/v1/deconv",
-                self._qos_wrap(
-                    self._cache_wrap(
-                        "/v1/deconv", self._deconv_v1, self.metrics
+                self._trace_wrap(
+                    "/v1/deconv",
+                    self._qos_wrap(
+                        self._cache_wrap(
+                            "/v1/deconv", self._deconv_v1, self.metrics
+                        ),
+                        self.metrics,
                     ),
-                    self.metrics,
                 ),
             )
         )
         self.server.route("POST", "/v1/dream")(
-            self._trace_wrap(
+            self._obs_wrap(
                 "/v1/dream",
-                self._qos_wrap(
-                    self._cache_wrap(
-                        "/v1/dream", self._dream_v1, self.dream_metrics
+                self._trace_wrap(
+                    "/v1/dream",
+                    self._qos_wrap(
+                        self._cache_wrap(
+                            "/v1/dream", self._dream_v1, self.dream_metrics
+                        ),
+                        self.dream_metrics,
                     ),
-                    self.dream_metrics,
                 ),
             )
         )
@@ -1603,6 +1634,54 @@ class DeconvService:
 
     # ----------------------------------------------------- tracing spine
 
+    def _obs_wrap(self, route: str, handler):
+        """Per-route latency observation (round 19): every completed
+        request — hit, miss, 4xx, shed, crash-synthesized 500 — lands
+        one sample in the ``request_duration_seconds`` fixed-bucket
+        histogram (labels: route + QoS class) and in every matching SLO
+        tracker.  This is the fleet's TRUE-p99 source: the quantile
+        reservoirs elsewhere are exact per process but cannot be
+        aggregated, histograms sum across the federation endpoint.
+        Cost: one bisect + a handful of increments per request."""
+        slos = [t for t in self.slos if t.matches(route)]
+
+        async def observed(req: Request) -> Response:
+            t0 = time.perf_counter()
+            try:
+                resp = await handler(req)
+                status = resp.status
+            except asyncio.CancelledError:
+                # client disconnect: no response was produced; a
+                # fabricated breach sample would let impatient clients
+                # burn the SLO budget (the _trace_wrap rule)
+                raise
+            except BaseException:
+                dt = time.perf_counter() - t0
+                self.metrics.observe_hist(
+                    "request_duration_seconds",
+                    ("route", "qos_class"),
+                    (route, req.tclass or "default"),
+                    dt,
+                )
+                for t in slos:
+                    t.observe(dt, 500)
+                raise
+            dt = time.perf_counter() - t0
+            # tclass is stamped by the QoS admission wrap (inside this
+            # one), so by completion it names the request's class;
+            # "default" with QoS off keeps the label set bounded
+            self.metrics.observe_hist(
+                "request_duration_seconds",
+                ("route", "qos_class"),
+                (route, req.tclass or "default"),
+                dt,
+            )
+            for t in slos:
+                t.observe(dt, status)
+            return resp
+
+        return observed
+
     def _trace_wrap(self, route: str, handler):
         """Give every request on a compute route a span-structured trace
         (round 8, serving/trace.py): activate it on the request's task
@@ -1616,6 +1695,14 @@ class DeconvService:
 
         async def traced(req: Request) -> Response:
             tr = RequestTrace(req.id, route)
+            if req.hop is not None:
+                # router-forwarded request (round 19): stamp WHICH
+                # attempt this was (ordinal + primary/hedge/failover/
+                # canary/replica) before the handler runs, so even a
+                # crash trace is attributable when the router assembles
+                # the cross-hop timeline — a retried request's two
+                # backend traces must be distinguishable
+                tr.annotate(hop=req.hop[0], hop_purpose=req.hop[1])
             token = trace_mod.activate(tr)
             try:
                 resp = await handler(req)
@@ -1669,27 +1756,24 @@ class DeconvService:
                 errors.BadRequest("tracing disabled: set trace_ring > 0"),
                 req.id,
             )
-
-        def truthy(v: str) -> bool:
-            return v.lower() in ("1", "true", "yes", "on")
-
         try:
-            limit = int(req.query.get("limit", "50"))
+            # the shared /v1/debug/requests query contract (round 19:
+            # the router serves the same surface — one parser, no drift)
+            args = trace_mod.debug_query_args(
+                req.query, self.cfg.trace_ring
+            )
         except ValueError:
             return _error_response(
                 errors.BadRequest("limit must be an int"), req.id
             )
         traces = self.recorder.query(
-            slow=truthy(req.query.get("slow", "")),
-            error=truthy(req.query.get("error", "")),
-            trace_id=req.query.get("id") or None,
+            **args,
             # round 13: "which tenant is slow" straight off the flight
             # recorder — filters on the admission wrap's annotation
             tenant=req.query.get("tenant") or None,
             # round 15: "is it only vgg19 requests" — filters on the
             # model-resolution annotation
             model=req.query.get("model") or None,
-            limit=max(1, min(limit, 10 * max(1, self.cfg.trace_ring))),
         )
         return Response.json(
             {
@@ -2181,6 +2265,17 @@ class DeconvService:
             # round 13: tenant occupancy on the probe — a fleet
             # dashboard reads "who is in flight" without /v1/config
             body["qos"] = self.qos.counts()
+        if self.slos:
+            # round 19: the SLO burn picture on the probe — each
+            # objective's multi-window burn rate plus an at-a-glance
+            # ok bit (fast window under budget-spend parity).
+            # Informational: a burning SLO must NOT fail readiness —
+            # pulling capacity at the exact moment the error budget is
+            # burning is how a latency incident becomes an outage.
+            body["slo"] = {
+                t.name: {**t.snapshot(), "ok": t.burn_rates()["5m"] <= 1.0}
+                for t in self.slos
+            }
         return Response.json(body, status=200 if ok else 503)
 
     async def _debug_faults(self, req: Request) -> Response:
@@ -2212,6 +2307,9 @@ class DeconvService:
             # trace-spine per-stage summary (round 8): span seconds/count
             # totals + ring occupancy ride the same exposition
             text += self.recorder.prometheus("deconv")
+        # SLO burn-rate gauges + good/breach totals (round 19) — the
+        # alerting surface the runbook's multiwindow rules scrape
+        text += slo_prometheus(self.slos, "deconv")
         return Response.text(
             text, content_type="text/plain; version=0.0.4"
         )
@@ -2290,6 +2388,10 @@ class DeconvService:
         cfg["trace_active"] = self.recorder is not None
         if self.recorder is not None:
             cfg["trace_counts"] = self.recorder.counts()
+        # latency SLOs (round 19): the effective objectives + live burn
+        cfg["slos"] = bool(cfg["slos"])  # raw spec may be long; no leak
+        if self.slos:
+            cfg["slo_state"] = {t.name: t.snapshot() for t in self.slos}
         # robustness layer (round 9): live breaker / fault / drain state
         cfg["breaker_active"] = self.cfg.breaker_threshold > 0
         if cfg["breaker_active"]:
@@ -3458,6 +3560,12 @@ def main(argv: list[str] | None = None) -> None:
         help="head-sample rate for the recent-trace ring (0..1)",
     )
     p.add_argument(
+        "--slo", default=None, metavar="NAME=MS:PCT[:ROUTE],...",
+        help="latency SLO objects, "
+        "'name=<threshold_ms>:<objective_pct>[:<route>]' — burn-rate "
+        "gauges on /metrics, an slo block on /readyz (default none)",
+    )
+    p.add_argument(
         "--fault", action="append", default=None, metavar="SITE=SPEC",
         help="arm a fault-injection site at startup (repeatable; implies "
         "fault injection enabled — see serving/faults.py for sites/specs)",
@@ -3629,6 +3737,8 @@ def main(argv: list[str] | None = None) -> None:
         overrides["trace_slow_ms"] = args.trace_slow_ms
     if args.trace_sample is not None:
         overrides["trace_sample"] = args.trace_sample
+    if args.slo is not None:
+        overrides["slos"] = args.slo
     if args.no_singleflight:
         overrides["singleflight"] = False
     if args.fault:

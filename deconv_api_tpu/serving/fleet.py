@@ -160,6 +160,38 @@ against the full forward timeout.  Four pieces fix that:
 routing byte-identical to round 16 (the hot-key-replication escape-
 hatch precedent).
 
+Round 19 makes the fleet debuggable as ONE system — the observability
+plane.  Since rounds 15-18 a request's real story crosses HA routers,
+hedge legs, slow-member demotions, peer fills, replica reads and
+failover hops, and the router recorded none of it:
+
+- **Router flight recorder**: the backend's RequestTrace/FlightRecorder
+  spine runs HERE too — spans for ring pick and every forward ATTEMPT
+  (backend-attributed; hedge legs as sibling spans with the loser's
+  cancellation point; failover hops; deadline-at-router expiry), with
+  the same slow/error tail-sampling knobs at GET /v1/debug/requests
+  and the same ``trace_ring=0`` escape hatch.  Router-side error paths
+  that used to vanish — the deliberately backend-less 504, hedge
+  exhaustion, all-slow fallbacks — now each leave an error trace
+  listing what was tried.
+
+- **Cross-hop propagation + assembly**: each attempt is stamped
+  ``x-trace-hop: <ordinal>:<purpose>`` (primary|hedge|failover|canary|
+  replica), which the backend folds into its own trace; GET
+  /v1/debug/trace/{id} joins the router's span tree with every touched
+  backend's flight-recorder record into one merged timeline.
+
+- **Metrics federation**: GET /v1/metrics/fleet scrapes member
+  /v1/metrics and re-exports every family with a ``backend=`` label
+  (one TYPE header per family), fleet rollups, and per-member
+  scrape-staleness gauges — one Prometheus target sees the fleet.
+
+- **True latency histograms + SLO burn rates**: the shared
+  fixed-bucket ``request_duration_seconds`` family renders here with a
+  closed route-family label, and configurable SLO objects
+  (``--slo name=<ms>:<pct>[:<route>]``) publish multi-window burn-rate
+  gauges and a ``/readyz`` ``slo`` block.
+
 Observability rides the existing machinery: a ``Metrics`` registry in
 non-core mode (prefix ``router``) carries
 ``router_requests_total{backend=}`` / ``router_backend_state{backend=}``
@@ -195,7 +227,19 @@ from deconv_api_tpu.serving import faults as faults_mod
 from deconv_api_tpu.serving.batcher import CircuitBreaker
 from deconv_api_tpu.serving.cache import canonical_digest
 from deconv_api_tpu.serving.http import HttpServer, Request, Response
-from deconv_api_tpu.serving.metrics import Metrics
+from deconv_api_tpu.serving.metrics import (
+    Metrics,
+    escape_label,
+    parse_slos,
+    slo_prometheus,
+)
+from deconv_api_tpu.serving.trace import (
+    RID_RE,
+    FlightRecorder,
+    RequestTrace,
+    assemble_timeline,
+    debug_query_args,
+)
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.fleet")
@@ -236,6 +280,25 @@ _STATE_GAUGE = {
 # unique keys must never grow router memory; a clipped key double-counts
 # at worst, and the clip itself is counted).
 MOVED_SEEN_MAX = 4096
+
+# Route families for the router's latency histogram + SLO labels
+# (round 19): req.path is attacker-chosen and job paths embed ids, so
+# the label vocabulary is a CLOSED map — bounded cardinality by
+# construction (the PR 8 tenant rule, applied to metric labels).
+_ROUTE_FAMILIES = frozenset(
+    (
+        "/", "/v1/deconv", "/v1/dream", "/v1/jobs", "/v1/models",
+        "/v1/config", "/v1/metrics", "/metrics", "/healthz", "/readyz",
+    )
+)
+
+
+def _route_family(path: str) -> str:
+    if path in _ROUTE_FAMILIES:
+        return path
+    if path.startswith("/v1/jobs/"):
+        return "/v1/jobs/{id}"
+    return "other"
 
 
 class LatencyDigest:
@@ -785,6 +848,10 @@ class FleetRouter:
         fault_injection: bool = False,
         faults_spec: str = "",
         fault_seed: int = 0,
+        trace_ring: int = 256,
+        trace_slow_ms: float = 100.0,
+        trace_sample: float = 1.0,
+        slos: str = "",
         metrics: Metrics | None = None,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -807,6 +874,39 @@ class FleetRouter:
         self.hot_key_replicas = max(1, int(hot_key_replicas))
         self._clock = clock
         self.metrics = metrics or Metrics(prefix="router", core=False)
+        # Router flight recorder (round 19): the SAME RequestTrace/
+        # FlightRecorder spine the backend runs, recording the router's
+        # side of every request — ring pick, each forward attempt
+        # (backend-attributed, hedge legs as siblings), failover hops,
+        # peer-fill hints, deadline-at-router expiry.  trace_ring=0 is
+        # the same escape hatch: no recorder, no RequestTrace object,
+        # zero per-request state.
+        self.trace_slow_ms = float(trace_slow_ms)
+        self.trace_sample = float(trace_sample)
+        self.recorder = (
+            FlightRecorder(
+                trace_ring, slow_ms=trace_slow_ms, sample=trace_sample
+            )
+            if int(trace_ring) > 0
+            else None
+        )
+        self.trace_ring = int(trace_ring)
+        # Router-side latency SLOs (round 19): fed by every terminal
+        # response path, route-scoped by the closed _route_family map —
+        # which is also the scope vocabulary a --slo route must name
+        # (a typo'd route is a boot error, not a 0.0-burn dead object)
+        self.slos = parse_slos(
+            slos,
+            observable_routes=frozenset(
+                (*_ROUTE_FAMILIES, "/v1/jobs/{id}", "other")
+            ),
+        )
+        # last successful per-member /v1/metrics scrape, for the
+        # federation endpoint: (monotonic ts, exposition text).  A
+        # member that stops answering re-exports its LAST-GOOD text
+        # with the staleness gauge climbing — a vanished family reads
+        # as a counter reset to every downstream rate() otherwise.
+        self._scrape_cache: dict[str, tuple[float, str]] = {}
         # round 17 tail tolerance: OFF pins topology and routing
         # byte-identical to the round-16 router (the escape hatch the
         # hot-key-replication precedent set) — no digests fed, no slow
@@ -934,6 +1034,19 @@ class FleetRouter:
         self.server.route("GET", "/v1/config")(self._config)
         self.server.route("GET", "/metrics")(self._metrics_route)
         self.server.route("GET", "/v1/metrics")(self._metrics_route)
+        # fleet observability surfaces (round 19).  NOTE the first two
+        # exact routes SHADOW proxying of those paths (the
+        # /v1/debug/faults precedent): the router's own flight recorder
+        # answers /v1/debug/requests — query a BACKEND's recorder by
+        # asking it directly, or let /v1/debug/trace/{id} join both
+        # sides for you.
+        self.server.route("GET", "/v1/debug/requests")(
+            self._debug_requests
+        )
+        self.server.route_prefix("GET", "/v1/debug/trace/")(
+            self._debug_trace
+        )
+        self.server.route("GET", "/v1/metrics/fleet")(self._metrics_fleet)
         if self.fleet_token:
             # self-registration surface (round 16): ONLY with a shared
             # token configured — a tokenless router keeps the whole
@@ -1750,6 +1863,33 @@ class FleetRouter:
         self._rr += 1
         return pool[self._rr % len(pool)]
 
+    @staticmethod
+    def _attempt_purpose(
+        owner: str | None,
+        m: BackendMember,
+        tried: set[str],
+        replicas: list[str] | None,
+    ) -> str:
+        """Classify a pick for the x-trace-hop stamp + attempt span
+        (round 19), from the same state _pick used (``owner`` is the
+        key's ring owner, computed ONCE per attempt by the caller —
+        this runs on the hot proxy path): a retry walk is a
+        ``failover``; a hot-key spread read off the primary is a
+        ``replica``; a pick that LANDED on a slow member is a
+        ``canary`` (the canary cadence or the all-slow fallback —
+        either way a deliberate visit to the demoted member); a keyed
+        pick standing in for a demoted owner is a ``failover``;
+        everything else is the ``primary``."""
+        if tried:
+            return "failover"
+        if replicas and m.name != replicas[0]:
+            return "replica"
+        if m.state == "slow":
+            return "canary"
+        if owner is not None and owner != m.name:
+            return "failover"
+        return "primary"
+
     def _peer_hint(self, key: str, owner: str) -> str | None:
         """Previous ring owner for a key whose placement moved in the
         last PEER_FILL_WINDOW_S — the ``x-peer-fill`` hint — and the
@@ -1790,13 +1930,23 @@ class FleetRouter:
         key: str | None,
         owner: str,
         hint: str | None = None,
+        hop: str | None = None,
     ) -> dict[str, str]:
-        # x-peer-fill is router-authoritative: a client-supplied hint
-        # would point a trusting backend at an arbitrary host:port
+        # x-peer-fill and x-trace-hop are router-authoritative: a
+        # client-supplied hint would point a trusting backend at an
+        # arbitrary host:port, and a client-supplied hop would let it
+        # forge attempt attribution in the backend's flight recorder
         fwd_headers = {
             k: v for k, v in req.headers.items()
-            if k not in _HOP_HEADERS and k != "x-peer-fill"
+            if k not in _HOP_HEADERS
+            and k not in ("x-peer-fill", "x-trace-hop")
         }
+        if hop is not None:
+            # cross-hop trace context (round 19): WHICH attempt this
+            # forward is (ordinal:purpose) — the backend folds it into
+            # its own trace so the assembled timeline can tell a
+            # retry's two backend traces apart
+            fwd_headers["x-trace-hop"] = hop
         # the router's id IS the fleet's id: honored inbound ids pass
         # through untouched; minted ones (absent/insane inbound) are
         # stamped here so the backend's flight recorder, the backend
@@ -1821,6 +1971,32 @@ class FleetRouter:
             target += "?" + urllib.parse.urlencode(req.query)
         return target
 
+    def _observe_route(
+        self, path: str, dt_s: float, status: int
+    ) -> None:
+        """Round 19: one histogram sample + every matching SLO tracker
+        per terminal response — the router's true-p99/burn-rate source,
+        labeled by the CLOSED route-family map (bounded cardinality)."""
+        family = _route_family(path)
+        self.metrics.observe_hist(
+            "request_duration_seconds", ("route",), (family,), dt_s
+        )
+        for t in self.slos:
+            if t.matches(family):
+                t.observe(dt_s, status)
+
+    def _record_trace(
+        self,
+        tr: RequestTrace | None,
+        status: int,
+        error: str | None = None,
+        cache: str | None = None,
+    ) -> None:
+        if tr is None or self.recorder is None:
+            return
+        tr.finish(status, error=error, cache=cache)
+        self.recorder.record(tr)
+
     def _respond(
         self,
         req: Request,
@@ -1830,6 +2006,7 @@ class FleetRouter:
         body: bytes,
         t0: float,
         stream: object | None = None,
+        trace: RequestTrace | None = None,
     ) -> Response:
         """Per-forward bookkeeping + the response the client sees (the
         success tail shared by the keyed, job-entity and fan-out paths).
@@ -1841,6 +2018,12 @@ class FleetRouter:
         self.metrics.observe_stage("forward", dt)
         code = errors.code_from_body(body) if status >= 400 else None
         self.metrics.observe_request(dt, code)
+        self._observe_route(req.path, dt, status)
+        if trace is not None:
+            trace.annotate(backend=m.name)
+            self._record_trace(
+                trace, status, error=code, cache=headers.get("x-cache")
+            )
         slog.event(
             _log, "router_request",
             level=logging.WARNING if status >= 500 else logging.INFO,
@@ -1857,9 +2040,18 @@ class FleetRouter:
             status=status, body=body, headers=resp_headers, stream=stream
         )
 
-    def _unavailable(self, req: Request, t0: float, last_err: str) -> Response:
+    def _unavailable(
+        self,
+        req: Request,
+        t0: float,
+        last_err: str,
+        trace: RequestTrace | None = None,
+    ) -> Response:
         # no backend reachable (empty ring, or every candidate
-        # infra-failed)
+        # infra-failed).  Round 19 satellite: this is a router-side
+        # error that used to vanish without a trace — the attempts that
+        # were tried (incl. both legs of an exhausted hedge) are
+        # already spans on ``trace``; the error ring keeps them.
         e = errors.BackendUnavailable(
             "no backend available"
             + (f" (last: {last_err})" if last_err else ""),
@@ -1867,6 +2059,8 @@ class FleetRouter:
         )
         dt = time.perf_counter() - t0
         self.metrics.observe_request(dt, e.code)
+        self._observe_route(req.path, dt, e.status)
+        self._record_trace(trace, e.status, error=e.code)
         slog.event(
             _log, "router_request", level=logging.ERROR,
             method=req.method, path=req.path, status=e.status,
@@ -1886,14 +2080,21 @@ class FleetRouter:
             self._job_owners.popitem(last=False)
 
     def _deadline_expired(
-        self, req: Request, t0: float, during: str | None = None
+        self,
+        req: Request,
+        t0: float,
+        during: str | None = None,
+        trace: RequestTrace | None = None,
     ) -> Response:
         """Round 17 satellite: a request whose ``x-deadline-ms`` budget
         is spent 504s AT THE ROUTER — before consuming a backend
         (``during`` None), or the moment its deadline-capped forward
         times out mid-flight (``during`` names the backend; that
         timeout is the CALLER's budget lapsing, not backend death, so
-        it never feeds the ejection breaker)."""
+        it never feeds the ejection breaker).  Round 19: the 504 that
+        deliberately carries no ``x-backend`` now carries a TRACE —
+        annotated deadline_expired, with whatever attempts ran before
+        the budget died (none, when it expired on arrival)."""
         e = errors.DeadlineExpired(
             "x-deadline-ms budget exhausted at the router"
             + (f" (forward to {during} cut short)" if during else "")
@@ -1901,6 +2102,13 @@ class FleetRouter:
         self.metrics.inc_counter("deadline_expired_total")
         dt = time.perf_counter() - t0
         self.metrics.observe_request(dt, e.code)
+        self._observe_route(req.path, dt, e.status)
+        if trace is not None:
+            trace.annotate(
+                deadline_expired=True,
+                **({"during": during} if during else {}),
+            )
+            self._record_trace(trace, e.status, error=e.code)
         slog.event(
             _log, "router_request", level=logging.WARNING,
             method=req.method, path=req.path, status=e.status,
@@ -1927,6 +2135,9 @@ class FleetRouter:
         timeout_s: float,
         tried: set[str],
         deadline_capped: bool = False,
+        tr: RequestTrace | None = None,
+        hops: list[int] | None = None,
+        purpose: str = "primary",
     ) -> tuple[BackendMember, int, dict[str, str], bytes, float]:
         """One forward with a tail hedge (round 17): the primary fires
         immediately; once it has been out longer than the live fleet
@@ -1939,16 +2150,46 @@ class FleetRouter:
         timing out is the CALLER's budget lapsing, not backend death:
         it is never noted, and when it is all that remains the plain
         ``_BackendError`` propagates so the caller's deadline guard
-        answers 504."""
+        answers 504.
 
-        async def timed(mm: BackendMember, hdrs: dict, to: float):
-            ts = time.perf_counter()
-            s, h, b = await self._backend_request(
-                mm, req.method, target, hdrs, req.body, to
-            )
+        Round 19 tracing: the two legs are SIBLING ``attempt`` spans on
+        ``tr`` — the helper records the failed and cancelled legs (a
+        cancelled loser's span ends at its cancellation point, with
+        ``cancelled: true``); the caller records the winner's span,
+        because only it knows the final disposition.  ``hops`` is the
+        request's shared attempt-ordinal counter: the hedge leg takes
+        the next ordinal so a later failover never collides."""
+        prim_ord = hops[0] if hops is not None else 1
+        # per-leg start times + span metadata, for the failure spans
+        # recorded in ``timed`` and the CANCELLED-loser span recorded
+        # synchronously in the finally below (recording it from the
+        # loser's own CancelledError handler would land AFTER the
+        # winner's trace was snapshotted into the recorder — the
+        # cancellation point would vanish from the recorded trace)
+        leg_t0: dict[str, float] = {}
+
+        async def timed(
+            mm: BackendMember, hdrs: dict, to: float,
+            hop_ord: int, leg_purpose: str,
+        ):
+            leg_t0[mm.name] = ts = time.perf_counter()
+            try:
+                s, h, b = await self._backend_request(
+                    mm, req.method, target, hdrs, req.body, to
+                )
+            except _BackendError as e:
+                if tr is not None:
+                    tr.add_span(
+                        "attempt", ts, time.perf_counter() - ts,
+                        backend=mm.name, hop=hop_ord,
+                        purpose=leg_purpose, error=str(e),
+                    )
+                raise
             return s, h, b, time.perf_counter() - ts
 
-        prim_task = asyncio.ensure_future(timed(m, fwd_headers, timeout_s))
+        prim_task = asyncio.ensure_future(
+            timed(m, fwd_headers, timeout_s, prim_ord, purpose)
+        )
         delay = self._hedge_delay_s()
         if delay is None or delay >= timeout_s:
             s, h, b, dt = await prim_task
@@ -1969,13 +2210,28 @@ class FleetRouter:
             s, h, b, dt = await prim_task
             return m, s, h, b, dt
         self.metrics.inc_counter("hedges_fired_total")
+        if hops is not None:
+            hops[0] += 1
+        hedge_ord = prim_ord + 1
+        if tr is not None:
+            tr.annotate(hedge_fired=True, hedge_backend=hm.name)
         remaining = max(0.001, self._effective_timeout(req, timeout_s))
         # no x-peer-fill hint on the duplicate: the obvious fill source
         # is the very primary being raced
         hedge_task = asyncio.ensure_future(
-            timed(hm, self._forward_headers(req, key, hm.name), remaining)
+            timed(
+                hm,
+                self._forward_headers(
+                    req, key, hm.name, hop=f"{hedge_ord}:hedge"
+                ),
+                remaining, hedge_ord, "hedge",
+            )
         )
         by_task = {prim_task: m, hedge_task: hm}
+        leg_meta = {
+            prim_task: (prim_ord, purpose),
+            hedge_task: (hedge_ord, "hedge"),
+        }
         pending = set(by_task)
         last_err: _BackendError | None = None
         deadline_err: _BackendError | None = None
@@ -1994,7 +2250,12 @@ class FleetRouter:
                     except _BackendError as e:
                         if deadline_capped and _is_timeout(e):
                             # the caller's budget lapsed on this leg:
-                            # no breaker state, no tried entry
+                            # no breaker state, no tried entry.  Name
+                            # WHICH leg — m in the caller is still the
+                            # pre-hedge primary, and the 504's `during`
+                            # (and its trace annotation) must not blame
+                            # the primary for the hedge leg's timeout.
+                            e.member = mm.name
                             deadline_err = e
                             continue
                         last_err = e
@@ -2017,11 +2278,31 @@ class FleetRouter:
         finally:
             # close the loser's (or, on exhaustion, nobody's) in-flight
             # connection; the swallow callback retrieves the
-            # CancelledError so the loop never logs an orphan
+            # CancelledError so the loop never logs an orphan.  The
+            # loser's span is recorded HERE — synchronously, at the
+            # cancellation point — so it is already on the trace when
+            # the caller's _respond snapshots it into the recorder.
             for t in by_task:
                 if not t.done():
+                    mm = by_task[t]
+                    if tr is not None:
+                        ts = leg_t0.get(mm.name, time.perf_counter())
+                        ord_, purp = leg_meta[t]
+                        tr.add_span(
+                            "attempt", ts, time.perf_counter() - ts,
+                            backend=mm.name, hop=ord_, purpose=purp,
+                            cancelled=True,
+                        )
                     t.cancel()
                     t.add_done_callback(_swallow_task_result)
+
+    def _new_trace(self, req: Request) -> RequestTrace | None:
+        """The router's side of a request's story (round 19): a
+        RequestTrace on the shared spine, or None with the recorder off
+        — the trace_ring=0 escape hatch allocates NOTHING per request."""
+        if self.recorder is None:
+            return None
+        return RequestTrace(req.id, _route_family(req.path))
 
     async def _proxy(self, req: Request) -> Response:
         t0 = time.perf_counter()
@@ -2029,24 +2310,32 @@ class FleetRouter:
             # the peer-fill surface is backend-to-backend on the trusted
             # mesh: unauthenticated and QoS-unmetered BY DESIGN, which
             # is exactly why the router must not re-export it to
-            # clients.  Same shape as a route that does not exist.
+            # clients.  Same shape as a route that does not exist —
+            # but still a histogram/SLO sample (round 19): bad-path
+            # traffic must not be invisible to the rate the fleet p99
+            # is computed over.
+            self._observe_route(
+                req.path, time.perf_counter() - t0, 404
+            )
             return Response.json(
                 {"error": f"no route for {req.path}"}, 404
             )
+        tr = self._new_trace(req)
         if req.deadline is not None and (
             req.deadline - time.perf_counter() <= 0.01
         ):
             # already expired at the router (round 17 satellite): 504
             # without consuming a backend — forwarding work whose
             # caller has given up is the router-tier version of
-            # dispatching dead work to the device
-            return self._deadline_expired(req, t0)
+            # dispatching dead work to the device.  The trace says so
+            # (round 19): no attempt spans, deadline_expired annotated.
+            return self._deadline_expired(req, t0, trace=tr)
         if req.method in ("GET", "DELETE"):
             if req.method == "GET" and req.path.rstrip("/") == "/v1/jobs":
-                return await self._proxy_jobs_collection(req, t0)
+                return await self._proxy_jobs_collection(req, t0, tr)
             jm = _JOBS_ENTITY_RE.match(req.path)
             if jm is not None:
-                return await self._proxy_job(req, jm.group(1), t0)
+                return await self._proxy_job(req, jm.group(1), t0, tr)
         key = None
         if req.method == "POST" and req.body:
             # the SAME canonicalization as the backend cache key
@@ -2129,6 +2418,12 @@ class FleetRouter:
                     self._replica_cache[key] = owners
                 if len(owners) > 1:
                     replicas = owners
+        if tr is not None and key is not None:
+            # enough digest to eyeball cache/ring joins without bloating
+            # every retained trace with 64 hex chars
+            tr.annotate(key=key[:16])
+            if replicas:
+                tr.annotate(replicas=list(replicas))
         tried: set[str] = set()
         last_err = ""
         target = self._forward_target(req)
@@ -2161,10 +2456,26 @@ class FleetRouter:
             # every eligible request deposits its fraction of a hedge
             # token — the <=pct% bound is against this stream
             self.hedge_budget.on_request()
+        # attempt-ordinal counter shared with the hedge helper (round
+        # 19): every forward leg — primary, hedge, failover — gets a
+        # distinct x-trace-hop ordinal, so the assembled timeline can
+        # tell the backend traces apart
+        hops = [0]
         for _attempt in range(attempts):
+            t_pick = time.perf_counter()
             m = self._pick(key, tried, replicas)
             if m is None:
                 break
+            # the key's ring owner, computed once per attempt: the
+            # purpose classifier AND the demoted-primary hint below
+            # both need it (one blake2b+bisect, hot path)
+            owner = self.ring.owner(key) if key is not None else None
+            purpose = self._attempt_purpose(owner, m, tried, replicas)
+            if tr is not None:
+                tr.add_span(
+                    "ring_pick", t_pick, time.perf_counter() - t_pick,
+                    backend=m.name, purpose=purpose,
+                )
             # round 17 satellite: effective timeout = min(forward
             # timeout, remaining deadline budget), re-derived per
             # attempt; a spent budget 504s without consuming a backend
@@ -2173,7 +2484,7 @@ class FleetRouter:
             if req.deadline is not None:
                 remaining = req.deadline - time.perf_counter()
                 if remaining <= 0.01:
-                    return self._deadline_expired(req, t0)
+                    return self._deadline_expired(req, t0, trace=tr)
                 if remaining < timeout_s:
                     timeout_s = remaining
                     deadline_capped = True
@@ -2199,7 +2510,6 @@ class FleetRouter:
                 and replicas is None
                 and self.peer_fill
             ):
-                owner = self.ring.owner(key)
                 if (
                     owner is not None
                     and owner != m.name
@@ -2211,10 +2521,17 @@ class FleetRouter:
                     # copies bytes from it instead of recomputing the
                     # whole demoted keyspace
                     hint = owner
-            fwd_headers = self._forward_headers(req, key, m.name, hint=hint)
+            hops[0] += 1
+            hop_ord = hops[0]
+            fwd_headers = self._forward_headers(
+                req, key, m.name, hint=hint,
+                hop=f"{hop_ord}:{purpose}",
+            )
+            picked = m  # the pre-hedge pick: m may become the winner
+            hedged_path = hedgeable and not tried and m.state != "slow"
             t_att = time.perf_counter()
             try:
-                if hedgeable and not tried and m.state != "slow":
+                if hedged_path:
                     # a SLOW pick (canary, or the all-slow fallback) is
                     # never hedged: a winning hedge would cancel the
                     # canary's observation — the whole point is to let
@@ -2224,6 +2541,7 @@ class FleetRouter:
                             req, m, key, target, fwd_headers,
                             timeout_s, tried,
                             deadline_capped=deadline_capped,
+                            tr=tr, hops=hops, purpose=purpose,
                         )
                     )
                 else:
@@ -2233,15 +2551,31 @@ class FleetRouter:
                     )
                     dt = time.perf_counter() - t_att
             except _HedgeExhausted as e:
-                # both race legs already noted/`tried` inside the
-                # helper — just move the walk along
+                # both race legs already noted/`tried`/span-recorded
+                # inside the helper — just move the walk along
                 last_err = str(e)
                 continue
             except _BackendError as e:
+                if tr is not None and not hedged_path:
+                    # the hedged path's legs record their own spans
+                    # inside the helper (incl. a fast primary failure
+                    # re-raised through it) — recording here too would
+                    # double the span
+                    tr.add_span(
+                        "attempt", t_att, time.perf_counter() - t_att,
+                        backend=m.name, hop=hop_ord, purpose=purpose,
+                        error=str(e),
+                    )
                 if deadline_capped and _is_timeout(e):
                     # the CALLER's budget lapsed mid-forward — not
-                    # backend death; 504, and the breaker stays clean
-                    return self._deadline_expired(req, t0, during=m.name)
+                    # backend death; 504, and the breaker stays clean.
+                    # A hedged race stamps the timed-out LEG's name on
+                    # the error (m still names the pre-hedge primary).
+                    return self._deadline_expired(
+                        req, t0,
+                        during=getattr(e, "member", m.name),
+                        trace=tr,
+                    )
                 last_err = str(e)
                 self._note_forward_result(m, ok=False)
                 tried.add(m.name)
@@ -2250,6 +2584,25 @@ class FleetRouter:
                     backend=m.name, id=req.id, error=last_err,
                 )
                 continue
+            if tr is not None:
+                # the WINNING leg's span (the hedge helper records only
+                # losers — it cannot know the final disposition).  The
+                # winner mark is scoped to THIS attempt having raced
+                # (hedged_path): hedge_fired is a trace-level
+                # annotation, and a later failover after an exhausted
+                # hedge must not be painted as a race winner.
+                won_hedge = m is not picked
+                raced = hedged_path and tr.annotations.get("hedge_fired")
+                tr.add_span(
+                    "attempt",
+                    time.perf_counter() - dt,
+                    dt,
+                    backend=m.name,
+                    hop=hop_ord + 1 if won_hedge else hop_ord,
+                    purpose="hedge" if won_hedge else purpose,
+                    status=status,
+                    **({"winner": True} if raced else {}),
+                )
             # 500/502 = the backend (or ITS downstream) crashing — a
             # passive-ejection signal like a timeout.  503/504 are
             # designed backpressure (sheds, breakers, deadlines): they
@@ -2278,11 +2631,17 @@ class FleetRouter:
                 jid = headers.get("location", "").rsplit("/", 1)[-1]
                 if jid:
                     self._learn_job_owner(jid, m.name)
-            return self._respond(req, m, status, headers, body, t0)
-        return self._unavailable(req, t0, last_err)
+            return self._respond(
+                req, m, status, headers, body, t0, trace=tr
+            )
+        return self._unavailable(req, t0, last_err, trace=tr)
 
     async def _proxy_job(
-        self, req: Request, job_id: str, t0: float
+        self,
+        req: Request,
+        job_id: str,
+        t0: float,
+        tr: RequestTrace | None = None,
     ) -> Response:
         """GET/DELETE ``/v1/jobs/{id}[/...]`` — follow the JOB, not the
         ring.  The owner pinned at submit time goes first; after a
@@ -2324,8 +2683,16 @@ class FleetRouter:
         miss: tuple | None = None
         no_route: tuple | None = None
         last_err = ""
+        hop_ord = 0
         for m in cands:
-            fwd_headers = self._forward_headers(req, None, m.name)
+            hop_ord += 1
+            # the pinned owner is the walk's primary; every further
+            # candidate is a failover hop — stamped so the backend's
+            # trace of a walked poll is attributable (round 19)
+            purpose = "primary" if hop_ord == 1 else "failover"
+            fwd_headers = self._forward_headers(
+                req, None, m.name, hop=f"{hop_ord}:{purpose}"
+            )
             stream = None
             # the pinned owner gets the full forward timeout (a /result
             # body may be large); blind-walk candidates get a short
@@ -2343,7 +2710,7 @@ class FleetRouter:
                 req.deadline - time.perf_counter() <= 0.01
             ):
                 # the budget ran out mid-walk: stop consuming members
-                return self._deadline_expired(req, t0)
+                return self._deadline_expired(req, t0, trace=tr)
             timeout = self._effective_timeout(req, base_timeout)
             deadline_capped = timeout < base_timeout
             t_att = time.perf_counter()
@@ -2376,11 +2743,19 @@ class FleetRouter:
                         req.body, timeout,
                     )
             except _BackendError as e:
+                if tr is not None:
+                    tr.add_span(
+                        "attempt", t_att, time.perf_counter() - t_att,
+                        backend=m.name, hop=hop_ord, purpose=purpose,
+                        error=str(e),
+                    )
                 if deadline_capped and _is_timeout(e):
                     # the caller's budget lapsed mid-forward — not this
                     # member's failure, and no point walking on with an
                     # already-spent budget
-                    return self._deadline_expired(req, t0, during=m.name)
+                    return self._deadline_expired(
+                        req, t0, during=m.name, trace=tr
+                    )
                 last_err = str(e)
                 self._note_forward_result(m, ok=False)
                 slog.event(
@@ -2388,6 +2763,13 @@ class FleetRouter:
                     backend=m.name, id=req.id, error=last_err,
                 )
                 continue
+            if tr is not None:
+                tr.add_span(
+                    "attempt", t_att, time.perf_counter() - t_att,
+                    backend=m.name, hop=hop_ord, purpose=purpose,
+                    status=status,
+                    **({"stream": True} if stream is not None else {}),
+                )
             # stream heads are EXCLUDED from the latency digest (round
             # 17): an SSE head's timing is dominated by the job's own
             # state, not the network path
@@ -2416,7 +2798,8 @@ class FleetRouter:
             if status < 500:
                 self._learn_job_owner(job_id, m.name)
             return self._respond(
-                req, m, status, headers, body, t0, stream=stream
+                req, m, status, headers, body, t0, stream=stream,
+                trace=tr,
             )
         # members not askable right now (ejected, or still joining) may
         # be this durable job's only holder — their jobs survive on disk
@@ -2439,14 +2822,20 @@ class FleetRouter:
             final = miss if miss is not None else no_route
             if final is not None:
                 m, status, headers, body = final
-                return self._respond(req, m, status, headers, body, t0)
+                return self._respond(
+                    req, m, status, headers, body, t0, trace=tr
+                )
         return self._unavailable(
             req, t0,
             last_err or f"unreachable members: {', '.join(unreachable)}",
+            trace=tr,
         )
 
     async def _proxy_jobs_collection(
-        self, req: Request, t0: float
+        self,
+        req: Request,
+        t0: float,
+        tr: RequestTrace | None = None,
     ) -> Response:
         """GET ``/v1/jobs`` — scatter-gather over every in-ring member:
         jobs are per-backend state, so a single-backend view through the
@@ -2468,7 +2857,7 @@ class FleetRouter:
             or (m.state == "draining" and not m.announced_drain)
         ]
         if not members:
-            return self._unavailable(req, t0, "")
+            return self._unavailable(req, t0, "", trace=tr)
         target = self._forward_target(req)
 
         async def one(m: BackendMember):
@@ -2481,11 +2870,24 @@ class FleetRouter:
                 # minutes (no member is "pinned" for a listing)
                 got = await self._backend_request(
                     m, "GET", target,
-                    self._forward_headers(req, None, m.name), b"",
+                    self._forward_headers(
+                        req, None, m.name, hop="1:primary"
+                    ),
+                    b"",
                     eff,
                 )
+                if tr is not None:
+                    tr.add_span(
+                        "fanout", t_att, time.perf_counter() - t_att,
+                        backend=m.name, status=got[0],
+                    )
                 return m, got, (time.perf_counter() - t_att) * 1e3, False
             except _BackendError as e:
+                if tr is not None:
+                    tr.add_span(
+                        "fanout", t_att, time.perf_counter() - t_att,
+                        backend=m.name, error=str(e),
+                    )
                 # a deadline-capped leg timing out is the CALLER's
                 # budget, not this member's failure (partial view, but
                 # no breaker state)
@@ -2550,6 +2952,10 @@ class FleetRouter:
         dt = time.perf_counter() - t0
         self.metrics.observe_stage("forward", dt)
         self.metrics.observe_request(dt)
+        self._observe_route(req.path, dt, 200)
+        if tr is not None:
+            tr.annotate(fanout=len(members), partial=partial)
+            self._record_trace(tr, 200)
         slog.event(
             _log, "router_request", method=req.method, path=req.path,
             status=200, backend="*", id=req.id, ms=round(dt * 1e3, 1),
@@ -2616,6 +3022,13 @@ class FleetRouter:
                     for m in self.members.values()
                 },
             }
+        if self.slos:
+            # round 19: burn picture on the probe — informational, the
+            # backend rule (a burning SLO must not pull router capacity)
+            body["slo"] = {
+                t.name: {**t.snapshot(), "ok": t.burn_rates()["5m"] <= 1.0}
+                for t in self.slos
+            }
         return Response.json(body, status=200 if ok else 503)
 
     async def _config(self, _req: Request) -> Response:
@@ -2680,6 +3093,17 @@ class FleetRouter:
                     ),
                     "fleet_latency": self._fleet_latency.snapshot(),
                 },
+                # round 19: the router observability plane — recorder
+                # state + live SLO burn, mirroring the backend contract
+                "trace_active": self.recorder is not None,
+                **(
+                    {"trace_counts": self.recorder.counts()}
+                    if self.recorder is not None
+                    else {}
+                ),
+                "slo_state": {
+                    t.name: t.snapshot() for t in self.slos
+                },
                 "fault_injection_active": self.faults is not None,
                 **(
                     {"faults_state": self.faults.snapshot()}
@@ -2734,9 +3158,279 @@ class FleetRouter:
             {"faults": self.faults.snapshot(), "request_id": req.id}
         )
 
-    async def _metrics_route(self, _req: Request) -> Response:
+    # ------------------------------------------------- observability plane
+
+    async def _debug_requests(self, req: Request) -> Response:
+        """GET /v1/debug/requests — the ROUTER's flight-recorder query
+        surface (round 19), same contract as the backend's: ``?slow=1``
+        / ``?error=1`` select the tail-sampled rings, ``?id=`` searches
+        every ring, ``?limit=N`` caps.  NOTE this exact route shadows
+        proxying of the path (the /v1/debug/faults precedent): ask a
+        backend's recorder directly, or use /v1/debug/trace/{id} for
+        the joined view."""
+        if self.recorder is None:
+            e = errors.BadRequest(
+                "router tracing disabled: set --trace-ring > 0"
+            )
+            return Response.json(errors.to_payload(e, req.id), e.status)
+        try:
+            args = debug_query_args(req.query, self.trace_ring)
+        except ValueError:
+            e = errors.BadRequest("limit must be an int")
+            return Response.json(errors.to_payload(e, req.id), e.status)
+        traces = self.recorder.query(**args)
+        return Response.json(
+            {
+                "requests": traces,
+                "counts": self.recorder.counts(),
+                "slow_ms": self.trace_slow_ms,
+                "sample": self.trace_sample,
+            }
+        )
+
+    async def _fetch_backend_trace(
+        self, m: BackendMember, trace_id: str
+    ) -> list[dict] | None:
+        """One backend's flight-recorder records for ``trace_id`` via
+        its existing debug endpoint; None on any failure (the assembly
+        reports it as a missing side, never an error)."""
+        try:
+            status, _h, body = await raw_request(
+                m.host, m.port, "GET",
+                f"/v1/debug/requests?id={urllib.parse.quote(trace_id)}",
+                {}, b"", self.walk_timeout_s,
+            )
+            if status != 200:
+                return None
+            doc = json.loads(body)
+            reqs = doc.get("requests")
+            return reqs if isinstance(reqs, list) else None
+        except (_BackendError, ValueError):
+            return None
+
+    async def _debug_trace(self, req: Request) -> Response:
+        """GET /v1/debug/trace/{id} — cross-hop trace assembly (round
+        19).  Joins the router's span tree for one request id with
+        every touched backend's flight-recorder record (fetched live
+        via the backends' own /v1/debug/requests, keyed by the same
+        id) into ONE merged timeline: every attempt backend-attributed,
+        both legs of a hedge with the loser's cancellation point, the
+        winner's server-side decode/dispatch/encode spans inline.  A
+        backend that no longer holds the trace (ring rolled over,
+        tracing off, member gone) appears under ``missing`` — partial
+        assembly beats a 502."""
+        if self.recorder is None:
+            e = errors.BadRequest(
+                "router tracing disabled: set --trace-ring > 0"
+            )
+            return Response.json(errors.to_payload(e, req.id), e.status)
+        trace_id = req.path[len("/v1/debug/trace/"):]
+        if not RID_RE.match(trace_id):
+            e = errors.BadRequest("malformed trace id")
+            return Response.json(errors.to_payload(e, req.id), e.status)
+        found = self.recorder.query(trace_id=trace_id, limit=1)
+        if not found:
+            return Response.json(
+                {
+                    "error": "trace_not_found",
+                    "message": "no router trace for that id (ring "
+                    "rolled over, or the request never crossed this "
+                    "router)",
+                    "request_id": req.id,
+                },
+                404,
+            )
+        router_trace = found[0]
+        # every backend the router's spans attribute — attempt legs,
+        # fan-out hops, hedge losers — in first-touch order
+        touched: list[str] = []
+        for span in router_trace.get("spans", ()):
+            b = span.get("backend")
+            if isinstance(b, str) and b not in touched:
+                touched.append(b)
+        backend_traces: dict[str, list[dict]] = {}
+        missing: list[str] = []
+        known = [
+            (name, self.members.get(name)) for name in touched
+        ]
+        fetched = await asyncio.gather(
+            *(
+                self._fetch_backend_trace(m, trace_id)
+                for _name, m in known
+                if m is not None
+            )
+        )
+        it = iter(fetched)
+        for name, m in known:
+            if m is None:
+                missing.append(name)
+                continue
+            got = next(it)
+            if got:
+                backend_traces[name] = got
+            else:
+                missing.append(name)
+        return Response.json(
+            {
+                "id": trace_id,
+                "router": router_trace,
+                "backends": backend_traces,
+                "missing": missing,
+                "timeline": assemble_timeline(
+                    router_trace, backend_traces
+                ),
+                "request_id": req.id,
+            }
+        )
+
+    async def _scrape_member(
+        self, m: BackendMember
+    ) -> tuple[str, str | None, float | None]:
+        """(name, exposition text or None, staleness seconds): a live
+        scrape is staleness ~0; a failed one falls back to the cached
+        last-good text with its age — a member mid-restart must not
+        read as a counter reset to every downstream rate()."""
+        now = self._clock()
+        try:
+            status, _h, body = await raw_request(
+                m.host, m.port, "GET", "/v1/metrics", {}, b"",
+                self.walk_timeout_s,
+            )
+            if status == 200:
+                text = body.decode("utf-8", "replace")
+                self._scrape_cache[m.name] = (now, text)
+                return m.name, text, 0.0
+        except _BackendError:
+            pass
+        cached = self._scrape_cache.get(m.name)
+        if cached is not None:
+            ts, text = cached
+            return m.name, text, round(now - ts, 3)
+        return m.name, None, None
+
+    async def _metrics_fleet(self, req: Request) -> Response:
+        """GET /v1/metrics/fleet — metrics federation (round 19): one
+        scrape target for the whole fleet.  Every member's /v1/metrics
+        families re-export with a ``backend="host:port"`` label spliced
+        in (ONE TYPE/HELP header per family across all members — the
+        exposition lint's uniqueness rule), plus ``fleet_*`` rollups
+        and per-member scrape-health gauges.  Because the histogram
+        families share one fixed bucket vocabulary, downstream
+        aggregation (sum by le) yields the TRUE fleet-wide p99 — the
+        thing per-process quantiles mathematically cannot."""
+        members = list(self.members.values())
+        results = await asyncio.gather(
+            *(self._scrape_member(m) for m in members)
+        )
+        # family -> kind line, help line, ordered sample lines; plus
+        # the label-free-counter rollups, collected in the SAME walk
+        order: list[str] = []
+        kinds: dict[str, str] = {}
+        helps: dict[str, str] = {}
+        samples: dict[str, list[str]] = {}
+        rollup: dict[str, float] = {}
+        for name, text, _staleness in results:
+            if text is None:
+                continue
+            current: str | None = None
+            cur_kind: str | None = None
+            label = f'backend="{escape_label(name)}"'
+            for line in text.splitlines():
+                if not line:
+                    continue
+                if line.startswith("# TYPE "):
+                    parts = line.split(" ")
+                    if len(parts) != 4:
+                        continue
+                    current, cur_kind = parts[2], parts[3]
+                    if current not in kinds:
+                        kinds[current] = cur_kind
+                        order.append(current)
+                    continue
+                if line.startswith("# HELP "):
+                    fam = line.split(" ", 3)[2]
+                    helps.setdefault(fam, line)
+                    continue
+                if line.startswith("#") or current is None:
+                    continue
+                # splice the backend label into the sample line: after
+                # '{' when a label block exists, else a fresh block.
+                # Insertion at the block's HEAD is escape-safe — no
+                # existing label value is crossed.
+                metric, _, rest = line.partition(" ")
+                if "{" in metric:
+                    mname, _, tail = metric.partition("{")
+                    rewritten = f"{mname}{{{label},{tail} {rest}"
+                else:
+                    rewritten = f"{metric}{{{label}}} {rest}"
+                    if cur_kind == "counter":
+                        # rollup: label-free counters summed across
+                        # members — the fleet totals a dashboard wants
+                        # without PromQL (exported as a gauge: a member
+                        # restart legitimately lowers the sum)
+                        try:
+                            rollup[metric] = (
+                                rollup.get(metric, 0.0) + float(rest)
+                            )
+                        except ValueError:
+                            pass
+                samples.setdefault(current, []).append(rewritten)
+        lines: list[str] = []
+        for fam in order:
+            if fam in helps:
+                lines.append(helps[fam])
+            lines.append(f"# TYPE {fam} {kinds[fam]}")
+            lines.extend(samples.get(fam, ()))
+        if rollup:
+            lines.append(
+                "# HELP fleet_counter_sum label-free counters summed "
+                "across scraped members"
+            )
+            lines.append("# TYPE fleet_counter_sum gauge")
+            for fam, v in sorted(rollup.items()):
+                lines.append(
+                    f'fleet_counter_sum{{family="{fam}"}} {v:g}'
+                )
+        lines.append("# HELP fleet_scrape_ok live scrape succeeded")
+        lines.append("# TYPE fleet_scrape_ok gauge")
+        for name, text, staleness in results:
+            ok = 1 if staleness == 0.0 else 0
+            lines.append(
+                f'fleet_scrape_ok{{backend="{escape_label(name)}"}}'
+                f" {ok}"
+            )
+        lines.append(
+            "# HELP fleet_scrape_staleness_seconds age of the "
+            "exposition re-exported per member (0 = live)"
+        )
+        lines.append("# TYPE fleet_scrape_staleness_seconds gauge")
+        for name, text, staleness in results:
+            if staleness is None:
+                continue  # never scraped: no last-good to be stale
+            lines.append(
+                "fleet_scrape_staleness_seconds"
+                f'{{backend="{escape_label(name)}"}} {staleness:g}'
+            )
+        lines.append("# TYPE fleet_backends_scraped gauge")
+        lines.append(
+            "fleet_backends_scraped "
+            f"{sum(1 for _n, t, _s in results if t is not None)}"
+        )
+        self.metrics.inc_counter("fleet_scrapes_total")
         return Response.text(
-            self.metrics.prometheus(),
+            "\n".join(lines) + "\n",
+            content_type="text/plain; version=0.0.4",
+        )
+
+    async def _metrics_route(self, _req: Request) -> Response:
+        text = self.metrics.prometheus()
+        if self.recorder is not None:
+            # router trace-spine block (round 19): span seconds/count
+            # aggregates + ring occupancy, the backend precedent
+            text += self.recorder.prometheus("router")
+        text += slo_prometheus(self.slos, "router")
+        return Response.text(
+            text,
             content_type="text/plain; version=0.0.4",
         )
 
@@ -2925,7 +3619,40 @@ def main(argv: list[str] | None = None) -> int:
         "--fault-seed", type=int, default=0,
         help="seed for probabilistic fault specs (chaos replays)",
     )
+    p.add_argument(
+        "--trace-ring", type=int, default=256,
+        help="router flight-recorder ring size per class (recent/slow/"
+        "error rings + GET /v1/debug/requests + /v1/debug/trace/{id} "
+        "assembly; 0 disables router tracing entirely — default 256)",
+    )
+    p.add_argument(
+        "--trace-slow-ms", type=float, default=100.0,
+        help="router-side latency threshold for the slow-trace ring "
+        "(default 100)",
+    )
+    p.add_argument(
+        "--trace-sample", type=float, default=1.0,
+        help="head-sample rate for the router's recent-trace ring "
+        "(0..1, default 1.0; slow/error traces always kept)",
+    )
+    p.add_argument(
+        "--slo", default="", metavar="NAME=MS:PCT[:ROUTE],...",
+        help="router latency SLO objects "
+        "('name=<threshold_ms>:<objective_pct>[:<route>]'): burn-rate "
+        "gauges on /metrics + an slo block on /readyz (default none)",
+    )
     args = p.parse_args(argv)
+    if args.slo:
+        try:
+            # validate BEFORE binding a listener on a typo'd objective
+            parse_slos(
+                args.slo,
+                observable_routes=frozenset(
+                    (*_ROUTE_FAMILIES, "/v1/jobs/{id}", "other")
+                ),
+            )
+        except ValueError as e:
+            p.error(str(e))
     backends = [b.strip() for b in args.backends.split(",") if b.strip()]
     if not backends and not args.membership_file and not args.fleet_token:
         p.error(
@@ -2967,6 +3694,10 @@ def main(argv: list[str] | None = None) -> int:
         fault_injection=args.fault_injection,
         faults_spec=faults_spec,
         fault_seed=args.fault_seed,
+        trace_ring=args.trace_ring,
+        trace_slow_ms=args.trace_slow_ms,
+        trace_sample=args.trace_sample,
+        slos=args.slo,
     )
     asyncio.run(_serve_forever(router, args.host, args.port))
     return 0

@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 from urllib.parse import parse_qsl, unquote, urlsplit
 
 from deconv_api_tpu.serving import faults
-from deconv_api_tpu.serving.trace import deadline_from, request_id_from
+from deconv_api_tpu.serving.trace import deadline_from, hop_from, request_id_from
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.http")
@@ -73,6 +73,13 @@ class Request:
     # singleflight waiters time out on their OWN deadline independently
     # of the flight leader.
     deadline: float | None = None
+    # Cross-hop trace context (round 19, fleet observability): the
+    # router stamps each forward attempt with ``x-trace-hop:
+    # <ordinal>:<purpose>``; parsed here (same parse-time rule as id /
+    # deadline) so the backend's flight-recorder trace can annotate
+    # which attempt of a retried/hedged request it served.  None for
+    # direct traffic or a malformed header — never an error.
+    hop: tuple[int, str] | None = None
     # Tenant identity (round 13 QoS): stamped by the admission wrap
     # (serving/qos.py resolves x-api-key / x-tenant) so the access-log
     # line, the flight-recorder trace, and the dispatcher queue all
@@ -482,6 +489,7 @@ class HttpServer:
             method.upper(), unquote(parts.path), query, headers, body,
             request_id_from(headers.get("x-request-id")),
             deadline_from(headers.get("x-deadline-ms")),
+            hop_from(headers.get("x-trace-hop")),
         )
 
     async def _read_chunked(self, reader: asyncio.StreamReader) -> bytes:

@@ -85,6 +85,34 @@ def request_id_from(raw: str | None) -> str:
     return new_request_id()
 
 
+# Cross-hop trace propagation (round 19): the fleet router stamps each
+# forward ATTEMPT with ``x-trace-hop: <ordinal>:<purpose>`` so a
+# backend's flight-recorder trace of a router-forwarded request knows
+# WHICH attempt it was — a retried request's two backend traces would
+# otherwise be indistinguishable when the router assembles them into
+# one timeline (GET /v1/debug/trace/{id}).  Closed vocabulary + bounded
+# ordinal: anything else reads as "no hop context", never an error.
+HOP_PURPOSES = frozenset(
+    ("primary", "hedge", "failover", "canary", "replica")
+)
+HOP_RE = re.compile(
+    r"^([0-9]{1,3}):(primary|hedge|failover|canary|replica)$"
+)
+
+
+def hop_from(raw: str | None) -> tuple[int, str] | None:
+    """Parse an inbound ``x-trace-hop`` header into ``(attempt ordinal,
+    purpose)``; malformed or absent yields None — hop context is
+    annotation metadata, and rejecting a request over it would fail
+    work the caller still wants (the x-deadline-ms rule)."""
+    if not raw:
+        return None
+    m = HOP_RE.match(raw)
+    if not m:
+        return None
+    return int(m.group(1)), m.group(2)
+
+
 # A deadline header longer than a day is a client bug, not a budget;
 # ignoring it (no deadline) beats honoring a nonsense value.
 MAX_DEADLINE_MS = 24 * 3600 * 1000
@@ -382,3 +410,78 @@ class FlightRecorder:
                     f'{{span="{escape_label(name)}"}} {mx:.6f}'
                 )
         return "\n".join(lines) + "\n"
+
+
+def debug_query_args(query: dict, trace_ring: int) -> dict:
+    """Parse the ``GET /v1/debug/requests`` query contract —
+    ``?slow=``/``?error=`` ring selectors, ``?id=`` search, ``?limit=``
+    (default 50, clamped to 10x the ring) — into ``FlightRecorder.query``
+    kwargs.  ONE implementation for the backend (serving/app.py) and the
+    router (serving/fleet.py, round 19), so the two surfaces cannot
+    silently diverge; identity filters (tenant/model) layer on top at
+    the backend.  Raises ValueError on a non-integer limit (the caller
+    answers 400)."""
+
+    def truthy(v: str) -> bool:
+        return v.lower() in ("1", "true", "yes", "on")
+
+    limit = int(query.get("limit", "50"))
+    return {
+        "slow": truthy(query.get("slow", "")),
+        "error": truthy(query.get("error", "")),
+        "trace_id": query.get("id") or None,
+        "limit": max(1, min(limit, 10 * max(1, trace_ring))),
+    }
+
+
+# ------------------------------------------------------ trace assembly
+
+
+def assemble_timeline(
+    router_trace: dict, backend_traces: dict[str, list[dict]]
+) -> list[dict]:
+    """Merge a router flight-recorder trace with the per-backend traces
+    it touched into ONE ordered timeline (round 19, the
+    ``GET /v1/debug/trace/{id}`` surface).
+
+    Every span gains a ``source`` ("router" or the backend's host:port)
+    and its ``start_ms`` is re-anchored to the ROUTER trace's start
+    using each trace's wall-clock ``ts`` — approximate across hosts
+    (NTP-grade skew applies; the runbook says so), exact enough to read
+    "the hedge fired at +52 ms, the loser was cancelled at +81 ms, the
+    winner's device span ran +55..+74 ms" off one listing.  Each
+    backend trace also contributes a synthetic ``backend_request`` span
+    covering its whole server-side life, carrying its hop annotations
+    (attempt ordinal + purpose) so the two legs of a retry or hedge are
+    attributable at a glance.  Spans sort by start offset."""
+    t0 = float(router_trace.get("ts") or 0.0)
+    timeline: list[dict] = []
+    for span in router_trace.get("spans", ()):
+        timeline.append({**span, "source": "router"})
+    for backend, traces in backend_traces.items():
+        for tr in traces:
+            shift_ms = round((float(tr.get("ts") or t0) - t0) * 1e3, 3)
+            summary = {
+                "name": "backend_request",
+                "source": backend,
+                "start_ms": shift_ms,
+                "ms": tr.get("total_ms"),
+                "status": tr.get("status"),
+                "route": tr.get("route"),
+            }
+            for key in ("hop", "hop_purpose", "cache", "error"):
+                if tr.get(key) is not None:
+                    summary[key] = tr[key]
+            timeline.append(summary)
+            for span in tr.get("spans", ()):
+                timeline.append(
+                    {
+                        **span,
+                        "source": backend,
+                        "start_ms": round(
+                            float(span.get("start_ms") or 0.0) + shift_ms, 3
+                        ),
+                    }
+                )
+    timeline.sort(key=lambda s: (s.get("start_ms") or 0.0))
+    return timeline

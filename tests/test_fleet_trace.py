@@ -1,0 +1,764 @@
+"""Fleet observability plane tests (round 19).
+
+Covers the router flight recorder (attempt spans with backend
+attribution, hedge legs as siblings with the loser's cancellation
+point, failover hops, router-side error traces for the paths that used
+to vanish), cross-hop propagation (``x-trace-hop`` stamping +
+``hop_from`` grammar + backend annotation), ``GET /v1/debug/trace/{id}``
+assembly into one merged timeline, ``GET /v1/metrics/fleet`` federation
+(backend-label rewrite through the exposition lint, last-good staleness
+fallback), the fixed-bucket latency histograms (bucket monotonicity
+through the lint walker), the SLO burn-rate math under an injected
+clock, and the ``trace_ring=0`` pin (a trace-off router allocates zero
+per-request trace state).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import httpx
+import pytest
+
+from deconv_api_tpu.serving import fleet
+from deconv_api_tpu.serving.cache import canonical_digest
+from deconv_api_tpu.serving.fleet import FleetRouter, _route_family
+from deconv_api_tpu.serving.http import Request
+from deconv_api_tpu.serving.metrics import (
+    HIST_BUCKETS_S,
+    Metrics,
+    SloTracker,
+    parse_slos,
+    slo_prometheus,
+)
+from deconv_api_tpu.serving.trace import assemble_timeline, hop_from
+from tests.test_metrics_exposition import lint_exposition
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _ready_200():
+    return 200, {}, json.dumps({"ready": True}).encode()
+
+
+def _probe_script(monkeypatch, names):
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        return _ready_200()
+
+    monkeypatch.setattr(fleet, "raw_request", fake)
+
+
+def _post_req(body: bytes, path="/v1/deconv", headers=None, **kw) -> Request:
+    return Request(
+        method="POST", path=path, query={},
+        headers={
+            "content-type": "application/x-www-form-urlencoded",
+            **(headers or {}),
+        },
+        body=body, id=kw.pop("id", "rid-obs"), **kw,
+    )
+
+
+def _key_for(body: bytes, path="/v1/deconv") -> str:
+    return canonical_digest(
+        f"fleet|{path}", "application/x-www-form-urlencoded", body
+    )
+
+
+def _owned_body(router, owner_name, path="/v1/deconv"):
+    """A form body whose fleet digest lands on ``owner_name``."""
+    for i in range(500):
+        body = f"layer=c3&file=probe{i}".encode()
+        if router.ring.owner(_key_for(body, path)) == owner_name:
+            return body
+    raise AssertionError("no body found for owner")
+
+
+# ------------------------------------------------------------- hop grammar
+
+
+def test_hop_from_grammar():
+    assert hop_from("1:primary") == (1, "primary")
+    assert hop_from("2:hedge") == (2, "hedge")
+    assert hop_from("17:failover") == (17, "failover")
+    assert hop_from("3:replica") == (3, "replica")
+    assert hop_from("4:canary") == (4, "canary")
+    for bad in (
+        None, "", "primary", "0x1:hedge", "1:unknown", "1:HEDGE",
+        "1:hedge:extra", "-1:primary", "1234:primary", "1 :primary",
+    ):
+        assert hop_from(bad) is None, bad
+
+
+def test_route_family_is_a_closed_vocabulary():
+    assert _route_family("/v1/deconv") == "/v1/deconv"
+    assert _route_family("/v1/jobs/abc123/events") == "/v1/jobs/{id}"
+    # attacker-chosen paths collapse to one label value: label
+    # cardinality is bounded by construction
+    assert _route_family("/v1/%s" % ("x" * 64)) == "other"
+    assert _route_family("/../../etc/passwd") == "other"
+
+
+# ------------------------------------------------- histograms + SLO math
+
+
+def test_histogram_bucket_monotonicity_through_the_lint():
+    m = Metrics()
+    for v in (0.001, 0.004, 0.012, 0.09, 0.4, 3.0, 250.0):
+        m.observe_hist(
+            "request_duration_seconds", ("route", "qos_class"),
+            ("/v1/deconv", "default"), v,
+        )
+    text = m.prometheus()
+    families, samples = lint_exposition(text)  # checks le-monotonicity,
+    # +Inf == _count, _sum presence
+    assert families["deconv_request_duration_seconds"] == "histogram"
+    block = 'route="/v1/deconv",qos_class="default"'
+    # cumulative counts at a few pinned bounds
+    assert samples[
+        ("deconv_request_duration_seconds_bucket", f'{block},le="0.005"')
+    ] == 2.0
+    assert samples[
+        ("deconv_request_duration_seconds_bucket", f'{block},le="0.1"')
+    ] == 4.0
+    assert samples[
+        ("deconv_request_duration_seconds_bucket", f'{block},le="+Inf"')
+    ] == 7.0
+    assert samples[
+        ("deconv_request_duration_seconds_count", block)
+    ] == 7.0
+    # the in-process accessor sees the same observation set
+    series = m.hist_series("request_duration_seconds")
+    h = series[("/v1/deconv", "default")]
+    assert h["count"] == 7
+    assert sum(h["buckets"]) == 7
+    assert h["buckets"][len(HIST_BUCKETS_S)] == 1  # the 250 s overflow
+    # label-tuple discipline is enforced like inc_labeled's
+    with pytest.raises(ValueError):
+        m.observe_hist(
+            "request_duration_seconds", ("route",), ("/x",), 0.1
+        )
+    with pytest.raises(TypeError):
+        m.observe_hist(
+            "request_duration_seconds", ("route", "qos_class"), "/x", 0.1
+        )
+
+
+def test_slo_burn_rate_math_under_injected_clock():
+    clock = _FakeClock()
+    t = SloTracker("api", 100.0, 99.0, clock=clock)
+    # 2 bad of 10 in the window: error rate 0.2, budget 0.01 -> burn 20
+    for _ in range(8):
+        t.observe(0.050, 200)
+    t.observe(0.500, 200)  # over threshold
+    t.observe(0.001, 500)  # fast 500 still breaches
+    assert t.requests_total == 10 and t.breaches_total == 2
+    assert t.burn_rates() == {"5m": 20.0, "1h": 20.0}
+    # 6 minutes later: the 5m window is clean, the 1h window remembers
+    clock.t += 360.0
+    for _ in range(10):
+        t.observe(0.010, 200)
+    rates = t.burn_rates()
+    assert rates["5m"] == 0.0
+    assert rates["1h"] == pytest.approx((2 / 20) / 0.01)
+    # 2 hours later both windows are empty -> zero burn, totals keep
+    clock.t += 7200.0
+    assert t.burn_rates() == {"5m": 0.0, "1h": 0.0}
+    assert t.requests_total == 20 and t.breaches_total == 2
+    # exposition block lints next to a registry
+    text = Metrics().prometheus() + slo_prometheus([t], "deconv")
+    families, samples = lint_exposition(text)
+    assert families["deconv_slo_burn_rate"] == "gauge"
+    assert samples[("deconv_slo_requests_total", 'slo="api"')] == 20.0
+    assert samples[("deconv_slo_breaches_total", 'slo="api"')] == 2.0
+
+
+def test_slo_spec_validation():
+    trackers = parse_slos("api=250:99,fast=100:99.9:/v1/deconv")
+    assert [t.name for t in trackers] == ["api", "fast"]
+    assert trackers[1].matches("/v1/deconv")
+    assert not trackers[1].matches("/v1/dream")
+    assert trackers[0].matches("/anything")
+    for bad in (
+        "noequals", "a=x:y", "a=100", "a=100:0", "a=100:100",
+        "a=-5:99", "a=100:99:relative", "a=1:9,a=2:9", "=100:99",
+    ):
+        with pytest.raises(ValueError):
+            parse_slos(bad)
+
+
+# ------------------------------------------------- router flight recorder
+
+
+def test_failover_trace_two_attempts_two_backends(monkeypatch):
+    router = FleetRouter(["b0:8000", "b1:8001"], eject_threshold=5)
+    _probe_script(monkeypatch, None)
+    seen: list[tuple[str, str | None]] = []
+    dead: set[str] = set()
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        seen.append((name, headers.get("x-trace-hop")))
+        if name in dead:
+            raise fleet._BackendError("connection refused")
+        return 200, {}, name.encode()
+
+    async def go():
+        await router.probe_once()
+        body = _owned_body(router, "b0:8000")
+        dead.add("b0:8000")
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        seen.clear()
+        resp = await router._proxy(_post_req(body, id="rid-fo"))
+        assert resp.status == 200
+        assert resp.headers["x-backend"] == "b1:8001"
+        # the wire carried per-attempt hop stamps
+        assert seen == [
+            ("b0:8000", "1:primary"), ("b1:8001", "2:failover"),
+        ]
+        # the recorded trace shows both attempts, backend-attributed
+        [tr] = router.recorder.query(trace_id="rid-fo")
+        attempts = [s for s in tr["spans"] if s["name"] == "attempt"]
+        assert [
+            (s["backend"], s["hop"], s["purpose"]) for s in attempts
+        ] == [("b0:8000", 1, "primary"), ("b1:8001", 2, "failover")]
+        assert "error" in attempts[0] and attempts[1]["status"] == 200
+        assert tr["backend"] == "b1:8001" and tr["status"] == 200
+        picks = [s for s in tr["spans"] if s["name"] == "ring_pick"]
+        assert len(picks) == 2
+
+    asyncio.run(go())
+
+
+def _seed_fleet_latency(router, ms=10.0, n=4):
+    m = next(iter(router.members.values()))
+    for _ in range(n):
+        router._observe_latency(m, ms)
+
+
+def test_hedge_trace_sibling_spans_and_loser_cancellation(monkeypatch):
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], slow_min_samples=2,
+        hedge_min_delay_ms=20.0,
+    )
+    _probe_script(monkeypatch, None)
+    stall: set[str] = set()
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        if name in stall:
+            await asyncio.sleep(30.0)
+        return 200, {}, name.encode()
+
+    async def go():
+        await router.probe_once()
+        body = _owned_body(router, "b0:8000")
+        _seed_fleet_latency(router)
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        stall.add("b0:8000")
+        resp = await router._proxy(_post_req(body, id="rid-hedge"))
+        assert resp.status == 200
+        assert resp.headers["x-backend"] == "b1:8001"
+        [tr] = router.recorder.query(trace_id="rid-hedge")
+        assert tr["hedge_fired"] is True
+        assert tr["hedge_backend"] == "b1:8001"
+        attempts = {
+            s["purpose"]: s
+            for s in tr["spans"]
+            if s["name"] == "attempt"
+        }
+        # both legs are sibling spans: the winner with its status, the
+        # loser ending at its CANCELLATION point — recorded before the
+        # trace snapshot, so it cannot vanish from the ring
+        assert attempts["hedge"]["backend"] == "b1:8001"
+        assert attempts["hedge"]["status"] == 200
+        assert attempts["hedge"]["winner"] is True
+        assert attempts["hedge"]["hop"] == 2
+        loser = attempts["primary"]
+        assert loser["backend"] == "b0:8000"
+        assert loser["cancelled"] is True and loser["hop"] == 1
+        # the loser's span ended around the hedge decision, not 30 s out
+        assert loser["ms"] < 5000
+
+    asyncio.run(go())
+
+
+def test_failover_after_exhausted_hedge_not_marked_winner(monkeypatch):
+    """A hedge that exhausts (both legs infra-fail) annotates
+    hedge_fired on the TRACE; the non-hedged failover attempt that
+    then succeeds must not inherit a winner mark — it never raced."""
+    router = FleetRouter(
+        ["b0:8000", "b1:8001", "b2:8002"], slow_min_samples=2,
+        hedge_min_delay_ms=10.0, eject_threshold=5,
+    )
+    _probe_script(monkeypatch, None)
+
+    async def go():
+        await router.probe_once()
+        body = _owned_body(router, "b0:8000")
+        key = _key_for(body)
+        o0, o1, o2 = router.ring.owners(key)
+        behavior = {}
+
+        async def fake(host, port, method, target, headers, body_,
+                       timeout_s):
+            name = f"{host}:{port}"
+            delay, outcome = behavior[name]
+            if delay:
+                await asyncio.sleep(delay)
+            if outcome == "fail":
+                raise fleet._BackendError(f"{name}: boom")
+            return 200, {}, name.encode()
+
+        behavior[o0] = (0.2, "fail")   # slow enough to trigger a hedge
+        behavior[o1] = (0.0, "fail")   # the hedge leg dies too
+        behavior[o2] = (0.0, "ok")     # the plain failover serves
+        _seed_fleet_latency(router)
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        resp = await router._proxy(_post_req(body, id="rid-exh"))
+        assert resp.status == 200
+        assert resp.headers["x-backend"] == o2
+        assert router.metrics.counter("hedges_fired_total") == 1
+        [tr] = router.recorder.query(trace_id="rid-exh")
+        assert tr["hedge_fired"] is True
+        by_purpose = {
+            s["purpose"]: s
+            for s in tr["spans"]
+            if s["name"] == "attempt"
+        }
+        assert "error" in by_purpose["primary"]
+        assert "error" in by_purpose["hedge"]
+        ok = by_purpose["failover"]
+        assert ok["backend"] == o2 and ok["status"] == 200
+        assert "winner" not in ok
+
+    asyncio.run(go())
+
+
+def test_deadline_at_router_records_error_trace_without_backend(
+    monkeypatch,
+):
+    router = FleetRouter(["b0:8000"], eject_threshold=5)
+    _probe_script(monkeypatch, None)
+
+    async def never(host, port, method, target, headers, body, timeout_s):
+        raise AssertionError("no backend may be contacted")
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", never)
+        req = _post_req(
+            b"layer=c3", id="rid-dead",
+            deadline=time.perf_counter() - 1.0,
+        )
+        resp = await router._proxy(req)
+        assert resp.status == 504
+        assert "x-backend" not in resp.headers
+        # the 504 that used to vanish without a trace now sits in the
+        # error ring, annotated, with ZERO attempt spans
+        errs = router.recorder.query(error=True)
+        [tr] = [t for t in errs if t["id"] == "rid-dead"]
+        assert tr["deadline_expired"] is True
+        assert tr["status"] == 504 and tr["error"] == "deadline_expired"
+        assert not [s for s in tr["spans"] if s["name"] == "attempt"]
+
+    asyncio.run(go())
+
+
+def test_unavailable_records_error_trace_with_tried_attempts(monkeypatch):
+    router = FleetRouter(["b0:8000", "b1:8001"], eject_threshold=5)
+    _probe_script(monkeypatch, None)
+
+    async def refuse(host, port, method, target, headers, body, timeout_s):
+        raise fleet._BackendError("connection refused")
+
+    async def go():
+        await router.probe_once()
+        body = _owned_body(router, "b0:8000")
+        monkeypatch.setattr(fleet, "raw_request", refuse)
+        resp = await router._proxy(_post_req(body, id="rid-unavail"))
+        assert resp.status == 502
+        errs = router.recorder.query(error=True)
+        [tr] = [t for t in errs if t["id"] == "rid-unavail"]
+        assert tr["error"] == "backend_unavailable"
+        attempts = [s for s in tr["spans"] if s["name"] == "attempt"]
+        # both ring owners were tried and both are attributable
+        assert {s["backend"] for s in attempts} == {"b0:8000", "b1:8001"}
+        assert all("error" in s for s in attempts)
+
+    asyncio.run(go())
+
+
+def test_trace_off_router_allocates_zero_per_request_trace_state(
+    monkeypatch,
+):
+    router = FleetRouter(["b0:8000"], trace_ring=0, eject_threshold=5)
+    assert router.recorder is None
+    _probe_script(monkeypatch, None)
+
+    async def ok(host, port, method, target, headers, body, timeout_s):
+        return 200, {}, b"{}"
+
+    def boom(*a, **k):
+        raise AssertionError("RequestTrace built with tracing off")
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", ok)
+        monkeypatch.setattr(fleet, "RequestTrace", boom)
+        resp = await router._proxy(_post_req(b"layer=c3", id="rid-off"))
+        assert resp.status == 200
+        get = Request(
+            method="GET", path="/v1/models", query={}, headers={},
+            body=b"", id="rid-off2",
+        )
+        assert (await router._proxy(get)).status == 200
+        # the debug surfaces answer 400, mirroring the backend contract
+        dbg = await router._debug_requests(
+            Request(
+                method="GET", path="/v1/debug/requests", query={},
+                headers={}, body=b"", id="r",
+            )
+        )
+        assert dbg.status == 400
+        asm = await router._debug_trace(
+            Request(
+                method="GET", path="/v1/debug/trace/rid-off", query={},
+                headers={}, body=b"", id="r",
+            )
+        )
+        assert asm.status == 400
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------- assembly
+
+
+def test_debug_trace_assembles_backend_sides(monkeypatch):
+    router = FleetRouter(["b0:8000", "b1:8001"], slow_min_samples=2,
+                         hedge_min_delay_ms=20.0)
+    _probe_script(monkeypatch, None)
+    stall: set[str] = set()
+    backend_traces = {
+        "b0:8000": {
+            "id": "rid-asm", "route": "/v1/deconv", "ts": 0.0,
+            "status": None, "total_ms": None, "hop": 1,
+            "hop_purpose": "primary",
+            "spans": [{"name": "decode", "start_ms": 1.0, "ms": 2.0}],
+        },
+        "b1:8001": {
+            "id": "rid-asm", "route": "/v1/deconv", "ts": 0.05,
+            "status": 200, "total_ms": 9.0, "hop": 2,
+            "hop_purpose": "hedge",
+            "spans": [{"name": "device", "start_ms": 2.0, "ms": 5.0}],
+        },
+    }
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        if target.startswith("/v1/debug/requests"):
+            return 200, {}, json.dumps(
+                {"requests": [backend_traces[name]]}
+            ).encode()
+        if name in stall:
+            await asyncio.sleep(30.0)
+        return 200, {}, name.encode()
+
+    async def go():
+        await router.probe_once()
+        body = _owned_body(router, "b0:8000")
+        _seed_fleet_latency(router)
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        stall.add("b0:8000")
+        resp = await router._proxy(_post_req(body, id="rid-asm"))
+        assert resp.status == 200
+        # fix the fake backend timestamps relative to the real router
+        # trace's wall clock so the re-anchoring is deterministic
+        [rt] = router.recorder.query(trace_id="rid-asm")
+        backend_traces["b0:8000"]["ts"] = rt["ts"]
+        backend_traces["b1:8001"]["ts"] = rt["ts"] + 0.05
+        out = await router._debug_trace(
+            Request(
+                method="GET", path="/v1/debug/trace/rid-asm", query={},
+                headers={}, body=b"", id="r",
+            )
+        )
+        assert out.status == 200
+        doc = json.loads(out.body)
+        assert set(doc["backends"]) == {"b0:8000", "b1:8001"}
+        assert doc["missing"] == []
+        sources = {s["source"] for s in doc["timeline"]}
+        assert sources == {"router", "b0:8000", "b1:8001"}
+        # both legs visible: the hedge winner's server side with its
+        # hop annotation, and the loser's router-side cancellation
+        summaries = [
+            s for s in doc["timeline"] if s["name"] == "backend_request"
+        ]
+        assert {
+            (s["source"], s.get("hop"), s.get("hop_purpose"))
+            for s in summaries
+        } == {("b0:8000", 1, "primary"), ("b1:8001", 2, "hedge")}
+        cancelled = [
+            s for s in doc["timeline"]
+            if s["name"] == "attempt" and s.get("cancelled")
+        ]
+        assert len(cancelled) == 1
+        assert cancelled[0]["source"] == "router"
+        assert cancelled[0]["backend"] == "b0:8000"
+        # the hedge leg's backend device span is re-anchored AFTER the
+        # router's trace start (offset ~50ms + its own 2ms)
+        device = next(
+            s for s in doc["timeline"] if s["name"] == "device"
+        )
+        assert device["start_ms"] == pytest.approx(52.0, abs=5.0)
+        # an unknown id is an honest 404, not a 502
+        miss = await router._debug_trace(
+            Request(
+                method="GET", path="/v1/debug/trace/never-seen",
+                query={}, headers={}, body=b"", id="r",
+            )
+        )
+        assert miss.status == 404
+
+    asyncio.run(go())
+
+
+def test_assemble_timeline_orders_and_reanchors():
+    router_trace = {
+        "id": "x", "ts": 1000.0,
+        "spans": [
+            {"name": "attempt", "start_ms": 0.5, "ms": 30.0,
+             "backend": "b0:8000"},
+        ],
+    }
+    backend = {
+        "id": "x", "ts": 1000.010, "status": 200, "total_ms": 20.0,
+        "hop": 1, "hop_purpose": "primary",
+        "spans": [{"name": "device", "start_ms": 3.0, "ms": 9.0}],
+    }
+    tl = assemble_timeline(router_trace, {"b0:8000": [backend]})
+    assert [s["name"] for s in tl] == [
+        "attempt", "backend_request", "device",
+    ]
+    assert tl[1]["start_ms"] == pytest.approx(10.0)
+    assert tl[2]["start_ms"] == pytest.approx(13.0)
+    assert tl[0]["source"] == "router"
+    assert tl[2]["source"] == "b0:8000"
+
+
+# -------------------------------------------------------- federation
+
+
+def _backend_metrics_text(hits: int) -> str:
+    m = Metrics()
+    m.observe_request(0.01)
+    m.observe_request(0.2, error_code='we"ird')
+    m.inc_counter("cache_hits_total", hits)
+    m.inc_labeled("faults_injected_total", "site", "device.dispatch_error")
+    m.observe_hist(
+        "request_duration_seconds", ("route", "qos_class"),
+        ("/", "default"), 0.02,
+    )
+    m.set_gauge("cache_resident_bytes", 123)
+    return m.prometheus()
+
+
+def test_metrics_federation_label_rewrite_round_trips_the_lint(
+    monkeypatch,
+):
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], eject_threshold=5, clock=clock
+    )
+    down: set[str] = set()
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        if target == "/v1/metrics":
+            if name in down:
+                raise fleet._BackendError("connection refused")
+            return 200, {}, _backend_metrics_text(
+                3 if name == "b0:8000" else 5
+            ).encode()
+        return _ready_200()
+
+    async def go():
+        monkeypatch.setattr(fleet, "raw_request", fake)
+        await router.probe_once()
+        resp = await router._metrics_fleet(
+            Request(
+                method="GET", path="/v1/metrics/fleet", query={},
+                headers={}, body=b"", id="r",
+            )
+        )
+        text = resp.body.decode()
+        families, samples = lint_exposition(text)
+        # ONE TYPE header per family across both members; every sample
+        # gained the backend label with values preserved
+        assert families["deconv_cache_hits_total"] == "counter"
+        assert families["deconv_request_duration_seconds"] == "histogram"
+        assert samples[
+            ("deconv_cache_hits_total", 'backend="b0:8000"')
+        ] == 3.0
+        assert samples[
+            ("deconv_cache_hits_total", 'backend="b1:8001"')
+        ] == 5.0
+        # multi-label + hostile-value lines keep their labels intact
+        # behind the spliced backend label
+        assert samples[
+            (
+                "deconv_faults_injected_total",
+                'backend="b0:8000",site="device.dispatch_error"',
+            )
+        ] == 1.0
+        assert any(
+            name == "deconv_errors_total" and 'we\\"ird' in labels
+            for name, labels in samples
+        )
+        # histogram buckets federate per backend (the lint already
+        # verified le-monotonicity per labelset)
+        assert samples[
+            (
+                "deconv_request_duration_seconds_count",
+                'backend="b1:8001",route="/",qos_class="default"',
+            )
+        ] == 1.0
+        # rollups + scrape health
+        assert samples[
+            ("fleet_counter_sum", 'family="deconv_cache_hits_total"')
+        ] == 8.0
+        assert samples[("fleet_scrape_ok", 'backend="b0:8000"')] == 1.0
+        assert samples[("fleet_backends_scraped", "")] == 2.0
+        assert samples[
+            ("fleet_scrape_staleness_seconds", 'backend="b0:8000"')
+        ] == 0.0
+        # a member going dark re-exports its LAST-GOOD text with the
+        # staleness gauge climbing — not a counter reset
+        down.add("b1:8001")
+        clock.t += 30.0
+        resp2 = await router._metrics_fleet(
+            Request(
+                method="GET", path="/v1/metrics/fleet", query={},
+                headers={}, body=b"", id="r",
+            )
+        )
+        families2, samples2 = lint_exposition(resp2.body.decode())
+        assert samples2[
+            ("deconv_cache_hits_total", 'backend="b1:8001"')
+        ] == 5.0
+        assert samples2[("fleet_scrape_ok", 'backend="b1:8001"')] == 0.0
+        assert samples2[
+            ("fleet_scrape_staleness_seconds", 'backend="b1:8001"')
+        ] == 30.0
+        assert samples2[("fleet_backends_scraped", "")] == 2.0
+
+    asyncio.run(go())
+
+
+def test_router_histogram_and_slo_fed_by_proxy(monkeypatch):
+    router = FleetRouter(
+        ["b0:8000"], eject_threshold=5, slos="api=1000:99",
+    )
+    _probe_script(monkeypatch, None)
+
+    async def ok(host, port, method, target, headers, body, timeout_s):
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", ok)
+        for _ in range(3):
+            resp = await router._proxy(_post_req(b"layer=c3"))
+            assert resp.status == 200
+        series = router.metrics.hist_series("request_duration_seconds")
+        assert series[("/v1/deconv",)]["count"] == 3
+        [t] = router.slos
+        assert t.requests_total == 3 and t.breaches_total == 0
+        # the router's own /metrics carries the histogram + slo block
+        # + recorder block, and it all lints as one exposition
+        out = await router._metrics_route(None)
+        families, samples = lint_exposition(out.body.decode())
+        assert families["router_request_duration_seconds"] == "histogram"
+        assert families["router_slo_burn_rate"] == "gauge"
+        assert families["router_traces_total"] == "counter"
+        assert samples[("router_slo_requests_total", 'slo="api"')] == 3.0
+        # /readyz carries the slo block
+        rz = await router._readyz(None)
+        doc = json.loads(rz.body)
+        assert doc["slo"]["api"]["ok"] is True
+
+    asyncio.run(go())
+
+
+# --------------------------------------------------------------- e2e
+
+
+def test_e2e_cross_hop_trace_assembly_over_real_backends():
+    """A real request through a real router: the backend's trace
+    carries the hop annotation the router stamped, and the router's
+    /v1/debug/trace/{id} joins both sides into one timeline whose
+    backend spans (decode/device/encode) sit inside the router's
+    attempt window."""
+    from tests.test_fleet import FleetFixture, _data_url
+
+    with FleetFixture(n_backends=2) as f:
+        rid = "fleet-trace-e2e-1"
+        resp = httpx.post(
+            f.router_url + "/",
+            data={"file": _data_url(31), "layer": "b2c1"},
+            headers={"x-request-id": rid},
+            timeout=120,
+        )
+        assert resp.status_code == 200, resp.text
+        backend = resp.headers["x-backend"]
+        # the backend's own flight recorder annotated the hop context
+        direct = httpx.get(
+            f"http://{backend}/v1/debug/requests", params={"id": rid},
+            timeout=10,
+        )
+        [btr] = direct.json()["requests"]
+        assert btr["hop"] == 1 and btr["hop_purpose"] == "primary"
+        # assembly joins the router + backend sides
+        out = httpx.get(
+            f.router_url + f"/v1/debug/trace/{rid}", timeout=10
+        )
+        assert out.status_code == 200, out.text
+        doc = out.json()
+        assert doc["id"] == rid
+        assert backend in doc["backends"]
+        assert doc["missing"] == []
+        names = {s["name"] for s in doc["timeline"]}
+        assert "attempt" in names  # the router side
+        assert "backend_request" in names  # the backend summary
+        # server-side pipeline spans made it into the merged view
+        assert names & {"decode", "device", "dispatch", "encode"}
+        att = next(
+            s for s in doc["timeline"]
+            if s["name"] == "attempt" and s["source"] == "router"
+        )
+        assert att["backend"] == backend and att["status"] == 200
+        summary = next(
+            s for s in doc["timeline"] if s["name"] == "backend_request"
+        )
+        # wall clocks of two processes on one host: the backend's
+        # server-side life must sit inside the router's attempt window
+        # (generous skew allowance — same machine)
+        assert abs(summary["start_ms"] - att["start_ms"]) < 1000.0
+        # the federation endpoint sees both backends with one TYPE per
+        # family and a true histogram to aggregate
+        fed = httpx.get(f.router_url + "/v1/metrics/fleet", timeout=10)
+        families, samples = lint_exposition(fed.text)
+        assert families["deconv_request_duration_seconds"] == "histogram"
+        for p in f.ports:
+            assert samples[
+                ("fleet_scrape_ok", f'backend="127.0.0.1:{p}"')
+            ] == 1.0

@@ -35,12 +35,32 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
 _KINDS = ("counter", "gauge", "summary", "histogram", "untyped")
 
 
+def _hist_base(name: str, families: dict[str, str]) -> str | None:
+    """Resolve a ``_bucket``/``_sum``/``_count`` sample name to its
+    histogram family's base name (TYPE lives on the base — the round-19
+    histogram exposition shape), or None for a plain sample."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if families.get(base) == "histogram":
+                return base
+    return None
+
+
 def lint_exposition(text: str) -> tuple[dict[str, str], dict[tuple, float]]:
     """Walk every line of a Prometheus text exposition; returns
     ``(family -> kind, (family, label-block) -> value)``.  Raises
-    AssertionError on any format violation."""
+    AssertionError on any format violation.
+
+    Round 19 adds histogram families: ``name_bucket``/``name_sum``/
+    ``name_count`` samples resolve to a base family typed ``histogram``,
+    every ``_bucket`` must carry an ``le`` label, the cumulative bucket
+    counts must be monotone in ``le`` per labelset, and the ``+Inf``
+    bucket must equal the labelset's ``_count``."""
     families: dict[str, str] = {}
     samples: dict[tuple, float] = {}
+    # (family, labels-without-le) -> [(le, cumulative count), ...]
+    hist_buckets: dict[tuple, list[tuple[float, float]]] = {}
     for line in text.rstrip("\n").split("\n"):
         assert line, "blank line in exposition"
         if line.startswith("# TYPE "):
@@ -67,8 +87,42 @@ def lint_exposition(text: str) -> tuple[dict[str, str], dict[tuple, float]]:
                 )
                 assert rebuilt == labels, f"bad label escaping in {line!r}"
             samples[(name, labels or "")] = float(value)
-    for name, _ in samples:
-        assert name in families, f"sample {name} has no TYPE header"
+            base = _hist_base(name, families)
+            if base is not None and name.endswith("_bucket"):
+                pairs = dict(_LABEL_RE.findall(labels or ""))
+                assert "le" in pairs, f"bucket sample without le: {line!r}"
+                rest = ",".join(
+                    f'{k}="{v}"' for k, v in _LABEL_RE.findall(labels or "")
+                    if k != "le"
+                )
+                le = float("inf") if pairs["le"] == "+Inf" else float(
+                    pairs["le"]
+                )
+                hist_buckets.setdefault((base, rest), []).append(
+                    (le, float(value))
+                )
+    for name, _labels in samples:
+        assert (
+            name in families or _hist_base(name, families) is not None
+        ), f"sample {name} has no TYPE header"
+    for (base, rest), pairs in hist_buckets.items():
+        ordered = sorted(pairs)
+        assert ordered == pairs, f"{base}{{{rest}}} buckets out of le order"
+        counts = [c for _le, c in ordered]
+        assert counts == sorted(counts), (
+            f"{base}{{{rest}}} cumulative buckets not monotone in le"
+        )
+        assert ordered[-1][0] == float("inf"), (
+            f"{base}{{{rest}}} missing +Inf bucket"
+        )
+        count_key = (f"{base}_count", rest)
+        assert count_key in samples, f"{base}{{{rest}}} missing _count"
+        assert samples[count_key] == ordered[-1][1], (
+            f"{base}{{{rest}}} +Inf bucket != _count"
+        )
+        assert (f"{base}_sum", rest) in samples, (
+            f"{base}{{{rest}}} missing _sum"
+        )
     return families, samples
 
 
@@ -108,6 +162,15 @@ def _traffic(m: Metrics) -> None:
     m.inc_labeled("tenant_device_ms_total", "tenant", "acme", 12.345)
     m.inc_labeled("tenant_shed_total", "tenant", "acme")
     m.set_gauge("tenant_fairness", 1.0)
+    # round-19 fixed-bucket latency histogram (multi-label, le buckets)
+    m.observe_hist(
+        "request_duration_seconds", ("route", "qos_class"),
+        ("/v1/deconv", "standard"), 0.012,
+    )
+    m.observe_hist(
+        "request_duration_seconds", ("route", "qos_class"),
+        ("/v1/deconv", "standard"), 0.3,
+    )
 
 
 def test_every_family_typed_once_and_labels_escape():

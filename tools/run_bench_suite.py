@@ -90,6 +90,15 @@ FLEET_HA_RECOVERY_FRAC = 0.8
 FLEET_TAIL_DETECT_BUDGET_S = 5.0
 FLEET_TAIL_P99_FACTOR = 1.5
 
+# Observability-plane budget (round 19): the router flight recorder on
+# its default knobs (ring 256, sample 1.0) may cost the hot proxy path
+# at most this much throughput versus a --trace-ring 0 router over the
+# same warmed backends.  The drill also errors on a vacuous hedge
+# phase, an incomplete assembly (either hedge leg missing from the
+# merged timeline / no loser cancellation point / no hop annotations),
+# or incomplete federation on ANY router.
+FLEET_TRACE_OVERHEAD_BUDGET_PCT = 3.0
+
 # Multi-model paging budget (round 15): the weight-manager machinery
 # engaged for a SINGLE model (budget set, no second model) may cost the
 # hot path at most this much throughput versus the inert pre-round-15
@@ -650,6 +659,63 @@ def run_fleet_tail_guard(timeout_s: float = 1800.0) -> dict:
     )
     # the drill assembles its own violation list against the same
     # budgets; carry it verbatim — the guard's job is the recorded row
+    if "error" in drill:
+        row["error"] = drill["error"]
+    return row
+
+
+def run_fleet_trace_guard(timeout_s: float = 1800.0) -> dict:
+    """Observability-plane drill guard (round 19):
+    tools/loopback_load.py --fleet-trace — two routers over three
+    warmed backends with ``fleet.head_delay_ms`` armed so hedges fire.
+
+    The row fails LOUDLY (`error` field) when:
+    - no hedge fired/recorded (vacuous drill);
+    - no hedged request assembles at GET /v1/debug/trace/{id} with
+      BOTH backend sides, the loser's cancellation point, and hop
+      annotations on the backend traces;
+    - GET /v1/metrics/fleet on any router misses a backend, misses the
+      core/histogram families, or emits a duplicate TYPE header;
+    - the router trace-on/off A/B exceeds
+      FLEET_TRACE_OVERHEAD_BUDGET_PCT;
+    - any request in any phase came back non-200."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "FLEET_TRACE_OVERHEAD_BUDGET_PCT": str(
+            FLEET_TRACE_OVERHEAD_BUDGET_PCT
+        ),
+    }
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--fleet-trace"], timeout_s, env=env
+    )
+    row = {"config": "fleet-trace", "which": "loopback_fleet_trace_drill"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    assembled = drill.get("assembled", {})
+    row.update(
+        n_backends=drill.get("n_backends"),
+        n_routers=drill.get("n_routers"),
+        requests=drill.get("requests"),
+        key_dist=drill.get("key_dist"),
+        hedges_fired=drill.get("hedges_fired"),
+        assembled_id=assembled.get("id"),
+        assembled_backends=assembled.get("distinct_backends"),
+        loser_cancellation_visible=assembled.get(
+            "loser_cancellation_visible"
+        ),
+        hop_annotated_sides=assembled.get("hop_annotated_sides"),
+        federation=drill.get("federation"),
+        trace_on_p50_ms=drill.get("trace_on_p50_ms"),
+        trace_off_p50_ms=drill.get("trace_off_p50_ms"),
+        trace_overhead_pct=drill.get("trace_overhead_pct"),
+        overhead_budget_pct=drill.get(
+            "overhead_budget_pct", FLEET_TRACE_OVERHEAD_BUDGET_PCT
+        ),
+    )
+    # the drill assembles its own violation list against the same
+    # budgets; carry it verbatim
     if "error" in drill:
         row["error"] = drill["error"]
     return row
@@ -1228,6 +1294,13 @@ def main() -> int:
             # hedges budgeted, restoration after disarm, tail-off pin
             result = run_fleet_tail_guard()
             result["date"] = date
+        elif tok == "fleet-trace":
+            # observability-plane drill (round 19): assembled hedge
+            # trace (both legs + loser cancellation + hop annotations),
+            # federation completeness on every router, and the router
+            # trace-on/off A/B within its 3% budget
+            result = run_fleet_trace_guard()
+            result["date"] = date
         elif tok == "models":
             # multi-model paging drill (round 15): three backbones from
             # one pool under a budget that forces paging + the
@@ -1267,7 +1340,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'models', 'quant', 'aot-boot'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'models', 'quant', 'aot-boot'])}",
             }
         else:
             n = int(tok)

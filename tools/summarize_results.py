@@ -95,6 +95,25 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "trace_overhead_pct" in row and "hedges_fired" in row:
+        # observability-plane rows (round 19): the assembled hedge
+        # trace, federation coverage and the trace-on/off overhead in
+        # one line; error kept visible
+        fed = row.get("federation") or []
+        covered = "/".join(
+            str(f.get("backends_covered")) for f in fed
+        )
+        line = (
+            f"hedge trace assembled={bool(row.get('assembled_id'))} "
+            f"(legs={row.get('assembled_backends')}, loser_cancel="
+            f"{row.get('loser_cancellation_visible')}), federation "
+            f"covered={covered or '?'} routers={len(fed)}, trace "
+            f"overhead {row.get('trace_overhead_pct')}% "
+            f"(budget {row.get('overhead_budget_pct', 3)}%)"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "detection_s" in row or "p99_ratio" in row:
         # tail-tolerance rows (round 17): gray detection time, the p99
         # containment ratio, the hedge ledger and restoration in one
