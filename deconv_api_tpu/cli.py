@@ -36,7 +36,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "cache_bytes", "cache_ttl_s",
         "trace_ring", "trace_slow_ms", "trace_sample", "slo",
         "fault_seed", "breaker_threshold", "breaker_cooldown_s",
-        "drain_grace_s", "lanes", "lowc_kpack", "compile_cache_dir",
+        "drain_grace_s", "lanes", "lowc_kpack", "fused_unpool",
+        "compile_cache_dir",
         "jobs_dir", "jobs_workers", "jobs_queue_depth",
         "tenants", "qos_default_class",
         "serve_models", "pinned_models", "hbm_budget_bytes", "weight_dtype",
@@ -365,6 +366,14 @@ def main(argv: list[str] | None = None) -> int:
         help="pack the K projections into the channel dim for the "
         "low-channel backward tail (sequential models; default off — "
         "see docs/OPERATIONS.md 'Low-channel layout packing')",
+    )
+    s.add_argument(
+        "--fused-unpool", default=None, dest="fused_unpool",
+        metavar="off|auto|forced",
+        help="fuse the backward tail's switch-unpool into the flipped "
+        "conv as one Pallas kernel (sequential models; auto = TPU "
+        "only; default off — see docs/OPERATIONS.md 'Fused "
+        "unpool+conv tail')",
     )
     s.add_argument(
         "--compile-cache-dir", default=None, dest="compile_cache_dir",

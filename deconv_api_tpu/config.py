@@ -362,6 +362,23 @@ class ServerConfig:
     # on/off (tests/test_kpack.py); the knob still folds into the
     # response-cache key prefix, same rule as DECONV_FWD_LOWC_BF16.
     lowc_kpack: str = "off"  # 'off' | 'auto' | 'forced' | '<channels>'
+    # Fused Pallas unpool+flipped-conv backward tail (round 20,
+    # ops/pallas_deconv.py): fuse each certified pool -> backward-ReLU
+    # -> flipped-conv triple of the backward walk into ONE kernel that
+    # scatters the pooled signal through its switches in VMEM and feeds
+    # the conv's input formation directly — the 2x-spatial unpooled
+    # intermediate never round-trips HBM (the remaining modeled MFU gap
+    # past lowc_kpack; tools/roofline.py --fused).  'off' (default —
+    # program bytes identical to pre-round-20) | 'auto' (fuse certified
+    # sites on TPU; elsewhere inert) | 'forced' (fuse everywhere —
+    # interpret mode off-TPU, the parity/probe harness, NOT a CPU fast
+    # path).  Composes with lowc_kpack (packed grouped sites fuse too);
+    # sequential-spec engines only — DAG models and dreams normalise it
+    # out.  Uncertified shapes fall back to the unfused pair silently
+    # (bit-identical); the knob still folds into the response-cache key
+    # prefix (config-invalidates-everything rule) and /v1/config
+    # reports the resolved engagement (fused_unpool_resolved).
+    fused_unpool: str = "off"  # 'off' | 'auto' | 'forced'
     # Persistent XLA compilation cache (first compile on TPU is
     # expensive: warmup re-pays a multi-second per-bucket compile tax on
     # EVERY restart without it).  Round 10: default OFF for the server —

@@ -43,6 +43,7 @@ def autodeconv_visualizer(
     sweep_layers: tuple[str, ...] | None = None,
     donate: bool = False,
     lowc_kpack: str = "off",
+    fused_unpool: str = "off",
 ):
     """Build a jitted ``fn(params, image) -> {images, indices, sums, valid}``.
 
@@ -74,10 +75,19 @@ def autodeconv_visualizer(
     already batch through one vmapped cotangent pass.  The program (and
     its bytes) is identical for every policy value — pinned by
     tests/test_kpack.py.
+
+    ``fused_unpool`` (round 20, ops/pallas_deconv.py) gets the same
+    treatment for the same reason: the vjp walk has no explicit
+    pool -> backward-ReLU -> flipped-conv triple to fuse (pooling
+    cotangents flow through XLA's own select-and-scatter), so the
+    policy is validated and inert — pinned by
+    tests/test_pallas_deconv.py.
     """
     from deconv_api_tpu.engine.deconv import resolve_kpack_chan
+    from deconv_api_tpu.ops.pallas_deconv import resolve_fused_unpool
 
     resolve_kpack_chan(lowc_kpack, top_k)  # validate the vocabulary only
+    resolve_fused_unpool(fused_unpool)  # likewise
     if mode not in ("all", "max"):
         raise ValueError(f"illegal visualize mode {mode!r}; expected 'all' or 'max'")
     if donate:

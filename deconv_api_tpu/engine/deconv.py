@@ -142,6 +142,21 @@ def _unpool_nchw(y, idx_nhwc, pool_size, out_hw, fuse_relu=False):
     return up
 
 
+def _fusable_conv(l) -> bool:
+    """Whether a conv layer's backward projection may be consumed by the
+    fused unpool+conv kernel (round 20, ops/pallas_deconv.py): the same
+    odd-SAME-stride-1 rule as the pack certification — the only case
+    whose backward is the plain flipped conv the kernel computes."""
+    kh, kw = l.kernel_size
+    return (
+        l.kind == "conv"
+        and tuple(l.strides) == (1, 1)
+        and l.padding == "SAME"
+        and kh % 2 == 1
+        and kw % 2 == 1
+    )
+
+
 def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool,
                groups: int = 1, layout: str = "nhwc"):
     """One downward (deconv) step.  With ``groups > 1`` the signal carries
@@ -201,14 +216,54 @@ def _down_step(e: Entry, params, x, switches, prev_shape, bug_compat: bool,
 
 
 def _down_chain(entries, params, ups, switches, x, start, stop_after,
-                bug_compat, groups: int = 1, layout: str = "nhwc"):
+                bug_compat, groups: int = 1, layout: str = "nhwc",
+                fused_unpool: str = "off"):
     """Walk the backward chain from entry `start` down to `stop_after`
     (exclusive) — the ONE walker shared by the per-projection (vmapped)
     path, the K-packed tail, and the NCHW tail, so the peephole and
-    per-kind dispatch can never drift between them."""
+    per-kind dispatch can never drift between them.
+
+    ``fused_unpool`` (round 20, ops/pallas_deconv.py) fuses each
+    certified pool -> backward-ReLU -> flipped-conv triple into ONE
+    pallas op that scatters the pooled signal through its switches and
+    feeds the conv's input formation in VMEM — the 2x-spatial unpooled
+    intermediate never round-trips HBM.  Uncertified shapes fall back
+    to the pair inside the op itself (bit-identical, silent), so this
+    walker only matches the pattern; NHWC only (the NCHW tail keeps its
+    own layout machinery)."""
     j = start
     while j > stop_after:
         e = entries[j]
+        # Fused-tail peephole (round 20): pool, its conv's companion
+        # activation (relu folds into the kernel's scatter; linear is
+        # the identity) and the certified conv below collapse into one
+        # fused unpool+flipped-conv op; the bug_compat re-activation
+        # stays outside (elementwise — XLA fuses it into the epilogue).
+        if (
+            fused_unpool != "off"
+            and layout == "nhwc"
+            and not e.is_companion_act
+            and e.layer.kind == "pool"
+            and j - 2 > stop_after
+            and entries[j - 1].is_companion_act
+            and entries[j - 1].layer.activation in ("relu", "linear")
+            and not entries[j - 2].is_companion_act
+            and _fusable_conv(entries[j - 2].layer)
+        ):
+            sw_idx, out_hw = switches[e.name]
+            conv_l = entries[j - 2].layer
+            x = ops.fused_unpool_backward(
+                x, sw_idx, params[conv_l.name]["w"].astype(x.dtype),
+                e.layer.pool_size, out_hw,
+                fuse_relu=entries[j - 1].layer.activation == "relu",
+                groups=groups, mode=fused_unpool,
+            )
+            if bug_compat:
+                # the reference's config-clone keeps the fused
+                # activation in the backward conv model (SURVEY §2.2.2)
+                x = ops.apply_activation(x, conv_l.activation)
+            j -= 3
+            continue
         # Peephole: a pool followed (downward) by the deconvnet
         # backward-ReLU collapses into one fused unpool+ReLU op call.
         # Equivalent on every dispatch path; matters for the pallas
@@ -261,14 +316,9 @@ def _pack_boundary(entries, ups, i, max_chan: int) -> int:
         elif l.kind in ("input", "pool"):
             safe.append(True)
         elif l.kind == "conv":
-            kh, kw = l.kernel_size
-            safe.append(
-                act_ok
-                and tuple(l.strides) == (1, 1)
-                and l.padding == "SAME"
-                and kh % 2 == 1
-                and kw % 2 == 1
-            )
+            # the one odd-SAME-stride-1 rule, shared with the fused
+            # unpool+conv peephole so the two certifications cannot drift
+            safe.append(act_ok and _fusable_conv(l))
         else:  # dense / flatten: leave to the general vmapped path
             safe.append(False)
     jb = -1
@@ -457,7 +507,7 @@ def _seed_fmap(output, idx, mode):
 
 def _visualize_entry(
     entries, params, ups, switches, i, top_k, mode, bug_compat, backward_dtype,
-    kpack_chan=0, nchw_chan=0,
+    kpack_chan=0, nchw_chan=0, fused_unpool="off",
 ):
     """Top-K selection + vmapped backward projection from entry index `i`.
 
@@ -508,7 +558,8 @@ def _visualize_entry(
             # projection chain (8/9 of the FLOPs) runs in e.g. bfloat16.
             x = x.astype(backward_dtype)
         return _down_chain(
-            entries, params, ups, switches, x, i, stop_after, bug_compat
+            entries, params, ups, switches, x, i, stop_after, bug_compat,
+            fused_unpool=fused_unpool,
         )
 
     def packed_tail(xk):
@@ -521,7 +572,7 @@ def _visualize_entry(
         kk = xk.shape[0]
         x = _down_chain(
             entries, params, ups, switches, pack_k(xk), jb, -1, bug_compat,
-            groups=kk,
+            groups=kk, fused_unpool=fused_unpool,
         )
         return unpack_k(x, kk)
 
@@ -550,7 +601,7 @@ def _visualize_entry(
 
 def _sweep_merged(
     entries, params, ups, switches, vis_indices, top_k, mode, bug_compat,
-    backward_dtype,
+    backward_dtype, fused_unpool="off",
 ):
     """All-layers sweep with cross-layer projections MERGED through the
     shared tail (VERDICT r3 item 7; BASELINE config 2).
@@ -595,7 +646,8 @@ def _sweep_merged(
         offset += k
         next_stop = vis_indices[pos + 1] if pos + 1 < len(vis_indices) else -1
         carry = _down_chain(
-            entries, params, ups, switches, carry, i, next_stop, bug_compat
+            entries, params, ups, switches, carry, i, next_stop, bug_compat,
+            fused_unpool=fused_unpool,
         )
     out_dtype = ups[0].dtype
     carry = carry.astype(out_dtype)
@@ -645,6 +697,7 @@ def get_visualizer(
     fwd_lowc_bf16: int | None = None,
     donate: bool = False,
     quant=None,
+    fused_unpool: str | None = None,
 ):
     """Build (and cache) the jitted visualizer for a static configuration.
 
@@ -680,6 +733,16 @@ def get_visualizer(
     (engine/quant.py).  Selection and the backward projection keep their
     existing dtypes; a quant request disables the fwd_lowc_bf16 prefix
     (the two forward rewrites are mutually exclusive).
+    ``fused_unpool`` (round 20, ops/pallas_deconv.py) fuses each
+    certified pool -> backward-ReLU -> flipped-conv triple of the
+    backward walk into one pallas kernel: 'off' (default — program
+    bytes identical to pre-round-20) | 'auto' (fuse on TPU) | 'forced'
+    (fuse everywhere certified; interpret mode off-TPU — the parity
+    harness).  ``None`` resolves DECONV_FUSED_UNPOOL (default off);
+    composes with ``kpack_chan`` (the packed tail's grouped sites fuse
+    too) and is normalised to 'off' before the cache key whenever the
+    backend disengages it, so an inert policy can never fragment the
+    program cache.
     """
     import os
 
@@ -741,10 +804,22 @@ def get_visualizer(
                 "or a tuple of (entry, amax) pairs"
             )
         fwd_lowc_bf16 = 0  # mutually exclusive forward rewrites
+    from deconv_api_tpu.ops.pallas_deconv import (
+        fused_engaged,
+        resolve_fused_unpool,
+    )
+
+    if fused_unpool is None:
+        fused_unpool = os.environ.get("DECONV_FUSED_UNPOOL", "off")
+    fused_unpool = resolve_fused_unpool(fused_unpool)
+    if not fused_engaged(fused_unpool):
+        # a policy the backend disengages (auto off-TPU) must hit the
+        # same cached program as 'off' — no duplicate executables
+        fused_unpool = "off"
     return _get_visualizer_cached(
         spec, layer_name, top_k, mode, bug_compat, sweep, batched,
         backward_dtype, kpack_chan, bool(sweep_merged), nchw_chan,
-        sweep_chunk, fwd_lowc_bf16, donate, quant,
+        sweep_chunk, fwd_lowc_bf16, donate, quant, fused_unpool,
     )
 
 
@@ -765,6 +840,7 @@ def _get_visualizer_cached(
     fwd_lowc_bf16: int = 0,
     donate: bool = False,
     quant=None,
+    fused_unpool: str = "off",
 ):
     if donate:
         allow_unusable_donation()
@@ -808,12 +884,13 @@ def _get_visualizer_cached(
         if merged_active:
             return _sweep_merged(
                 entries, params, ups, switches, vis_indices, top_k, mode,
-                bug_compat, bwd_dtype,
+                bug_compat, bwd_dtype, fused_unpool=fused_unpool,
             )
         return {
             entries[i].name: _visualize_entry(
                 entries, params, ups, switches, i, top_k, mode, bug_compat,
                 bwd_dtype, kpack_chan=kpack_chan, nchw_chan=nchw_chan,
+                fused_unpool=fused_unpool,
             )
             for i in vis_indices
         }

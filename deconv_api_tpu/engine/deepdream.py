@@ -322,7 +322,11 @@ def deepdream_batch(
     whole-dream and the per-octave checkpointed form alike)
     byte-identical.  The serving layer normalises the knob out of its
     dream dispatch keys accordingly (serving/models.py), and
-    tests/test_kpack.py pins the byte-parity end to end.
+    tests/test_kpack.py pins the byte-parity end to end.  The fused
+    unpool+conv tail (``fused_unpool``, round 20) is inert here for the
+    same reason: the gradient's pooling cotangent is XLA's own
+    select-and-scatter, not the deconvnet switch-unpool the kernel
+    fuses — tests/test_pallas_deconv.py pins the dream byte-parity.
     """
     base = images.astype(jnp.float32)
     h, w = base.shape[1:3]

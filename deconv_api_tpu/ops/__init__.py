@@ -23,6 +23,11 @@ from deconv_api_tpu.ops.conv import (
     flip_kernel,
     tile_kernel_groups,
 )
+from deconv_api_tpu.ops.pallas_deconv import (
+    fused_engaged,
+    fused_unpool_backward,
+    resolve_fused_unpool,
+)
 from deconv_api_tpu.ops.linear import (
     dense,
     dense_input_backward,
@@ -50,8 +55,11 @@ __all__ = [
     "dense_input_backward",
     "dense_q8",
     "flatten",
+    "fused_engaged",
+    "fused_unpool_backward",
     "int8_safe_activation",
     "flip_kernel",
+    "resolve_fused_unpool",
     "maxpool_with_argmax",
     "maxpool_with_switches",
     "maxpool_switched",

@@ -1,0 +1,537 @@
+"""Fused Pallas unpool+flipped-conv kernel for the low-C backward tail.
+
+The roofline endgame past ``lowc_kpack`` (ROADMAP open item 1, round 20):
+PR 7's packing recovers the 128-lane padding slack, but the remaining
+modeled gap is pure data movement the MXU never sees — the switch-scatter
+of ``unpool_with_argmax`` materialises a 2x-spatial intermediate in HBM
+that the very next flipped conv immediately re-reads as its input.  At
+VGG block1 widths that intermediate is 8x the pooled signal's bytes, per
+projection, per pool level.  "Anatomy of High-Performance Deep Learning
+Convolutions on SIMD Architectures" (PAPERS.md) makes the low-C case
+directly: fuse the data reorganisation into the conv's INPUT FORMATION
+instead of running it as a separate pass.
+
+This kernel does exactly that for the certified ``_down_step`` case (odd
+kernel, SAME, stride 1, NHWC — the only case the engine's pack
+certification admits): each grid step reads a pooled-activation tile and
+its int8 switch-index tile into VMEM, scatters the tile into its
+unpooled positions on the fly (the one-hot compare fused into a
+multiply, exactly the ops/pool.py semantics), and feeds the flipped
+conv's accumulation directly — the 2x-spatial unpooled tensor never
+touches HBM.  Both engine forms are covered: the vmapped per-K path
+(the custom_vmap rule collapses the K and batch axes into the kernel's
+leading grid dim, switch blocks shared via the index map — the
+pallas_pool idiom) and the kpack grouped form (``groups=K`` with the
+group-invariant switch broadcast across packed groups, matching
+``pack_k``'s group-major channel order).
+
+Two kernel bodies share the certification, dispatch and scatter
+semantics; which one runs is decided by the backend:
+
+- ``exact`` (interpret mode, the non-TPU body): a single whole-array
+  grid step whose body computes the unfused pair's ops VERBATIM on the
+  kernel refs — ``unpool_with_argmax`` then
+  ``conv2d_input_backward[_grouped]``, same primitives, same operands,
+  same extents.  fp32 (and bf16) BIT-equality with the unfused pair is
+  therefore guaranteed by construction, which is what lets the serving
+  layer pin ``fused_unpool=forced`` byte-parity end-to-end on CPU
+  (tests/test_pallas_deconv.py) the way kpack pins its layout.
+- ``mxu`` (the compiled TPU body): pooled rows are tiled (divisor of the
+  pooled height under a VMEM budget) with a one-pooled-row halo read
+  from the neighbouring blocks (the same arrays passed with shifted,
+  clamped index maps; boundary halos zeroed in-kernel), the scatter
+  interleaves into the unpooled tile in registers, and the conv runs as
+  tap-major shifted ``dot_general`` accumulation — kh*kw MXU matmuls
+  over the channel dim per tile.  Its interpret-mode numerics are
+  pinned against the exact body (tests: allclose at fp32 reduction
+  tolerance; the layout/halo logic is shared with the exact path or
+  covered by dedicated tiled-vs-whole tests); BIT-parity of the
+  compiled body on real hardware is asserted by tools/fused_probe.py on
+  a TPU host and recorded loudly by the `fused` bench-suite token — the
+  same "the TPU run decides" discipline as kpack.
+
+Policy: the ``fused_unpool`` config knob (off|auto|forced), resolved by
+``resolve_fused_unpool`` below — the ONE place the vocabulary is
+validated, shared by config boot, the serving layer, the engine env
+fallback (DECONV_FUSED_UNPOOL) and the probes.  ``auto`` engages on TPU
+only (the interpret body is a correctness harness, not a CPU fast
+path); ``forced`` engages everywhere certified — on CPU that means the
+interpret body, which is how the parity contract is pinned without
+hardware.  Uncertified shapes fall back to the unfused pair SILENTLY in
+every mode: the public op is always bit-identical to the pair it
+replaces or it does not engage.
+
+This module supersedes ops/pallas_pool.py as the low-C Pallas attack:
+the standalone pool/unpool kernels measured end-to-end NEGATIVE because
+their custom-call boundary cost XLA more fusion than the kernel saved
+(its docstring has the numbers); fusing the unpool INTO the conv removes
+the boundary's whole reason to lose — the conv was the fusion the
+boundary was breaking.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# jax.experimental.pallas is imported lazily inside the dispatch path
+# (the ops/pool.py treatment of pallas_pool): the policy resolver and
+# certification run at config-boot and trace time on every server, and
+# must not pull the pallas machinery into processes that never fuse.
+
+# VMEM budget (bytes) for one mxu-body block's fp32 working set — the
+# unpooled halo-extended tile, the per-group accumulator, the output
+# tile and one shifted operand.  Mosaic double-buffers pipeline operands
+# on top of this, so 4M of accounted working set keeps the total under
+# the 16M scoped-vmem limit with the same headroom discipline as
+# pallas_pool's 512K x ~8 overhead factor.
+_FUSED_BLOCK_BUDGET = 4 * 1024 * 1024
+
+FUSED_MODES = ("off", "auto", "forced")
+
+
+def resolve_fused_unpool(policy) -> str:
+    """Resolve (and validate) the ``fused_unpool`` policy knob — the ONE
+    place the off|auto|forced vocabulary (config.py) is parsed, shared
+    by boot validation, the serving layer, get_visualizer's env fallback
+    and the probes so the mapping can never drift (the
+    resolve_kpack_chan convention).
+
+    - ``off`` (also '', '0', 'false', 'no'): disabled — the unfused
+      unpool+conv pair everywhere.
+    - ``auto``: fuse certified sites when the attached backend is TPU
+      (the compiled kernel is the point; the interpret body would make a
+      CPU server slower, not faster).
+    - ``forced``: fuse certified sites on every backend — interpret mode
+      off-TPU, which is the parity/probe harness, not a fast path.
+    """
+    if isinstance(policy, bool):  # bool is an int/str-coercible footgun
+        raise ValueError(f"illegal fused_unpool policy {policy!r}")
+    p = str(policy).strip().lower()
+    if p in ("", "0", "off", "false", "no"):
+        return "off"
+    if p in ("auto", "forced"):
+        return p
+    raise ValueError(
+        f"illegal fused_unpool policy {policy!r}; expected "
+        "'off', 'auto' or 'forced'"
+    )
+
+
+def fused_engaged(mode: str) -> bool:
+    """Whether a resolved policy engages the kernel on THIS backend (the
+    per-site shape certification still applies on top)."""
+    if mode == "forced":
+        return True
+    if mode == "auto":
+        return jax.default_backend() == "tpu"
+    return False
+
+
+def _interpret() -> bool:
+    # interpret off-TPU so the parity contract and the vmap rules stay
+    # testable on CPU (the pallas_pool convention)
+    return jax.default_backend() != "tpu"
+
+
+def fused_body() -> str:
+    """Which kernel body an ENGAGED site runs on this backend —
+    'kernel' (compiled mxu) or 'interpret' (the exact parity-harness
+    body).  The one backend->body mapping, shared by /v1/config's
+    ``fused_unpool_resolved`` and the probe's ``fused_body`` row field
+    so the reported body can never drift from the dispatched one."""
+    return "interpret" if _interpret() else "kernel"
+
+
+def _halo_rows(kh: int, ph: int) -> int:
+    """Pooled rows of halo one side needs: ceil((kh//2) / ph)."""
+    return -(-(kh // 2) // ph)
+
+
+def _fused_row_tile(
+    ho: int, wo: int, cy: int, cin_total: int, ph: int, pw: int,
+    kh: int, kw: int,
+) -> int:
+    """Largest divisor of ``ho`` whose mxu-body working set fits the
+    budget (and can supply its own halo: tp >= the pooled halo rows).
+    0 = nothing fits — the shape is uncertified and the caller falls
+    back to the unfused pair."""
+    kh2, kw2 = kh // 2, kw // 2
+    hp = _halo_rows(kh, ph)
+    w_full = wo * pw
+    cout = max(cy, 1)
+    best = 0
+    for tp in range(1, ho + 1):
+        if ho % tp:
+            continue
+        if hp and tp < hp:
+            continue
+        r = tp * ph
+        working = (
+            (r + 2 * kh2) * (w_full + 2 * kw2) * cy * 4  # unpooled tile
+            + 2 * r * w_full * cin_total * 4  # accumulator + out tile
+            + r * w_full * cout * 4  # one shifted operand view
+        )
+        if working <= _FUSED_BLOCK_BUDGET:
+            best = tp
+    return best
+
+
+def fused_supported(
+    y_shape, idx_shape, w_shape, pool_size, out_hw, groups: int,
+) -> bool:
+    """Static shape certification for the kernel — everything else takes
+    the silent unfused fallback.  Mirrors the engine's pack
+    certification (odd SAME stride-1 is asserted by the caller's layer
+    walk; this adds the kernel's own layout constraints): 4-D NHWC,
+    evenly-divisible pooled extents (out_hw exactly ho*ph x wo*pw — the
+    pallas_pool divisibility rule), switch batch dividing the signal
+    batch, the group-packed channel contract, and a row tiling that
+    fits the VMEM budget."""
+    if len(y_shape) != 4 or len(idx_shape) != 4 or len(w_shape) != 4:
+        return False
+    b, ho, wo, cy = y_shape
+    bi, hi, wi, ci = idx_shape
+    kh, kw, cin, cout = w_shape
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    if (hi, wi) != (ho, wo) or bi <= 0 or b % bi:
+        return False
+    if kh % 2 == 0 or kw % 2 == 0:
+        return False
+    if groups < 1 or cy != groups * ci or ci != cout:
+        return False
+    if out_hw is not None and tuple(out_hw) != (ho * ph, wo * pw):
+        return False
+    return (
+        _fused_row_tile(ho, wo, cy, groups * cin, ph, pw, kh, kw) > 0
+    )
+
+
+# --- kernel bodies ----------------------------------------------------------
+
+
+def _exact_kernel(y_ref, idx_ref, w_ref, o_ref, *, ph, pw, relu, groups, rep):
+    """The interpret-mode body: the unfused pair's ops verbatim on the
+    kernel refs.  ``rep`` replays each switch slice across `rep`
+    consecutive signal slices (the collapsed vmap-axis-major layout the
+    custom_vmap rule produces) — jnp.repeat copies values, so the
+    per-slice arithmetic is bit-identical to the pair's broadcast.
+    Parity with the pair is by construction: same primitives, same
+    operands, same extents (the whole collapsed batch in one grid
+    step)."""
+    from deconv_api_tpu.ops.conv import (
+        conv2d_input_backward,
+        conv2d_input_backward_grouped,
+    )
+    from deconv_api_tpu.ops.pool import unpool_with_argmax
+
+    y = y_ref[...]
+    idx = idx_ref[...]
+    if rep > 1:
+        idx = jnp.repeat(idx, rep, axis=0)
+    up = unpool_with_argmax(
+        y, idx, (ph, pw), fuse_relu=relu, groups=groups
+    )
+    if groups > 1:
+        o_ref[...] = conv2d_input_backward_grouped(up, w_ref[...], groups)
+    else:
+        o_ref[...] = conv2d_input_backward(up, w_ref[...])
+
+
+def _scatter_block(y, idx, ph: int, pw: int, groups: int, relu: bool):
+    """Scatter a pooled (t, wo, C) block to its (t*ph, wo*pw, C)
+    unpooled positions in registers — the ops/pool.py semantics
+    (one-hot compare fused into a multiply; ``relu`` folds the
+    deconvnet backward-ReLU into the scatter) with the interleave
+    expressed as the stack/reshape pattern Mosaic lowers (the
+    _unpool_kernel idiom).  ``groups``: the switch index is
+    group-invariant and broadcasts across the packed groups."""
+    t, wo, cy = y.shape
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    yg = y.reshape(t, wo, groups, cy // groups) if groups > 1 else None
+    rows = []
+    for di in range(ph):
+        cols = []
+        for dj in range(pw):
+            m = (idx == di * pw + dj).astype(y.dtype)
+            if groups > 1:
+                cols.append((yg * m[:, :, None, :]).reshape(t, wo, cy))
+            else:
+                cols.append(y * m)
+        # (t, wo, pw, C) -> (t, wo*pw, C): interleave columns back
+        rows.append(jnp.stack(cols, axis=2).reshape(t, wo * pw, cy))
+    # (t, ph, W, C) -> (t*ph, W, C): interleave rows back
+    return jnp.stack(rows, axis=1).reshape(t * ph, wo * pw, cy)
+
+
+def _mxu_kernel(
+    y_ref, yp_ref, yn_ref, idx_ref, ip_ref, in_ref, fk_ref, o_ref,
+    *, ph, pw, kh, kw, relu, groups, nb,
+):
+    """The compiled TPU body: scatter the pooled tile (plus a one-block
+    halo each side, zeroed at the array boundary — SAME padding) into
+    its unpooled form in VMEM, then accumulate the flipped conv as
+    tap-major shifted matmuls on the MXU.  Compute runs fp32 (Mosaic's
+    sub-32-bit relayouts are incomplete — the pallas_pool note) and
+    narrows at the store; the int8 switch index widens to int32 for the
+    compare for the same reason."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    kh2, kw2 = kh // 2, kw // 2
+    hp = _halo_rows(kh, ph)
+    tp = y_ref.shape[1]
+
+    def scat(yb, ib):
+        yb = yb.astype(jnp.float32)
+        ib = ib.astype(jnp.int32)
+        return _scatter_block(yb, ib, ph, pw, groups, relu)
+
+    cur = scat(y_ref[...][0], idx_ref[...][0])  # (tp*ph, W, Cy)
+    if kh2:
+        top = scat(
+            yp_ref[...][0, tp - hp :], ip_ref[...][0, tp - hp :]
+        )[hp * ph - kh2 :]
+        bot = scat(yn_ref[...][0, :hp], in_ref[...][0, :hp])[:kh2]
+        # boundary blocks read a clamped (self) halo: zero it — SAME pad
+        top = jnp.where(j == 0, jnp.zeros_like(top), top)
+        bot = jnp.where(j == nb - 1, jnp.zeros_like(bot), bot)
+        up = jnp.concatenate([top, cur, bot], axis=0)
+    else:
+        up = cur
+    if kw2:
+        zc = jnp.zeros((up.shape[0], kw2, up.shape[2]), up.dtype)
+        up = jnp.concatenate([zc, up, zc], axis=1)
+
+    r = tp * ph
+    w_full = o_ref.shape[2]
+    fk = fk_ref[...].astype(jnp.float32)  # (kh, kw, Cout, Cin) flipped
+    cout, cin = fk.shape[2], fk.shape[3]
+    # Every packed group applies the SAME flipped kernel (the kpack
+    # tiling, ops/conv.py:tile_kernel_groups), so the grouped conv is
+    # one matmul with the group axis folded into M — (R*W*G, Cout) @
+    # (Cout, Cin) — instead of G quarter-filled dots.  Per-output-
+    # element reduction order is unchanged (still the one kernel's Cout
+    # contraction), so the interpret numerics match the per-group form.
+    acc = jnp.zeros((r * w_full * groups, cin), jnp.float32)
+    for di in range(kh):
+        for dj in range(kw):
+            sh = up[di : di + r, dj : dj + w_full, :].reshape(
+                r * w_full * groups, cout
+            )
+            acc = acc + jax.lax.dot_general(
+                sh, fk[di, dj],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    out = acc.reshape(r, w_full, groups * cin)
+    o_ref[...] = out.astype(o_ref.dtype)[None]
+
+
+# --- pallas dispatch --------------------------------------------------------
+
+
+def fused_pallas_call(
+    y: jnp.ndarray,
+    idx: jnp.ndarray,
+    w: jnp.ndarray,
+    pool_size: tuple[int, int],
+    relu: bool = False,
+    groups: int = 1,
+    impl: str | None = None,
+    interpret: bool | None = None,
+    rows_per_block: int | None = None,
+):
+    """Build and invoke the pallas kernel on certified shapes (callers
+    go through ``fused_unpool_backward``; tests drive the bodies
+    directly to pin the mxu form in interpret mode).  ``w`` is the
+    UNFLIPPED forward HWIO kernel — the exact body consumes it verbatim
+    (its conv flips in-trace, like the pair); the mxu body takes the
+    flipped form, computed here outside the kernel."""
+    from jax.experimental import pallas as pl
+
+    from deconv_api_tpu.ops.conv import flip_kernel
+
+    ph, pw = int(pool_size[0]), int(pool_size[1])
+    b, ho, wo, cy = y.shape
+    bi = idx.shape[0]
+    rep = b // bi
+    kh, kw, cin, cout = w.shape
+    if interpret is None:
+        interpret = _interpret()
+    if impl is None:
+        impl = "exact" if interpret else "mxu"
+
+    if impl == "exact":
+        kernel = functools.partial(
+            _exact_kernel, ph=ph, pw=pw, relu=relu, groups=groups, rep=rep
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((b, ho, wo, cy), lambda i: (0, 0, 0, 0)),
+                pl.BlockSpec(
+                    (bi, ho, wo, idx.shape[3]), lambda i: (0, 0, 0, 0)
+                ),
+                pl.BlockSpec((kh, kw, cin, cout), lambda i: (0, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (b, ho * ph, wo * pw, groups * cin),
+                lambda i: (0, 0, 0, 0),
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (b, ho * ph, wo * pw, groups * cin), y.dtype
+            ),
+            interpret=interpret,
+        )(y, idx, w)
+
+    tp = rows_per_block or _fused_row_tile(
+        ho, wo, cy, groups * cin, ph, pw, kh, kw
+    )
+    assert tp > 0 and ho % tp == 0, (
+        f"fused mxu body: no row tile for ho={ho} under the VMEM budget "
+        "(certification should have fallen back)"
+    )
+    nb = ho // tp
+    kernel = functools.partial(
+        _mxu_kernel, ph=ph, pw=pw, kh=kh, kw=kw, relu=relu,
+        groups=groups, nb=nb,
+    )
+    ci = idx.shape[3]
+
+    def at(i, j):
+        return (i, j, 0, 0)
+
+    def at_prev(i, j):
+        return (i, jnp.maximum(j - 1, 0), 0, 0)
+
+    def at_next(i, j):
+        return (i, jnp.minimum(j + 1, nb - 1), 0, 0)
+
+    # the switch blocks are shared by `rep` consecutive signal slices
+    # (vmap-axis-major collapse) through the grid index map — the
+    # K-fold broadcast never materialises in HBM (pallas_pool idiom)
+    def iat(i, j):
+        return (i // rep, j, 0, 0)
+
+    def iat_prev(i, j):
+        return (i // rep, jnp.maximum(j - 1, 0), 0, 0)
+
+    def iat_next(i, j):
+        return (i // rep, jnp.minimum(j + 1, nb - 1), 0, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, tp, wo, cy), at),
+            pl.BlockSpec((1, tp, wo, cy), at_prev),
+            pl.BlockSpec((1, tp, wo, cy), at_next),
+            pl.BlockSpec((1, tp, wo, ci), iat),
+            pl.BlockSpec((1, tp, wo, ci), iat_prev),
+            pl.BlockSpec((1, tp, wo, ci), iat_next),
+            pl.BlockSpec((kh, kw, cout, cin), lambda i, j: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tp * ph, wo * pw, groups * cin), at
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (b, ho * ph, wo * pw, groups * cin), y.dtype
+        ),
+        interpret=interpret,
+    )(y, y, y, idx, idx, idx, flip_kernel(w))
+
+
+# --- vmap composition -------------------------------------------------------
+# The engine vmaps over images (batched serving) and over the K
+# projections (the per-K backward path); jax's generic pallas_call
+# batching rewrites blocks in ways Mosaic cannot lower, so the public op
+# is a custom_vmap wrapper whose rule collapses every mapped axis into
+# the kernel's existing leading (batch) grid dim — the pallas_pool
+# composition, switch sharing included.
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_op(ph: int, pw: int, relu: bool, groups: int):
+    from jax import custom_batching
+
+    @custom_batching.custom_vmap
+    def op(y, idx, w):
+        return fused_pallas_call(y, idx, w, (ph, pw), relu, groups)
+
+    @op.def_vmap
+    def _rule(axis_size, in_batched, y, idx, w):  # noqa: ANN001
+        if in_batched[2]:
+            raise NotImplementedError(
+                "fused unpool+conv: a vmapped conv kernel has no packed "
+                "layout here — the engine never maps params"
+            )
+        if not in_batched[0]:
+            y = jnp.broadcast_to(y[None], (axis_size, *y.shape))
+        v, b = y.shape[0], y.shape[1]
+        if in_batched[1]:
+            idx = idx.reshape(idx.shape[0] * idx.shape[1], *idx.shape[2:])
+        elif idx.shape[0] > 1:
+            # Unbatched idx with its own batch > 1: the flattened y is
+            # vmap-axis-major, so the kernel's `i // rep` map would pair
+            # signal slices with the WRONG switch blocks; tile idx along
+            # the new leading axis so pairing stays vmap-axis-major
+            # (the pallas_pool rule, same reasoning).
+            idx = jnp.tile(idx, (v,) + (1,) * (idx.ndim - 1))
+        out = op(y.reshape(v * b, *y.shape[2:]), idx, w)
+        return out.reshape(v, b, *out.shape[1:]), True
+
+    return op
+
+
+def fused_unpool_backward(
+    y: jnp.ndarray,
+    idx: jnp.ndarray,
+    w: jnp.ndarray,
+    pool_size=(2, 2),
+    out_hw: tuple[int, int] | None = None,
+    fuse_relu: bool = False,
+    groups: int = 1,
+    mode: str = "off",
+) -> jnp.ndarray:
+    """Switch-unpool ``y`` through ``idx`` and project it through the
+    flipped conv of ``w`` — ONE op, fused on certified shapes.
+
+    Contract: bit-identical to the pair it replaces,
+
+        up = unpool_with_argmax(y, idx, pool_size, out_hw,
+                                fuse_relu=fuse_relu, groups=groups)
+        conv2d_input_backward[_grouped](up, w[, groups])
+
+    in every mode — ``off`` and every uncertified shape run the pair
+    verbatim (the SILENT fallback; the engine's program bytes with the
+    knob off are exactly the pre-round-20 bytes), and the engaged
+    interpret body computes the same primitives inside the kernel
+    (module docstring).  The compiled TPU body's parity is pinned by
+    tools/fused_probe.py on hardware.
+    """
+    mode = resolve_fused_unpool(mode)
+    engaged = fused_engaged(mode) and fused_supported(
+        y.shape, idx.shape, w.shape, pool_size, out_hw, groups
+    )
+    if engaged:
+        return _fused_op(
+            int(pool_size[0]), int(pool_size[1]), bool(fuse_relu),
+            int(groups),
+        )(y, idx, w)
+    from deconv_api_tpu.ops.conv import (
+        conv2d_input_backward,
+        conv2d_input_backward_grouped,
+    )
+    from deconv_api_tpu.ops.pool import unpool_with_argmax
+
+    up = unpool_with_argmax(
+        y, idx, pool_size, out_hw, fuse_relu=fuse_relu, groups=groups
+    )
+    if groups > 1:
+        return conv2d_input_backward_grouped(up, w, groups)
+    return conv2d_input_backward(up, w)

@@ -1,12 +1,18 @@
-"""EXPERIMENTAL Pallas TPU kernels for the switch pool/unpool hot path.
+"""SUPERSEDED Pallas TPU kernels for the switch pool/unpool hot path.
 
-Status (round 12): explicitly gated as a measured-negative experiment.
-The engine's supported low-channel story is the channel-packed backward
-tail (`lowc_kpack`, engine/deconv.py: grouped convs + the group-broadcast
-unpool in ops/pool.py); these kernels remain importable and tested behind
-`pallas_enabled()` (DECONV_PALLAS opt-in, TPU only) purely as the
-measurement harness for re-probing the custom-call trade-off on future
-toolchains — enabling them logs a one-time experimental warning.
+Status (round 20): superseded as the low-C Pallas attack by the FUSED
+unpool+flipped-conv kernel (`fused_unpool`, ops/pallas_deconv.py).
+These standalone kernels measured end-to-end NEGATIVE (numbers below)
+because their pallas_call boundary is opaque to XLA: it broke the very
+elementwise/conv fusion around the unpool that the lowering relied on.
+The fused kernel removes the boundary's whole reason to lose — the conv
+IS inside it, so the scatter feeds the MXU from VMEM instead of fencing
+it off.  Operators reaching for a Pallas knob want `fused_unpool`
+(config.py, docs/OPERATIONS.md "Fused unpool+conv tail"); DECONV_PALLAS
+remains importable and tested behind `pallas_enabled()` (opt-in, TPU
+only) purely as the measurement harness for re-probing the standalone
+custom-call trade-off on future toolchains — enabling it logs a
+one-time warning pointing at the supersession.
 
 
 The reference's hot loop #1 is an interpreted 4-deep Python loop recording
@@ -196,11 +202,12 @@ def pallas_enabled(op: str = "") -> bool:
     the measurements behind the default).  DECONV_PALLAS: '0' (default,
     off), '1' (all ops), or a comma list of op names ('pool', 'unpool').
 
-    Enabling logs a ONE-TIME experimental warning: both recorded TPU
-    measurements (r2, r3-pipelined) had XLA beating these kernels end to
-    end, and the packed low-C tail (lowc_kpack) superseded them as the
-    supported attack on the same slack — an operator flipping this on in
-    production should be doing it on purpose, with a stopwatch."""
+    Enabling logs a ONE-TIME warning: both recorded TPU measurements
+    (r2, r3-pipelined) had XLA beating these kernels end to end, and the
+    FUSED unpool+conv kernel (fused_unpool, ops/pallas_deconv.py)
+    superseded them as the Pallas attack on the same slack — an operator
+    flipping this on in production should be doing it on purpose, with a
+    stopwatch."""
     val = os.environ.get("DECONV_PALLAS", "0").lower()
     if val in ("0", "false", "off", ""):
         return False
@@ -215,9 +222,10 @@ def pallas_enabled(op: str = "") -> bool:
         import warnings
 
         warnings.warn(
-            "DECONV_PALLAS is EXPERIMENTAL and measured slower end-to-end "
+            "DECONV_PALLAS is SUPERSEDED and measured slower end-to-end "
             "than the XLA lowering (ops/pallas_pool.py docstring); the "
-            "supported low-channel path is lowc_kpack",
+            "supported low-channel paths are lowc_kpack and the fused "
+            "unpool+conv tail (fused_unpool, ops/pallas_deconv.py)",
             stacklevel=2,
         )
     return enabled

@@ -85,6 +85,12 @@ class DeconvService:
         from deconv_api_tpu.engine.deconv import resolve_kpack_chan
 
         resolve_kpack_chan(self.cfg.lowc_kpack, self.cfg.top_k)
+        # Same rule for the fused unpool+conv tail (round 20): the
+        # off|auto|forced vocabulary fails a typo at boot, not at the
+        # first dispatch.
+        from deconv_api_tpu.ops.pallas_deconv import resolve_fused_unpool
+
+        resolve_fused_unpool(self.cfg.fused_unpool)
         if self.cfg.weight_dtype not in WEIGHT_DTYPES:
             raise ValueError(
                 f"weight_dtype must be one of {WEIGHT_DTYPES}, got "
@@ -469,6 +475,7 @@ class DeconvService:
                     "strict_compat": self.cfg.strict_compat,
                     "backward_dtype": self.cfg.backward_dtype,
                     "lowc_kpack": self.cfg.lowc_kpack,
+                    "fused_unpool": self.cfg.fused_unpool,
                     "fwd_lowc_bf16": os.environ.get(
                         "DECONV_FWD_LOWC_BF16", "0"
                     ),
@@ -755,6 +762,11 @@ class DeconvService:
                 # invalidate every key by rule — same treatment as
                 # DECONV_FWD_LOWC_BF16 below.
                 self.cfg.lowc_kpack,
+                # fused unpool+conv tail policy (round 20): bit-inert on
+                # the interpret path (tests/test_pallas_deconv.py), but
+                # the compiled TPU kernel's parity is probe-pinned, not
+                # proof-pinned — config-invalidates-everything applies.
+                self.cfg.fused_unpool,
                 # stored weight precision (round 15): bf16/int8 tiers
                 # change output bytes within their PSNR bounds, so a
                 # precision change must invalidate every cached payload
@@ -1040,6 +1052,7 @@ class DeconvService:
             self.cfg.backward_dtype or None, post, sweep,
             donate=self.cfg.donate_inputs, lane=lane,
             lowc_kpack=self.cfg.lowc_kpack, quant=quant,
+            fused_unpool=self.cfg.fused_unpool,
         )
         bucket = self._bucket_for(len(images))
         # cfg.dtype is the forward/selection dtype (the engine follows the
@@ -2379,6 +2392,24 @@ class DeconvService:
             if self.bundle.spec is not None
             else 0
         )
+        # Fused unpool+conv tail (round 20): the RESOLVED engagement the
+        # policy reaches on this process — 'off' (policy off, a DAG
+        # backbone, or a backend that disengages auto), 'kernel' (the
+        # compiled TPU body) or 'interpret' (forced off-TPU: the parity
+        # harness body).  Per-site shape certification still applies on
+        # top (uncertified shapes silently run the unfused pair).
+        from deconv_api_tpu.ops.pallas_deconv import (
+            fused_body,
+            fused_engaged,
+            resolve_fused_unpool,
+        )
+
+        fmode = resolve_fused_unpool(self.cfg.fused_unpool)
+        cfg["fused_unpool_resolved"] = (
+            "off"
+            if self.bundle.spec is None or not fused_engaged(fmode)
+            else fused_body()
+        )
         # live response-cache state (round 7): operators confirm the cache
         # is on and how full it is without scraping /metrics
         cfg["cache_active"] = self.cache is not None
@@ -3602,6 +3633,14 @@ def main(argv: list[str] | None = None) -> None:
         "channel threshold (default off)",
     )
     p.add_argument(
+        "--fused-unpool", default=None, metavar="off|auto|forced",
+        help="fuse the backward tail's switch-unpool into the flipped "
+        "conv's input formation as one Pallas kernel (sequential "
+        "models): auto = TPU only, forced = everywhere certified "
+        "(interpret mode off-TPU — a parity harness, not a fast path; "
+        "default off — see docs/OPERATIONS.md 'Fused unpool+conv tail')",
+    )
+    p.add_argument(
         "--compile-cache-dir", default=None, metavar="DIR",
         help="persistent XLA compilation cache directory (default off): "
         "warm restarts skip the per-bucket-per-lane warmup compile tax",
@@ -3756,6 +3795,8 @@ def main(argv: list[str] | None = None) -> None:
         overrides["serve_lanes"] = args.lanes
     if args.lowc_kpack is not None:
         overrides["lowc_kpack"] = args.lowc_kpack
+    if args.fused_unpool is not None:
+        overrides["fused_unpool"] = args.fused_unpool
     if args.compile_cache_dir is not None:
         overrides["compilation_cache_dir"] = args.compile_cache_dir
     if args.jobs_dir is not None:

@@ -199,6 +199,7 @@ class ModelBundle:
         lane: int = 0,
         lowc_kpack: str = "off",
         quant=None,
+        fused_unpool: str = "off",
     ):
         """fn(params, batch) -> {layer: {..., indices, sums, valid}} —
         jitted once per static configuration and cached.  ``bug_compat``
@@ -254,7 +255,16 @@ class ModelBundle:
         (entry, amax) scales (engine/quant.py) — sequential specs only;
         the serving layer normalises DAG requests down to bf16 before
         this call, and the None default keeps the exact pre-round-18
-        program and cache keys."""
+        program and cache keys.
+
+        ``fused_unpool`` (round 20) is the fused Pallas
+        unpool+flipped-conv backward-tail policy (config.py;
+        ops/pallas_deconv.py:resolve_fused_unpool).  Sequential specs
+        thread it into the engine; DAG models — and any backend the
+        resolved mode disengages on (auto off-TPU) — normalise it to
+        "off" BEFORE the cache key (the lowc_kpack rule: an inert
+        policy value must not compile duplicate identical
+        executables)."""
         lane_pl = self.lane_placement(lane)
         lane_mesh = None
         if lane_pl is not None:
@@ -264,15 +274,23 @@ class ModelBundle:
                 lane_mesh = lane_pl
         mesh = self.mesh if self.mesh is not None else lane_mesh
         from deconv_api_tpu.engine.deconv import resolve_kpack_chan
+        from deconv_api_tpu.ops.pallas_deconv import (
+            fused_engaged,
+            resolve_fused_unpool,
+        )
 
-        # Resolve (and thereby validate) the policy for every model
+        # Resolve (and thereby validate) the policies for every model
         # family; only sequential specs key their cache on the result.
         kpack_chan = resolve_kpack_chan(lowc_kpack, top_k)
+        fused_unpool = resolve_fused_unpool(fused_unpool)
+        if not fused_engaged(fused_unpool):
+            fused_unpool = "off"
         if self.spec is None:
             backward_dtype = None
             kpack_chan = 0
             quant = None  # DAG walks have no quantized form (normalized
             # to bf16 upstream); None keeps the key from fragmenting
+            fused_unpool = "off"  # vjp walk has no pool+conv triple
         if mesh is not None:
             donate = False  # sharded jit boundary; donation not threaded
         if donate:
@@ -282,7 +300,7 @@ class ModelBundle:
         # lane stays the key's TAIL — test_lanes and the warmup loop read
         # k[-1] as the lane a cached program is pinned to
         key = (layer, mode, top_k, bug_compat, backward_dtype, post, sweep,
-               donate, kpack_chan, quant, lane)
+               donate, kpack_chan, quant, fused_unpool, lane)
         if key not in self._vis_cache:
             if self.spec is not None:
                 # On a dp mesh the merged-sweep batch chunking must stay
@@ -296,7 +314,7 @@ class ModelBundle:
                     backward_dtype=backward_dtype or None,
                     kpack_chan=kpack_chan,
                     sweep_chunk=0 if mesh is not None else None,
-                    quant=quant,
+                    quant=quant, fused_unpool=fused_unpool,
                 )
             else:
                 sweep_names = self.sweep_layers(layer) if sweep else None

@@ -1,0 +1,576 @@
+"""Fused Pallas unpool+flipped-conv backward tail (round 20): fused_unpool.
+
+Fast-lane (tier-1) coverage of ops/pallas_deconv.py at CPU-sized shapes,
+so kernel/dispatch drift is caught without a TPU: interpret-mode fp32
+BIT-parity of the fused op against the unfused
+`unpool_with_argmax` → `conv2d_input_backward[_grouped]` pair across
+C ∈ {3, 64, 128}, odd batch and odd (padded) extents, relu-fused and
+plain variants, groups ∈ {1, K}; the compiled-form (mxu) kernel body
+pinned in interpret mode including its row-tiled halo logic; silent
+fallback on every uncertified shape; the off|auto|forced policy
+resolving through `/v1/config`; and end-to-end serving byte-parity with
+the knob forced vs off (deconv, sweep, dream — cache bypassed).
+Headline-shape A/B *timing* lives in tools/fused_probe.py (the `fused`
+bench-suite token); compiled-kernel parity on real hardware is that
+probe's job, not this file's (ops/pallas_deconv.py docstring).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deconv_api_tpu import ops
+from deconv_api_tpu.engine.deconv import get_visualizer
+from deconv_api_tpu.models.spec import init_params
+from deconv_api_tpu.ops import pallas_deconv
+from deconv_api_tpu.ops.conv import (
+    conv2d_input_backward,
+    conv2d_input_backward_grouped,
+)
+from deconv_api_tpu.ops.pool import unpool_with_argmax
+from tests.test_engine_parity import TINY
+
+
+# ---------------------------------------------------------------- helpers
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_params(TINY, jax.random.PRNGKey(42))
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), dtype
+    )
+
+
+def _idx(shape, seed=0, hi=4):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, hi, shape), jnp.int8
+    )
+
+
+def _pair(y, idx, w, pool, out_hw, relu, groups):
+    """The reference pair the fused op must be bit-identical to."""
+    up = unpool_with_argmax(
+        y, idx, pool, out_hw, fuse_relu=relu, groups=groups
+    )
+    if groups > 1:
+        return conv2d_input_backward_grouped(up, w, groups)
+    return conv2d_input_backward(up, w)
+
+
+def _has_pallas(fn, *args) -> bool:
+    """Engagement marker: the pallas_call primitive in the traced jaxpr
+    (interpret mode inlines the kernel out of lowered HLO, so jaxpr
+    inspection is the backend-independent check the probe also uses)."""
+    return "pallas_call" in str(jax.make_jaxpr(fn)(*args))
+
+
+# ------------------------------------------------------ op-level parity
+
+
+class TestFusedOpParity:
+    @pytest.mark.parametrize("c", [3, 64, 128])
+    @pytest.mark.parametrize("relu", [False, True])
+    def test_bitwise_parity_groups1(self, c, relu):
+        """Interpret-mode fp32 BIT-equality with the unfused pair at the
+        certified widths — including an odd batch (serving bucket
+        shapes are not powers of two)."""
+        b, ho, wo, cin, kh = 3, 4, 5, 7, 3
+        y = _rand((b, ho, wo, c), seed=c + relu)
+        idx = _idx((b, ho, wo, c), seed=c)
+        w = _rand((kh, kh, cin, c), seed=c + 1)
+        got = pallas_deconv.fused_unpool_backward(
+            y, idx, w, (2, 2), (ho * 2, wo * 2),
+            fuse_relu=relu, mode="forced",
+        )
+        want = _pair(y, idx, w, (2, 2), (ho * 2, wo * 2), relu, 1)
+        assert got.shape == want.shape == (b, ho * 2, wo * 2, cin)
+        assert jnp.array_equal(got, want)
+
+    @pytest.mark.parametrize("groups", [4, 8])
+    @pytest.mark.parametrize("relu", [False, True])
+    def test_bitwise_parity_grouped(self, groups, relu):
+        """The kpack grouped form: groups=K packed signal, group-
+        invariant switch index, tiled shared kernel — bit-equal to the
+        grouped pair."""
+        b, ho, wo, c, cin = 2, 6, 4, 16, 5
+        y = _rand((b, ho, wo, groups * c), seed=groups + relu)
+        idx = _idx((b, ho, wo, c), seed=groups)
+        w = _rand((3, 3, cin, c), seed=groups + 2)
+        got = pallas_deconv.fused_unpool_backward(
+            y, idx, w, (2, 2), (ho * 2, wo * 2),
+            fuse_relu=relu, groups=groups, mode="forced",
+        )
+        want = _pair(y, idx, w, (2, 2), (ho * 2, wo * 2), relu, groups)
+        assert got.shape == want.shape == (b, ho * 2, wo * 2, groups * cin)
+        assert jnp.array_equal(got, want)
+
+    def test_bitwise_parity_5x5_kernel_and_3x3_pool(self):
+        """Wider odd kernels and non-2x2 pools stay certified (halo is
+        ceil(kh2/ph) pooled rows) and bit-equal."""
+        b, ho, wo, c, cin = 2, 4, 4, 6, 3
+        y = _rand((b, ho, wo, c), seed=9)
+        idx = _idx((b, ho, wo, c), seed=9, hi=9)
+        w = _rand((5, 5, cin, c), seed=10)
+        got = pallas_deconv.fused_unpool_backward(
+            y, idx, w, (3, 3), (ho * 3, wo * 3), mode="forced"
+        )
+        want = _pair(y, idx, w, (3, 3), (ho * 3, wo * 3), False, 1)
+        assert jnp.array_equal(got, want)
+
+    def test_bitwise_parity_bf16(self):
+        """The serving config runs the backward chain bfloat16; the
+        engaged interpret body must stay bit-equal there too."""
+        b, ho, wo, c, cin = 2, 4, 4, 8, 5
+        y = _rand((b, ho, wo, c), seed=3).astype(jnp.bfloat16)
+        idx = _idx((b, ho, wo, c), seed=3)
+        w = _rand((3, 3, cin, c), seed=4).astype(jnp.bfloat16)
+        got = pallas_deconv.fused_unpool_backward(
+            y, idx, w, (2, 2), (ho * 2, wo * 2), fuse_relu=True,
+            mode="forced",
+        )
+        want = _pair(y, idx, w, (2, 2), (ho * 2, wo * 2), True, 1)
+        assert got.dtype == want.dtype == jnp.bfloat16
+        assert jnp.array_equal(got, want)
+
+    def test_vmap_composition_matches_pair(self):
+        """The engine's two vmap axes (K projections with shared
+        switches, then the request batch) must collapse into the kernel
+        bit-identically to vmapping the pair."""
+        k, bo = 4, 2
+        yk = _rand((bo, k, 1, 4, 4, 16), seed=11)
+        idx = _idx((bo, 1, 4, 4, 16), seed=11)
+        w = _rand((3, 3, 7, 16), seed=12)
+
+        def fused(ys, ii):
+            return jax.vmap(
+                lambda s: pallas_deconv.fused_unpool_backward(
+                    s, ii, w, (2, 2), (8, 8), fuse_relu=True,
+                    mode="forced",
+                )
+            )(ys)
+
+        def ref(ys, ii):
+            return jax.vmap(
+                lambda s: _pair(s, ii, w, (2, 2), (8, 8), True, 1)
+            )(ys)
+
+        got = jax.vmap(fused)(yk, idx)
+        want = jax.vmap(ref)(yk, idx)
+        assert jnp.array_equal(got, want)
+
+
+# ------------------------------------------------- the compiled (mxu) body
+
+
+class TestMxuBody:
+    """The tap-major shifted-matmul body that compiles on TPU, pinned in
+    interpret mode: its scatter/halo/layout logic must reproduce the
+    pair at fp32 reduction tolerance (bit-parity of the COMPILED form is
+    tools/fused_probe.py's job on real hardware)."""
+
+    @pytest.mark.parametrize("groups", [1, 4])
+    @pytest.mark.parametrize("tp", [1, 2, 3])
+    def test_row_tiled_halo_matches_pair(self, groups, tp):
+        """Every row tiling — including tilings that need the
+        neighbour-block halo — must agree with the untiled pair; this
+        is the test that owns the halo index-map logic."""
+        b, ho, wo, c, cin = 2, 6, 5, 8, 3
+        y = _rand((b, ho, wo, groups * c), seed=tp + groups)
+        idx = _idx((b, ho, wo, c), seed=tp)
+        w = _rand((3, 3, cin, c), seed=tp + 5)
+        got = pallas_deconv.fused_pallas_call(
+            y, idx, w, (2, 2), relu=True, groups=groups,
+            impl="mxu", interpret=True, rows_per_block=tp,
+        )
+        want = _pair(y, idx, w, (2, 2), (ho * 2, wo * 2), True, groups)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
+    def test_wide_kernel_halo(self):
+        """kh=5 needs a full pooled halo row each side (hp=1 at ph=2):
+        the boundary zeroing and interior stitching must both hold."""
+        b, ho, wo, c, cin = 1, 4, 4, 6, 4
+        y = _rand((b, ho, wo, c), seed=21)
+        idx = _idx((b, ho, wo, c), seed=21)
+        w = _rand((5, 5, cin, c), seed=22)
+        got = pallas_deconv.fused_pallas_call(
+            y, idx, w, (2, 2), relu=False, groups=1,
+            impl="mxu", interpret=True, rows_per_block=1,
+        )
+        want = _pair(y, idx, w, (2, 2), (ho * 2, wo * 2), False, 1)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
+    def test_switch_sharing_rep(self):
+        """idx batch < y batch: switch blocks replay across `rep`
+        consecutive signal slices through the grid index map (the
+        pallas_pool idiom) — against the pair with the broadcast
+        materialised."""
+        bi, rep = 2, 3
+        ho, wo, c, cin = 4, 4, 8, 5
+        y = _rand((bi * rep, ho, wo, c), seed=31)
+        idx = _idx((bi, ho, wo, c), seed=31)
+        w = _rand((3, 3, cin, c), seed=32)
+        got = pallas_deconv.fused_pallas_call(
+            y, idx, w, (2, 2), relu=False, groups=1,
+            impl="mxu", interpret=True, rows_per_block=2,
+        )
+        want = _pair(
+            y, jnp.repeat(idx, rep, axis=0), w, (2, 2), (ho * 2, wo * 2),
+            False, 1,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
+
+# ----------------------------------------------- certification + fallback
+
+
+class TestCertification:
+    def test_odd_extent_falls_back_silently(self):
+        """A padded out_hw (pool did not divide the activation) is
+        uncertified: the public op must produce the pair's exact bytes
+        with NO pallas_call in the trace."""
+        b, ho, wo, c, cin = 2, 3, 3, 8, 5
+        y = _rand((b, ho, wo, c), seed=41)
+        idx = _idx((b, ho, wo, c), seed=41)
+        w = _rand((3, 3, cin, c), seed=42)
+
+        def op(yy, ii, ww):
+            return pallas_deconv.fused_unpool_backward(
+                yy, ii, ww, (2, 2), (7, 7), mode="forced"
+            )
+
+        got = op(y, idx, w)
+        want = _pair(y, idx, w, (2, 2), (7, 7), False, 1)
+        assert jnp.array_equal(got, want)
+        assert not _has_pallas(op, y, idx, w)
+
+    def test_even_kernel_falls_back(self):
+        y = _rand((1, 4, 4, 6), seed=43)
+        idx = _idx((1, 4, 4, 6), seed=43)
+        w = _rand((2, 2, 3, 6), seed=44)  # even kernel: uncertified
+        assert not pallas_deconv.fused_supported(
+            y.shape, idx.shape, w.shape, (2, 2), (8, 8), 1
+        )
+
+    def test_off_mode_never_engages(self):
+        y = _rand((1, 4, 4, 6), seed=45)
+        idx = _idx((1, 4, 4, 6), seed=45)
+        w = _rand((3, 3, 3, 6), seed=46)
+
+        def op(yy, ii, ww):
+            return pallas_deconv.fused_unpool_backward(
+                yy, ii, ww, (2, 2), (8, 8), mode="off"
+            )
+
+        assert not _has_pallas(op, y, idx, w)
+
+    def test_forced_engages(self):
+        y = _rand((1, 4, 4, 6), seed=45)
+        idx = _idx((1, 4, 4, 6), seed=45)
+        w = _rand((3, 3, 3, 6), seed=46)
+
+        def op(yy, ii, ww):
+            return pallas_deconv.fused_unpool_backward(
+                yy, ii, ww, (2, 2), (8, 8), mode="forced"
+            )
+
+        assert _has_pallas(op, y, idx, w)
+
+    def test_auto_disengages_off_tpu(self):
+        """auto means "the compiled kernel where it pays" — on a CPU
+        host it must resolve to the unfused pair, not the interpreter."""
+        assert pallas_deconv.resolve_fused_unpool("auto") == "auto"
+        if jax.default_backend() != "tpu":
+            assert not pallas_deconv.fused_engaged("auto")
+
+    def test_channel_mismatch_uncertified(self):
+        # y channels not groups * idx channels
+        assert not pallas_deconv.fused_supported(
+            (1, 4, 4, 7), (1, 4, 4, 3), (3, 3, 2, 3), (2, 2), (8, 8), 2
+        )
+        # idx channels != kernel out channels
+        assert not pallas_deconv.fused_supported(
+            (1, 4, 4, 6), (1, 4, 4, 6), (3, 3, 2, 4), (2, 2), (8, 8), 1
+        )
+
+
+# ------------------------------------------------------- policy resolution
+
+
+class TestResolveFusedUnpool:
+    @pytest.mark.parametrize(
+        "policy,want",
+        [
+            ("off", "off"), ("", "off"), ("0", "off"), ("false", "off"),
+            ("no", "off"), ("OFF", "off"), ("auto", "auto"),
+            ("FORCED", "forced"),
+        ],
+    )
+    def test_vocabulary(self, policy, want):
+        assert pallas_deconv.resolve_fused_unpool(policy) == want
+
+    @pytest.mark.parametrize("policy", ["bogus", "64", "-1", True, "1.5"])
+    def test_rejects_garbage(self, policy):
+        with pytest.raises(ValueError, match="fused_unpool"):
+            pallas_deconv.resolve_fused_unpool(policy)
+
+
+# ----------------------------------------------------- engine env plumbing
+
+
+class TestEngineKnob:
+    def test_env_builds_fused_program(self, tiny_params, monkeypatch):
+        """DECONV_FUSED_UNPOOL=forced must actually change the traced
+        program (pallas_call present), off must not, and the outputs
+        must stay bit-equal either way.  Env vars resolve OUTSIDE the
+        visualizer cache, so monkeypatching between calls takes
+        effect."""
+        batch = _rand((2, 16, 16, 3), seed=7)
+
+        def build():
+            return get_visualizer(
+                TINY, "b2c1", 4, "all", True, batched=True
+            )
+
+        monkeypatch.setenv("DECONV_FUSED_UNPOOL", "forced")
+        assert _has_pallas(build(), tiny_params, batch)
+        fused_out = build()(tiny_params, batch)["b2c1"]
+        monkeypatch.setenv("DECONV_FUSED_UNPOOL", "off")
+        assert not _has_pallas(build(), tiny_params, batch)
+        base = build()(tiny_params, batch)["b2c1"]
+        assert jnp.array_equal(base["images"], fused_out["images"])
+        assert jnp.array_equal(base["indices"], fused_out["indices"])
+
+    def test_composes_with_kpack(self, tiny_params):
+        """fused over the packed tail: the grouped (groups=K) kernel
+        form engages and stays bit-equal to both the packed-unfused and
+        the vmapped baselines."""
+        from deconv_api_tpu.engine.deconv import KPACK_FORCED_CHAN
+
+        batch = _rand((2, 16, 16, 3), seed=8)
+        base = get_visualizer(
+            TINY, "b2c1", 4, "all", True, batched=True,
+            fused_unpool="off",
+        )(tiny_params, batch)["b2c1"]
+        packed_fused_fn = get_visualizer(
+            TINY, "b2c1", 4, "all", True, batched=True,
+            kpack_chan=KPACK_FORCED_CHAN, fused_unpool="forced",
+        )
+        assert _has_pallas(packed_fused_fn, tiny_params, batch)
+        pf = packed_fused_fn(tiny_params, batch)["b2c1"]
+        assert jnp.array_equal(base["images"], pf["images"])
+        assert jnp.array_equal(base["indices"], pf["indices"])
+
+    def test_sweep_bit_parity(self, tiny_params):
+        batch = _rand((2, 16, 16, 3), seed=9)
+        off = get_visualizer(
+            TINY, "b2c1", 4, "all", True, batched=True, sweep=True,
+            fused_unpool="off",
+        )(tiny_params, batch)
+        on = get_visualizer(
+            TINY, "b2c1", 4, "all", True, batched=True, sweep=True,
+            fused_unpool="forced",
+        )(tiny_params, batch)
+        for name in off:
+            assert jnp.array_equal(off[name]["images"], on[name]["images"])
+
+    def test_engine_rejects_garbage(self, tiny_params):
+        with pytest.raises(ValueError, match="fused_unpool"):
+            get_visualizer(
+                TINY, "b2c1", 4, "all", True, batched=True,
+                fused_unpool="bogus",
+            )
+
+
+# ------------------------------------------------------- DAG normalisation
+
+
+class TestDagInert:
+    def test_autodeconv_validates_but_ignores(self, tiny_params):
+        """The vjp walk has no pool->relu->conv triple to fuse: the
+        policy is accepted (and validated) but the projection is
+        identical."""
+        from deconv_api_tpu.engine import autodeconv_visualizer
+        from deconv_api_tpu.models.apply import spec_forward
+
+        img = _rand((16, 16, 3), seed=9)
+        base = autodeconv_visualizer(
+            spec_forward(TINY), "b2c1", top_k=4, fused_unpool="off"
+        )(tiny_params, img)
+        fused = autodeconv_visualizer(
+            spec_forward(TINY), "b2c1", top_k=4, fused_unpool="forced"
+        )(tiny_params, img)
+        assert jnp.array_equal(base["images"], fused["images"])
+        with pytest.raises(ValueError, match="fused_unpool"):
+            autodeconv_visualizer(
+                spec_forward(TINY), "b2c1", top_k=4, fused_unpool="bogus"
+            )
+
+    def test_bundle_normalises_policy_out_of_cache_key(self, tiny_params):
+        """A DAG bundle must hand back the SAME cached program for every
+        policy value — and so must any bundle on a backend where the
+        resolved policy disengages (auto on CPU)."""
+        from deconv_api_tpu.models.apply import spec_forward
+        from deconv_api_tpu.serving.models import ModelBundle
+
+        bundle = ModelBundle(
+            name="tiny_dag",
+            params=tiny_params,
+            image_size=16,
+            preprocess=lambda x: x,
+            layer_names=("b1c1", "b1c2", "b2c1"),
+            dream_layers=(),
+            forward_fn=spec_forward(TINY),
+        )
+        off = bundle.batched_visualizer("b2c1", "all", 4, fused_unpool="off")
+        forced = bundle.batched_visualizer(
+            "b2c1", "all", 4, fused_unpool="forced"
+        )
+        assert off is forced
+
+    def test_auto_on_cpu_shares_the_off_program(self, tiny_params):
+        """Sequential bundles too: auto on a CPU host must not compile a
+        duplicate of the off program."""
+        if jax.default_backend() == "tpu":
+            pytest.skip("auto engages on TPU")
+        from deconv_api_tpu.serving.models import spec_bundle
+
+        bundle = spec_bundle(TINY, tiny_params)
+        off = bundle.batched_visualizer("b2c1", "all", 4, fused_unpool="off")
+        auto = bundle.batched_visualizer(
+            "b2c1", "all", 4, fused_unpool="auto"
+        )
+        assert off is auto
+
+
+# --------------------------------------------------------- serving (e2e)
+
+
+def _service(fused_unpool: str):
+    from deconv_api_tpu.config import ServerConfig
+    from tests.test_serving import ServiceFixture
+
+    cfg = ServerConfig(
+        image_size=16,
+        max_batch=4,
+        batch_window_ms=1.0,
+        compilation_cache_dir="",
+        fused_unpool=fused_unpool,
+    )
+    return ServiceFixture(cfg)
+
+
+class TestServingKnob:
+    @pytest.mark.parametrize(
+        "policy,want",
+        [("off", "off"), ("auto", "off"), ("forced", "interpret")],
+    )
+    def test_config_reports_resolved_engagement(self, policy, want):
+        """/v1/config must say what the policy actually reaches on this
+        process — on a CPU host: auto disengages, forced runs the
+        interpret body (on TPU the same field reads 'kernel')."""
+        import httpx
+
+        if jax.default_backend() == "tpu":  # pragma: no cover — CI is CPU
+            want = {"off": "off", "auto": "kernel", "forced": "kernel"}[
+                policy
+            ]
+        with _service(policy) as s:
+            cfg = httpx.get(s.base_url + "/v1/config").json()
+            assert cfg["fused_unpool"] == policy
+            assert cfg["fused_unpool_resolved"] == want
+
+    def test_boot_rejects_bad_policy(self):
+        from deconv_api_tpu.config import ServerConfig
+        from deconv_api_tpu.serving.app import DeconvService
+
+        params = init_params(TINY, jax.random.PRNGKey(3))
+        with pytest.raises(ValueError, match="fused_unpool"):
+            DeconvService(
+                ServerConfig(
+                    image_size=16, fused_unpool="bogus",
+                    compilation_cache_dir="",
+                ),
+                spec=TINY, params=params,
+            )
+
+    def test_e2e_byte_parity_fused_vs_off(self):
+        """The serving contract behind the knob: the SAME request bytes
+        come back with fused_unpool forced vs off — deconv, sweep and
+        dream alike (dreams are inert by design) — with the response
+        cache bypassed so the device program actually runs on both
+        sides.  Since `off` is the pre-round-20 program verbatim, this
+        pins both the default's byte-stability and the engaged
+        interpret body's parity end to end."""
+        import httpx
+
+        from tests.test_serving import _data_url
+
+        headers = {"Cache-Control": "no-cache, no-store"}
+        requests = [
+            ("/v1/deconv", {"file": _data_url(5), "layer": "b2c1"}),
+            (
+                "/v1/deconv",
+                {"file": _data_url(5), "layer": "b2c1", "sweep": "1"},
+            ),
+            (
+                "/v1/dream",
+                {
+                    "file": _data_url(5), "layers": "b2c1", "steps": "2",
+                    "octaves": "2", "lr": "0.05",
+                },
+            ),
+        ]
+        bodies: dict[str, list[bytes]] = {"off": [], "forced": []}
+        for policy in ("off", "forced"):
+            with _service(policy) as s:
+                for path, form in requests:
+                    r = httpx.post(
+                        s.base_url + path, data=form, headers=headers,
+                        timeout=120,
+                    )
+                    assert r.status_code == 200, r.text
+                    assert r.headers["x-cache"] == "bypass"
+                    bodies[policy].append(r.content)
+        for (path, form), off, forced in zip(
+            requests, bodies["off"], bodies["forced"]
+        ):
+            assert off == forced, f"{path} {form.get('sweep', '')} drifted"
+
+
+# ------------------------------------------------- real backbones (slow)
+
+
+@pytest.mark.slow
+class TestRealBackbones:
+    """VGG16 fused-vs-unfused bit parity at real channel widths (the
+    C=64/128 tail at 224² the probe times), composed with the packed
+    tail — the exact endgame configuration headline_fused profiles."""
+
+    def test_fused_tail_bit_parity(self):
+        from deconv_api_tpu.engine.deconv import KPACK_FORCED_CHAN
+        from deconv_api_tpu.models.vgg16 import vgg16_init
+
+        spec, params = vgg16_init()
+        batch = _rand((1, 224, 224, 3), seed=11) * 30.0
+        layer = "block3_conv1"
+        base = get_visualizer(
+            spec, layer, 8, "all", True, batched=True, kpack_chan=0,
+            fused_unpool="off",
+        )(params, batch)[layer]
+        fused = get_visualizer(
+            spec, layer, 8, "all", True, batched=True,
+            kpack_chan=KPACK_FORCED_CHAN, fused_unpool="forced",
+        )(params, batch)[layer]
+        assert jnp.array_equal(base["indices"], fused["indices"])
+        assert jnp.array_equal(base["images"], fused["images"])

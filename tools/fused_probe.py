@@ -1,0 +1,202 @@
+"""Regression probe for the fused Pallas unpool+flipped-conv tail (round 20).
+
+The kpack-probe discipline applied to `fused_unpool` (ops/pallas_deconv.py):
+A/B the REAL engine program at headline shapes, fused vs the unfused pair,
+and record one JSON row the `fused` bench-suite token wraps:
+
+1. assert BIT-EQUALITY of the two paths on the exact-fp32 program
+   (indices and images; exits nonzero on drift).  On a CPU host the
+   engaged body is the interpret-mode exact kernel, whose parity is by
+   construction (ops/pallas_deconv.py docstring) — the assert then pins
+   the dispatch/peephole plumbing.  On a TPU host the engaged body is
+   the COMPILED mxu kernel, and this same assert is the hardware parity
+   gate the CPU cannot provide: a drifting row errors loudly and the
+   policy default stays off.
+2. verify the fused program actually ENGAGED — `pallas_call` present in
+   the traced jaxpr, plus the `tpu_custom_call` custom-call in the
+   lowered HLO on TPU (a probe silently timing two identical programs
+   would record a vacuous 1.0x).
+3. time both at the headline shape under stream-fused sync (the bench.py
+   methodology).  NOTE the backend asymmetry, annotated in the row: on
+   CPU the fused path runs the Pallas INTERPRETER — its wall time is a
+   structural number, not the headline; only a TPU row speaks to the
+   roofline claim (tools/roofline.py --fused models the recoverable
+   MFU).  The `fused` token therefore applies its speedup budget to TPU
+   rows only, while parity/engagement gate every backend.
+4. emit ONE JSON row for bench_suite_results.jsonl.
+
+Usage: python tools/fused_probe.py [--batch N] [--iters N]
+       [--layer block5_conv1] [--model vgg16] [--kpack off|auto|forced]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build(spec, layer: str, top_k: int, fused: str, kpack_chan: int,
+           backward_dtype: str | None):
+    from deconv_api_tpu.engine import get_visualizer
+
+    return get_visualizer(
+        spec, layer, top_k, "all", True, batched=True,
+        backward_dtype=backward_dtype, kpack_chan=kpack_chan,
+        fused_unpool=fused,
+    )
+
+
+def _engaged(fn, params, batch) -> bool:
+    """Did the fused kernel actually make it into the program?  The
+    jaxpr check works on every backend (interpret mode inlines the
+    kernel out of the lowered HLO, so HLO grepping is CPU-blind); on
+    TPU the compiled custom call must ALSO be present in the lowering —
+    both, or the A/B is vacuous."""
+    import jax
+
+    if "pallas_call" not in str(jax.make_jaxpr(fn)(params, batch)):
+        return False
+    if jax.default_backend() == "tpu":
+        return "tpu_custom_call" in fn.lower(params, batch).as_text()
+    return True
+
+
+def _timed_stream(step, batches) -> float:
+    """Seconds/batch, stream-fused sync (bench/suite.py methodology)."""
+    sums = [step(b) for b in batches]  # warm
+    for s in sums:
+        float(s)
+    t0 = time.perf_counter()
+    sums = [step(b) for b in batches]
+    last = float(sums[-1])
+    dt = time.perf_counter() - t0
+    vals = [float(s) for s in sums[:-1]] + [last]
+    assert all(v == v for v in vals)
+    return dt / len(batches)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=None,
+                    help="default: 32 on TPU, 2 on CPU (the CPU fused "
+                    "side runs the Pallas interpreter — structural "
+                    "timing only)")
+    ap.add_argument("--iters", type=int, default=None,
+                    help="default: 10 on TPU, 3 on CPU")
+    ap.add_argument("--layer", default="block5_conv1")
+    ap.add_argument("--model", default="vgg16", choices=("vgg16", "vgg19"))
+    ap.add_argument("--top-k", type=int, default=8)
+    ap.add_argument("--kpack", default="off",
+                    help="compose with the channel-packed tail: the "
+                    "grouped (groups=K) fused form is what the packed "
+                    "low-C endgame runs; 'off' isolates the fusion "
+                    "itself (default)")
+    args = ap.parse_args()
+
+    from deconv_api_tpu.config import ServerConfig, enable_compilation_cache
+    from deconv_api_tpu.engine.deconv import resolve_kpack_chan
+    from deconv_api_tpu.ops.pallas_deconv import (
+        fused_body,
+        fused_engaged,
+        resolve_fused_unpool,
+    )
+
+    enable_compilation_cache(ServerConfig.from_env(), bench_default=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from deconv_api_tpu.bench.suite import tree_checksum
+
+    backend = jax.default_backend()
+    batch = args.batch if args.batch is not None else (
+        32 if backend == "tpu" else 2
+    )
+    iters = args.iters if args.iters is not None else (
+        10 if backend == "tpu" else 3
+    )
+    kpack_chan = resolve_kpack_chan(args.kpack, args.top_k)
+    mode = resolve_fused_unpool("forced")
+    assert fused_engaged(mode)
+    print(f"device: {jax.devices()[0]} batch={batch} iters={iters} "
+          f"kpack_chan={kpack_chan}", file=sys.stderr, flush=True)
+
+    if args.model == "vgg16":
+        from deconv_api_tpu.models.vgg16 import vgg16_init as init
+    else:
+        from deconv_api_tpu.models.vgg19 import vgg19_init as init
+    spec, params = init()
+
+    # --- correctness: exact-fp32 bit parity + engagement check ----------
+    probe_batch = jax.random.normal(
+        jax.random.PRNGKey(0), (min(batch, 2), 224, 224, 3)
+    ) * 30.0
+    exact_u = _build(spec, args.layer, args.top_k, "off", kpack_chan, None)
+    exact_f = _build(spec, args.layer, args.top_k, "forced", kpack_chan, None)
+    engaged = _engaged(exact_f, params, probe_batch)
+    a = exact_u(params, probe_batch)[args.layer]
+    b = exact_f(params, probe_batch)[args.layer]
+    bitwise = bool(
+        jnp.array_equal(a["images"], b["images"])
+        and jnp.array_equal(a["indices"], b["indices"])
+    )
+
+    # --- serving-config variant: bf16 backward numeric delta ------------
+    mixed_u = _build(
+        spec, args.layer, args.top_k, "off", kpack_chan, "bfloat16"
+    )
+    mixed_f = _build(
+        spec, args.layer, args.top_k, "forced", kpack_chan, "bfloat16"
+    )
+    ma = mixed_u(params, probe_batch)[args.layer]["images"].astype(jnp.float32)
+    mb = mixed_f(params, probe_batch)[args.layer]["images"].astype(jnp.float32)
+    bf16_diff = float(jnp.abs(ma - mb).max())
+
+    # --- throughput A/B at the headline shape (stream-fused sync) -------
+    batches = [
+        jax.random.normal(jax.random.PRNGKey(10 + i), (batch, 224, 224, 3))
+        * 30.0
+        for i in range(iters)
+    ]
+    step_u = jax.jit(lambda p, x: tree_checksum(mixed_u(p, x)))
+    step_f = jax.jit(lambda p, x: tree_checksum(mixed_f(p, x)))
+    unfused_s = _timed_stream(lambda x: step_u(params, x), batches)
+    fused_s = _timed_stream(lambda x: step_f(params, x), batches)
+
+    row = {
+        "which": "fused_ab_headline",
+        "backend": backend,
+        "model": args.model,
+        "layer": args.layer,
+        "batch": batch,
+        "iters": iters,
+        "top_k": args.top_k,
+        "kpack_chan": kpack_chan,
+        "fused_body": fused_body(),
+        "fused_engaged": engaged,
+        "bitwise_equal_fp32": bitwise,
+        "max_abs_diff_bf16": bf16_diff,
+        "unfused_ms_per_batch": round(unfused_s * 1e3, 2),
+        "fused_ms_per_batch": round(fused_s * 1e3, 2),
+        "unfused_img_s": round(batch / unfused_s, 2),
+        "fused_img_s": round(batch / fused_s, 2),
+        "speedup": round(unfused_s / fused_s, 3),
+    }
+    if backend != "tpu":
+        row["cpu_note"] = (
+            "fused side ran the Pallas interpreter — parity/engagement "
+            "row only; the TPU run decides the headline "
+            "(tools/roofline.py --fused models the recoverable MFU)"
+        )
+    print(json.dumps(row), flush=True)
+    # bit-inequality is a correctness failure, not a perf datum
+    return 0 if bitwise and engaged else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,36 @@ def build_headline_kpack(batch_size: int = 64):
     return fn, (params, batch)
 
 
+def build_headline_fused(batch_size: int = 64):
+    """The headline program with the fused unpool+flipped-conv tail ON
+    TOP of the packed layout (round 20: fused_unpool=forced composed
+    with kpack_chan=64 — the low-C endgame configuration the `fused`
+    bench token A/Bs): same shape as build_headline, but every
+    certified pool -> backward-ReLU -> conv triple of the backward walk
+    runs as ONE pallas kernel (ops/pallas_deconv.py) and the packed
+    tail's grouped sites fuse in their groups=K form.  Captured so the
+    next TPU session can attribute the fused kernel's MXU/HBM behaviour
+    next to the vmapped fusion.93 and the kpack grouped rows without
+    code changes.  On CPU the kernel runs in interpret mode — a
+    structural capture only (see the committed summary's note)."""
+    import jax
+
+    from deconv_api_tpu.engine import get_visualizer
+    from deconv_api_tpu.engine.deconv import KPACK_AUTO_CHAN
+    from deconv_api_tpu.models.vgg16 import vgg16_init
+
+    spec, params = vgg16_init()
+    fn = get_visualizer(
+        spec, "block5_conv1", 8, "all", True,
+        batched=True, backward_dtype="bfloat16",
+        kpack_chan=KPACK_AUTO_CHAN, fused_unpool="forced",
+    )
+    batch = jax.random.normal(
+        jax.random.PRNGKey(0), (batch_size, 224, 224, 3)
+    )
+    return fn, (params, batch)
+
+
 def build_sweep():
     import jax
 
@@ -125,6 +155,7 @@ def build_dream():
 PROGRAMS = {
     "headline": build_headline,
     "headline_kpack": build_headline_kpack,
+    "headline_fused": build_headline_fused,
     "sweep": build_sweep,
     "dream": build_dream,
 }

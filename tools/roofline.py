@@ -50,7 +50,7 @@ def _lane_factor(c: int) -> float:
 
 
 def _conv_segs(l, in_shape, out, batch, nsig, lane: str = "ideal",
-               kpack_chan: int = 0):
+               kpack_chan: int = 0, fused_site: bool = False):
     """Forward + backward accounting for one conv layer, with `nsig`
     projection signals crossing it downward (headline: top_k; sweep:
     top_k x vis-layers-above).  ONE formula set for both rooflines so the
@@ -62,7 +62,14 @@ def _conv_segs(l, in_shape, out, batch, nsig, lane: str = "ideal",
     'vmapped' = channel-minor lane padding at the per-projection widths;
     'packed' = the kpack layout: signals at or under ``kpack_chan``
     channels carry nsig x C packed channels (engine/deconv.py), so their
-    lane factor is computed at the packed width."""
+    lane factor is computed at the packed width;
+    'fused' (round 20) = 'packed' at the same threshold PLUS the fused
+    unpool+conv kernel's traffic model (ops/pallas_deconv.py) — a conv
+    whose backward input arrives from the pool above it (``fused_site``)
+    forms that input in VMEM from the scattered pooled tile, so its
+    out-resolution read never touches HBM; the write of its own
+    backward output (the next op below consumes it from HBM) and the
+    kernel-weight read remain."""
     oh, ow, cout = out
     kh, kw = l.kernel_size
     cin = in_shape[-1]
@@ -72,17 +79,25 @@ def _conv_segs(l, in_shape, out, batch, nsig, lane: str = "ideal",
         in_shape[0] * in_shape[1] * cin + oh * ow * cout
     ) * 4 + kh * kw * cin * cout * 4
     fwd = (f"fwd {l.name}", flops, fbytes)
-    bbytes = nsig * batch * (
-        in_shape[0] * in_shape[1] * cin + oh * ow * cout
-    ) * 2 + kh * kw * cin * cout * 2
+    read_b = nsig * batch * oh * ow * cout * 2.0
+    write_b = nsig * batch * in_shape[0] * in_shape[1] * cin * 2.0
+    fused_here = lane == "fused" and fused_site
+    if fused_here:
+        read_b = 0.0  # input formation happens in VMEM (the fused kernel)
+    bbytes = read_b + write_b + kh * kw * cin * cout * 2
     bflops = flops * nsig
     if lane != "ideal":
-        packed = lane == "packed" and cout <= kpack_chan
+        packed = lane in ("packed", "fused") and cout <= kpack_chan
         win, wout = (cin * nsig, cout * nsig) if packed else (cin, cout)
         f = max(_lane_factor(win), _lane_factor(wout))
         bflops *= f
         bbytes *= f
-    tag = " [packed]" if lane == "packed" and cout <= kpack_chan else ""
+    packed_tag = (
+        " [packed]"
+        if lane in ("packed", "fused") and cout <= kpack_chan
+        else ""
+    )
+    tag = packed_tag + (" [fused]" if fused_here else "")
     bwd = (f"bwd {l.name} x{nsig}{tag}", bflops, bbytes)
     return fwd, bwd
 
@@ -97,7 +112,15 @@ def _pool_segs(l, in_shape, out, batch, nsig, lane: str = "ideal",
     Under the 'packed' lane model a tail pool's unpool runs
     group-broadcast (ops/pool.py groups=): full-lane bf16 traffic at the
     packed width AND the int8 switch index read ONCE per batch instead
-    of once per signal — packing the K-invariant switch is free."""
+    of once per signal — packing the K-invariant switch is free.
+
+    Under the 'fused' model (round 20, ops/pallas_deconv.py) the unpool
+    disappears as a standalone HBM pass: the kernel reads the pooled
+    signal and switch-index tiles into VMEM (THREE times each — the
+    one-block halo the conv's receptive field needs re-reads both
+    neighbours) and the 2x-spatial unpooled intermediate is never
+    written; the conv segment below accounts for the matching removed
+    read (``_conv_segs`` fused_site)."""
     h, w, c = in_shape
     oh, ow, _ = out
     fbytes = batch * (h * w * c * 4 + oh * ow * c * 4 + oh * ow * c)
@@ -106,12 +129,21 @@ def _pool_segs(l, in_shape, out, batch, nsig, lane: str = "ideal",
     idx_bytes = nsig * batch * oh * ow * c
     tag = ""
     if lane != "ideal":
-        packed = lane == "packed" and c <= kpack_chan
+        packed = lane in ("packed", "fused") and c <= kpack_chan
         f = _lane_factor(c * nsig) if packed else _lane_factor(c)
-        sig_bytes *= f
-        if packed:
-            idx_bytes = batch * oh * ow * c  # broadcast: one read per batch
-            tag = " [packed]"
+        if lane == "fused":
+            # pooled read x3 (self + halo neighbours); no full-res write
+            sig_bytes = 3 * nsig * batch * oh * ow * c * 2 * f
+            idx_base = (
+                batch if packed else nsig * batch
+            ) * oh * ow * c
+            idx_bytes = 3 * idx_base
+            tag = (" [packed]" if packed else "") + " [fused]"
+        else:
+            sig_bytes *= f
+            if packed:
+                idx_bytes = batch * oh * ow * c  # one read per batch
+                tag = " [packed]"
     bwd = (f"bwd {l.name} (unpool+relu) x{nsig}{tag}", 0.0,
            sig_bytes + idx_bytes)
     return fwd, bwd
@@ -130,11 +162,19 @@ def segments(batch: int, top_k: int, layer: str = "block5_conv1",
     shapes = layer_output_shapes(spec)
     segs = []
     in_shape = tuple(spec.input_shape)
-    for l in spec.layers:
+    layers = list(spec.layers)
+    for pos, l in enumerate(layers):
         out = shapes[l.name]
         if l.kind == "conv":
+            # a conv immediately before a pool (forward order) is the
+            # conv the fused kernel feeds on the way DOWN — its backward
+            # input forms in VMEM from the scattered pooled tile
+            nxt = layers[pos + 1] if pos + 1 < len(layers) else None
             segs.extend(
-                _conv_segs(l, in_shape, out, batch, top_k, lane, kpack_chan)
+                _conv_segs(
+                    l, in_shape, out, batch, top_k, lane, kpack_chan,
+                    fused_site=nxt is not None and nxt.kind == "pool",
+                )
             )
         elif l.kind == "pool":
             segs.extend(
@@ -213,12 +253,18 @@ def main() -> int:
                     help="also model the 128-lane channel-padding waste of "
                     "the backward tail, vmapped vs kpack-packed at this "
                     "channel threshold (engine lowc_kpack; headline only)")
+    ap.add_argument("--fused", action="store_true",
+                    help="also model the fused unpool+conv tail (round 20, "
+                    "engine fused_unpool): the packed model at the --kpack "
+                    "threshold (0 = over the vmapped layout) minus the HBM "
+                    "round-trip of the unpooled intermediate each fused "
+                    "pool->conv site removes (headline only)")
     ap.add_argument("--measured-ms", type=float, default=None,
                     help="measured ms/batch to compare against the ceiling")
     args = ap.parse_args()
 
-    if args.kpack and args.sweep:
-        ap.error("--kpack models the headline program only")
+    if (args.kpack or args.fused) and args.sweep:
+        ap.error("--kpack/--fused model the headline program only")
     segs = (
         sweep_segments(args.batch, args.top_k)
         if args.sweep
@@ -252,7 +298,7 @@ def main() -> int:
         print(f"measured           : {args.measured_ms:7.2f} ms/batch "
               f"-> {100 * mxu_time / meas:.1f}% MFU "
               f"({100 * t_roof / meas:.0f}% of roofline)")
-    if args.kpack:
+    if args.kpack or args.fused:
         # Lane-padded comparison (round 12): the SAME program mix with the
         # 128-lane channel-padding waste modeled on the backward segments,
         # vmapped layout vs the kpack-packed layout.  Ceilings are quoted
@@ -261,17 +307,38 @@ def main() -> int:
         t_v = _roof_time(
             segments(args.batch, args.top_k, lane="vmapped")
         )
-        t_p = _roof_time(
-            segments(args.batch, args.top_k, lane="packed",
-                     kpack_chan=args.kpack)
-        )
         print(f"\nlane-padded model (128-wide lanes, waste capped 2x):")
         print(f"vmapped layout     : {t_v * 1e3:7.2f} ms/batch "
               f"-> ceiling {100 * mxu_time / t_v:.1f}% MFU")
-        print(f"packed (C<={args.kpack:3d})    : {t_p * 1e3:7.2f} ms/batch "
-              f"-> ceiling {100 * mxu_time / t_p:.1f}% MFU "
-              f"({100 * (t_v - t_p) / t_v:.1f}% throughput headroom over "
-              "vmapped)")
+        t_base = t_v
+        if args.kpack:
+            t_p = _roof_time(
+                segments(args.batch, args.top_k, lane="packed",
+                         kpack_chan=args.kpack)
+            )
+            print(f"packed (C<={args.kpack:3d})    : {t_p * 1e3:7.2f} "
+                  f"ms/batch -> ceiling {100 * mxu_time / t_p:.1f}% MFU "
+                  f"({100 * (t_v - t_p) / t_v:.1f}% throughput headroom "
+                  "over vmapped)")
+            t_base = t_p
+        if args.fused:
+            # Fused unpool+conv model (round 20): the packed model at the
+            # same threshold minus the HBM round-trip of the unpooled
+            # intermediate at every fused pool->conv site — the traffic
+            # the kernel's VMEM input formation removes.  The delta vs
+            # the packed ceiling is the PREDICTED RECOVERABLE MFU the
+            # TPU `fused` bench token goes hunting for.
+            t_f = _roof_time(
+                segments(args.batch, args.top_k, lane="fused",
+                         kpack_chan=args.kpack)
+            )
+            base_name = (
+                f"packed C<={args.kpack}" if args.kpack else "vmapped"
+            )
+            print(f"fused tail         : {t_f * 1e3:7.2f} ms/batch "
+                  f"-> ceiling {100 * mxu_time / t_f:.1f}% MFU "
+                  f"(+{100 * mxu_time / t_f - 100 * mxu_time / t_base:.1f} "
+                  f"MFU points predicted recoverable over {base_name})")
     return 0
 
 

@@ -131,6 +131,15 @@ AOT_BOOT_SPEEDUP_BUDGET = 2.0
 # evidence for flipping lowc_kpack=auto on.
 KPACK_SPEEDUP_BUDGET = 1.0
 
+# Fused unpool+conv backward-tail budget (round 20): same discipline as
+# kpack — the fused path must not run slower than the unfused pair ON A
+# TPU (where the compiled kernel is the point); a regression keeps the
+# default off with a loud error.  On CPU the fused side is the Pallas
+# INTERPRETER (a parity/engagement harness, not a fast path), so the
+# speedup guard applies to TPU rows only — parity drift and a
+# silently-unfused vacuous A/B error on every backend.
+FUSED_SPEEDUP_BUDGET = 1.0
+
 
 def run_chaos_guard(timeout_s: float = 900.0, lanes: int | None = None) -> dict:
     """The end-to-end chaos drill (round 9): codec workers dying at
@@ -813,6 +822,50 @@ def run_kpack_guard(timeout_s: float = 3600.0) -> dict:
     return row
 
 
+def run_fused_guard(timeout_s: float = 3600.0) -> dict:
+    """Fused unpool+flipped-conv tail A/B (round 20): run
+    tools/fused_probe.py — the real headline program, fused_unpool
+    forced vs off, bit-equality asserted in the child — and record the
+    row.  Fails LOUDLY (`error` field) when the child errored
+    (bit-inequality exits nonzero there), when the fused kernel never
+    engaged (a vacuous identical-programs A/B), or — on TPU only, where
+    the compiled kernel is what's being sold — when fused throughput
+    falls below FUSED_SPEEDUP_BUDGET of the unfused pair.  CPU rows pin
+    parity + engagement and annotate that their fused wall is the
+    interpreter's."""
+    probe = run_cmd_json(
+        [sys.executable, os.path.join(REPO, "tools", "fused_probe.py")],
+        timeout_s,
+        # the probe exits nonzero on bit-inequality/non-engagement but
+        # still prints its row — keep it so the guard can say WHICH
+        # contract broke instead of recording an opaque rc=1
+        json_on_error=True,
+    )
+    row = {"config": "fused", **probe}
+    row.setdefault("which", "fused_ab_headline")
+    if "error" in probe:
+        return row
+    row["budget"] = FUSED_SPEEDUP_BUDGET
+    problems = []
+    if not probe.get("bitwise_equal_fp32"):
+        problems.append("fused path NOT bit-equal to the unfused pair (fp32)")
+    if not probe.get("fused_engaged"):
+        problems.append("fused kernel never engaged (A/B vacuous)")
+    if (
+        probe.get("backend") == "tpu"
+        and probe.get("speedup", 0.0) < FUSED_SPEEDUP_BUDGET
+    ):
+        problems.append(
+            f"fused path regressed: {probe.get('speedup')}x vs the "
+            f"{FUSED_SPEEDUP_BUDGET:.1f}x floor "
+            f"({probe.get('fused_img_s')} vs {probe.get('unfused_img_s')} "
+            "img/s)"
+        )
+    if problems:
+        row["error"] = "; ".join(problems)
+    return row
+
+
 def run_quant_guard(timeout_s: float = 1800.0) -> dict:
     """Int8 quality-tier drill guard (round 18):
     tools/loopback_load.py --quant — interactive-full vs bulk-int8 mix
@@ -1313,6 +1366,13 @@ def main() -> int:
             # never-engaged packed program
             result = run_kpack_guard()
             result["date"] = date
+        elif tok == "fused":
+            # fused unpool+conv tail A/B (round 20): bit-equality +
+            # engagement asserted in the probe on every backend; the
+            # speedup budget gates TPU rows (the CPU fused side is the
+            # Pallas interpreter — a parity harness, not a fast path)
+            result = run_fused_guard()
+            result["date"] = date
         elif tok == "quant":
             # int8 quality-tier drill (round 18): interactive-full vs
             # bulk-int8 mix — byte-identity at full, PSNR floor, key
@@ -1340,7 +1400,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'models', 'quant', 'aot-boot'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'models', 'quant', 'aot-boot'])}",
             }
         else:
             n = int(tok)

@@ -36,6 +36,19 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "fused_img_s" in row and "unfused_img_s" in row:
+        # fused unpool+conv A/B rows (round 20): both sides + the
+        # speedup next to the kpack trajectory, the engaged body named
+        # (interpret rows are parity evidence, kernel rows the
+        # headline), error kept visible
+        line = (
+            f"fused={row['fused_img_s']} vs unfused={row['unfused_img_s']}"
+            f" img/s (x{row.get('speedup')}, {row.get('backend', '?')}"
+            f" b{row.get('batch', '?')}, body={row.get('fused_body', '?')})"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "victim_mixed_p99_ms" in row:
         # qos noisy-neighbor rows (round 13): the fairness contract in
         # one line — victim p99 solo vs mixed, the shed split, and the
