@@ -83,6 +83,9 @@ def cmd_fleet_router(args: argparse.Namespace) -> int:
         "hedge_min_delay_ms", "fault_seed",
         # round 19 observability plane: router flight recorder + SLOs
         "trace_ring", "trace_slow_ms", "trace_sample", "slo",
+        # round 21 data-plane fast path: pools, relay, REUSEPORT workers
+        "workers", "connection_pool", "pool_size", "pool_idle_s",
+        "stream_relay_min_bytes",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -651,6 +654,33 @@ def main(argv: list[str] | None = None) -> int:
         metavar="NAME=MS:PCT[:ROUTE],...",
         help="router-side latency SLO objects: burn-rate gauges on "
         "/metrics + an slo block on /readyz (default none)",
+    )
+    s.add_argument(
+        "--workers", type=int, default=None,
+        help="accept-loop router processes sharing --port via "
+        "SO_REUSEPORT (each a full stateless router; worker=N labeled "
+        "metrics; default 1)",
+    )
+    s.add_argument(
+        "--connection-pool", default=None, dest="connection_pool",
+        choices=("on", "off"),
+        help="persistent keep-alive connection pools per backend "
+        "(default on; 'off' restores dial-per-forward)",
+    )
+    s.add_argument(
+        "--pool-size", type=int, default=None, dest="pool_size",
+        help="max idle pooled connections per backend (default 8)",
+    )
+    s.add_argument(
+        "--pool-idle-s", type=float, default=None, dest="pool_idle_s",
+        help="idle seconds before a pooled connection is reaped "
+        "(default 30)",
+    )
+    s.add_argument(
+        "--stream-relay-min-bytes", type=int, default=None,
+        dest="stream_relay_min_bytes",
+        help="content-length threshold for the chunk-by-chunk response "
+        "relay (default 262144; 0 disables)",
     )
     s.set_defaults(fn=cmd_fleet_router)
 

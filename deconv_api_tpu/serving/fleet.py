@@ -255,6 +255,44 @@ _HOP_HEADERS = frozenset(
      "host", "upgrade", "te", "trailer", "proxy-connection")
 )
 
+# Everything stripped from CLIENT headers before a forward: hop-by-hop
+# plus the two router-authoritative trust headers (round 21 fast path:
+# precomputed once so the hot path does one frozenset lookup per key).
+_FWD_STRIP = _HOP_HEADERS | frozenset(("x-peer-fill", "x-trace-hop"))
+
+
+def _splice_worker_label(text: str, worker: int) -> str:
+    """Splice ``worker="N"`` into every sample line of a Prometheus
+    exposition (round 21 SO_REUSEPORT routers): same head-of-block
+    insertion as the federation splice in ``_metrics_fleet`` — no
+    existing label value is crossed, so it is escape-safe."""
+    label = f'worker="{worker}"'
+    out: list[str] = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        metric, _, rest = line.partition(" ")
+        if "{" in metric:
+            mname, _, tail = metric.partition("{")
+            out.append(f"{mname}{{{label},{tail} {rest}")
+        else:
+            out.append(f"{metric}{{{label}}} {rest}")
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
+
+
+def _connection_nominated(headers: dict[str, str]) -> frozenset | set:
+    """RFC 9110 §7.6.1 (round 21 bugfix): headers NOMINATED by a
+    ``connection`` header are hop-by-hop too and must be stripped by an
+    intermediary.  The always-``connection: close`` dial-per-forward
+    transport masked this; keep-alive upstreams do not."""
+    nominated = headers.get("connection")
+    if not nominated:
+        return _HOP_HEADERS
+    return _HOP_HEADERS | {
+        t.strip().lower() for t in nominated.split(",") if t.strip()
+    }
+
 # How long a moved key keeps its previous-owner hint after a rebalance:
 # past this, the new owner has either filled (peer or compute) or the
 # entry was cold anyway — a stale hint only costs a pointless peer miss.
@@ -811,6 +849,441 @@ async def raw_request_stream(
     return status, resp_headers, _chunks()
 
 
+# Scripted-transport seam (round 21): dozens of fleet tests monkeypatch
+# ``fleet.raw_request`` with a per-backend response script.  The pooled
+# fast path honors that contract by checking whether the module global
+# still IS the real implementation — a patched transport wins over the
+# pool, so every pre-pool test (and the loopback drills' fault scripts)
+# keeps intercepting the wire exactly as before.
+_DIAL_RAW_REQUEST = raw_request
+_DIAL_RAW_REQUEST_STREAM = raw_request_stream
+
+
+class _PoolConn:
+    """One pooled keep-alive socket.  ``reused`` marks a checkout that
+    came from the idle list — the only kind whose immediate EOF/reset is
+    a keep-alive race (the backend reaped the idle socket between our
+    checkout and our write) rather than a backend failure."""
+
+    __slots__ = ("reader", "writer", "reused", "idle_since")
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self.reused = False
+        self.idle_since = 0.0
+
+
+class BackendPool:
+    """Bounded keep-alive HTTP/1.1 connection pool for ONE backend
+    (round 21 data-plane fast path).
+
+    Replaces dial-per-forward: checkout prefers the warmest idle socket
+    (LIFO), dials when the idle list is empty, and enforces framed reads
+    (head + exact content-length) instead of read-to-EOF so the socket
+    survives the response.  Responses without a content-length, with
+    ``transfer-encoding``, or carrying ``connection: close`` are drained
+    to EOF and the socket destroyed — correctness first, reuse second.
+
+    Staleness contract: a REUSED socket that dies before yielding a
+    single response byte is retried exactly once on a freshly dialed
+    connection (``pool_stale_retry_total``); a fresh socket's failure,
+    or any failure after response bytes arrived, is a real
+    ``_BackendError``.  Cancellation mid-roundtrip (a hedge loser)
+    destroys the socket — a connection with an unread response on it
+    must never return to the pool.
+
+    Accounting: ``pool_{dial,reuse,stale_retry}_total`` counters,
+    ``pool_{idle,in_use}{backend=}`` gauges, and dial wall time into
+    ``connect_seconds_total{backend=}`` — the probe-RTT honesty metric
+    (pooled probes no longer pay connect time, so it is surfaced
+    separately instead of silently vanishing from the digests)."""
+
+    def __init__(
+        self,
+        name: str,
+        host: str,
+        port: int,
+        *,
+        size: int = 8,
+        idle_max_s: float = 30.0,
+        metrics: Metrics | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.size = max(1, int(size))
+        self.idle_max_s = float(idle_max_s)
+        self._metrics = metrics
+        self._clock = clock
+        self._idle: deque[_PoolConn] = deque()
+        self.in_use = 0
+        self.dials = 0
+        self.reuses = 0
+        self.stale_retries = 0
+        # pre-serialized per-backend header template (round 21 fast
+        # path): host + connection are constants of the backend, so
+        # they are encoded once; per-request fields are appended.
+        self._head_base = (
+            f"host: {host}:{port}\r\nconnection: keep-alive\r\n"
+        ).encode("latin-1")
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _publish(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_labeled_gauge(
+                "pool_idle", "backend", self.name, len(self._idle)
+            )
+            self._metrics.set_labeled_gauge(
+                "pool_in_use", "backend", self.name, self.in_use
+            )
+
+    @staticmethod
+    def _close(c: _PoolConn) -> None:
+        try:
+            c.writer.close()
+        except Exception:  # noqa: BLE001 — close is best-effort cleanup
+            pass
+
+    async def _dial(self) -> _PoolConn:
+        t0 = time.perf_counter()
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        self.dials += 1
+        if self._metrics is not None:
+            self._metrics.inc_counter("pool_dial_total")
+            self._metrics.inc_labeled(
+                "connect_seconds_total", "backend", self.name,
+                time.perf_counter() - t0,
+            )
+        return _PoolConn(reader, writer)
+
+    async def checkout(self, *, fresh: bool = False) -> _PoolConn:
+        """Pop the most-recently-parked idle socket (skipping reaped or
+        half-closed ones), else dial.  ``fresh=True`` bypasses the idle
+        list — the stale-retry leg must not draw a second possibly-dead
+        socket from the same era."""
+        now = self._clock()
+        while not fresh and self._idle:
+            c = self._idle.pop()
+            if (
+                (self.idle_max_s > 0 and now - c.idle_since > self.idle_max_s)
+                or c.reader.at_eof()
+                or c.writer.is_closing()
+            ):
+                self._close(c)
+                continue
+            c.reused = True
+            self.reuses += 1
+            if self._metrics is not None:
+                self._metrics.inc_counter("pool_reuse_total")
+            self.in_use += 1
+            self._publish()
+            return c
+        c = await self._dial()
+        self.in_use += 1
+        self._publish()
+        return c
+
+    def release(self, c: _PoolConn) -> None:
+        """Return a socket whose response was fully consumed."""
+        self.in_use -= 1
+        if (
+            len(self._idle) >= self.size
+            or c.reader.at_eof()
+            or c.writer.is_closing()
+        ):
+            self._close(c)
+        else:
+            c.reused = False
+            c.idle_since = self._clock()
+            self._idle.append(c)
+        self._publish()
+
+    def destroy(self, c: _PoolConn) -> None:
+        """Drop a socket that failed, was cancelled mid-roundtrip, or
+        carries unread response bytes.  Never back to the pool."""
+        self.in_use -= 1
+        self._close(c)
+        self._publish()
+
+    def flush(self) -> None:
+        """Close every idle socket (breaker open / ejection / drain /
+        router stop): a member leaving the ring must not leave warm
+        sockets behind that would be reused against its next life."""
+        while self._idle:
+            self._close(self._idle.pop())
+        self._publish()
+
+    def reap(self) -> None:
+        """Idle reap, run on the probe tick: sockets parked longer than
+        ``idle_max_s`` are closed oldest-first (the backend side reaps
+        at its own idle timeout — reaping ours first keeps the stale-
+        retry path an edge case instead of the steady state)."""
+        if self.idle_max_s <= 0:
+            return
+        now = self._clock()
+        while self._idle and now - self._idle[0].idle_since > self.idle_max_s:
+            self._close(self._idle.popleft())
+        self._publish()
+
+    # ------------------------------------------------------------ requests
+
+    def build_wire(
+        self, method: str, target: str, headers: dict[str, str], body: bytes
+    ) -> bytes:
+        """Request head from the pre-serialized template + per-request
+        fields.  Same dialect as ``_build_request_head`` except the
+        keep-alive connection token — the one divergence the pool is."""
+        parts = [
+            f"{method} {target} HTTP/1.1\r\n".encode("latin-1"),
+            self._head_base,
+        ]
+        append = parts.append
+        for k, v in headers.items():
+            append(f"{k}: {v}\r\n".encode("latin-1"))
+        if body or method not in ("GET", "HEAD", "DELETE"):
+            append(b"content-length: %d\r\n" % len(body))
+        append(b"\r\n")
+        if body:
+            append(body)
+        return b"".join(parts)
+
+    async def _roundtrip(
+        self, c: _PoolConn, wire: bytes
+    ) -> tuple[int, dict[str, str], bytes, bool]:
+        """Write + framed read on one socket.  Returns ``(status,
+        headers, payload, reusable)``; raises the raw transport error
+        (classified by the caller, which owns stale-retry)."""
+        c.writer.write(wire)
+        await c.writer.drain()
+        head_raw = await c.reader.readuntil(b"\r\n\r\n")
+        status, resp_headers = _parse_response_head(head_raw[:-4], self.name)
+        cl = resp_headers.get("content-length")
+        if (
+            cl is not None
+            and cl.isdigit()
+            and "chunked"
+            not in resp_headers.get("transfer-encoding", "").lower()
+        ):
+            want = int(cl)
+            try:
+                payload = await c.reader.readexactly(want) if want else b""
+            except asyncio.IncompleteReadError as e:
+                raise _BackendError(
+                    f"{self.name}: truncated body "
+                    f"({len(e.partial)}B of content-length {want})"
+                ) from e
+            reusable = (
+                resp_headers.get("connection", "keep-alive").lower()
+                != "close"
+            )
+            return status, resp_headers, payload, reusable
+        # unknown length (streamed / legacy close-framed response): the
+        # socket is spent — read to EOF and let the caller destroy it
+        payload = await c.reader.read()
+        return status, resp_headers, payload, False
+
+    async def request(
+        self,
+        method: str,
+        target: str,
+        headers: dict[str, str],
+        body: bytes,
+        timeout_s: float,
+    ) -> tuple[int, dict[str, str], bytes]:
+        """Pooled equivalent of ``raw_request``: same signature shape,
+        same ``_BackendError`` classification (cause chains preserved so
+        ``_is_timeout`` still reads deadline-capped legs as 504s), plus
+        the stale-retry-once contract."""
+        wire = self.build_wire(method, target, headers, body)
+        deadline = time.perf_counter() + timeout_s
+        for attempt in (0, 1):
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                try:
+                    raise asyncio.TimeoutError()
+                except asyncio.TimeoutError as e:
+                    raise _BackendError(
+                        f"{self.name}: TimeoutError: pooled budget spent"
+                    ) from e
+            try:
+                c = await asyncio.wait_for(
+                    self.checkout(fresh=attempt == 1), remaining
+                )
+            except (OSError, asyncio.TimeoutError, TimeoutError) as e:
+                raise _BackendError(
+                    f"{self.name}: {type(e).__name__}: {e}"
+                ) from e
+            try:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError()
+                status, resp_headers, payload, reusable = (
+                    await asyncio.wait_for(
+                        self._roundtrip(c, wire), remaining
+                    )
+                )
+            except BaseException as e:  # noqa: BLE001 — single destroy point
+                self.destroy(c)
+                if isinstance(e, asyncio.CancelledError):
+                    # hedge-loser cancellation: socket destroyed above,
+                    # never leaked; the cancellation itself propagates
+                    raise
+                if isinstance(e, _BackendError):
+                    raise
+                if (
+                    attempt == 0
+                    and c.reused
+                    and isinstance(
+                        e,
+                        (
+                            ConnectionResetError,
+                            BrokenPipeError,
+                            asyncio.IncompleteReadError,
+                        ),
+                    )
+                    and not getattr(e, "partial", b"")
+                ):
+                    # keep-alive race: the backend reaped this socket
+                    # while it was parked.  Retry once, dialed fresh.
+                    self.stale_retries += 1
+                    if self._metrics is not None:
+                        self._metrics.inc_counter("pool_stale_retry_total")
+                    continue
+                if isinstance(
+                    e,
+                    (
+                        OSError,
+                        asyncio.TimeoutError,
+                        TimeoutError,
+                        asyncio.IncompleteReadError,
+                        asyncio.LimitOverrunError,
+                    ),
+                ):
+                    raise _BackendError(
+                        f"{self.name}: {type(e).__name__}: {e}"
+                    ) from e
+                raise
+            (self.release if reusable else self.destroy)(c)
+            return status, resp_headers, payload
+        raise _BackendError(f"{self.name}: stale retry exhausted")
+
+    async def request_stream(
+        self,
+        method: str,
+        target: str,
+        headers: dict[str, str],
+        body: bytes,
+        head_timeout_s: float,
+    ) -> tuple[int, dict[str, str], object]:
+        """Pooled equivalent of ``raw_request_stream``: HEAD bounded by
+        ``head_timeout_s`` (stale-retry-once applies), body handed back
+        as an async chunk iterator.  Content-length-framed bodies read
+        exactly that many bytes and RETURN the socket to the pool;
+        unframed bodies (SSE) stream to EOF on a spent socket.  The
+        caller owns the iterator — exhaust or ``aclose()`` it."""
+        wire = self.build_wire(method, target, headers, body)
+        for attempt in (0, 1):
+            try:
+                c = await asyncio.wait_for(
+                    self.checkout(fresh=attempt == 1), head_timeout_s
+                )
+            except (OSError, asyncio.TimeoutError, TimeoutError) as e:
+                raise _BackendError(
+                    f"{self.name}: {type(e).__name__}: {e}"
+                ) from e
+            try:
+                c.writer.write(wire)
+                await c.writer.drain()
+                head_raw = await asyncio.wait_for(
+                    c.reader.readuntil(b"\r\n\r\n"), head_timeout_s
+                )
+            except BaseException as e:  # noqa: BLE001 — single destroy point
+                self.destroy(c)
+                if isinstance(e, (asyncio.CancelledError, _BackendError)):
+                    raise
+                if (
+                    attempt == 0
+                    and c.reused
+                    and isinstance(
+                        e,
+                        (
+                            ConnectionResetError,
+                            BrokenPipeError,
+                            asyncio.IncompleteReadError,
+                        ),
+                    )
+                    and not getattr(e, "partial", b"")
+                ):
+                    self.stale_retries += 1
+                    if self._metrics is not None:
+                        self._metrics.inc_counter("pool_stale_retry_total")
+                    continue
+                if isinstance(
+                    e,
+                    (
+                        OSError,
+                        asyncio.TimeoutError,
+                        TimeoutError,
+                        asyncio.IncompleteReadError,
+                        asyncio.LimitOverrunError,
+                    ),
+                ):
+                    raise _BackendError(
+                        f"{self.name}: {type(e).__name__}: {e}"
+                    ) from e
+                raise
+            status, resp_headers = _parse_response_head(
+                head_raw[:-4], self.name
+            )
+            cl = resp_headers.get("content-length")
+            framed = (
+                cl is not None
+                and cl.isdigit()
+                and "chunked"
+                not in resp_headers.get("transfer-encoding", "").lower()
+            )
+            reusable = framed and (
+                resp_headers.get("connection", "keep-alive").lower()
+                != "close"
+            )
+            pool = self
+
+            async def _chunks(want=int(cl) if framed else -1, conn=c):
+                done = False
+                try:
+                    if want >= 0:
+                        left = want
+                        while left > 0:
+                            chunk = await conn.reader.read(min(65536, left))
+                            if not chunk:
+                                raise _BackendError(
+                                    f"{pool.name}: truncated body "
+                                    f"({want - left}B short of "
+                                    f"content-length {want})"
+                                )
+                            left -= len(chunk)
+                            yield chunk
+                        done = True
+                    else:
+                        while True:
+                            chunk = await conn.reader.read(65536)
+                            if not chunk:
+                                done = True
+                                return
+                            yield chunk
+                finally:
+                    if done and reusable:
+                        pool.release(conn)
+                    else:
+                        pool.destroy(conn)
+
+            return status, resp_headers, _chunks()
+        raise _BackendError(f"{self.name}: stale retry exhausted")
+
+
 class FleetRouter:
     """The routing tier: one of these per router process (or embedded in
     a drill).  ``start()`` binds the listener and launches the prober;
@@ -852,6 +1325,11 @@ class FleetRouter:
         trace_slow_ms: float = 100.0,
         trace_sample: float = 1.0,
         slos: str = "",
+        connection_pool: bool = True,
+        pool_size: int = 8,
+        pool_idle_s: float = 30.0,
+        stream_relay_min_bytes: int = 262144,
+        worker: int | None = None,
         metrics: Metrics | None = None,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -874,6 +1352,31 @@ class FleetRouter:
         self.hot_key_replicas = max(1, int(hot_key_replicas))
         self._clock = clock
         self.metrics = metrics or Metrics(prefix="router", core=False)
+        # round 21 data-plane fast path: per-backend keep-alive pools
+        # (created lazily — members join at runtime), the zero-copy
+        # relay threshold, and the multi-process worker ordinal whose
+        # ``worker=`` label keeps the PR 14 federation sums truthful
+        # when N SO_REUSEPORT routers share one scrape port.
+        # connection_pool=False is the escape hatch: dial-per-forward,
+        # byte-identical to the pre-pool dialect.
+        self.connection_pool = bool(connection_pool)
+        self.pool_size = max(1, int(pool_size))
+        self.pool_idle_s = float(pool_idle_s)
+        self.stream_relay_min_bytes = int(stream_relay_min_bytes)
+        self.worker = worker
+        self.pools: dict[str, BackendPool] = {}
+        # Pre-register the new counter families at zero so the
+        # exposition carries them from the first scrape — a counter
+        # that never fired (e.g. stale_retry on a quiet pool, or a
+        # torn relay that never happened) must still be visible to
+        # the lint and to rate() queries.
+        if self.connection_pool:
+            for fam in ("pool_dial_total", "pool_reuse_total",
+                        "pool_stale_retry_total"):
+                self.metrics.inc_counter(fam, 0)
+        for fam in ("relayed_responses_total", "relay_bytes_total",
+                    "relay_torn_total"):
+            self.metrics.inc_counter(fam, 0)
         # Router flight recorder (round 19): the SAME RequestTrace/
         # FlightRecorder spine the backend runs, recording the router's
         # side of every request — ring pick, each forward attempt
@@ -1376,6 +1879,12 @@ class FleetRouter:
             m.latency.clear()
             m.fwd_latency.clear()
             m.probe_latency.clear()
+            # ...and its warm sockets describe the same ended life: an
+            # ejection/drain flushes the member's pool so nothing is
+            # reused against its next incarnation (round 21)
+            pool = self.pools.get(m.name)
+            if pool is not None:
+                pool.flush()
         slog.event(
             _log, "backend_state", level=logging.WARNING,
             backend=m.name, state=state, was=old, reason=reason,
@@ -1462,7 +1971,19 @@ class FleetRouter:
         if m.breaker.state == CircuitBreaker.OPEN and m.state != "ejected":
             self._set_state(m, "ejected", "consecutive_forward_failures")
 
-    # ------------------------------------------------------ tail tolerance
+    # ------------------------------------------------------ transport
+
+    def _pool_for(self, m: BackendMember) -> BackendPool:
+        pool = self.pools.get(m.name)
+        if pool is None:
+            pool = self.pools[m.name] = BackendPool(
+                m.name, m.host, m.port,
+                size=self.pool_size,
+                idle_max_s=self.pool_idle_s,
+                metrics=self.metrics,
+                clock=self._clock,
+            )
+        return pool
 
     async def _backend_request(
         self,
@@ -1493,9 +2014,18 @@ class FleetRouter:
                 delay = min((act.param or 100.0) / 1e3, timeout_s)
                 await asyncio.sleep(delay)
                 timeout_s = max(0.001, timeout_s - delay)
-        status, resp_headers, payload = await raw_request(
-            m.host, m.port, method, target, headers, body, timeout_s
-        )
+        if self.connection_pool and raw_request is _DIAL_RAW_REQUEST:
+            # round 21 fast path: pooled keep-alive roundtrip.  A
+            # monkeypatched ``fleet.raw_request`` (the test suites'
+            # scripted transports) takes the dial branch below instead
+            # — the pool must never hide a scripted wire.
+            status, resp_headers, payload = await self._pool_for(
+                m
+            ).request(method, target, headers, body, timeout_s)
+        else:
+            status, resp_headers, payload = await raw_request(
+                m.host, m.port, method, target, headers, body, timeout_s
+            )
         if reg is not None:
             act = reg.check("fleet.head_delay_ms", who=m.name)
             if act is not None:
@@ -1510,6 +2040,120 @@ class FleetRouter:
             if reg.check("fleet.torn_body", who=m.name) is not None:
                 raise _BackendError(f"{m.name}: torn body (injected)")
         return status, resp_headers, payload
+
+    async def _backend_request_stream(
+        self,
+        m: BackendMember,
+        method: str,
+        target: str,
+        headers: dict[str, str],
+        body: bytes,
+        head_timeout_s: float,
+    ) -> tuple[int, dict[str, str], object]:
+        """Streaming sibling of ``_backend_request`` (round 21): the
+        same ``fleet.*`` fault sites, applied where a stream actually
+        has them — blackhole/connect_delay before the wire, head_delay
+        after the head, and the BODY faults (trickle, torn) riding the
+        chunk iterator so a torn body tears MID-RELAY, which is the
+        failure shape a streamed response really has."""
+        reg = self.faults
+        if reg is not None:
+            if reg.check("fleet.blackhole", who=m.name) is not None:
+                await asyncio.sleep(head_timeout_s)
+                raise _BackendError(f"{m.name}: blackhole (injected)")
+            act = reg.check("fleet.connect_delay_ms", who=m.name)
+            if act is not None:
+                delay = min((act.param or 100.0) / 1e3, head_timeout_s)
+                await asyncio.sleep(delay)
+                head_timeout_s = max(0.001, head_timeout_s - delay)
+        if (
+            self.connection_pool
+            and raw_request_stream is _DIAL_RAW_REQUEST_STREAM
+        ):
+            status, resp_headers, chunks = await self._pool_for(
+                m
+            ).request_stream(method, target, headers, body, head_timeout_s)
+        else:
+            status, resp_headers, chunks = await raw_request_stream(
+                m.host, m.port, method, target, headers, body,
+                head_timeout_s,
+            )
+        if reg is not None:
+            act = reg.check("fleet.head_delay_ms", who=m.name)
+            if act is not None:
+                await asyncio.sleep((act.param or 100.0) / 1e3)
+            trickle = reg.check("fleet.body_trickle", who=m.name)
+            torn = reg.check("fleet.torn_body", who=m.name)
+            if trickle is not None or torn is not None:
+                chunks = self._faulted_chunks(m, chunks, trickle, torn)
+        return status, resp_headers, chunks
+
+    @staticmethod
+    async def _faulted_chunks(m, chunks, trickle, torn):
+        n = 0
+        try:
+            async for chunk in chunks:
+                if trickle is not None:
+                    per = max(1, (len(chunk) + 65535) // 65536)
+                    await asyncio.sleep((trickle.param or 20.0) / 1e3 * per)
+                if torn is not None and n >= 1:
+                    raise _BackendError(f"{m.name}: torn body (injected)")
+                n += 1
+                yield chunk
+            if torn is not None and n <= 1:
+                # a one-chunk (or empty) body still tears — the site
+                # must fire regardless of how the backend chunked it
+                raise _BackendError(f"{m.name}: torn body (injected)")
+        finally:
+            aclose = getattr(chunks, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:  # noqa: BLE001 — cleanup must not mask
+                    pass
+
+    async def _forward_maybe_relay(
+        self,
+        m: BackendMember,
+        req: Request,
+        target: str,
+        fwd_headers: dict[str, str],
+        timeout_s: float,
+    ) -> tuple[int, dict[str, str], bytes, object | None]:
+        """Non-hedged forward with the zero-copy relay engaged (round
+        21): the head is bounded by ``timeout_s`` exactly as before; a
+        200 whose content-length is at or above
+        ``stream_relay_min_bytes`` returns ``(status, headers, b"",
+        chunk-iterator)`` and is piped upstream→client with
+        backpressure instead of buffered to completion.  Small bodies,
+        error statuses and unframed responses buffer — byte-identical
+        to ``_backend_request``.  Scripted transports (a monkeypatched
+        ``raw_request``) and the relay-off knob take the buffered path
+        wholesale."""
+        if (
+            self.stream_relay_min_bytes <= 0
+            or raw_request is not _DIAL_RAW_REQUEST
+        ):
+            s, h, b = await self._backend_request(
+                m, req.method, target, fwd_headers, req.body, timeout_s
+            )
+            return s, h, b, None
+        status, headers, chunks = await self._backend_request_stream(
+            m, req.method, target, fwd_headers, req.body, timeout_s
+        )
+        cl = headers.get("content-length", "")
+        if (
+            status == 200
+            and cl.isdigit()
+            and int(cl) >= self.stream_relay_min_bytes
+        ):
+            return status, headers, b"", chunks
+        try:
+            body = await asyncio.wait_for(_read_all(chunks), timeout_s)
+        except (asyncio.TimeoutError, TimeoutError) as te:
+            await chunks.aclose()
+            raise _BackendError(f"{m.name}: stalled body") from te
+        return status, headers, body, None
 
     def _update_slow_states(self) -> None:
         """Gray-failure outlier ejection (round 17), run every probe
@@ -1703,6 +2347,11 @@ class FleetRouter:
             # demotion-on-cooldown must not wait for traffic on the
             # cooled key: decay + re-rank on the probe cadence
             self.hot_keys.recompute()
+        for pool in self.pools.values():
+            # idle-reap rides the probe cadence too: a connection parked
+            # past pool_idle_s is closed here rather than discovered
+            # stale at checkout (round 21)
+            pool.reap()
         await asyncio.gather(
             *(self._probe(m) for m in list(self.members.values()))
         )
@@ -1935,12 +2584,25 @@ class FleetRouter:
         # x-peer-fill and x-trace-hop are router-authoritative: a
         # client-supplied hint would point a trusting backend at an
         # arbitrary host:port, and a client-supplied hop would let it
-        # forge attempt attribution in the backend's flight recorder
-        fwd_headers = {
-            k: v for k, v in req.headers.items()
-            if k not in _HOP_HEADERS
-            and k not in ("x-peer-fill", "x-trace-hop")
-        }
+        # forge attempt attribution in the backend's flight recorder.
+        # The hop-stripped base is identical across the retry/hedge
+        # attempts of one request, so it is filtered once and memoized
+        # on the request (round 21 fast path); connection-nominated
+        # client headers are hop-by-hop per RFC 9110 §7.6.1 and join
+        # the strip set.
+        base = req._fwd_base
+        if base is None:
+            strip = _FWD_STRIP
+            nominated = req.headers.get("connection")
+            if nominated:
+                strip = strip | {
+                    t.strip().lower()
+                    for t in nominated.split(",") if t.strip()
+                }
+            base = req._fwd_base = [
+                (k, v) for k, v in req.headers.items() if k not in strip
+            ]
+        fwd_headers = dict(base)
         if hop is not None:
             # cross-hop trace context (round 19): WHICH attempt this
             # forward is (ordinal:purpose) — the backend folds it into
@@ -2021,9 +2683,11 @@ class FleetRouter:
         self._observe_route(req.path, dt, status)
         if trace is not None:
             trace.annotate(backend=m.name)
-            self._record_trace(
-                trace, status, error=code, cache=headers.get("x-cache")
-            )
+            if stream is None:
+                self._record_trace(
+                    trace, status, error=code,
+                    cache=headers.get("x-cache"),
+                )
         slog.event(
             _log, "router_request",
             level=logging.WARNING if status >= 500 else logging.INFO,
@@ -2033,12 +2697,71 @@ class FleetRouter:
             **({"stream": True} if stream is not None else {}),
         )
         resp_headers = {
-            k: v for k, v in headers.items() if k not in _HOP_HEADERS
+            k: v for k, v in headers.items()
+            if k not in _connection_nominated(headers)
         }
         resp_headers["x-backend"] = m.name
+        if stream is not None:
+            # zero-copy relay (round 21): the head is on the books; the
+            # body pipes through ``_relay_stream`` which counts bytes,
+            # adds the relay span, and records the trace at stream end.
+            # A framed body keeps its content-length on the way out so
+            # the CLIENT detects a torn relay as truncation.
+            cl = headers.get("content-length", "")
+            if cl.isdigit():
+                resp_headers["content-length"] = cl
+            stream = self._relay_stream(
+                stream, m, trace, status, code, headers.get("x-cache")
+            )
         return Response(
             status=status, body=body, headers=resp_headers, stream=stream
         )
+
+    async def _relay_stream(
+        self,
+        chunks,
+        m: BackendMember,
+        trace: RequestTrace | None,
+        status: int,
+        code: str | None,
+        cache: str | None,
+    ):
+        """Relay accounting for a streamed body (round 21): count bytes,
+        record the relay span + the request trace at STREAM END, and
+        keep a torn upstream from crashing the client's connection task
+        — the client sees truncation via the preserved content-length,
+        and the breaker is NOT re-fed (the head already reported this
+        forward's outcome to ``_note_forward_result``)."""
+        t0 = time.perf_counter()
+        n = 0
+        err: str | None = None
+        try:
+            async for chunk in chunks:
+                n += len(chunk)
+                yield chunk
+        except (_BackendError, OSError, asyncio.IncompleteReadError) as e:
+            err = str(e)
+            self.metrics.inc_counter("relay_torn_total")
+            slog.event(
+                _log, "relay_torn", level=logging.WARNING,
+                backend=m.name, bytes=n, error=err,
+            )
+        finally:
+            aclose = getattr(chunks, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:  # noqa: BLE001 — cleanup only
+                    pass
+            self.metrics.inc_counter("relayed_responses_total")
+            self.metrics.inc_counter("relay_bytes_total", n)
+            if trace is not None:
+                trace.add_span(
+                    "relay", t0, time.perf_counter() - t0,
+                    backend=m.name, bytes=n,
+                    **({"error": err} if err else {}),
+                )
+                self._record_trace(trace, status, error=code, cache=cache)
 
     def _unavailable(
         self,
@@ -2528,7 +3251,19 @@ class FleetRouter:
                 hop=f"{hop_ord}:{purpose}",
             )
             picked = m  # the pre-hedge pick: m may become the winner
-            hedged_path = hedgeable and not tried and m.state != "slow"
+            # the hedge helper buffers both legs (the race needs bytes
+            # it can throw away when the loser is cancelled), so it is
+            # only taken when a hedge could actually FIRE: eligible
+            # traffic AND a warm enough digest to price the delay.  A
+            # cold router, or traffic hedging excludes, takes the
+            # streaming-relay path instead.
+            hedged_path = (
+                hedgeable
+                and not tried
+                and m.state != "slow"
+                and self._hedge_delay_s() is not None
+            )
+            stream = None  # hedged forwards stay buffered (race needs bytes)
             t_att = time.perf_counter()
             try:
                 if hedged_path:
@@ -2545,9 +3280,10 @@ class FleetRouter:
                         )
                     )
                 else:
-                    status, headers, body = await self._backend_request(
-                        m, req.method, target, fwd_headers,
-                        req.body, timeout_s,
+                    status, headers, body, stream = (
+                        await self._forward_maybe_relay(
+                            m, req, target, fwd_headers, timeout_s
+                        )
                     )
                     dt = time.perf_counter() - t_att
             except _HedgeExhausted as e:
@@ -2632,7 +3368,8 @@ class FleetRouter:
                 if jid:
                     self._learn_job_owner(jid, m.name)
             return self._respond(
-                req, m, status, headers, body, t0, trace=tr
+                req, m, status, headers, body, t0, stream=stream,
+                trace=tr,
             )
         return self._unavailable(req, t0, last_err, trace=tr)
 
@@ -2716,9 +3453,11 @@ class FleetRouter:
             t_att = time.perf_counter()
             try:
                 if is_stream:
-                    status, headers, stream = await raw_request_stream(
-                        m.host, m.port, req.method, target, fwd_headers,
-                        req.body, timeout,
+                    status, headers, stream = (
+                        await self._backend_request_stream(
+                            m, req.method, target, fwd_headers,
+                            req.body, timeout,
+                        )
                     )
                     body = b""
                     if status != 200:
@@ -3429,6 +4168,12 @@ class FleetRouter:
             # aggregates + ring occupancy, the backend precedent
             text += self.recorder.prometheus("router")
         text += slo_prometheus(self.slos, "router")
+        if self.worker is not None:
+            # SO_REUSEPORT multi-router (round 21): every sample line
+            # carries worker="N" so the federation plane's sum over
+            # interchangeable workers stays truthful (N processes
+            # answer this scrape round-robin behind one port)
+            text = _splice_worker_label(text, self.worker)
         return Response.text(
             text,
             content_type="text/plain; version=0.0.4",
@@ -3436,8 +4181,14 @@ class FleetRouter:
 
     # ------------------------------------------------------------ lifecycle
 
-    async def start(self, host: str = "0.0.0.0", port: int = 8100) -> int:
-        bound = await self.server.start(host, port)
+    async def start(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8100,
+        *,
+        reuse_port: bool = False,
+    ) -> int:
+        bound = await self.server.start(host, port, reuse_port=reuse_port)
         self.bound = (host, bound)
         # one immediate sweep so a fully-healthy fleet serves from the
         # first request instead of waiting out a probe interval
@@ -3458,17 +4209,27 @@ class FleetRouter:
             except asyncio.CancelledError:
                 pass
             self._probe_task = None
+        for pool in self.pools.values():
+            # drop the idle keep-alive sockets so backend listeners are
+            # not held open through their own shutdown grace
+            pool.flush()
         await self.server.stop(grace_s)
 
 
-async def _serve_forever(router: FleetRouter, host: str, port: int) -> None:
+async def _serve_forever(
+    router: FleetRouter,
+    host: str,
+    port: int,
+    reuse_port: bool = False,
+) -> None:
     import signal
 
-    bound = await router.start(host, port)
+    bound = await router.start(host, port, reuse_port=reuse_port)
     slog.configure()
     slog.event(
         _log, "router_start", host=host, port=bound,
         backends=sorted(router.members),
+        **({"worker": router.worker} if router.worker is not None else {}),
     )
     print(
         f"deconv fleet router on {host}:{bound} over "
@@ -3524,6 +4285,35 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8100)
+    p.add_argument(
+        "--workers", type=int, default=1,
+        help="accept-loop processes sharing --port via SO_REUSEPORT "
+        "(round 21): each is a full stateless router over the same "
+        "merge-safe membership file, with worker=N labeled metrics; "
+        "default 1 (no fork)",
+    )
+    p.add_argument(
+        "--connection-pool", choices=("on", "off"), default="on",
+        help="persistent per-backend keep-alive connection pools for "
+        "forwards and probes (round 21 fast path); 'off' pins the "
+        "prior dial-per-forward transport byte-identical",
+    )
+    p.add_argument(
+        "--pool-size", type=int, default=8,
+        help="max idle keep-alive connections retained per backend "
+        "(default 8; in-flight checkouts beyond this dial fresh)",
+    )
+    p.add_argument(
+        "--pool-idle-s", type=float, default=30.0,
+        help="idle seconds before a pooled connection is reaped on the "
+        "probe cadence (default 30)",
+    )
+    p.add_argument(
+        "--stream-relay-min-bytes", type=int, default=262144,
+        help="content-length at or above which a proxied 200 relays "
+        "chunk-by-chunk instead of buffering (default 262144; 0 "
+        "disables the streaming relay)",
+    )
     p.add_argument(
         "--vnodes", type=int, default=64,
         help="virtual nodes per backend (movement granularity; default 64)",
@@ -3668,38 +4458,89 @@ def main(argv: list[str] | None = None) -> int:
             parse_fault_specs(faults_spec)
         except ValueError as e:
             p.error(str(e))
-    router = FleetRouter(
-        backends,
-        vnodes=args.vnodes,
-        probe_interval_s=args.probe_interval_s,
-        probe_timeout_s=args.probe_timeout_s,
-        eject_threshold=args.eject_threshold,
-        cooldown_s=args.cooldown_s,
-        peer_fill=not args.no_peer_fill,
-        forward_timeout_s=args.forward_timeout_s,
-        membership_file=args.membership_file,
-        fleet_token=args.fleet_token,
-        hot_key_top_k=args.hot_key_top_k,
-        hot_key_replicas=args.hot_key_replicas,
-        tail_tolerance=args.tail_tolerance == "on",
-        slow_eject_k=args.slow_eject_k,
-        slow_restore_k=args.slow_restore_k,
-        slow_min_samples=args.slow_min_samples,
-        slow_hold_s=args.slow_hold_s,
-        slow_floor_ms=args.slow_floor_ms,
-        slow_canary_every=args.slow_canary_every,
-        latency_window_s=args.latency_window_s,
-        hedge_budget_pct=args.hedge_budget_pct,
-        hedge_min_delay_ms=args.hedge_min_delay_ms,
-        fault_injection=args.fault_injection,
-        faults_spec=faults_spec,
-        fault_seed=args.fault_seed,
-        trace_ring=args.trace_ring,
-        trace_slow_ms=args.trace_slow_ms,
-        trace_sample=args.trace_sample,
-        slos=args.slo,
-    )
-    asyncio.run(_serve_forever(router, args.host, args.port))
+    def _build(worker: int | None = None) -> FleetRouter:
+        return FleetRouter(
+            backends,
+            vnodes=args.vnodes,
+            probe_interval_s=args.probe_interval_s,
+            probe_timeout_s=args.probe_timeout_s,
+            eject_threshold=args.eject_threshold,
+            cooldown_s=args.cooldown_s,
+            peer_fill=not args.no_peer_fill,
+            forward_timeout_s=args.forward_timeout_s,
+            membership_file=args.membership_file,
+            fleet_token=args.fleet_token,
+            hot_key_top_k=args.hot_key_top_k,
+            hot_key_replicas=args.hot_key_replicas,
+            tail_tolerance=args.tail_tolerance == "on",
+            slow_eject_k=args.slow_eject_k,
+            slow_restore_k=args.slow_restore_k,
+            slow_min_samples=args.slow_min_samples,
+            slow_hold_s=args.slow_hold_s,
+            slow_floor_ms=args.slow_floor_ms,
+            slow_canary_every=args.slow_canary_every,
+            latency_window_s=args.latency_window_s,
+            hedge_budget_pct=args.hedge_budget_pct,
+            hedge_min_delay_ms=args.hedge_min_delay_ms,
+            fault_injection=args.fault_injection,
+            faults_spec=faults_spec,
+            fault_seed=args.fault_seed,
+            trace_ring=args.trace_ring,
+            trace_slow_ms=args.trace_slow_ms,
+            trace_sample=args.trace_sample,
+            slos=args.slo,
+            connection_pool=args.connection_pool == "on",
+            pool_size=args.pool_size,
+            pool_idle_s=args.pool_idle_s,
+            stream_relay_min_bytes=args.stream_relay_min_bytes,
+            worker=worker,
+        )
+
+    if args.workers > 1:
+        # SO_REUSEPORT multi-router (round 21): fork AFTER parsing and
+        # BEFORE any event loop exists; each child builds its own
+        # router (own loop, own pools, own metrics registry carrying a
+        # worker= label) and binds the SAME fixed port.
+        if args.port == 0:
+            p.error("--workers > 1 needs a fixed --port (the processes "
+                    "share one port via SO_REUSEPORT)")
+        import signal
+
+        pids: list[int] = []
+        for k in range(args.workers):
+            pid = os.fork()
+            if pid == 0:
+                code = 0
+                try:
+                    asyncio.run(_serve_forever(
+                        _build(worker=k), args.host, args.port,
+                        reuse_port=True,
+                    ))
+                except BaseException:  # noqa: BLE001 — child must exit
+                    code = 1
+                finally:
+                    os._exit(code)
+            pids.append(pid)
+
+        def _relay(signum, _frame):
+            for pid in pids:
+                try:
+                    os.kill(pid, signum)
+                except OSError:
+                    pass
+
+        signal.signal(signal.SIGTERM, _relay)
+        signal.signal(signal.SIGINT, _relay)
+        rc = 0
+        for pid in pids:
+            try:
+                _, status = os.waitpid(pid, 0)
+            except OSError:
+                continue
+            if status != 0:
+                rc = 1
+        return rc
+    asyncio.run(_serve_forever(_build(), args.host, args.port))
     return 0
 
 

@@ -105,6 +105,11 @@ class Request:
     # the parsed form and the route handler parses the same body again;
     # one parse serves both (round 7).  None = not parsed yet.
     _form: dict[str, str] | None = field(default=None, repr=False, compare=False)
+    # memoized forward-header base (round 21 router fast path): the
+    # hop-stripped client headers are identical across retry/hedge
+    # attempts of one request, so the router filters them once and
+    # reuses the list for every attempt.  None = not computed yet.
+    _fwd_base: list | None = field(default=None, repr=False, compare=False)
 
     def form(self) -> dict[str, str]:
         """Parse the body as a form: urlencoded or multipart/form-data.
@@ -241,8 +246,17 @@ class HttpServer:
 
         return register
 
-    async def start(self, host: str, port: int) -> int:
-        self._server = await asyncio.start_server(self._handle_conn, host, port)
+    async def start(
+        self, host: str, port: int, *, reuse_port: bool = False
+    ) -> int:
+        # reuse_port (round 21): SO_REUSEPORT lets N independent router
+        # processes share one accept queue — the kernel load-balances
+        # connections across their accept loops.  Only passed through
+        # when requested so the default path stays portable.
+        kwargs = {"reuse_port": True} if reuse_port else {}
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, port, **kwargs
+        )
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self, grace_s: float = 5.0) -> None:
@@ -448,17 +462,36 @@ class HttpServer:
             raise _BadRequest(431, "headers too large") from None
         if len(head) > MAX_HEADER:
             raise _BadRequest(431, "headers too large")
-        lines = head.decode("latin-1").split("\r\n")
+        # Single-pass parse (round 21 fast path): walk the raw bytes with
+        # one find() per boundary instead of whole-head decode + split +
+        # per-line partition.  Every proxied request pays this parse on
+        # the router hop, so its allocations are hop-budget dollars.
+        # Semantics are unchanged: keys stripped+lowercased, values
+        # stripped, colon-less non-empty lines become empty-valued keys.
+        end = len(head) - 4  # drop the trailing \r\n\r\n
+        eol = head.find(b"\r\n", 0, end)
+        if eol < 0:
+            eol = end
+        reqline = head[:eol].decode("latin-1")
         try:
-            method, target, _version = lines[0].split(" ", 2)
+            method, target, _version = reqline.split(" ", 2)
         except ValueError:
-            raise _BadRequest(400, f"malformed request line {lines[0]!r}") from None
+            raise _BadRequest(400, f"malformed request line {reqline!r}") from None
         headers: dict[str, str] = {}
-        for line in lines[1:]:
-            if not line:
-                continue
-            k, _, v = line.partition(":")
-            headers[k.strip().lower()] = v.strip()
+        pos = eol + 2
+        while pos < end:
+            nxt = head.find(b"\r\n", pos, end)
+            if nxt < 0:
+                nxt = end
+            if nxt > pos:
+                colon = head.find(b":", pos, nxt)
+                if colon < 0:
+                    headers[head[pos:nxt].strip().lower().decode("latin-1")] = ""
+                else:
+                    headers[
+                        head[pos:colon].strip().lower().decode("latin-1")
+                    ] = head[colon + 1 : nxt].strip().decode("latin-1")
+            pos = nxt + 2
         body = b""
         if "content-length" in headers:
             try:

@@ -1,0 +1,727 @@
+"""Router data-plane fast path tests (round 21, serving/fleet.py):
+per-backend keep-alive pools (checkout/reuse/idle-reap/stale-retry-once,
+hedge-loser destroy, ejection flush), the zero-copy streaming relay
+(incremental chunks, backpressure, torn-stream semantics), SO_REUSEPORT
+multi-router port sharing, pooled-vs-dialed byte parity, the RFC 9110
+§7.6.1 connection-nominated strip, and exposition lint for every new
+metric family."""
+
+import asyncio
+import hashlib
+import json
+import socket
+import time
+
+import pytest
+
+from deconv_api_tpu.serving import fleet
+from deconv_api_tpu.serving.fleet import (
+    BackendMember,
+    BackendPool,
+    FleetRouter,
+)
+from deconv_api_tpu.serving.http import HttpServer, Request, Response
+from deconv_api_tpu.serving.metrics import Metrics
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------- raw stubs
+
+
+async def _start_raw_stub(handler):
+    """Minimal HTTP stub on a raw asyncio server — full control of
+    framing and connection lifecycle (the pieces HttpServer abstracts
+    away are exactly what these tests exercise)."""
+    srv = await asyncio.start_server(handler, "127.0.0.1", 0)
+    port = srv.sockets[0].getsockname()[1]
+    return srv, port
+
+
+async def _read_head(reader):
+    head = await reader.readuntil(b"\r\n\r\n")
+    lines = head[:-4].decode("latin-1").split("\r\n")
+    method, target, _ = lines[0].split(" ", 2)
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    body = b""
+    cl = headers.get("content-length")
+    if cl and cl.isdigit() and int(cl):
+        body = await reader.readexactly(int(cl))
+    return method, target, headers, body
+
+
+def _framed(payload: bytes, status: int = 200, extra: str = "") -> bytes:
+    return (
+        f"HTTP/1.1 {status} OK\r\ncontent-type: text/plain\r\n{extra}"
+        f"content-length: {len(payload)}\r\n\r\n"
+    ).encode("latin-1") + payload
+
+
+async def _echo_handler(reader, writer):
+    """Keep-alive echo: POST/GET any target -> 200 'ok:<body>'."""
+    try:
+        while True:
+            _m, _t, _h, body = await _read_head(reader)
+            writer.write(_framed(b"ok:" + body))
+            await writer.drain()
+    except (
+        asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError
+    ):
+        pass
+    finally:
+        writer.close()
+
+
+def _pool(port, metrics=None, **kw):
+    return BackendPool(
+        f"127.0.0.1:{port}", "127.0.0.1", port, metrics=metrics, **kw
+    )
+
+
+# --------------------------------------------------- pool unit behavior
+
+
+def test_pool_checkout_reuse_and_gauges():
+    async def go():
+        srv, port = await _start_raw_stub(_echo_handler)
+        metrics = Metrics(prefix="router", core=False)
+        pool = _pool(port, metrics)
+        try:
+            s1, _h1, b1 = await pool.request("POST", "/", {}, b"a", 5.0)
+            s2, _h2, b2 = await pool.request("POST", "/", {}, b"b", 5.0)
+            assert (s1, b1) == (200, b"ok:a")
+            assert (s2, b2) == (200, b"ok:b")
+            # one dial, then the parked socket is reused (LIFO)
+            assert pool.dials == 1 and pool.reuses == 1
+            assert pool.in_use == 0 and len(pool._idle) == 1
+            assert metrics.counter("pool_dial_total") == 1
+            assert metrics.counter("pool_reuse_total") == 1
+            name = pool.name
+            assert metrics.labeled_gauge("pool_idle")[name] == 1
+            assert metrics.labeled_gauge("pool_in_use")[name] == 0
+            # dial wall time surfaced as the probe-RTT honesty metric
+            assert metrics.labeled("connect_seconds_total")[name] > 0
+        finally:
+            pool.flush()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_pool_release_bound_and_flush():
+    async def go():
+        srv, port = await _start_raw_stub(_echo_handler)
+        pool = _pool(port, size=2)
+        try:
+            conns = [await pool.checkout(fresh=True) for _ in range(3)]
+            assert pool.in_use == 3
+            for c in conns:
+                pool.release(c)
+            # the idle list is bounded at size; the overflow is closed
+            assert len(pool._idle) == 2 and pool.in_use == 0
+            pool.flush()
+            assert len(pool._idle) == 0
+        finally:
+            pool.flush()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_pool_idle_reap_and_expired_checkout():
+    async def go():
+        srv, port = await _start_raw_stub(_echo_handler)
+        clock = _FakeClock()
+        metrics = Metrics(prefix="router", core=False)
+        pool = _pool(port, metrics, idle_max_s=30.0, clock=clock)
+        try:
+            await pool.request("GET", "/", {}, b"", 5.0)
+            assert len(pool._idle) == 1
+            # within the window the reap keeps it
+            clock.t += 10
+            pool.reap()
+            assert len(pool._idle) == 1
+            # past the window the probe-tick reap closes it
+            clock.t += 25
+            pool.reap()
+            assert len(pool._idle) == 0
+            assert metrics.labeled_gauge("pool_idle")[pool.name] == 0
+            # an expired socket still parked at checkout time is
+            # skipped (closed), not handed out
+            await pool.request("GET", "/", {}, b"", 5.0)
+            clock.t += 31
+            await pool.request("GET", "/", {}, b"", 5.0)
+            assert pool.dials == 3 and pool.reuses == 0
+        finally:
+            pool.flush()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_pool_stale_retry_once_on_reused_eof():
+    """A REUSED socket dying before any response byte is the keep-alive
+    race: retried exactly once on a fresh dial, counted, invisible to
+    the caller."""
+
+    async def go():
+        srv, port = await _start_raw_stub(_echo_handler)
+        metrics = Metrics(prefix="router", core=False)
+        pool = _pool(port, metrics)
+        try:
+            await pool.request("GET", "/", {}, b"", 5.0)  # park one
+            orig = pool._roundtrip
+            seen = []
+
+            async def flaky(c, wire):
+                seen.append(c.reused)
+                if len(seen) == 1:
+                    raise ConnectionResetError("peer reset idle socket")
+                return await orig(c, wire)
+
+            pool._roundtrip = flaky
+            status, _h, body = await pool.request("GET", "/", {}, b"", 5.0)
+            assert status == 200 and body == b"ok:"
+            # attempt 0 drew the parked (reused) socket, the retry
+            # dialed fresh
+            assert seen == [True, False]
+            assert pool.stale_retries == 1
+            assert metrics.counter("pool_stale_retry_total") == 1
+            assert pool.in_use == 0  # nothing leaked either way
+        finally:
+            pool.flush()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_pool_fresh_socket_failure_never_retried():
+    """The retry is for the keep-alive race ONLY: a freshly dialed
+    socket's reset is a real backend failure, surfaced first time."""
+
+    async def go():
+        srv, port = await _start_raw_stub(_echo_handler)
+        pool = _pool(port)
+        try:
+
+            async def dead(c, wire):
+                raise ConnectionResetError("backend fell over")
+
+            pool._roundtrip = dead
+            with pytest.raises(fleet._BackendError):
+                await pool.request("GET", "/", {}, b"", 5.0)
+            assert pool.stale_retries == 0
+            assert pool.in_use == 0 and len(pool._idle) == 0
+        finally:
+            pool.flush()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_pool_hedge_loser_cancellation_destroys_never_leaks():
+    """A hedge loser is cancelled mid-roundtrip: the socket (with an
+    unread response possibly in flight) must be destroyed — returning
+    it would hand the NEXT checkout a poisoned stream."""
+
+    async def hang_handler(reader, writer):
+        try:
+            await _read_head(reader)
+            await asyncio.sleep(3600)  # never answers
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def go():
+        srv, port = await _start_raw_stub(hang_handler)
+        pool = _pool(port)
+        try:
+            task = asyncio.create_task(
+                pool.request("GET", "/", {}, b"", 30.0)
+            )
+            await asyncio.sleep(0.1)
+            assert pool.in_use == 1
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            # destroyed, not parked: the loser's socket never returns
+            assert pool.in_use == 0 and len(pool._idle) == 0
+        finally:
+            pool.flush()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------- router + pool wiring
+
+
+async def _boot_http_stub():
+    """HttpServer stub: /readyz for probes, deterministic POST echo,
+    a small cached GET — the shape the router proxies."""
+    srv = HttpServer()
+
+    async def _readyz(_req):
+        return Response(
+            status=200, body=b'{"ready": true}',
+            headers={"content-type": "application/json"},
+        )
+
+    async def _models(_req):
+        return Response(
+            status=200, body=b'{"models": []}',
+            headers={"content-type": "application/json", "x-cache": "hit"},
+        )
+
+    async def _echo(req):
+        digest = hashlib.sha256(req.body).hexdigest().encode()
+        return Response(status=200, body=digest)
+
+    srv.route("GET", "/readyz")(_readyz)
+    srv.route("GET", "/v1/models")(_models)
+    srv.route("POST", "/")(_echo)
+    port = await srv.start("127.0.0.1", 0)
+    return srv, port
+
+
+def _req(method, path, body=b"", headers=None, i="x"):
+    return Request(
+        method=method, path=path, query={},
+        headers=dict(headers or {}), body=body, id=f"rid-fastpath-{i}",
+    )
+
+
+def test_router_ejection_flushes_member_pool():
+    async def go():
+        srv, port = await _boot_http_stub()
+        name = f"127.0.0.1:{port}"
+        router = FleetRouter([name], probe_interval_s=30.0)
+        try:
+            await router.probe_once()
+            m = router.members[name]
+            assert m.in_ring
+            resp = await router._proxy(_req("GET", "/v1/models"))
+            assert resp.status == 200
+            pool = router.pools[name]
+            assert len(pool._idle) >= 1  # warm socket parked
+            router._set_state(m, "ejected", "test")
+            # leaving the ring flushed the member's warm sockets
+            assert len(pool._idle) == 0
+        finally:
+            for p in router.pools.values():
+                p.flush()
+            await srv.stop(grace_s=0.2)
+
+    asyncio.run(go())
+
+
+def test_fault_sites_fire_on_pooled_connections():
+    """The fleet.* sites must keep working now that forwards ride the
+    pool: connect_delay shapes wall time, torn_body still tears."""
+
+    async def go():
+        srv, port = await _boot_http_stub()
+        name = f"127.0.0.1:{port}"
+        router = FleetRouter(
+            [name], probe_interval_s=30.0, fault_injection=True
+        )
+        try:
+            await router.probe_once()
+            router.faults.arm("fleet.connect_delay_ms", f"p1:200@{name}")
+            t0 = time.perf_counter()
+            resp = await router._proxy(_req("GET", "/v1/models", i="cd"))
+            dt = time.perf_counter() - t0
+            assert resp.status == 200 and dt >= 0.2
+            router.faults.disarm("fleet.connect_delay_ms")
+            router.faults.arm("fleet.torn_body", f"n1@{name}")
+            await router._proxy(_req("GET", "/v1/models", i="torn"))
+            fired = router.metrics.labeled("faults_injected_total")
+            assert fired.get("fleet.torn_body") == 1
+            # and all of it went over the pool, not a per-request dial
+            assert router.pools[name].dials >= 1
+        finally:
+            for p in router.pools.values():
+                p.flush()
+            await srv.stop(grace_s=0.2)
+
+    asyncio.run(go())
+
+
+def test_byte_parity_pooled_vs_dialed_and_pool_off_pin():
+    """16 sampled keys through a pooled router and a --connection-pool
+    off router: byte-identical to each other and to the direct oracle;
+    the dialed router never creates a pool (the escape hatch IS the
+    pre-round-21 dial-per-forward dialect)."""
+
+    async def go():
+        srv, port = await _boot_http_stub()
+        name = f"127.0.0.1:{port}"
+        pooled = FleetRouter([name], probe_interval_s=30.0)
+        dialed = FleetRouter(
+            [name], probe_interval_s=30.0, connection_pool=False
+        )
+        try:
+            await pooled.probe_once()
+            await dialed.probe_once()
+            bodies = [f"parity-key-{i}".encode() * 7 for i in range(16)]
+            for i, body in enumerate(bodies):
+                want = hashlib.sha256(body).hexdigest().encode()
+                rp = await pooled._proxy(_req("POST", "/", body, i=f"p{i}"))
+                rd = await dialed._proxy(_req("POST", "/", body, i=f"d{i}"))
+                assert rp.status == rd.status == 200
+                assert rp.body == rd.body == want
+            pool = pooled.pools[name]
+            assert pool.dials >= 1 and pool.reuses >= 1
+            # connection_pool=False never builds a pool at all
+            assert dialed.pools == {}
+        finally:
+            for p in pooled.pools.values():
+                p.flush()
+            await srv.stop(grace_s=0.2)
+
+    asyncio.run(go())
+
+
+def test_exposition_lint_every_new_family():
+    """Every round-21 family renders with exactly one TYPE line and at
+    least one sample — including the never-fired counters (stale retry,
+    torn relay), which must read 0 rather than vanish."""
+
+    async def go():
+        srv, port = await _boot_http_stub()
+        name = f"127.0.0.1:{port}"
+        router = FleetRouter([name], probe_interval_s=30.0)
+        try:
+            await router.probe_once()
+            await router._proxy(_req("GET", "/v1/models"))
+            text = router.metrics.prometheus()
+            for fam in (
+                "router_pool_dial_total",
+                "router_pool_reuse_total",
+                "router_pool_stale_retry_total",
+                "router_connect_seconds_total",
+                "router_pool_idle",
+                "router_pool_in_use",
+                "router_relayed_responses_total",
+                "router_relay_bytes_total",
+                "router_relay_torn_total",
+            ):
+                assert text.count(f"# TYPE {fam} ") == 1, fam
+                samples = [
+                    line for line in text.splitlines()
+                    if not line.startswith("#")
+                    and line.partition(" ")[0].partition("{")[0] == fam
+                ]
+                assert samples, f"no sample line for {fam}"
+        finally:
+            for p in router.pools.values():
+                p.flush()
+            await srv.stop(grace_s=0.2)
+
+    asyncio.run(go())
+
+
+# -------------------------------------------------------- streaming relay
+
+
+def test_request_stream_sse_chunks_arrive_incrementally():
+    """SSE relay timing: the first event must reach the consumer while
+    the server is still producing later ones — buffering to completion
+    (the pre-round-21 shape) would hold everything to the end."""
+
+    done = asyncio.Event
+    state = {}
+
+    async def sse_handler(reader, writer):
+        try:
+            await _read_head(reader)
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"content-type: text/event-stream\r\n\r\n"
+            )
+            await writer.drain()
+            for i in range(3):
+                writer.write(f"data: event-{i}\n\n".encode())
+                await writer.drain()
+                await asyncio.sleep(0.12)
+            state["server_done"].set()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def go():
+        state["server_done"] = done()
+        srv, port = await _start_raw_stub(sse_handler)
+        pool = _pool(port)
+        try:
+            status, headers, chunks = await pool.request_stream(
+                "GET", "/events", {}, b"", 5.0
+            )
+            assert status == 200
+            got = b""
+            first_seen_early = None
+            async for chunk in chunks:
+                if first_seen_early is None:
+                    first_seen_early = not state["server_done"].is_set()
+                got += chunk
+            assert first_seen_early is True
+            assert got.count(b"data: event-") == 3
+            # an unframed (EOF-terminated) stream spends the socket
+            assert pool.in_use == 0 and len(pool._idle) == 0
+        finally:
+            pool.flush()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_request_stream_framed_body_returns_socket():
+    async def go():
+        srv, port = await _start_raw_stub(_echo_handler)
+        pool = _pool(port)
+        try:
+            status, headers, chunks = await pool.request_stream(
+                "POST", "/", {}, b"zz", 5.0
+            )
+            got = b"".join([c async for c in chunks])
+            assert status == 200 and got == b"ok:zz"
+            # exact content-length consumed -> reusable, parked
+            assert len(pool._idle) == 1 and pool.in_use == 0
+        finally:
+            pool.flush()
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_relay_backpressure_pulls_lazily():
+    """The relay must not read ahead of the client: each upstream pull
+    happens only when the consumer asks for the next chunk — that
+    per-chunk lockstep is what propagates client backpressure to the
+    upstream socket."""
+
+    async def go():
+        router = FleetRouter(["b0:8000"])
+        pulls = []
+        consumed = []
+
+        async def upstream():
+            for i in range(5):
+                pulls.append(i)
+                yield b"x" * 1024
+
+        relay = router._relay_stream(
+            upstream(), BackendMember("b0:8000"), None, 200, None, None
+        )
+        async for chunk in relay:
+            consumed.append(chunk)
+            # lazy lockstep: never more than one pull ahead of the
+            # chunks the consumer has actually taken
+            assert len(pulls) <= len(consumed) + 1
+            await asyncio.sleep(0.01)
+        assert len(consumed) == 5
+        assert router.metrics.counter("relayed_responses_total") == 1
+        assert router.metrics.counter("relay_bytes_total") == 5 * 1024
+
+    asyncio.run(go())
+
+
+def test_torn_stream_mid_relay_truncates_client_no_breaker_feed():
+    """Upstream dies mid-relay: the client sees a short body under the
+    preserved content-length (detectable truncation, not a silent
+    success), the router counts relay_torn_total, and the member's
+    breaker is NOT fed a second failure for a forward whose head
+    already succeeded."""
+
+    big_cl = 400_000
+    sent = 100_000
+
+    async def torn_handler(reader, writer):
+        try:
+            while True:
+                _m, target, _h, _b = await _read_head(reader)
+                if target == "/readyz":
+                    writer.write(_framed(b'{"ready": true}'))
+                    await writer.drain()
+                    continue
+                writer.write(
+                    (
+                        f"HTTP/1.1 200 OK\r\ncontent-type: application/"
+                        f"octet-stream\r\ncontent-length: {big_cl}\r\n\r\n"
+                    ).encode("latin-1")
+                )
+                writer.write(b"y" * sent)
+                await writer.drain()
+                writer.close()
+                return
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+
+    async def go():
+        srv, port = await _start_raw_stub(torn_handler)
+        name = f"127.0.0.1:{port}"
+        router = FleetRouter(
+            [name], probe_interval_s=30.0, stream_relay_min_bytes=1024
+        )
+        rport = await router.start("127.0.0.1", 0)
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", rport
+            )
+            writer.write(b"GET /big HTTP/1.1\r\nhost: x\r\n\r\n")
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert b" 200 " in head.split(b"\r\n", 1)[0]
+            # content-length preserved so the client can DETECT the tear
+            assert f"content-length: {big_cl}".encode() in head.lower()
+            body = await reader.read()
+            writer.close()
+            assert 0 < len(body) < big_cl  # truncated, visibly
+            await asyncio.sleep(0.1)
+            assert router.metrics.counter("relay_torn_total") == 1
+            m = router.members[name]
+            # the tear was the body's, not the forward's: no breaker
+            # feed, the member stays healthy and in the ring
+            assert m.state == "healthy" and m.in_ring
+        finally:
+            await router.stop(grace_s=0.2)
+            srv.close()
+            await srv.wait_closed()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------- SO_REUSEPORT workers
+
+
+@pytest.mark.skipif(
+    not hasattr(socket, "SO_REUSEPORT"), reason="no SO_REUSEPORT"
+)
+def test_reuseport_routers_share_port_identical_placement():
+    async def go():
+        srv, port = await _boot_http_stub()
+        name = f"127.0.0.1:{port}"
+        r0 = FleetRouter([name], probe_interval_s=30.0, worker=0)
+        r1 = FleetRouter([name], probe_interval_s=30.0, worker=1)
+        shared = await r0.start("127.0.0.1", 0, reuse_port=True)
+        try:
+            assert await r1.start(
+                "127.0.0.1", shared, reuse_port=True
+            ) == shared
+            # stateless-by-construction: same member view => identical
+            # placement, so ANY worker answering is correct
+            keys = [f"{i:02d}" * 20 for i in range(32)]
+            assert [r0.ring.owner(k) for k in keys] == [
+                r1.ring.owner(k) for k in keys
+            ]
+            for i in range(8):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", shared
+                )
+                writer.write(
+                    b"GET /readyz HTTP/1.1\r\nhost: x\r\n"
+                    b"connection: close\r\n\r\n"
+                )
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                assert raw.split(b"\r\n", 1)[0].endswith(b"200 OK")
+            # the /metrics exposition carries worker= on every sample
+            # so the PR 14 federation sum over N workers stays truthful
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", shared
+            )
+            writer.write(
+                b"GET /metrics HTTP/1.1\r\nhost: x\r\n"
+                b"connection: close\r\n\r\n"
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            text = raw.split(b"\r\n\r\n", 1)[-1].decode("latin-1")
+            samples = [
+                line for line in text.splitlines()
+                if line and not line.startswith("#")
+            ]
+            assert samples and all('worker="' in s for s in samples)
+        finally:
+            await r0.stop(grace_s=0.2)
+            await r1.stop(grace_s=0.2)
+            await srv.stop(grace_s=0.2)
+
+    asyncio.run(go())
+
+
+def test_splice_worker_label_unit():
+    text = (
+        "# TYPE router_requests_total counter\n"
+        "router_requests_total 5\n"
+        'router_pool_idle{backend="b:1"} 2\n'
+    )
+    out = fleet._splice_worker_label(text, 3)
+    assert '# TYPE router_requests_total counter' in out
+    assert 'router_requests_total{worker="3"} 5' in out
+    assert 'router_pool_idle{worker="3",backend="b:1"} 2' in out
+    assert out.endswith("\n")
+
+
+# --------------------------------------- RFC 9110 §7.6.1 nominated strip
+
+
+def test_connection_nominated_headers_stripped_both_directions():
+    # helper: connection-nominated names join the hop-by-hop set
+    nominated = fleet._connection_nominated(
+        {"connection": "close, X-Secret-Token", "x-secret-token": "s"}
+    )
+    assert "x-secret-token" in nominated and "connection" in nominated
+
+    router = FleetRouter(["b0:8000"])
+    # client -> backend: a client-nominated header never forwards
+    req = _req(
+        "GET", "/v1/models",
+        headers={
+            "connection": "x-bar", "x-bar": "1", "x-keep": "2",
+            "te": "trailers",
+        },
+        i="nom",
+    )
+    fwd = router._forward_headers(req, None, "b0:8000")
+    assert "x-bar" not in fwd and "te" not in fwd
+    assert "connection" not in fwd
+    assert fwd["x-keep"] == "2" and fwd["x-request-id"] == req.id
+    # memoized base: the second call reuses the stripped list
+    assert router._forward_headers(req, None, "b0:8000")["x-keep"] == "2"
+
+    # backend -> client: an upstream-nominated header never relays
+    m = BackendMember("b0:8000")
+    resp = router._respond(
+        _req("GET", "/v1/models", i="nom2"), m, 200,
+        {
+            "connection": "x-upstream-secret", "x-upstream-secret": "v",
+            "x-cache": "hit", "content-length": "2",
+        },
+        b"hi", time.perf_counter(),
+    )
+    assert "x-upstream-secret" not in resp.headers
+    assert "connection" not in resp.headers
+    assert resp.headers["x-cache"] == "hit"
+    assert resp.headers["x-backend"] == "b0:8000"

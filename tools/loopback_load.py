@@ -20,7 +20,22 @@ Usage: python tools/loopback_load.py [--passes N] [--no-donate]
            [--compile-cache-dir DIR] [--heavy] [--jobs]
            [--jobs-dir DIR] [--qos] [--tenants default|SPEC]
            [--fleet N] [--fleet-ha] [--fleet-tail] [--fleet-trace]
+           [--fleet-fastpath] [--open-loop RATE]
            [depth ...]
+
+Round 21 added `--open-loop RATE` and `--fleet-fastpath`.  `--open-loop`
+drives Poisson arrivals at a FIXED offered rate regardless of
+completions — the existing closed-loop driver slows its own offered
+rate down with the server, hiding queueing collapse; this mode reports
+offered-vs-achieved rps and queue-inclusive latency quantiles instead.
+`--fleet-fastpath` is the router data-plane drill
+(run_fleet_fastpath_drill): two instant stub backends behind REAL
+router subprocesses — hop p50 (pooled router minus direct, budget
+< 0.5 ms), a pooled vs `--connection-pool off` closed-loop A/B, the
+open-loop cached-GET rps budget (>= 10k through one router process),
+a `--workers N` SO_REUSEPORT scaling row, and 16-key pooled/dialed/
+direct byte parity.  `tools/run_bench_suite.py`'s `router-fastpath`
+token records the row with loud error fields on any budget miss.
 
 Round 19 added `--fleet-trace` — the observability-plane drill
 (run_fleet_trace_drill): two routers over three warmed backends with
@@ -3265,6 +3280,599 @@ def run_quant_drill(
     return asyncio.run(drive())
 
 
+# --------------------------------------------------------------- round 21
+# Router data-plane fast path: the open-loop arrival engine, the
+# keep-alive loopback client it drives, and the router-fastpath drill
+# (pooled-vs-dialed A/B, hop latency, 1-vs-N REUSEPORT workers, parity).
+
+
+class _KAClient:
+    """One persistent keep-alive loopback connection with framed reads
+    — the client side of the round-21 fast path.  Reconnects once when
+    the server reaps the idle socket mid-checkout (the same staleness
+    race the router's own pool retries)."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self.reader = None
+        self.writer = None
+
+    async def _connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port
+        )
+
+    async def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self.writer = None
+
+    async def _once(self, wire: bytes) -> bytes:
+        self.writer.write(wire)
+        await self.writer.drain()
+        return await self.reader.readuntil(b"\r\n\r\n")
+
+    async def request(self, wire: bytes) -> tuple[int, bytes]:
+        if self.writer is None or self.writer.is_closing():
+            await self._connect()
+            head = await self._once(wire)
+        else:
+            try:
+                head = await self._once(wire)
+            except (ConnectionError, asyncio.IncompleteReadError, OSError):
+                # idle-reap race on a REUSED connection: retry once fresh
+                await self.close()
+                await self._connect()
+                head = await self._once(wire)
+        status = int(head.split(b" ", 2)[1])
+        length = 0
+        keep = True
+        for line in head[:-4].split(b"\r\n")[1:]:
+            name, _, val = line.partition(b":")
+            name = name.strip().lower()
+            if name == b"content-length":
+                length = int(val.strip())
+            elif name == b"connection" and val.strip().lower() == b"close":
+                keep = False
+        body = await self.reader.readexactly(length) if length else b""
+        if not keep:
+            await self.close()
+        return status, body
+
+
+def _quantiles_ms(lat_s: list[float]) -> dict:
+    if not lat_s:
+        return {"p50_ms": None, "p90_ms": None, "p99_ms": None,
+                "max_ms": None}
+    lat = sorted(lat_s)
+
+    def q(p: float) -> float:
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 3)
+
+    return {"p50_ms": q(0.50), "p90_ms": q(0.90), "p99_ms": q(0.99),
+            "max_ms": round(lat[-1] * 1e3, 3)}
+
+
+async def _closed_loop(
+    port: int, wires: list[bytes], concurrency: int
+) -> dict:
+    """Classic closed-loop drive over persistent connections: the next
+    request waits for the previous completion, so offered rate ==
+    achieved rate by construction (the collapse-hiding property the
+    open-loop engine exists to fix)."""
+    counter = iter(range(len(wires)))
+    lat: list[float] = []
+    errors = 0
+
+    async def worker() -> None:
+        nonlocal errors
+        c = _KAClient(port)
+        for i in counter:
+            t0 = time.perf_counter()
+            try:
+                status, _body = await c.request(wires[i])
+            except (OSError, asyncio.IncompleteReadError):
+                errors += 1
+                continue
+            if status != 200:
+                errors += 1
+            lat.append(time.perf_counter() - t0)
+        await c.close()
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker() for _ in range(concurrency)))
+    wall = time.perf_counter() - t0
+    return {
+        "requests": len(wires), "completed": len(lat), "errors": errors,
+        "req_s": round(len(lat) / wall, 1) if wall > 0 else None,
+        "wall_s": round(wall, 3), **_quantiles_ms(lat),
+    }
+
+
+async def _open_loop(
+    port: int,
+    wires: list[bytes],
+    rate: float,
+    concurrency: int,
+    seed: int = 0,
+) -> dict:
+    """Open-loop Poisson arrivals at a FIXED offered rate: arrival i
+    fires at its scheduled time whether or not earlier requests have
+    completed (a backed-up connection fires immediately it frees — the
+    backlog then shows up as latency, measured from the SCHEDULED
+    arrival, and as achieved < offered).  This is the honest load shape
+    a closed-loop driver cannot produce: a queueing collapse slows a
+    closed loop's offered rate down with the server, hiding itself."""
+    import random
+
+    rng = random.Random(seed)
+    n = len(wires)
+    sched: list[float] = []
+    t = 0.0
+    for _ in range(n):
+        t += rng.expovariate(rate)
+        sched.append(t)
+    lat: list[float] = []
+    errors = 0
+    t0 = time.perf_counter()
+
+    async def worker(k: int) -> None:
+        nonlocal errors
+        c = _KAClient(port)
+        for i in range(k, n, concurrency):
+            due = t0 + sched[i]
+            delay = due - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            try:
+                status, _body = await c.request(wires[i])
+            except (OSError, asyncio.IncompleteReadError):
+                errors += 1
+                continue
+            if status != 200:
+                errors += 1
+            # queue-inclusive latency: from the arrival the schedule
+            # DEMANDED, not from when a free connection got around to it
+            lat.append(time.perf_counter() - due)
+        await c.close()
+
+    await asyncio.gather(*(worker(k) for k in range(concurrency)))
+    wall = time.perf_counter() - t0
+    return {
+        "offered_rps": round(rate, 1),
+        "achieved_rps": round(len(lat) / wall, 1) if wall > 0 else None,
+        "arrivals": n, "completed": len(lat), "errors": errors,
+        "wall_s": round(wall, 3), **_quantiles_ms(lat),
+    }
+
+
+def run_open_loop(
+    rate: float,
+    n_arrivals: int | None = None,
+    key_dist: str = "zipf:1.1",
+    concurrency: int = 64,
+) -> dict:
+    """`--open-loop RATE`: the open-loop harness against the REAL tiny
+    server (the same serving machinery run_load measures), zipf keys
+    with the response cache on.  One warm phase (every distinct key
+    touched once, closed-loop) then the measured open-loop phase —
+    offered-vs-achieved rps and queue-inclusive latency quantiles."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import urllib.parse
+
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving.app import DeconvService
+
+    spec = _tiny_spec()
+    size = spec.input_shape[0]
+    params = init_params(spec, jax.random.PRNGKey(0))
+    cfg = ServerConfig(
+        image_size=size, max_batch=32, batch_window_ms=5.0,
+        compilation_cache_dir="", platform="cpu",
+        warmup_all_buckets=False, cache_bytes=cfg_cache_bytes(),
+    )
+    svc = DeconvService(cfg, spec=spec, params=params)
+    n = n_arrivals or max(256, int(rate * 2))
+    rng = np.random.default_rng(0)
+    stream = _key_streams(key_dist, n, 1, rng)[0]
+    wires: dict[int, bytes] = {}
+    for idx in sorted(set(stream)):
+        img = Image.fromarray(
+            np.random.default_rng(idx).integers(
+                0, 255, (size, size, 3), np.uint8
+            ),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        body = urllib.parse.urlencode({
+            "file": "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode(),
+            "layer": "c3",
+        }).encode()
+        wires[idx] = (
+            b"POST / HTTP/1.1\r\nhost: x\r\ncontent-type: "
+            b"application/x-www-form-urlencoded\r\ncontent-length: "
+            + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+
+    async def drive() -> dict:
+        port = await svc.start("127.0.0.1", 0)
+        await asyncio.to_thread(svc.warmup, "c3")
+        warm = await _closed_loop(
+            port, [wires[i] for i in sorted(set(stream))],
+            min(concurrency, 8),
+        )
+        phase = await _open_loop(
+            port, [wires[i] for i in stream], rate, concurrency
+        )
+        await svc.stop()
+        return {
+            "mode": "open-loop", "key_dist": key_dist,
+            "warm": warm, **phase,
+        }
+
+    return asyncio.run(drive())
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _boot_router_proc(
+    backend_ports: list[int], extra: list[str], ready_timeout_s: float = 20.0
+):
+    """One REAL router process (`python -m deconv_api_tpu.serving.fleet`)
+    over the in-process stub backends — the drill's rps numbers must be
+    what ONE OS process proxies, not an in-loop shortcut."""
+    import subprocess
+
+    port = _free_port()
+    argv = [
+        sys.executable, "-m", "deconv_api_tpu.serving.fleet",
+        "--backends",
+        ",".join(f"127.0.0.1:{p}" for p in backend_ports),
+        "--host", "127.0.0.1", "--port", str(port),
+        "--probe-interval-s", "0.5", "--forward-timeout-s", "30",
+        *extra,
+    ]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    deadline = time.monotonic() + ready_timeout_s
+    ready = 0
+    while time.monotonic() < deadline:
+        try:
+            status, _ = await _http(port, "GET", "/readyz")
+        except OSError:
+            status = 0
+        if status == 200:
+            ready += 1
+            # --workers N: /readyz lands on a random worker; several
+            # consecutive 200s ≈ every accept loop is up
+            if ready >= 3:
+                return proc, port
+        else:
+            ready = 0
+        await asyncio.sleep(0.05)
+    proc.kill()
+    raise RuntimeError(f"router {' '.join(extra)!r} never became ready")
+
+
+def run_fleet_fastpath_drill(
+    open_loop_rate: int = 12000,
+    workers: int = 2,
+    trials: int = 3,
+    concurrency: int = 32,
+) -> dict:
+    """The round-21 router data-plane drill.
+
+    Two instant stub backends (real HttpServer sockets, deterministic
+    bodies, zero device work — the ROUTER is the measured quantity)
+    behind real router subprocesses, phased:
+
+    - **hop latency**: closed-loop GET /v1/models direct-to-backend vs
+      through the pooled router at low concurrency; hop p50 = the
+      difference, budget < 0.5 ms.
+    - **pooled-vs-dialed A/B**: the same closed-loop drive against a
+      `--connection-pool off` router — pooled losing is a loud error.
+    - **open-loop budget**: Poisson cached-GET arrivals at a fixed
+      offered rate through ONE router process; achieved >= 10k rps is
+      the budget, measured not asserted.
+    - **1-vs-N workers**: the same open-loop phase against `--workers
+      N` SO_REUSEPORT routers — the scaling row.
+    - **byte parity**: 16 sampled POST keys, pooled vs dialed vs
+      direct, response bodies byte-identical.
+
+    Every latency/throughput phase runs ``trials`` times, best kept
+    (the PR 12 fleet-tail stability discipline)."""
+    from deconv_api_tpu.serving.http import HttpServer, Response
+
+    get_wire = (
+        b"GET /v1/models HTTP/1.1\r\nhost: x\r\n\r\n"
+    )
+    models_body = json.dumps(
+        {"models": [{"name": "loopback_tiny", "resident": True}]}
+    ).encode()
+
+    def post_wire(body: bytes) -> bytes:
+        return (
+            b"POST / HTTP/1.1\r\nhost: x\r\ncontent-type: "
+            b"application/octet-stream\r\ncontent-length: "
+            + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+
+    async def boot_stub():
+        import hashlib
+
+        srv = HttpServer(max_connections=2048)
+
+        async def _models(_req):
+            return Response(
+                status=200, body=models_body,
+                headers={"content-type": "application/json",
+                         "x-cache": "hit"},
+            )
+
+        async def _readyz(_req):
+            return Response(
+                status=200, body=b'{"ready": true}',
+                headers={"content-type": "application/json"},
+            )
+
+        async def _echo(req):
+            digest = hashlib.sha256(req.body).hexdigest().encode()
+            return Response(
+                status=200,
+                body=digest + b":" + str(len(req.body)).encode(),
+                headers={"content-type": "text/plain"},
+            )
+
+        srv.route("GET", "/v1/models")(_models)
+        srv.route("GET", "/readyz")(_readyz)
+        srv.route("POST", "/")(_echo)
+        port = await srv.start("127.0.0.1", 0)
+        return srv, port
+
+    async def drive() -> dict:
+        stubs = [await boot_stub() for _ in range(2)]
+        backend_ports = [p for _s, p in stubs]
+        row: dict = {
+            "which": "loopback_fleet_fastpath_drill",
+            "backends": 2, "open_loop_offered_rps": open_loop_rate,
+            "workers": workers, "trials": trials,
+        }
+        problems: list[str] = []
+        procs = []
+        try:
+            # --- phase: direct-to-backend closed-loop baseline
+            direct = min(
+                [
+                    await _closed_loop(
+                        backend_ports[0], [get_wire] * 600, 4
+                    )
+                    for _ in range(trials)
+                ],
+                key=lambda r: r["p50_ms"] or 9e9,
+            )
+            row["direct"] = direct
+
+            # --- pooled router: closed loop + open loop + parity +
+            # pool-metric sanity on ONE process
+            proc, rport = await _boot_router_proc(backend_ports, [])
+            procs.append(proc)
+            pooled = min(
+                [
+                    await _closed_loop(
+                        rport, [get_wire] * 1200, concurrency
+                    )
+                    for _ in range(trials)
+                ],
+                key=lambda r: r["p50_ms"] or 9e9,
+            )
+            row["pooled"] = pooled
+            # hop latency wants an UNQUEUED shape: same low concurrency
+            # as the direct baseline, or the delta measures queue depth
+            pooled_lowc = min(
+                [
+                    await _closed_loop(rport, [get_wire] * 600, 4)
+                    for _ in range(trials)
+                ],
+                key=lambda r: r["p50_ms"] or 9e9,
+            )
+            row["pooled_lowc"] = pooled_lowc
+            open_pooled = max(
+                [
+                    await _open_loop(
+                        rport, [get_wire] * open_loop_rate,
+                        float(open_loop_rate), 64, seed=i,
+                    )
+                    for i in range(trials)
+                ],
+                key=lambda r: r["achieved_rps"] or 0.0,
+            )
+            row["open_loop"] = open_pooled
+            parity_bodies = [
+                f"fastpath-parity-key-{i}".encode() * 7 for i in range(16)
+            ]
+            c = _KAClient(rport)
+            pooled_parity = [
+                (await c.request(post_wire(b)))[1] for b in parity_bodies
+            ]
+            await c.close()
+            _status, metrics_text = await _http_text(rport, "/metrics")
+            pool_metrics = {
+                fam: fam in metrics_text
+                for fam in (
+                    "router_pool_dial_total", "router_pool_reuse_total",
+                    "router_pool_stale_retry_total",
+                    "router_connect_seconds_total", "router_pool_idle",
+                    "router_pool_in_use",
+                )
+            }
+            row["pool_metric_families"] = pool_metrics
+            if not all(pool_metrics.values()):
+                problems.append(
+                    "missing pool metric families: "
+                    + ",".join(k for k, v in pool_metrics.items() if not v)
+                )
+            if "router_pool_reuse_total 0" in metrics_text:
+                problems.append(
+                    "pool never reused a connection under load"
+                )
+            proc.terminate()
+            proc.wait(timeout=10)
+
+            # --- dialed router (--connection-pool off): the A/B side
+            proc, dport = await _boot_router_proc(
+                backend_ports, ["--connection-pool", "off"]
+            )
+            procs.append(proc)
+            dialed = min(
+                [
+                    await _closed_loop(
+                        dport, [get_wire] * 1200, concurrency
+                    )
+                    for _ in range(trials)
+                ],
+                key=lambda r: r["p50_ms"] or 9e9,
+            )
+            row["dialed"] = dialed
+            c = _KAClient(dport)
+            dialed_parity = [
+                (await c.request(post_wire(b)))[1] for b in parity_bodies
+            ]
+            await c.close()
+            proc.terminate()
+            proc.wait(timeout=10)
+
+            # --- N-worker SO_REUSEPORT scaling row
+            proc, wport = await _boot_router_proc(
+                backend_ports, ["--workers", str(workers)]
+            )
+            procs.append(proc)
+            open_workers = max(
+                [
+                    await _open_loop(
+                        wport, [get_wire] * open_loop_rate,
+                        float(open_loop_rate), 64, seed=i,
+                    )
+                    for i in range(trials)
+                ],
+                key=lambda r: r["achieved_rps"] or 0.0,
+            )
+            row["open_loop_workers"] = open_workers
+            proc.terminate()
+            proc.wait(timeout=10)
+
+            # --- direct parity reference (both stubs answer
+            # identically, so one direct connection is the oracle)
+            c = _KAClient(backend_ports[0])
+            direct_parity = [
+                (await c.request(post_wire(b)))[1] for b in parity_bodies
+            ]
+            await c.close()
+
+            row["parity_keys"] = len(parity_bodies)
+            parity_ok = (
+                pooled_parity == dialed_parity == direct_parity
+                and all(pooled_parity)
+            )
+            row["parity_ok"] = parity_ok
+            if not parity_ok:
+                drift = sum(
+                    1 for a, b, d in zip(
+                        pooled_parity, dialed_parity, direct_parity
+                    )
+                    if not (a == b == d)
+                )
+                problems.append(
+                    f"byte parity drifted on {drift}/16 sampled keys"
+                )
+
+            # --- budgets, measured not asserted
+            hop_p50 = None
+            if (
+                pooled_lowc["p50_ms"] is not None
+                and direct["p50_ms"] is not None
+            ):
+                hop_p50 = round(
+                    pooled_lowc["p50_ms"] - direct["p50_ms"], 3
+                )
+            row["hop_p50_ms"] = hop_p50
+            row["hop_p50_budget_ms"] = 0.5
+            row["min_rps_budget"] = 10000
+            if hop_p50 is None or hop_p50 >= 0.5:
+                problems.append(
+                    f"router hop p50 {hop_p50} ms >= 0.5 ms budget"
+                )
+            if (open_pooled["achieved_rps"] or 0) < 10000:
+                problems.append(
+                    f"1-process open-loop achieved "
+                    f"{open_pooled['achieved_rps']} rps < 10000 budget"
+                )
+            if (
+                pooled["p50_ms"] is not None
+                and dialed["p50_ms"] is not None
+                and pooled["p50_ms"] > dialed["p50_ms"]
+            ):
+                problems.append(
+                    f"pooled p50 {pooled['p50_ms']} ms loses to dialed "
+                    f"{dialed['p50_ms']} ms"
+                )
+            if pooled["errors"] or dialed["errors"] or direct["errors"]:
+                problems.append(
+                    "closed-loop errors: "
+                    f"direct={direct['errors']} pooled={pooled['errors']}"
+                    f" dialed={dialed['errors']}"
+                )
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            for srv, _p in stubs:
+                await srv.stop(grace_s=0.5)
+        if problems:
+            row["error"] = "; ".join(problems)
+        return row
+
+    return asyncio.run(drive())
+
+
+async def _http_text(port: int, path: str) -> tuple[int, str]:
+    """GET a text surface (the /metrics exposition) over one
+    connection: the JSON-decoding `_http` helper can't carry it."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        f"GET {path} HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n"
+        .encode()
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    status, _ = _resp_status_code(raw)
+    return status, raw.split(b"\r\n\r\n", 1)[-1].decode("latin-1", "replace")
+
+
 def run_load(
     pipeline_depth: int,
     n_requests: int = 512,
@@ -3833,6 +4441,8 @@ def main() -> int:
     fleet_ha = False
     fleet_tail = False
     fleet_trace = False
+    fleet_fastpath = False
+    open_loop_rate: float | None = None
     tenants_drill: str | None = None
     concurrency = 64
     depths: list[int] = []
@@ -3919,6 +4529,18 @@ def main() -> int:
             # --tail-tolerance off topology pin
             fleet_tail = True
             i += 1
+        elif args[i] == "--fleet-fastpath":
+            # the round-21 data-plane drill: pooled-vs-dialed routers,
+            # hop p50, open-loop cached-GET rps through one process,
+            # N-worker SO_REUSEPORT scaling, 16-key byte parity
+            fleet_fastpath = True
+            i += 1
+        elif args[i] == "--open-loop":
+            # open-loop Poisson arrivals at a fixed offered rate: alone
+            # it drives the tiny server (run_open_loop); with
+            # --fleet-fastpath it sets the drill's offered rate
+            open_loop_rate = float(args[i + 1])
+            i += 2
         elif args[i] == "--fleet-trace":
             # the round-19 observability drill: 2 routers over 3
             # backends with an armed fleet.head_delay_ms fault —
@@ -3982,6 +4604,21 @@ def main() -> int:
         row = run_model_mix_drill(
             n_requests=n_requests or 360,
             concurrency=min(concurrency, 16),
+        )
+        print(json.dumps(row), flush=True)
+        return 0
+    if fleet_fastpath:
+        row = run_fleet_fastpath_drill(
+            open_loop_rate=int(open_loop_rate or 12000),
+        )
+        print(json.dumps(row), flush=True)
+        return 0
+    if open_loop_rate is not None:
+        row = run_open_loop(
+            open_loop_rate,
+            n_arrivals=n_requests,
+            key_dist=key_dist or "zipf:1.1",
+            concurrency=concurrency,
         )
         print(json.dumps(row), flush=True)
         return 0

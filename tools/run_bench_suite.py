@@ -124,6 +124,17 @@ QUANT_OVERHEAD_BUDGET_PCT = 3.0
 # recompile, never an error).
 AOT_BOOT_SPEEDUP_BUDGET = 2.0
 
+# Router data-plane fast-path budgets (round 21): with persistent
+# keep-alive connection pools and the streaming relay on, the proxied
+# hop (pooled router p50 minus direct-to-backend p50, both at low
+# concurrency) must price under the budget, and one router process
+# must sustain the rps floor on the cached-GET open-loop drill.  The
+# drill also errors when pooled loses to dial-per-forward (the whole
+# point of the pool), on byte-parity drift across pooled / dialed /
+# direct, or on a missing pool metric family.
+ROUTER_HOP_P50_BUDGET_MS = 0.5
+ROUTER_FASTPATH_MIN_RPS = 10000.0
+
 # Channel-packed backward-tail budget (round 12): the packed path must
 # not run SLOWER than the vmapped path it would replace — a recorded
 # regression (like the r3 prototype's 280-vs-368 img/s) keeps the
@@ -665,6 +676,64 @@ def run_fleet_tail_guard(timeout_s: float = 1800.0) -> dict:
         restored=restore.get("restored"),
         restore_s=restore.get("restore_s"),
         tail_off=tail_off,
+    )
+    # the drill assembles its own violation list against the same
+    # budgets; carry it verbatim — the guard's job is the recorded row
+    if "error" in drill:
+        row["error"] = drill["error"]
+    return row
+
+
+def run_router_fastpath_guard(timeout_s: float = 1800.0) -> dict:
+    """Router data-plane fast-path drill guard (round 21):
+    tools/loopback_load.py --fleet-fastpath — two stub backends behind
+    pooled / dialed / N-worker routers, closed-loop hop pricing plus a
+    Poisson open-loop phase at a fixed offered rate (the closed-loop
+    driver hides queueing collapse; open-loop does not).  Each phase
+    runs the 3-consecutive-trials discipline and keeps the best trial.
+
+    The row fails LOUDLY (`error` field) when:
+    - router hop p50 (pooled-router p50 minus direct-to-backend p50 at
+      low concurrency) >= ROUTER_HOP_P50_BUDGET_MS;
+    - one router process achieves < ROUTER_FASTPATH_MIN_RPS on the
+      cached-GET open-loop phase;
+    - the pooled router loses to the --connection-pool off dialed
+      router at matched concurrency;
+    - byte parity drifts across direct / pooled / dialed over the
+      sampled keys;
+    - any pool metric family is missing from /metrics, or any
+      closed-loop phase records request errors."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {"JAX_PLATFORMS": "cpu"}
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--fleet-fastpath"], timeout_s, env=env
+    )
+    row = {"config": "router-fastpath", "which": "loopback_fleet_fastpath_drill"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    direct = drill.get("direct", {})
+    pooled = drill.get("pooled", {})
+    dialed = drill.get("dialed", {})
+    open_loop = drill.get("open_loop", {})
+    open_workers = drill.get("open_loop_workers", {})
+    row.update(
+        workers=drill.get("workers"),
+        trials=drill.get("trials"),
+        direct_p50_ms=direct.get("p50_ms"),
+        pooled_p50_ms=pooled.get("p50_ms"),
+        dialed_p50_ms=dialed.get("p50_ms"),
+        hop_p50_ms=drill.get("hop_p50_ms"),
+        hop_p50_budget_ms=ROUTER_HOP_P50_BUDGET_MS,
+        pooled_req_s=pooled.get("req_s"),
+        dialed_req_s=dialed.get("req_s"),
+        open_loop_offered_rps=open_loop.get("offered_rps"),
+        open_loop_achieved_rps=open_loop.get("achieved_rps"),
+        open_loop_p99_ms=open_loop.get("p99_ms"),
+        open_loop_workers_achieved_rps=open_workers.get("achieved_rps"),
+        min_rps_budget=ROUTER_FASTPATH_MIN_RPS,
+        parity_ok=drill.get("parity_ok"),
+        pool_metric_families=drill.get("pool_metric_families"),
     )
     # the drill assembles its own violation list against the same
     # budgets; carry it verbatim — the guard's job is the recorded row
@@ -1354,6 +1423,12 @@ def main() -> int:
             # trace-on/off A/B within its 3% budget
             result = run_fleet_trace_guard()
             result["date"] = date
+        elif tok == "router-fastpath":
+            # router data-plane fast-path drill (round 21): pooled vs
+            # dial-per-forward A/B, hop p50 budget, open-loop rps
+            # floor, 1-vs-N-worker scaling, byte parity pinned
+            result = run_router_fastpath_guard()
+            result["date"] = date
         elif tok == "models":
             # multi-model paging drill (round 15): three backbones from
             # one pool under a budget that forces paging + the
@@ -1400,7 +1475,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'models', 'quant', 'aot-boot'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'router-fastpath', 'models', 'quant', 'aot-boot'])}",
             }
         else:
             n = int(tok)

@@ -108,6 +108,25 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "hop_p50_ms" in row:
+        # router fast-path rows (round 21): the hop price vs budget,
+        # open-loop offered-vs-achieved honesty, the pooled-vs-dialed
+        # A/B and the N-worker scaling point in one line; error kept
+        # visible — a busted budget is the row's whole point
+        line = (
+            f"hop p50 {row.get('hop_p50_ms')}ms "
+            f"(budget {row.get('hop_p50_budget_ms', 0.5)}), open-loop "
+            f"{row.get('open_loop_achieved_rps')}/"
+            f"{row.get('open_loop_offered_rps')} rps "
+            f"(floor {row.get('min_rps_budget')}), pooled p50 "
+            f"{row.get('pooled_p50_ms')} vs dialed "
+            f"{row.get('dialed_p50_ms')}ms, {row.get('workers')}w "
+            f"{row.get('open_loop_workers_achieved_rps')} rps, parity="
+            f"{row.get('parity_ok')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "trace_overhead_pct" in row and "hedges_fired" in row:
         # observability-plane rows (round 19): the assembled hedge
         # trace, federation coverage and the trace-on/off overhead in
