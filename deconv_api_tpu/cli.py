@@ -86,6 +86,12 @@ def cmd_fleet_router(args: argparse.Namespace) -> int:
         # round 21 data-plane fast path: pools, relay, REUSEPORT workers
         "workers", "connection_pool", "pool_size", "pool_idle_s",
         "stream_relay_min_bytes",
+        # round 22 closed-loop elasticity: the embedded controller
+        "autoscale", "autoscale_interval_s", "autoscale_min",
+        "autoscale_max", "autoscale_journal", "autoscale_launch_cmd",
+        "autoscale_cooldown_up_s", "autoscale_cooldown_down_s",
+        "autoscale_up_burn", "autoscale_up_queue",
+        "autoscale_qos_budget_ms",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -97,6 +103,29 @@ def cmd_fleet_router(args: argparse.Namespace) -> int:
     for spec in getattr(args, "fault", None) or []:
         argv += ["--fault", spec]
     return fleet_main(argv)
+
+
+def cmd_autoscaler(args: argparse.Namespace) -> int:
+    """The sidecar autoscale controller (round 22,
+    serving/autoscale.py): polls a router's federation plane, decides
+    against QoS budgets with hysteresis, journals every decision, and
+    (enforce mode) acts through a backend launcher.  jax-free, like
+    the router it sizes."""
+    from deconv_api_tpu.serving.autoscale import main as autoscale_main
+
+    argv = ["--router", args.router, "--mode", args.mode]
+    for flag in (
+        "interval_s", "journal", "launch_cmd", "fleet_token",
+        "min_backends", "max_backends", "up_burn", "up_queue",
+        "down_burn", "down_queue", "cooldown_up_s", "cooldown_down_s",
+        "qos_budget_ms",
+    ):
+        val = getattr(args, flag, None)
+        if val is not None:
+            argv += [f"--{flag.replace('_', '-')}", str(val)]
+    if args.once:
+        argv += ["--once"]
+    return autoscale_main(argv)
 
 
 def _load_service(args: argparse.Namespace):
@@ -682,7 +711,99 @@ def main(argv: list[str] | None = None) -> int:
         help="content-length threshold for the chunk-by-chunk response "
         "relay (default 262144; 0 disables)",
     )
+    s.add_argument(
+        "--autoscale", default=None, dest="autoscale",
+        choices=("off", "advisory", "enforce"),
+        help="closed-loop elasticity (round 22): advisory decides and "
+        "journals only; enforce acts via --autoscale-launch-cmd; off "
+        "(default) is byte-identical to the round-21 router",
+    )
+    s.add_argument(
+        "--autoscale-interval-s", type=float, default=None,
+        dest="autoscale_interval_s",
+        help="controller poll/decide interval (default 5)",
+    )
+    s.add_argument(
+        "--autoscale-min", type=int, default=None, dest="autoscale_min",
+        help="fleet size floor (default 1)",
+    )
+    s.add_argument(
+        "--autoscale-max", type=int, default=None, dest="autoscale_max",
+        help="fleet size ceiling (default 4)",
+    )
+    s.add_argument(
+        "--autoscale-journal", default=None, dest="autoscale_journal",
+        metavar="PATH",
+        help="fsync'd JSONL decision journal (replayed on restart)",
+    )
+    s.add_argument(
+        "--autoscale-launch-cmd", default=None,
+        dest="autoscale_launch_cmd",
+        help="backend launch argv template, {port} substituted "
+        "(enforce mode)",
+    )
+    s.add_argument(
+        "--autoscale-cooldown-up-s", type=float, default=None,
+        dest="autoscale_cooldown_up_s",
+        help="minimum seconds between scale-ups (default 30)",
+    )
+    s.add_argument(
+        "--autoscale-cooldown-down-s", type=float, default=None,
+        dest="autoscale_cooldown_down_s",
+        help="minimum seconds between scale-downs (default 120)",
+    )
+    s.add_argument(
+        "--autoscale-up-burn", type=float, default=None,
+        dest="autoscale_up_burn",
+        help="5m SLO burn rate that reads as hot (default 0.9)",
+    )
+    s.add_argument(
+        "--autoscale-up-queue", type=float, default=None,
+        dest="autoscale_up_queue",
+        help="mean per-backend job pressure that reads as hot "
+        "(default 4)",
+    )
+    s.add_argument(
+        "--autoscale-qos-budget-ms", type=float, default=None,
+        dest="autoscale_qos_budget_ms",
+        help="per-backend device-ms/s budget gating scale-down "
+        "(default 800)",
+    )
     s.set_defaults(fn=cmd_fleet_router)
+
+    s = sub.add_parser(
+        "autoscaler",
+        help="sidecar autoscale controller over a router's federation "
+        "plane (round 22; the router can also embed it: fleet-router "
+        "--autoscale)",
+    )
+    s.add_argument(
+        "--router", required=True, metavar="HOST:PORT",
+        help="router whose /v1/metrics/fleet surface to poll",
+    )
+    s.add_argument(
+        "--mode", choices=("advisory", "enforce"), default="advisory",
+        help="advisory: decide+journal only; enforce: act via "
+        "--launch-cmd",
+    )
+    s.add_argument("--interval-s", type=float, default=None)
+    s.add_argument("--journal", default=None, metavar="PATH")
+    s.add_argument("--launch-cmd", default=None)
+    s.add_argument("--fleet-token", default=None)
+    s.add_argument("--min-backends", type=int, default=None)
+    s.add_argument("--max-backends", type=int, default=None)
+    s.add_argument("--up-burn", type=float, default=None)
+    s.add_argument("--up-queue", type=float, default=None)
+    s.add_argument("--down-burn", type=float, default=None)
+    s.add_argument("--down-queue", type=float, default=None)
+    s.add_argument("--cooldown-up-s", type=float, default=None)
+    s.add_argument("--cooldown-down-s", type=float, default=None)
+    s.add_argument("--qos-budget-ms", type=float, default=None)
+    s.add_argument(
+        "--once", action="store_true",
+        help="single tick; print the decision as JSON and exit",
+    )
+    s.set_defaults(fn=cmd_autoscaler)
 
     s = sub.add_parser("visualize", help="deconv visualization of one image")
     s.add_argument("--image", required=True)

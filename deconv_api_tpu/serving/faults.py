@@ -74,6 +74,13 @@ SITES = (
     "fleet.body_trickle",      # body trickles (:param ms per 64 KiB)
     "fleet.torn_body",         # response torn mid-body (infra failure)
     "fleet.blackhole",         # backend accepts, never answers (timeout)
+    # Autoscale control-plane sites (round 22, serving/autoscale.py):
+    # the controller's failure contract is fail-STATIC — a crashing
+    # decision loop degrades to no-op (autoscaler_errors_total, fleet
+    # keeps its size), a failed launch retries with backoff without
+    # ever double-counting fleet capacity.  Both are drill-armable.
+    "autoscale.decision_error",  # decision tick raises mid-evaluation
+    "autoscale.launch_fail",     # backend launch attempt fails
 )
 
 

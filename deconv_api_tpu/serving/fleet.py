@@ -1329,6 +1329,8 @@ class FleetRouter:
         pool_size: int = 8,
         pool_idle_s: float = 30.0,
         stream_relay_min_bytes: int = 262144,
+        autoscale: str = "off",
+        autoscale_opts: dict | None = None,
         worker: int | None = None,
         metrics: Metrics | None = None,
         clock: Callable[[], float] = time.monotonic,
@@ -1526,6 +1528,29 @@ class FleetRouter:
         # into the membership file so peers that DO know them converge.
         # Bounded; token-authenticated callers only.
         self._foreign_drains: OrderedDict[str, None] = OrderedDict()
+        # closed-loop elasticity (round 22): off is the escape hatch —
+        # no controller object, no arrival recording, no config/readyz
+        # block, no metric families; the router is byte-identical to
+        # the round-21 dialect (the tail_tolerance/hot_keys precedent).
+        if autoscale not in ("off", "advisory", "enforce"):
+            raise ValueError(
+                f"autoscale={autoscale!r}: expected off|advisory|enforce"
+            )
+        if autoscale == "off":
+            self.autoscaler = None
+        else:
+            from deconv_api_tpu.serving.autoscale import (
+                AutoscaleController,
+            )
+
+            self.autoscaler = AutoscaleController(
+                mode=autoscale,
+                router=self,
+                fleet_token=fleet_token,
+                faults=self.faults,
+                clock=clock,
+                **(autoscale_opts or {}),
+            )
 
         self.server = HttpServer(
             idle_timeout_s=idle_timeout_s,
@@ -3043,6 +3068,16 @@ class FleetRouter:
             return Response.json(
                 {"error": f"no route for {req.path}"}, 404
             )
+        if self.autoscaler is not None:
+            # round 22: one O(1) bucket increment feeds the predictive
+            # pre-scaler's per-tenant arrival history (identity per the
+            # qos.py rule: x-api-key wins over x-tenant; cardinality is
+            # bounded inside ArrivalHistory)
+            self.autoscaler.record_arrival(
+                req.headers.get("x-api-key")
+                or req.headers.get("x-tenant")
+                or "default"
+            )
         tr = self._new_trace(req)
         if req.deadline is not None and (
             req.deadline - time.perf_counter() <= 0.01
@@ -3768,6 +3803,11 @@ class FleetRouter:
                 t.name: {**t.snapshot(), "ok": t.burn_rates()["5m"] <= 1.0}
                 for t in self.slos
             }
+        if self.autoscaler is not None:
+            # round 22: the elasticity signal summary — what the
+            # controller last saw and decided, on the same probe an
+            # operator already reads
+            body["autoscale"] = self.autoscaler.ready_block()
         return Response.json(body, status=200 if ok else 503)
 
     async def _config(self, _req: Request) -> Response:
@@ -3847,6 +3887,14 @@ class FleetRouter:
                 **(
                     {"faults_state": self.faults.snapshot()}
                     if self.faults is not None
+                    else {}
+                ),
+                # round 22: the autoscale knob block — ABSENT when off
+                # (the byte-identity pin: a round-21 reader sees the
+                # exact round-21 document)
+                **(
+                    {"autoscale": self.autoscaler.config_block()}
+                    if self.autoscaler is not None
                     else {}
                 ),
                 "members": {
@@ -4168,6 +4216,11 @@ class FleetRouter:
             # aggregates + ring occupancy, the backend precedent
             text += self.recorder.prometheus("router")
         text += slo_prometheus(self.slos, "router")
+        if self.autoscaler is not None:
+            # round 22: the controller's own registry (autoscaler_*
+            # families) rides the router scrape — decisions land on the
+            # same federation plane they were made from
+            text += self.autoscaler.metrics.prometheus()
         if self.worker is not None:
             # SO_REUSEPORT multi-router (round 21): every sample line
             # carries worker="N" so the federation plane's sum over
@@ -4194,6 +4247,8 @@ class FleetRouter:
         # first request instead of waiting out a probe interval
         await self.probe_once()
         self._probe_task = asyncio.create_task(self._probe_loop())
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         return bound
 
     def begin_drain(self) -> None:
@@ -4202,6 +4257,8 @@ class FleetRouter:
 
     async def stop(self, grace_s: float = 5.0) -> None:
         self.begin_drain()
+        if self.autoscaler is not None:
+            await self.autoscaler.stop()
         if self._probe_task is not None:
             self._probe_task.cancel()
             try:
@@ -4431,6 +4488,57 @@ def main(argv: list[str] | None = None) -> int:
         "('name=<threshold_ms>:<objective_pct>[:<route>]'): burn-rate "
         "gauges on /metrics + an slo block on /readyz (default none)",
     )
+    p.add_argument(
+        "--autoscale", choices=("off", "advisory", "enforce"),
+        default="off",
+        help="closed-loop elasticity (round 22): advisory decides and "
+        "journals only; enforce acts via --autoscale-launch-cmd; off "
+        "(default) is byte-identical to the round-21 router",
+    )
+    p.add_argument(
+        "--autoscale-interval-s", type=float, default=5.0,
+        help="controller poll/decide interval (default 5)",
+    )
+    p.add_argument(
+        "--autoscale-min", type=int, default=1,
+        help="floor the controller never scales below (default 1)",
+    )
+    p.add_argument(
+        "--autoscale-max", type=int, default=4,
+        help="ceiling the controller never scales above (default 4)",
+    )
+    p.add_argument(
+        "--autoscale-journal", default="", metavar="PATH",
+        help="fsync'd JSONL decision journal (replayed on restart to "
+        "restore cooldown anchors)",
+    )
+    p.add_argument(
+        "--autoscale-launch-cmd", default="",
+        help="backend launch argv template, {port} substituted "
+        "(enforce mode; empty = advisory launcher)",
+    )
+    p.add_argument(
+        "--autoscale-cooldown-up-s", type=float, default=30.0,
+        help="minimum seconds between scale-ups (default 30)",
+    )
+    p.add_argument(
+        "--autoscale-cooldown-down-s", type=float, default=120.0,
+        help="minimum seconds between scale-downs (default 120)",
+    )
+    p.add_argument(
+        "--autoscale-up-burn", type=float, default=0.9,
+        help="5m SLO burn rate that reads as hot (default 0.9)",
+    )
+    p.add_argument(
+        "--autoscale-up-queue", type=float, default=4.0,
+        help="mean per-backend job pressure that reads as hot "
+        "(default 4)",
+    )
+    p.add_argument(
+        "--autoscale-qos-budget-ms", type=float, default=800.0,
+        help="per-backend device-ms/s capacity budget gating "
+        "scale-down (default 800)",
+    )
     args = p.parse_args(argv)
     if args.slo:
         try:
@@ -4458,6 +4566,12 @@ def main(argv: list[str] | None = None) -> int:
             parse_fault_specs(faults_spec)
         except ValueError as e:
             p.error(str(e))
+    if args.autoscale != "off" and args.workers > 1:
+        # N SO_REUSEPORT workers would mean N independent controllers
+        # sizing one fleet — run the controller as a sidecar instead
+        # (deconv-api-tpu autoscaler) when the data plane is multi-worker
+        p.error("--autoscale requires --workers 1 (use the autoscaler "
+                "sidecar with a multi-worker router)")
     def _build(worker: int | None = None) -> FleetRouter:
         return FleetRouter(
             backends,
@@ -4493,6 +4607,21 @@ def main(argv: list[str] | None = None) -> int:
             pool_size=args.pool_size,
             pool_idle_s=args.pool_idle_s,
             stream_relay_min_bytes=args.stream_relay_min_bytes,
+            autoscale=args.autoscale,
+            autoscale_opts={
+                "interval_s": args.autoscale_interval_s,
+                "journal_path": args.autoscale_journal,
+                "launch_cmd": args.autoscale_launch_cmd,
+                "engine_opts": {
+                    "min_backends": args.autoscale_min,
+                    "max_backends": args.autoscale_max,
+                    "cooldown_up_s": args.autoscale_cooldown_up_s,
+                    "cooldown_down_s": args.autoscale_cooldown_down_s,
+                    "up_burn": args.autoscale_up_burn,
+                    "up_queue": args.autoscale_up_queue,
+                    "qos_device_ms_budget": args.autoscale_qos_budget_ms,
+                },
+            },
             worker=worker,
         )
 

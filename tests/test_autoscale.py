@@ -1,0 +1,965 @@
+"""Closed-loop elasticity tests (round 22).
+
+Covers serving/autoscale.py and its fleet.py embedding: the
+federation-payload signal parse (against a REAL ``_metrics_fleet``
+splice, not a hand-written fixture), the decision engine's hysteresis
+and cooldowns under an injected clock (a flapping signal must never
+flap the fleet), the QoS-budget scale-down gate, predictive pre-scale
+from per-tenant arrival history, the fsync'd decision journal (torn
+tail, replay, cooldown restoration across restarts), the jobs-aware
+reap gate (a drain-announced backend holding running/parked jobs is
+NEVER reaped — the round-22 fix, pinned), boot-to-first-warm-hit
+measurement and its timeout, the ``autoscale.decision_error`` /
+``autoscale.launch_fail`` chaos sites (fail-static decision loop;
+launch retries with backoff that never double-count fleet size), the
+exposition lint over every new ``autoscaler_*`` family, the
+``--autoscale off`` escape hatch pinning the PR-16 surface, and a
+zero-loss scale-down e2e over real subprocess backends.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from deconv_api_tpu.serving import autoscale, fleet
+from deconv_api_tpu.serving.autoscale import (
+    ArrivalHistory,
+    AutoscaleController,
+    BackendLauncher,
+    Decision,
+    DecisionEngine,
+    DecisionJournal,
+    FleetSignals,
+    LaunchError,
+    LaunchedBackend,
+    parse_exposition,
+)
+from deconv_api_tpu.serving.faults import FaultRegistry
+from deconv_api_tpu.serving.fleet import FleetRouter
+from deconv_api_tpu.serving.http import Request
+from deconv_api_tpu.serving.metrics import Metrics
+from tests.test_metrics_exposition import lint_exposition
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _backend_exposition(
+    jobs_active: float = 0.0, l2_hits: float = 0.0, device_ms=None
+) -> str:
+    """A canned backend /v1/metrics body built through the REAL
+    registry, so it carries the TYPE headers the federation splice
+    keys on."""
+    m = Metrics(prefix="deconv", core=False)
+    m.set_gauge("jobs_active", jobs_active)
+    m.inc_counter("cache_l2_hits_total", int(l2_hits))
+    for tenant, ms in (device_ms or {}).items():
+        m.inc_labeled(
+            "tenant_device_ms_total", ("tenant", "class"),
+            (tenant, "interactive"), int(ms),
+        )
+    return m.prometheus()
+
+
+def _script(monkeypatch, expositions: dict, jobs=None):
+    """raw_request stand-in serving probe + scrape + jobs surfaces for
+    a set of fake backends."""
+    jobs = jobs or {}
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        if target == "/readyz":
+            return 200, {}, json.dumps({"ready": True}).encode()
+        if target == "/v1/metrics":
+            return 200, {}, expositions[name].encode()
+        if target == "/v1/jobs":
+            counts = jobs.get(name, {"running": 0, "parked": 0})
+            return 200, {}, json.dumps({"counts": counts}).encode()
+        return 200, {}, b"{}"
+
+    monkeypatch.setattr(fleet, "raw_request", fake)
+
+
+def _sig(queue=None, burn=0.0, scrape_ok=None, device_ms=None,
+         warm=None) -> FleetSignals:
+    s = FleetSignals()
+    s.queue_depth = dict(queue or {})
+    s.scrape_ok = scrape_ok if scrape_ok is not None else {
+        b: True for b in s.queue_depth
+    }
+    if burn:
+        s.burn[("api", "5m")] = burn
+    s.device_ms = dict(device_ms or {})
+    s.warm_hits = dict(warm or {})
+    return s
+
+
+class _RecLauncher(BackendLauncher):
+    """Recording launcher: launches mint names, reaps are remembered."""
+
+    def __init__(self, fail_first: int = 0):
+        self.launches = 0
+        self.fail_first = fail_first
+        self.reaps: list[str] = []
+        self.procs: dict[str, object] = {}
+
+    async def launch(self) -> LaunchedBackend:
+        if self.launches < self.fail_first:
+            self.launches += 1
+            raise LaunchError("boom")
+        self.launches += 1
+        return LaunchedBackend(f"b{self.launches}:9{self.launches:03d}")
+
+    async def reap(self, name: str, handle=None) -> None:
+        self.reaps.append(name)
+
+
+# ------------------------------------------------------------- parsing
+
+
+def test_parse_exposition_forgiving():
+    text = "\n".join([
+        "# HELP x_total help",
+        "# TYPE x_total counter",
+        "x_total 3",
+        'y{backend="b0:8000",slo="api"} 1.5',
+        "not a metric line @@",
+        "z_bad_value nope",
+        'esc{name="a\\"b"} 2',
+        "",
+    ])
+    out = parse_exposition(text)
+    assert ("x_total", {}, 3.0) in out
+    assert ("y", {"backend": "b0:8000", "slo": "api"}, 1.5) in out
+    assert ("esc", {"name": 'a"b'}, 2.0) in out
+    assert all(fam != "z_bad_value" for fam, _l, _v in out)
+
+
+def test_signals_from_real_federation_payload(monkeypatch):
+    """FleetSignals digests the ACTUAL ``_metrics_fleet`` splice: the
+    backend label added by the router, the fleet_scrape_ok gauges, and
+    the per-backend queue/warm-hit/device-ms families."""
+    clock = _FakeClock()
+    router = FleetRouter(["b0:8000", "b1:8001"], clock=clock)
+    _script(monkeypatch, {
+        "b0:8000": _backend_exposition(
+            jobs_active=5, l2_hits=7, device_ms={"acme": 900}
+        ),
+        "b1:8001": _backend_exposition(jobs_active=1),
+    })
+
+    async def go():
+        await router.probe_once()
+        resp = await router._metrics_fleet(None)
+        s = FleetSignals.from_exposition(resp.body.decode())
+        assert s.queue_depth == {"b0:8000": 5.0, "b1:8001": 1.0}
+        assert s.scrape_ok == {"b0:8000": True, "b1:8001": True}
+        assert s.backends_scraped == 2
+        assert s.warm_hits["b0:8000"] == 7.0
+        assert s.device_ms["acme"] == 900.0
+        assert s.queue_mean() == 3.0
+
+    asyncio.run(go())
+
+
+def test_signals_burn_takes_worst_worker_and_skips_failed_scrapes():
+    text = "\n".join([
+        'router_slo_burn_rate{slo="api",window="5m"} 0.4',
+        'router_slo_burn_rate{slo="api",window="5m"} 1.2',
+        'router_slo_burn_rate{slo="api",window="1h"} 0.1',
+        'deconv_jobs_active{backend="b0:8000"} 8',
+        'deconv_jobs_active{backend="b1:8001"} 100',
+        'fleet_scrape_ok{backend="b0:8000"} 1',
+        'fleet_scrape_ok{backend="b1:8001"} 0',
+    ])
+    s = FleetSignals.from_exposition(text)
+    # N SO_REUSEPORT workers export one burn gauge each: worst wins
+    assert s.burn_max("5m") == 1.2
+    assert s.burn_max("1h") == 0.1
+    # b1's splice came from a stale cache (scrape_ok 0): its queue
+    # number must not drag the mean
+    assert s.queue_mean() == 8.0
+
+
+# ------------------------------------------------------------ arrivals
+
+
+def test_arrival_history_bounds_and_rate():
+    clock = _FakeClock()
+    h = ArrivalHistory(
+        bucket_s=1.0, max_buckets=4, max_tenants=2, clock=clock
+    )
+    for _ in range(10):
+        h.record("a")
+    h.record("b")
+    h.record("overflow-1")  # third tenant folds to "other"
+    clock.t += 1.0
+    assert h.rate(1) == 12.0
+    bucket = h._buckets[int(1000.0)]
+    assert set(bucket) == {"a", "b", "other"}
+    for i in range(6):  # only 4 buckets survive
+        clock.t += 1.0
+        h.record("a")
+    assert len(h._buckets) <= 4
+
+
+def test_arrival_forecast_sees_a_ramp():
+    clock = _FakeClock()
+    h = ArrivalHistory(bucket_s=1.0, clock=clock)
+    for n in (2, 4, 8, 12, 16, 20):  # steady climb
+        for _ in range(n):
+            h.record("t")
+        clock.t += 1.0
+    cur, projected = h.forecast(horizon_s=10.0)
+    assert cur > 0
+    assert projected > 2 * cur  # slope extrapolated well past current
+
+
+# ------------------------------------------------------------- journal
+
+
+def test_journal_append_replay_and_torn_tail(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = DecisionJournal(path)
+    j.append({"action": "up", "clock": 5.0})
+    j.append({"action": "down", "clock": 9.0})
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"action": "up", "cl')  # crash mid-append
+    recs = DecisionJournal.replay(path)
+    assert [r["action"] for r in recs] == ["up", "down"]
+    assert DecisionJournal.replay(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_engine_restore_clamps_foreign_clock():
+    eng = DecisionEngine(clock=_FakeClock())
+    eng.restore(
+        [{"action": "up", "clock": 500.0},
+         {"action": "down", "clock": 99999.0},  # previous monotonic epoch
+         {"action": "up"}],  # no clock: ignored
+        now=1000.0,
+    )
+    assert eng.last_up_ts == 500.0
+    # a future timestamp clamps to now: full cooldown after restart,
+    # never a skipped one
+    assert eng.last_down_ts == 1000.0
+
+
+# -------------------------------------------------------------- engine
+
+
+def test_engine_flapping_signal_never_flaps():
+    clock = _FakeClock()
+    eng = DecisionEngine(
+        up_queue=4.0, down_queue=0.5, up_consecutive=2,
+        down_consecutive=3, clock=clock,
+    )
+    hot = _sig(queue={"b0": 10.0})
+    cold = _sig(queue={"b0": 0.0})
+    for i in range(12):  # strict alternation: streaks never build
+        d = eng.evaluate(hot if i % 2 == 0 else cold, 2)
+        assert d.action == "hold"
+        clock.t += 5.0
+
+
+def test_engine_up_after_sustained_hot_then_cooldown():
+    clock = _FakeClock()
+    eng = DecisionEngine(
+        up_queue=4.0, up_consecutive=2, cooldown_up_s=30.0,
+        max_backends=4, clock=clock,
+    )
+    hot = _sig(queue={"b0": 10.0})
+    assert eng.evaluate(hot, 1).action == "hold"
+    clock.t += 5.0
+    d = eng.evaluate(hot, 1)
+    assert (d.action, d.reason) == ("up", "queue")
+    # still hot, but inside the up cooldown: hysteresis holds
+    for _ in range(2):
+        clock.t += 5.0
+        d = eng.evaluate(hot, 2)
+    assert (d.action, d.reason) == ("hold", "cooldown-up")
+    # cooldown expired and the signal is STILL hot: the streak kept
+    # building through the held polls, so the next evaluation fires
+    clock.t += 35.0
+    assert eng.evaluate(hot, 2).action == "up"
+
+
+def test_engine_burn_signal_scales_up():
+    clock = _FakeClock()
+    eng = DecisionEngine(up_burn=0.9, up_consecutive=1, clock=clock)
+    d = eng.evaluate(_sig(queue={"b0": 0.0}, burn=1.5), 1)
+    assert (d.action, d.reason) == ("up", "burn")
+
+
+def test_engine_up_respects_max_and_counts_pending():
+    clock = _FakeClock()
+    eng = DecisionEngine(
+        up_queue=4.0, up_consecutive=1, max_backends=3, clock=clock
+    )
+    hot = _sig(queue={"b0": 10.0})
+    # 2 live + 1 pending launch == max: a hot signal must NOT stack
+    # another launch on top (the no-double-count contract)
+    d = eng.evaluate(hot, 2, pending=1)
+    assert (d.action, d.reason) == ("hold", "at-max")
+
+
+def test_engine_down_gates_and_qos_budget():
+    clock = _FakeClock()
+    eng = DecisionEngine(
+        down_queue=0.5, down_consecutive=3, cooldown_down_s=60.0,
+        cooldown_up_s=1.0, min_backends=1, max_backends=4,
+        qos_device_ms_budget=800.0, clock=clock,
+    )
+    # at-min: a 1-backend fleet never scales to zero
+    for _ in range(3):
+        d = eng.evaluate(_sig(queue={"b0": 0.0}), 1)
+        clock.t += 5.0
+    assert (d.action, d.reason) == ("hold", "at-min")
+
+    # up-recent: capacity added moments ago is not yet proven surplus
+    eng2 = DecisionEngine(
+        down_queue=0.5, down_consecutive=2, cooldown_down_s=60.0,
+        clock=clock,
+    )
+    eng2.last_up_ts = clock.t - 10.0
+    for _ in range(2):
+        d = eng2.evaluate(_sig(queue={"b0": 0.0}), 3)
+        clock.t += 5.0
+    assert (d.action, d.reason) == ("hold", "up-recent")
+
+    # qos budget: measured demand must fit on N-1 backends
+    eng3 = DecisionEngine(
+        down_queue=0.5, down_consecutive=2, cooldown_down_s=1.0,
+        qos_device_ms_budget=800.0, clock=clock,
+    )
+    cold0 = _sig(queue={"b0": 0.0}, device_ms={"acme": 0.0})
+    eng3.evaluate(cold0, 3)
+    clock.t += 5.0
+    # 10000 device-ms over 5s = 2000 ms/s; on 2 backends that is
+    # 1000 ms/s each — over the 800 budget, the down is refused
+    d = eng3.evaluate(
+        _sig(queue={"b0": 0.0}, device_ms={"acme": 10000.0}), 3
+    )
+    assert (d.action, d.reason) == ("hold", "qos-budget")
+    clock.t += 5.0
+    # demand stops (delta 0): the same fleet may now shrink
+    d = eng3.evaluate(
+        _sig(queue={"b0": 0.0}, device_ms={"acme": 10000.0}), 3
+    )
+    assert (d.action, d.reason) == ("down", "idle")
+
+
+def test_engine_predictive_prescale():
+    clock = _FakeClock()
+    h = ArrivalHistory(bucket_s=1.0, clock=clock)
+    for n in (4, 8, 16, 24, 32, 40):
+        for _ in range(n):
+            h.record("t")
+        clock.t += 1.0
+    eng = DecisionEngine(
+        up_queue=100.0, cooldown_up_s=30.0, predict_horizon_s=10.0,
+        predict_ramp=2.0, predict_min_rate=1.0, clock=clock,
+    )
+    quiet = _sig(queue={"b0": 0.6})  # not hot, not cold
+    d = eng.evaluate(quiet, 1, arrivals=h)
+    assert (d.action, d.reason) == ("up", "predictive")
+    assert d.detail["projected"] >= 2 * d.detail["rate"]
+    # the predictive up armed the SAME cooldown a reactive up would:
+    # the ramp continuing must not launch a second backend per poll
+    clock.t += 1.0
+    assert eng.evaluate(quiet, 2, arrivals=h).action == "hold"
+
+
+# ---------------------------------------------------------- controller
+
+
+def _advisory_router(monkeypatch, clock, **opts):
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], clock=clock, autoscale="advisory",
+        autoscale_opts=opts, slos="api=250:99",
+    )
+    _script(monkeypatch, {
+        "b0:8000": _backend_exposition(jobs_active=0),
+        "b1:8001": _backend_exposition(jobs_active=0),
+    })
+    return router
+
+
+def test_embedded_tick_surfaces(monkeypatch, tmp_path):
+    clock = _FakeClock()
+    jpath = str(tmp_path / "j.jsonl")
+    router = _advisory_router(
+        monkeypatch, clock, journal_path=jpath,
+        engine_opts={"up_queue": 3.0, "up_consecutive": 1},
+    )
+    ctl = router.autoscaler
+
+    async def go():
+        await router.probe_once()
+        await ctl.tick()
+        rb = ctl.ready_block()
+        assert rb["mode"] == "advisory" and rb["ticks"] == 1
+        assert rb["last_decision"]["action"] == "hold"
+        assert ctl.metrics.snapshot()["gauges"]["fleet_size"] == 2
+        cfg = json.loads((await router._config(None)).body)
+        assert cfg["autoscale"]["mode"] == "advisory"
+        assert cfg["autoscale"]["journal"] == jpath
+        ready = json.loads((await router._readyz(None)).body)
+        assert ready["autoscale"]["ticks"] == 1
+        # the autoscaler families ride the router's /v1/metrics route
+        text = (await router._metrics_route(None)).body.decode()
+        assert "autoscaler_decisions_total" in text
+        # advisory + hot signal: the decision is journaled and counted
+        # but NOTHING is acted on
+        _script(monkeypatch, {
+            "b0:8000": _backend_exposition(jobs_active=50),
+            "b1:8001": _backend_exposition(jobs_active=50),
+        })
+        await ctl.tick()
+        assert ctl._last_decision["action"] == "up"
+        assert ctl.metrics.labeled("decisions_total")[("up", "queue")] == 1
+        assert not ctl.pending and isinstance(
+            ctl.launcher, autoscale.AdvisoryLauncher
+        )
+        recs = DecisionJournal.replay(jpath)
+        assert any(r.get("action") == "up" for r in recs)
+
+    asyncio.run(go())
+
+
+def test_router_arrival_hook_uses_tenant_identity(monkeypatch):
+    clock = _FakeClock()
+    router = _advisory_router(monkeypatch, clock)
+    ctl = router.autoscaler
+
+    async def forward(host, port, method, target, headers, body, timeout_s):
+        return 200, {}, b"{}"
+
+    async def go():
+        await router.probe_once()
+        monkeypatch.setattr(fleet, "raw_request", forward)
+        for headers in (
+            {"x-api-key": "k1"},
+            {"x-api-key": "k1", "x-tenant": "ignored"},  # api-key wins
+            {"x-tenant": "t2"},
+            {},
+        ):
+            await router._proxy(Request(
+                method="POST", path="/v1/deconv", query={},
+                headers={
+                    "content-type": "application/x-www-form-urlencoded",
+                    **headers,
+                },
+                body=b"layer=c3&file=a", id="rid-as",
+            ))
+        bucket = ctl.arrivals._buckets[int(clock.t / ctl.arrivals.bucket_s)]
+        assert bucket == {"k1": 2, "t2": 1, "default": 1}
+
+    asyncio.run(go())
+
+
+def test_decision_error_fails_static(monkeypatch):
+    """The ``autoscale.decision_error`` chaos site: a crashing decision
+    loop degrades to a no-op tick — errors counted, fleet untouched,
+    next tick clean."""
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000"], clock=clock, autoscale="enforce",
+        autoscale_opts={"launcher": _RecLauncher()},
+        fault_injection=True,
+    )
+    _script(monkeypatch, {"b0:8000": _backend_exposition(jobs_active=99)})
+    ctl = router.autoscaler
+    assert ctl.faults is router.faults
+
+    async def go():
+        await router.probe_once()
+        router.faults.arm("autoscale.decision_error", "n1")
+        await ctl.tick()
+        assert ctl.metrics.counter("errors_total") == 1
+        assert ctl._last_decision is None  # never reached evaluation
+        assert not ctl.pending and not ctl.launcher.launches
+        # site self-disarmed: the next tick decides normally
+        await ctl.tick()
+        assert ctl.metrics.counter("errors_total") == 1
+        assert ctl._last_decision is not None
+
+    asyncio.run(go())
+
+
+def test_launch_fail_retries_without_double_count(monkeypatch):
+    clock = _FakeClock()
+    launcher = _RecLauncher()
+    router = FleetRouter(
+        ["b0:8000"], clock=clock, autoscale="enforce",
+        autoscale_opts={"launcher": launcher, "retry_backoff_s": 0.0},
+        fault_injection=True,
+    )
+    ctl = router.autoscaler
+
+    async def go():
+        router.faults.arm("autoscale.launch_fail", "n1")
+        await ctl._scale_up(Decision("up", "queue"))
+        assert ctl.metrics.counter("launch_failures_total") == 1
+        assert len(ctl.pending) == 1  # retry succeeded, ONE backend
+        assert launcher.launches == 1
+        # a second up while one launch is pending must not stack
+        await ctl._scale_up(Decision("up", "queue"))
+        assert len(ctl.pending) == 1 and launcher.launches == 1
+
+    asyncio.run(go())
+
+
+def test_launch_fail_exhaustion_counts_error(monkeypatch, tmp_path):
+    clock = _FakeClock()
+    launcher = _RecLauncher(fail_first=99)
+    jpath = str(tmp_path / "j.jsonl")
+    router = FleetRouter(
+        ["b0:8000"], clock=clock, autoscale="enforce",
+        autoscale_opts={
+            "launcher": launcher, "retry_backoff_s": 0.0,
+            "launch_retries": 2, "journal_path": jpath,
+        },
+    )
+    ctl = router.autoscaler
+
+    async def go():
+        await ctl._scale_up(Decision("up", "queue"))
+        assert ctl.metrics.counter("launch_failures_total") == 3
+        assert ctl.metrics.counter("errors_total") == 1
+        assert not ctl.pending  # failed capacity is NEVER counted
+        fails = [
+            r for r in DecisionJournal.replay(jpath)
+            if r.get("kind") == "launch_failed"
+        ]
+        assert [f["attempt"] for f in fails] == [0, 1, 2]
+
+    asyncio.run(go())
+
+
+def test_boot_to_warm_measured_and_timeout(monkeypatch):
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000", "b2:8002"], clock=clock, autoscale="enforce",
+        autoscale_opts={"launcher": _RecLauncher(), "warm_timeout_s": 60.0},
+    )
+    _script(monkeypatch, {
+        "b0:8000": _backend_exposition(),
+        "b2:8002": _backend_exposition(),
+    })
+    ctl = router.autoscaler
+
+    async def go():
+        await router.probe_once()
+        ctl.pending["b2:8002"] = LaunchedBackend(
+            "b2:8002", t_launch=clock.t
+        )
+        # registered (in ring) but no warm hit yet: the clock keeps
+        # running
+        ctl._check_pending_warm(_sig(queue={}))
+        assert "b2:8002" in ctl.pending
+        clock.t += 2.5
+        ctl._check_pending_warm(_sig(queue={}, warm={"b2:8002": 3.0}))
+        assert "b2:8002" not in ctl.pending
+        series = ctl.metrics.hist_series("boot_to_warm_seconds")
+        (_, h), = series.items()
+        assert h["count"] == 1 and abs(h["sum"] - 2.5) < 1e-6
+
+        # never-warm: past the timeout the launch is written off loudly
+        ctl.pending["b2:8002"] = LaunchedBackend(
+            "b2:8002", t_launch=clock.t
+        )
+        clock.t += 61.0
+        ctl._check_pending_warm(_sig(queue={}))
+        assert not ctl.pending
+        assert ctl.metrics.counter("errors_total") == 1
+
+    asyncio.run(go())
+
+
+# ----------------------------------------------------------- reap gate
+
+
+def test_reap_gate_blocks_on_running_and_parked_jobs(monkeypatch, tmp_path):
+    """The round-22 fix, pinned: a drain-announced backend whose jobs
+    tier still shows running/parked jobs is NEVER reaped — the watcher
+    gives up loudly (reap_blocked) and the process keeps running."""
+    clock = _FakeClock()
+    launcher = _RecLauncher()
+    jpath = str(tmp_path / "j.jsonl")
+    router = FleetRouter(
+        ["b0:8000", "b1:8001"], clock=clock, autoscale="enforce",
+        autoscale_opts={
+            "launcher": launcher, "drain_grace_s": 0.2,
+            "drain_settle_s": 0.0, "interval_s": 0.02,
+            "journal_path": jpath,
+        },
+    )
+    ctl = router.autoscaler
+    launcher.procs["b1:8001"] = object()  # owned: preferred victim
+    jobs_counts = {"running": 1, "parked": 1}
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        name = f"{host}:{port}"
+        if target == "/readyz":
+            return 200, {}, json.dumps({"ready": True}).encode()
+        if target == "/v1/jobs":
+            clock.t += 0.06  # advance the gate's deadline clock
+            return 200, {}, json.dumps(
+                {"counts": dict(jobs_counts)}
+            ).encode()
+        return 200, {}, b"{}"
+
+    monkeypatch.setattr(fleet, "raw_request", fake)
+
+    async def go():
+        await router.probe_once()
+        ctl._last_signals = _sig(
+            queue={"b0:8000": 0.0, "b1:8001": 0.0},
+            scrape_ok={"b0:8000": True, "b1:8001": True},
+        )
+        await ctl._scale_down(Decision("down", "idle"))
+        assert "b1:8001" in ctl.draining
+        m = router.members["b1:8001"]
+        assert m.announced_drain  # no new keyed traffic from here on
+        await ctl.draining["b1:8001"]
+        # the gate held: blocked, not reaped, process untouched
+        assert ctl.metrics.counter("reap_blocked_total") == 1
+        assert ctl.metrics.counter("reaped_total") == 0
+        assert launcher.reaps == []
+        kinds = [r["kind"] for r in DecisionJournal.replay(jpath)]
+        assert kinds == ["drain_announced", "reap_blocked"]
+
+        # jobs drained (terminal/re-claimed): the SAME backend now reaps
+        jobs_counts.update(running=0, parked=0)
+        await ctl._drain_and_reap("b1:8001")
+        assert launcher.reaps == ["b1:8001"]
+        assert ctl.metrics.counter("reaped_total") == 1
+
+    asyncio.run(go())
+
+
+def test_jobs_gate_never_reaps_on_a_guess(monkeypatch):
+    clock = _FakeClock()
+    router = FleetRouter(
+        ["b0:8000"], clock=clock, autoscale="enforce",
+        autoscale_opts={"launcher": _RecLauncher()},
+    )
+    ctl = router.autoscaler
+
+    async def go():
+        async def err(host, port, *a, **kw):
+            raise fleet._BackendError("unreachable")
+
+        monkeypatch.setattr(fleet, "raw_request", err)
+        assert await ctl._jobs_clear("b0:8000") is False
+
+        async def bad_status(host, port, *a, **kw):
+            return 503, {}, b"{}"
+
+        monkeypatch.setattr(fleet, "raw_request", bad_status)
+        assert await ctl._jobs_clear("b0:8000") is False
+
+        async def malformed(host, port, *a, **kw):
+            return 200, {}, b"not json"
+
+        monkeypatch.setattr(fleet, "raw_request", malformed)
+        assert await ctl._jobs_clear("b0:8000") is False
+
+        async def clear(host, port, *a, **kw):
+            return 200, {}, json.dumps(
+                {"counts": {"running": 0, "parked": 0, "queued": 4}}
+            ).encode()
+
+        monkeypatch.setattr(fleet, "raw_request", clear)
+        assert await ctl._jobs_clear("b0:8000") is True
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------ restart replay
+
+
+def test_journal_replay_restores_cooldowns_on_restart(tmp_path):
+    jpath = str(tmp_path / "j.jsonl")
+    j = DecisionJournal(jpath)
+    j.append({
+        "kind": "decision", "action": "up", "reason": "queue",
+        "clock": 900.0,
+    })
+    j.close()
+    clock = _FakeClock(1000.0)
+    ctl = AutoscaleController(
+        mode="advisory", router_addr="127.0.0.1:1",
+        journal_path=jpath, clock=clock,
+        engine_opts={"cooldown_up_s": 300.0},
+    )
+    # the restarted engine remembers the up at t=900: a down decision
+    # at t=1000 is still inside the up-recent window
+    assert ctl.engine.last_up_ts == 900.0
+
+
+# ----------------------------------------------------- sidecar surface
+
+
+def test_sidecar_polls_federation_over_http(monkeypatch):
+    clock = _FakeClock()
+    ctl = AutoscaleController(
+        mode="advisory", router_addr="127.0.0.1:8100", clock=clock,
+        engine_opts={"up_queue": 3.0, "up_consecutive": 1},
+    )
+    fed_text = "\n".join([
+        'deconv_jobs_active{backend="b0:8000"} 9',
+        'deconv_jobs_active{backend="b1:8001"} 9',
+        'fleet_scrape_ok{backend="b0:8000"} 1',
+        'fleet_scrape_ok{backend="b1:8001"} 1',
+        "fleet_backends_scraped 2",
+    ])
+    polled = []
+
+    async def fake(host, port, method, target, headers, body, timeout_s):
+        polled.append((f"{host}:{port}", target))
+        return 200, {}, fed_text.encode()
+
+    monkeypatch.setattr(fleet, "raw_request", fake)
+
+    async def go():
+        await ctl.tick()
+        assert polled == [("127.0.0.1:8100", "/v1/metrics/fleet")]
+        # sidecar fleet size = scraped-OK backends
+        assert ctl.metrics.snapshot()["gauges"]["fleet_size"] == 2
+        assert ctl._last_decision["action"] == "up"
+        assert ctl._last_decision["fleet_size"] == 2
+
+    asyncio.run(go())
+
+
+def test_cli_autoscaler_subcommand_exists():
+    out = subprocess.run(
+        [sys.executable, "-m", "deconv_api_tpu.cli", "autoscaler",
+         "--help"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0
+    assert "advisory" in out.stdout and "--launch-cmd" in out.stdout
+
+
+def test_fleet_router_rejects_autoscale_with_workers():
+    out = subprocess.run(
+        [sys.executable, "-m", "deconv_api_tpu.serving.fleet",
+         "--backends", "b0:8000", "--workers", "2",
+         "--autoscale", "enforce"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 2
+    assert "--autoscale requires --workers 1" in out.stderr
+
+
+# ----------------------------------------------------- exposition lint
+
+
+def test_autoscaler_metric_families_lint():
+    clock = _FakeClock()
+    ctl = AutoscaleController(
+        mode="advisory", router_addr="127.0.0.1:1", clock=clock
+    )
+    ctl.metrics.inc_labeled(
+        "decisions_total", ("action", "reason"), ("up", "queue")
+    )
+    ctl.metrics.observe_hist(
+        "boot_to_warm_seconds", "backend", "b2:8002", 1.25
+    )
+    families, samples = lint_exposition(ctl.metrics.prometheus())
+    assert families["autoscaler_decisions_total"] == "counter"
+    assert families["autoscaler_boot_to_warm_seconds"] == "histogram"
+    assert families["autoscaler_fleet_size"] == "gauge"
+    assert families["autoscaler_pending_launches"] == "gauge"
+    for fam in ("errors_total", "launch_failures_total",
+                "reap_blocked_total", "reaped_total"):
+        # pre-registered at zero: visible from the first scrape
+        assert families[f"autoscaler_{fam}"] == "counter"
+        assert samples[(f"autoscaler_{fam}", "")] == 0.0
+    assert samples[(
+        "autoscaler_decisions_total", 'action="up",reason="queue"'
+    )] == 1.0
+
+
+# -------------------------------------------------------- escape hatch
+
+
+def test_autoscale_off_pins_pr16_surface(monkeypatch):
+    clock = _FakeClock()
+    router = FleetRouter(["b0:8000"], clock=clock)  # default: off
+    assert router.autoscaler is None
+    _script(monkeypatch, {"b0:8000": _backend_exposition()})
+
+    async def go():
+        await router.probe_once()
+        # /v1/config carries NO autoscale block — byte-compatible with
+        # the PR 16 surface
+        cfg = json.loads((await router._config(None)).body)
+        assert "autoscale" not in cfg
+        ready = json.loads((await router._readyz(None)).body)
+        assert "autoscale" not in ready
+        text = (await router._metrics_route(None)).body.decode()
+        assert "autoscaler_" not in text
+
+    asyncio.run(go())
+    with pytest.raises(ValueError, match="autoscale"):
+        FleetRouter(["b0:8000"], autoscale="bogus")
+    with pytest.raises(ValueError, match="advisory|enforce"):
+        AutoscaleController(mode="off", router_addr="x:1")
+
+
+# ------------------------------------------------- zero-loss e2e drill
+
+_STUB_SRC = r"""
+import asyncio, json, sys
+from deconv_api_tpu.serving.http import HttpServer, Response
+from deconv_api_tpu.serving.metrics import Metrics
+
+port = int(sys.argv[1])
+
+
+async def main():
+    m = Metrics(prefix="deconv", core=False)
+    m.set_gauge("jobs_active", 0)
+    m.inc_counter("cache_l2_hits_total", 1)
+    srv = HttpServer(max_connections=256)
+
+    async def readyz(_req):
+        return Response.json({"ready": True})
+
+    async def metrics(_req):
+        return Response.text(
+            m.prometheus(), content_type="text/plain; version=0.0.4"
+        )
+
+    async def jobs(_req):
+        return Response.json(
+            {"counts": {"running": 0, "parked": 0, "queued": 0}}
+        )
+
+    async def work(_req):
+        await asyncio.sleep(0.02)
+        return Response.json({"port": port})
+
+    srv.route("GET", "/readyz")(readyz)
+    srv.route("GET", "/v1/metrics")(metrics)
+    srv.route("GET", "/v1/jobs")(jobs)
+    srv.route("POST", "/v1/deconv")(work)
+    await srv.start("127.0.0.1", port)
+    print("up", flush=True)
+    await asyncio.sleep(600)
+
+
+asyncio.run(main())
+"""
+
+
+def _spawn_stub(port: int) -> subprocess.Popen:
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+    p = subprocess.Popen(
+        [sys.executable, "-c", _STUB_SRC, str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True,
+    )
+    assert p.stdout.readline().strip() == "up"
+    return p
+
+
+def test_scale_down_zero_loss_over_real_processes():
+    """E2E over real subprocess backends and the REAL wire path: under
+    continuous traffic, the controller drain-announces its victim,
+    proves the jobs tier empty over HTTP, reaps the actual process —
+    and not one request is lost."""
+    p0 = autoscale._free_port()
+    p1 = autoscale._free_port()
+    b0, b1 = f"127.0.0.1:{p0}", f"127.0.0.1:{p1}"
+    procs = [_spawn_stub(p0), _spawn_stub(p1)]
+    launcher = _RecLauncher()
+
+    class _ProcLauncher(_RecLauncher):
+        async def reap(self, name, handle=None):
+            self.reaps.append(name)
+            proc = self.procs.get(name)
+            proc.terminate()
+
+    launcher = _ProcLauncher()
+    launcher.procs[b1] = procs[1]
+    router = FleetRouter(
+        [b0, b1], probe_interval_s=0.2, probe_timeout_s=2.0,
+        autoscale="enforce",
+        autoscale_opts={
+            "launcher": launcher, "drain_grace_s": 5.0,
+            "drain_settle_s": 0.2, "interval_s": 0.5,
+        },
+    )
+    ctl = router.autoscaler
+    statuses: list[int] = []
+
+    async def go():
+        await router.probe_once()
+        assert all(m.in_ring for m in router.members.values())
+        await ctl.tick()  # real federation poll primes _last_signals
+        stop = asyncio.Event()
+
+        async def traffic():
+            i = 0
+            while not stop.is_set():
+                resp = await router._proxy(Request(
+                    method="POST", path="/v1/deconv", query={},
+                    headers={
+                        "content-type":
+                        "application/x-www-form-urlencoded",
+                    },
+                    body=f"layer=c3&file=k{i % 16}".encode(),
+                    id=f"rid-{i}",
+                ))
+                statuses.append(resp.status)
+                i += 1
+                await asyncio.sleep(0.01)
+
+        t = asyncio.create_task(traffic())
+        await asyncio.sleep(0.3)
+        await ctl._scale_down(Decision("down", "idle"))
+        assert list(ctl.draining) == [b1]  # owned proc preferred
+        await ctl.draining[b1]
+        # reaped for real: the OS process is gone
+        assert launcher.reaps == [b1]
+        assert procs[1].wait(timeout=10) is not None
+        await asyncio.sleep(0.5)  # traffic continues on the survivor
+        stop.set()
+        await t
+        await ctl.stop()
+
+    try:
+        asyncio.run(go())
+        assert len(statuses) > 20
+        assert all(s == 200 for s in statuses)  # ZERO loss
+        assert router.members[b1].announced_drain
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -64,6 +64,8 @@ def test_dream_runs_one_octave(tmp_path, png, capsys):
     assert Image.open(out).size == (224, 224)
 
 
+@pytest.mark.slow  # two full CLI visualize runs (~50s); the CLI visualize
+# path stays in tier-1 via test_visualize_writes_grid
 def test_visualize_honours_weights_flag(tmp_path, png, capsys):
     """--weights must actually change the served parameters."""
     from deconv_api_tpu.models.vgg16 import vgg16_init

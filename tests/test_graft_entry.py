@@ -3,6 +3,8 @@ dryrun_multichip() executes on the 8-device virtual CPU mesh."""
 
 import importlib.util
 import sys
+
+import pytest
 from pathlib import Path
 
 import jax
@@ -26,6 +28,8 @@ def test_entry_is_traceable():
     assert out["block5_conv1"]["images"].shape == (8, 224, 224, 3)
 
 
+@pytest.mark.slow  # 8-chip dryrun compile (~36s); the multichip dryrun path
+# stays in tier-1 via test_dryrun_multichip_odd
 def test_dryrun_multichip_8():
     mod = _load_graft()
     mod.dryrun_multichip(8)

@@ -823,6 +823,8 @@ def test_no_active_filters_400_on_dead_input():
         assert httpx.get(s.base_url + "/health-check").status_code == 200
 
 
+@pytest.mark.slow  # cold subprocess boot + warmup (~100s); in-process
+# graceful drain/stop stays covered across the serving and fleet tier-1 tests
 def test_sigterm_graceful_shutdown():
     """SIGTERM to the server process (the container's PID-1 path) triggers
     the graceful stop: shutdown events logged, clean exit code 0."""

@@ -154,6 +154,8 @@ def test_dag_train_step_runs_and_descends():
     assert r["model"] == "resnet50" and r["mesh"] == [4, 2]
 
 
+@pytest.mark.slow  # three full DAG training runs (~130s); the exact-resume
+# property stays in tier-1 via test_checkpoint_resume_is_exact (TINY)
 def test_dag_checkpoint_resume_is_exact(tmp_path):
     """Exact interrupt-and-resume for a DAG family (VERDICT r4 item 4):
     the TrainState round-trips through orbax with the nested block pytree

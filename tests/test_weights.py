@@ -288,6 +288,8 @@ def test_inception_v3_h5_too_few_convs_raises(tmp_path, rng, inception_init):
 # --------------------------------------------------------------- npz / orbax
 
 
+@pytest.mark.slow  # full-VGG16 save/load (~50s); npz loading stays in tier-1
+# via test_missing_layers_keep_init, h5 roundtrip via the keras2 param
 def test_npz_roundtrip_sequential(tmp_path):
     spec, init = vgg16_init(jax.random.PRNGKey(0))
     path = str(tmp_path / "w.npz")

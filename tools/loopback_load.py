@@ -4417,6 +4417,459 @@ def cfg_cache_bytes() -> int:
     return ServerConfig.from_env().cache_bytes
 
 
+def run_stub_backend(
+    port: int,
+    routers: str,
+    token: str,
+    l2_dir: str,
+    service_ms: float,
+) -> int:
+    """A real-process stand-in backend for the autoscale drill (round
+    22): the CONTROLLER is the measured quantity, so the backend is an
+    honest process boundary with the real fleet protocol surface —
+    /readyz (503 while draining, the round-9 contract), /v1/metrics (a
+    real registry, so the federation splice and the signal parser see
+    production family names), /v1/jobs (the reap gate's source of
+    truth), self-registration on boot and drain-announce + graceful
+    stop on SIGTERM (round 16) — and zero device work.
+
+    Warmth is modeled on the L2-retention contract: a non-empty
+    ``l2_dir`` (the hotset a reaped predecessor left behind) serves
+    ``x-cache: l2`` from the FIRST request and counts
+    ``cache_l2_hits_total`` — which is exactly the counter the
+    controller's boot-to-first-warm-hit clock watches."""
+    from deconv_api_tpu.serving.fleet import raw_request
+    from deconv_api_tpu.serving.http import HttpServer, Response
+    from deconv_api_tpu.serving.metrics import Metrics
+
+    router_list = [r.strip() for r in routers.split(",") if r.strip()]
+    warm = False
+    if l2_dir and os.path.isdir(l2_dir):
+        warm = any(os.scandir(l2_dir))
+
+    async def serve() -> int:
+        import signal
+
+        m = Metrics(prefix="deconv", core=False)
+        for fam in ("cache_hits_total", "cache_l2_hits_total"):
+            m.inc_counter(fam, 0)
+        for g in ("jobs_active", "jobs_queued", "jobs_running",
+                  "jobs_parked"):
+            m.set_gauge(g, 0)
+        inflight = 0
+        draining = False
+        srv = HttpServer(max_connections=2048)
+
+        async def _readyz(_req):
+            if draining:
+                return Response.json(
+                    {"ready": False, "checks": {"not_draining": False}},
+                    503,
+                )
+            return Response.json({"ready": True})
+
+        async def _metrics(_req):
+            return Response.text(
+                m.prometheus(), content_type="text/plain; version=0.0.4"
+            )
+
+        async def _jobs(_req):
+            return Response.json({
+                "jobs": [],
+                "counts": {"queued": 0, "running": 0, "parked": 0,
+                           "done": 0, "failed": 0, "cancelled": 0},
+                "queue_depth": 0,
+            })
+
+        async def _work(_req):
+            nonlocal inflight
+            inflight += 1
+            # jobs_active IS the queue-pressure signal the controller
+            # reads off the federation plane
+            m.set_gauge("jobs_active", inflight)
+            try:
+                await asyncio.sleep(service_ms / 1e3)
+                if warm:
+                    m.inc_counter("cache_l2_hits_total")
+                    kind = "l2"
+                else:
+                    kind = "miss"
+                return Response(
+                    status=200, body=b'{"ok": true}',
+                    headers={"content-type": "application/json",
+                             "x-cache": kind},
+                )
+            finally:
+                inflight -= 1
+                m.set_gauge("jobs_active", inflight)
+
+        srv.route("GET", "/readyz")(_readyz)
+        srv.route("GET", "/v1/metrics")(_metrics)
+        srv.route("GET", "/v1/jobs")(_jobs)
+        srv.route("POST", "/v1/deconv")(_work)
+        await srv.start("127.0.0.1", port)
+
+        me = f"127.0.0.1:{port}"
+
+        async def announce(action: str) -> int:
+            acks = 0
+            for r in router_list:
+                host, _, rp = r.rpartition(":")
+                try:
+                    status, _h, _b = await raw_request(
+                        host, int(rp), "POST",
+                        "/v1/internal/register",
+                        {"x-fleet-token": token,
+                         "content-type":
+                         "application/x-www-form-urlencoded"},
+                        f"backend={me}&action={action}".encode(),
+                        2.0,
+                    )
+                    if status == 200:
+                        acks += 1
+                except Exception:  # noqa: BLE001 — router may be booting
+                    pass
+            return acks
+
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop_ev.set)
+
+        # self-registration with retry: the router may still be binding
+        for _ in range(40):
+            if stop_ev.is_set() or not router_list:
+                break
+            if await announce("register"):
+                break
+            await asyncio.sleep(0.25)
+
+        await stop_ev.wait()
+        # graceful leave (round 16): readyz flips FIRST so no probe can
+        # clear the announcement, then drain-announce, then a beat for
+        # in-flight responses, then stop
+        draining = True
+        await announce("drain")
+        await asyncio.sleep(0.5)
+        await srv.stop(grace_s=2.0)
+        return 0
+
+    return asyncio.run(serve())
+
+
+def run_autoscale_diurnal_drill(
+    low_rps: float = 12.0,
+    high_rps: float = 120.0,
+    service_ms: float = 60.0,
+    max_backends: int = 3,
+) -> dict:
+    """The round-22 closed-loop elasticity drill: a 10x diurnal traffic
+    swing (low → ramp → plateau → ramp-down → low) against ONE
+    in-process router with the embedded controller in ENFORCE mode and
+    a real SubprocessLauncher — scale-ups are real process boots that
+    self-register and warm from the retained L2 hotset dir,
+    scale-downs are drain-announce → jobs-gate → SIGTERM reaps.
+
+    Loud ``error`` on: SLO burn >= 1 at any point, any cold-start 5xx,
+    any lost request (connection error / timeout — scale-down loss
+    would land here), boot-to-first-warm-hit over budget, a blocked
+    reap, or a run that never actually scaled (a controller that slept
+    through a 10x swing proved nothing)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from deconv_api_tpu.serving.autoscale import (
+        DecisionJournal, SubprocessLauncher,
+    )
+    from deconv_api_tpu.serving.fleet import FleetRouter
+
+    boot_warm_budget_s = float(
+        os.environ.get("AUTOSCALE_BOOT_WARM_BUDGET_S", "15")
+    )
+    token = "drill-token"
+    tmp = tempfile.mkdtemp(prefix="autoscale_drill_")
+    l2_dir = os.path.join(tmp, "l2")
+    os.makedirs(l2_dir)
+    # the retained hotset every boot warms from (L2 retention: reaps
+    # leave it in place, so a relaunch starts warm)
+    with open(os.path.join(l2_dir, "hotset"), "w") as f:
+        f.write("warm\n")
+    journal_path = os.path.join(tmp, "decisions.jsonl")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+
+    rport = _free_port()
+    stub_argv = [
+        sys.executable, os.path.abspath(__file__),
+        "--stub-backend", "{port}",
+        "--routers", f"127.0.0.1:{rport}",
+        "--token", token,
+        "--l2-dir", l2_dir,
+        "--service-ms", str(service_ms),
+    ]
+    launcher = SubprocessLauncher(stub_argv, env=env)
+
+    async def drive() -> dict:
+        router = FleetRouter(
+            [],
+            fleet_token=token,
+            probe_interval_s=0.3,
+            probe_timeout_s=1.0,
+            eject_threshold=3,
+            cooldown_s=1.0,
+            forward_timeout_s=30.0,
+            slos="api=250:99",
+            autoscale="enforce",
+            autoscale_opts={
+                "interval_s": 0.5,
+                "journal_path": journal_path,
+                "launcher": launcher,
+                "launch_retries": 2,
+                "retry_backoff_s": 0.2,
+                "warm_timeout_s": 20.0,
+                "drain_grace_s": 10.0,
+                "drain_settle_s": 0.3,
+                "jobs_poll_timeout_s": 2.0,
+                "arrival_bucket_s": 1.0,
+                "engine_opts": {
+                    "up_burn": 0.7,
+                    "up_queue": 3.0,
+                    "down_burn": 0.2,
+                    "down_queue": 0.8,
+                    "up_consecutive": 2,
+                    "down_consecutive": 6,
+                    "cooldown_up_s": 2.5,
+                    "cooldown_down_s": 5.0,
+                    "min_backends": 1,
+                    "max_backends": max_backends,
+                    "qos_device_ms_budget": 1e9,
+                    "predict_horizon_s": 8.0,
+                    "predict_ramp": 2.5,
+                    "predict_min_rate": 5.0,
+                },
+            },
+        )
+        await router.start("127.0.0.1", rport)
+        ctl = router.autoscaler
+
+        # the steady-state fleet of ONE: drill-owned, so the controller
+        # prefers reaping its own launches first
+        b0 = subprocess.Popen(
+            [a.format(port=_free_port()) if a == "{port}" else a
+             for a in stub_argv],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        )
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            await router.probe_once()
+            if any(m.in_ring for m in router.members.values()):
+                break
+            await asyncio.sleep(0.2)
+        else:
+            b0.kill()
+            raise RuntimeError("seed backend never joined the ring")
+
+        # ---- phased open-loop client ------------------------------
+        phases = [
+            (3.0, low_rps, low_rps),            # overnight steady state
+            (4.0, low_rps, high_rps),           # morning ramp
+            (6.0, high_rps, high_rps),          # daytime plateau
+            (4.0, high_rps, low_rps),           # evening ramp-down
+            (17.0, low_rps, low_rps),           # night: scale-down window
+        ]
+        sent = ok = http_5xx = lost = 0
+        kinds: dict[str, int] = {}
+        launch_times: list[float] = []
+        sem = asyncio.Semaphore(128)
+        tasks: set = set()
+
+        async def one(key: str) -> None:
+            nonlocal sent, ok, http_5xx, lost
+            sent += 1
+            body = f"layer=c3&file={key}".encode()
+            try:
+                async with sem:
+                    reader, writer = await asyncio.wait_for(
+                        asyncio.open_connection("127.0.0.1", rport), 5.0
+                    )
+                    writer.write(
+                        b"POST /v1/deconv HTTP/1.1\r\nhost: x\r\n"
+                        b"connection: close\r\ncontent-type: "
+                        b"application/x-www-form-urlencoded\r\n"
+                        b"content-length: " + str(len(body)).encode()
+                        + b"\r\n\r\n" + body
+                    )
+                    await writer.drain()
+                    raw = await asyncio.wait_for(reader.read(), 10.0)
+                    writer.close()
+            except (OSError, asyncio.TimeoutError):
+                lost += 1
+                return
+            status, _code = _resp_status_code(raw)
+            kind, _rid = _resp_meta(raw)
+            kinds[kind] = kinds.get(kind, 0) + 1
+            if status == 200:
+                ok += 1
+            elif status >= 500:
+                http_5xx += 1
+            else:
+                lost += 1  # unexpected 4xx on a well-formed drill key
+
+        burn_max = 0.0
+        fleet_max = 0
+        fleet_series: list[tuple[float, int]] = []
+        mon_stop = asyncio.Event()
+
+        async def monitor() -> None:
+            nonlocal burn_max, fleet_max
+            t_start = time.monotonic()
+            last_launches = 0
+            while not mon_stop.is_set():
+                burn = max(
+                    (t.burn_rates()["5m"] for t in router.slos),
+                    default=0.0,
+                )
+                burn_max = max(burn_max, burn)
+                size = sum(
+                    1 for m in router.members.values()
+                    if m.in_ring and not m.announced_drain
+                )
+                fleet_max = max(fleet_max, size)
+                fleet_series.append(
+                    (round(time.monotonic() - t_start, 1), size)
+                )
+                n_launch = len(launcher.procs)
+                if n_launch > last_launches:
+                    launch_times.append(time.monotonic())
+                    last_launches = n_launch
+                await asyncio.sleep(0.25)
+
+        mon = asyncio.create_task(monitor())
+        keys = [f"diurnal{i}" for i in range(24)]
+        ki = 0
+        t0 = time.monotonic()
+        elapsed0 = 0.0
+        for dur, r_from, r_to in phases:
+            t_phase = time.monotonic()
+            while True:
+                frac = (time.monotonic() - t_phase) / dur
+                if frac >= 1.0:
+                    break
+                rate = r_from + (r_to - r_from) * frac
+                t = asyncio.create_task(one(keys[ki % len(keys)]))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+                ki += 1
+                await asyncio.sleep(1.0 / max(rate, 0.1))
+            elapsed0 += dur
+        if tasks:
+            await asyncio.wait(tasks, timeout=15.0)
+        mon_stop.set()
+        await mon
+        total_s = round(time.monotonic() - t0, 1)
+
+        fleet_end = sum(
+            1 for m in router.members.values()
+            if m.in_ring and not m.announced_drain
+        )
+        # cold-start 5xx: a 5xx observed within 4 s after any launch
+        # (every other 5xx is still loud, just labeled plainly).  The
+        # client path counts per request; the windowing here is over
+        # aggregate timing because a zero-5xx run — the budget — makes
+        # the distinction moot.
+        cold_5xx = http_5xx if launch_times else 0
+
+        am = ctl.metrics
+        decisions = {
+            f"{a}/{r}": int(n)
+            for (a, r), n in am.labeled("decisions_total").items()
+            if n > 0
+        }
+        scale_ups = sum(
+            int(n) for (a, _r), n in
+            am.labeled("decisions_total").items() if a == "up"
+        )
+        predictive_ups = int(
+            am.labeled("decisions_total").get(("up", "predictive"), 0)
+        )
+        boots = [
+            rec["boot_to_warm_s"]
+            for rec in DecisionJournal.replay(journal_path)
+            if rec.get("kind") == "warm"
+        ]
+        reaped = am.counter("reaped_total")
+        reap_blocked = am.counter("reap_blocked_total")
+        row = {
+            "which": "autoscale-diurnal",
+            "low_rps": low_rps,
+            "high_rps": high_rps,
+            "swing": round(high_rps / low_rps, 1),
+            "service_ms": service_ms,
+            "duration_s": total_s,
+            "sent": sent,
+            "ok": ok,
+            "http_5xx": http_5xx,
+            "cold_5xx": cold_5xx,
+            "lost": lost,
+            "jobs_lost": 0 if reap_blocked == 0 else None,
+            "kinds": kinds,
+            "burn_5m_max": round(burn_max, 4),
+            "fleet_max": fleet_max,
+            "fleet_end": fleet_end,
+            "fleet_series": fleet_series[::8],
+            "scale_ups": scale_ups,
+            "predictive_ups": predictive_ups,
+            "reaped": int(reaped),
+            "reap_blocked": int(reap_blocked),
+            "launch_failures": am.counter("launch_failures_total"),
+            "controller_errors": am.counter("errors_total"),
+            "boots_measured": len(boots),
+            "boot_to_warm_s": round(max(boots), 3) if boots else None,
+            "boot_warm_budget_s": boot_warm_budget_s,
+            "decisions": decisions,
+        }
+        errs = []
+        if burn_max >= 1.0:
+            errs.append(f"slo burn {round(burn_max, 2)} >= 1")
+        if cold_5xx:
+            errs.append(f"{cold_5xx} cold-start 5xx")
+        elif http_5xx:
+            errs.append(f"{http_5xx} 5xx")
+        if lost:
+            errs.append(f"{lost} requests lost (scale-down loss budget 0)")
+        if reap_blocked:
+            errs.append(f"{reap_blocked} reaps blocked by the jobs gate")
+        if scale_ups == 0 or fleet_max < 2:
+            errs.append("controller never scaled up through a 10x swing")
+        if reaped == 0:
+            errs.append("controller never reaped back down")
+        if boots and max(boots) > boot_warm_budget_s:
+            errs.append(
+                f"boot-to-warm {round(max(boots), 1)}s over "
+                f"{boot_warm_budget_s}s budget"
+            )
+        if not boots and scale_ups:
+            errs.append("no boot-to-warm measurement despite scale-ups")
+        if errs:
+            row["error"] = "; ".join(errs)
+
+        # teardown: router stop() stops the controller (which kills its
+        # launches); the drill-owned seed backend goes last
+        await router.stop(grace_s=2.0)
+        for proc in list(launcher.procs.values()):
+            proc.terminate()
+        b0.terminate()
+        try:
+            b0.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            b0.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+        return row
+
+    return asyncio.run(drive())
+
+
 def main() -> int:
     args = sys.argv[1:]
     passes = 1
@@ -4442,6 +4895,12 @@ def main() -> int:
     fleet_tail = False
     fleet_trace = False
     fleet_fastpath = False
+    diurnal = False
+    stub_port: int | None = None
+    stub_routers = ""
+    stub_token = ""
+    stub_l2_dir = ""
+    service_ms = 60.0
     open_loop_rate: float | None = None
     tenants_drill: str | None = None
     concurrency = 64
@@ -4535,6 +4994,31 @@ def main() -> int:
             # N-worker SO_REUSEPORT scaling, 16-key byte parity
             fleet_fastpath = True
             i += 1
+        elif args[i] == "--diurnal":
+            # the round-22 closed-loop elasticity drill: a 10x diurnal
+            # traffic swing against ONE embedded-controller router in
+            # enforce mode — real subprocess scale-ups (self-register +
+            # L2 warm boot, boot-to-first-warm-hit measured), zero-loss
+            # jobs-gated scale-downs, burn < 1 throughout
+            diurnal = True
+            i += 1
+        elif args[i] == "--stub-backend":
+            # internal: the drill's launched-backend entrypoint (a real
+            # process with the fleet protocol surface and no device)
+            stub_port = int(args[i + 1])
+            i += 2
+        elif args[i] == "--routers":
+            stub_routers = args[i + 1]
+            i += 2
+        elif args[i] == "--token":
+            stub_token = args[i + 1]
+            i += 2
+        elif args[i] == "--l2-dir":
+            stub_l2_dir = args[i + 1]
+            i += 2
+        elif args[i] == "--service-ms":
+            service_ms = float(args[i + 1])
+            i += 2
         elif args[i] == "--open-loop":
             # open-loop Poisson arrivals at a fixed offered rate: alone
             # it drives the tiny server (run_open_loop); with
@@ -4593,6 +5077,15 @@ def main() -> int:
         except ValueError as e:
             print(e, file=sys.stderr)
             return 2
+    if stub_port is not None:
+        # must run before any drill dispatch: this process IS a backend
+        return run_stub_backend(
+            stub_port, stub_routers, stub_token, stub_l2_dir, service_ms
+        )
+    if diurnal:
+        row = run_autoscale_diurnal_drill(service_ms=service_ms)
+        print(json.dumps(row), flush=True)
+        return 0
     if quant_drill:
         row = run_quant_drill(
             n_requests=n_requests or 240,

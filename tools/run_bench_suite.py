@@ -135,6 +135,19 @@ AOT_BOOT_SPEEDUP_BUDGET = 2.0
 ROUTER_HOP_P50_BUDGET_MS = 0.5
 ROUTER_FASTPATH_MIN_RPS = 10000.0
 
+# Closed-loop elasticity budgets (round 22): through a 10x diurnal
+# traffic swing with the embedded controller in enforce mode, the SLO
+# burn rate must stay under AUTOSCALE_BURN_BUDGET at every sample (the
+# controller's whole job is to add capacity BEFORE the objective
+# burns), a freshly-launched backend must never answer 5xx while cold
+# (warm-boot: AOT store + retained L2 + self-registration), and
+# scale-downs must lose zero requests and zero jobs (drain-announce,
+# jobs gate, then reap).  Boot-to-first-warm-hit is measured as a
+# first-class metric and must land under its budget.
+AUTOSCALE_BURN_BUDGET = 1.0
+AUTOSCALE_COLD_5XX_BUDGET = 0
+AUTOSCALE_BOOT_WARM_BUDGET_S = 15.0
+
 # Channel-packed backward-tail budget (round 12): the packed path must
 # not run SLOWER than the vmapped path it would replace — a recorded
 # regression (like the r3 prototype's 280-vs-368 img/s) keeps the
@@ -734,6 +747,69 @@ def run_router_fastpath_guard(timeout_s: float = 1800.0) -> dict:
         min_rps_budget=ROUTER_FASTPATH_MIN_RPS,
         parity_ok=drill.get("parity_ok"),
         pool_metric_families=drill.get("pool_metric_families"),
+    )
+    # the drill assembles its own violation list against the same
+    # budgets; carry it verbatim — the guard's job is the recorded row
+    if "error" in drill:
+        row["error"] = drill["error"]
+    return row
+
+
+def run_autoscale_guard(timeout_s: float = 1800.0) -> dict:
+    """Closed-loop elasticity drill guard (round 22):
+    tools/loopback_load.py --diurnal — one embedded-controller router
+    in enforce mode with a real SubprocessLauncher, driven through a
+    10x diurnal swing (low / ramp / plateau / ramp-down / low).
+    Scale-ups are real process boots that self-register and warm from
+    the retained L2 dir; scale-downs are drain-announce -> jobs-gate ->
+    SIGTERM reaps.
+
+    The row fails LOUDLY (`error` field) when:
+    - SLO burn reaches AUTOSCALE_BURN_BUDGET at any monitor sample;
+    - any cold-start 5xx (> AUTOSCALE_COLD_5XX_BUDGET);
+    - ANY request is lost (scale-down loss budget is zero), or a reap
+      is blocked by the jobs gate;
+    - boot-to-first-warm-hit exceeds AUTOSCALE_BOOT_WARM_BUDGET_S, or
+      scale-ups happened with no warm measurement at all;
+    - the controller slept through the swing (no scale-up, or no reap
+      back down) — a flat fleet proved nothing."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "AUTOSCALE_BOOT_WARM_BUDGET_S": str(AUTOSCALE_BOOT_WARM_BUDGET_S),
+    }
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--diurnal"], timeout_s, env=env
+    )
+    row = {"config": "autoscale", "which": "loopback_autoscale_diurnal"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    row.update(
+        low_rps=drill.get("low_rps"),
+        high_rps=drill.get("high_rps"),
+        swing=drill.get("swing"),
+        sent=drill.get("sent"),
+        ok=drill.get("ok"),
+        http_5xx=drill.get("http_5xx"),
+        cold_5xx=drill.get("cold_5xx"),
+        cold_5xx_budget=AUTOSCALE_COLD_5XX_BUDGET,
+        lost=drill.get("lost"),
+        jobs_lost=drill.get("jobs_lost"),
+        burn_5m_max=drill.get("burn_5m_max"),
+        burn_budget=AUTOSCALE_BURN_BUDGET,
+        fleet_max=drill.get("fleet_max"),
+        fleet_end=drill.get("fleet_end"),
+        scale_ups=drill.get("scale_ups"),
+        predictive_ups=drill.get("predictive_ups"),
+        reaped=drill.get("reaped"),
+        reap_blocked=drill.get("reap_blocked"),
+        launch_failures=drill.get("launch_failures"),
+        controller_errors=drill.get("controller_errors"),
+        boots_measured=drill.get("boots_measured"),
+        boot_to_warm_s=drill.get("boot_to_warm_s"),
+        boot_warm_budget_s=drill.get("boot_warm_budget_s"),
+        decisions=drill.get("decisions"),
     )
     # the drill assembles its own violation list against the same
     # budgets; carry it verbatim — the guard's job is the recorded row
@@ -1429,6 +1505,13 @@ def main() -> int:
             # floor, 1-vs-N-worker scaling, byte parity pinned
             result = run_router_fastpath_guard()
             result["date"] = date
+        elif tok == "autoscale":
+            # closed-loop elasticity drill (round 22): 10x diurnal
+            # swing through an enforce-mode embedded controller — burn
+            # < 1 throughout, zero cold-start 5xx, zero-loss jobs-gated
+            # scale-downs, boot-to-first-warm-hit under budget
+            result = run_autoscale_guard()
+            result["date"] = date
         elif tok == "models":
             # multi-model paging drill (round 15): three backbones from
             # one pool under a budget that forces paging + the
@@ -1475,7 +1558,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'router-fastpath', 'models', 'quant', 'aot-boot'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'router-fastpath', 'autoscale', 'models', 'quant', 'aot-boot'])}",
             }
         else:
             n = int(tok)

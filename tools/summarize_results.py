@@ -92,6 +92,25 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "boot_to_warm_s" in row or "fleet_max" in row:
+        # closed-loop elasticity rows (round 22): the whole contract in
+        # one line — the swing the fleet tracked, burn vs budget, the
+        # zero-loss ledger (5xx / lost / blocked reaps), and
+        # boot-to-first-warm-hit; error kept visible
+        line = (
+            f"autoscale x{row.get('swing')} swing: fleet "
+            f"{row.get('fleet_end', '?')}↔{row.get('fleet_max', '?')} "
+            f"(ups={row.get('scale_ups')}, pred={row.get('predictive_ups')}, "
+            f"reaped={row.get('reaped')}), burn {row.get('burn_5m_max')} "
+            f"(budget {row.get('burn_budget', 1)}), "
+            f"5xx={row.get('http_5xx')} lost={row.get('lost')} "
+            f"blocked={row.get('reap_blocked')}, "
+            f"boot→warm {row.get('boot_to_warm_s')}s "
+            f"(budget {row.get('boot_warm_budget_s', 15)}s)"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "aot_warm_speedup" in row:
         # AOT warm-boot rows (round 18): the compile-once-boot-warm
         # claim — cold vs warm warmup wall, the hit ledger, and the
