@@ -45,6 +45,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "aot_dir", "aot_bytes",
         "l2_dir", "l2_bytes", "fleet_routers", "fleet_token",
         "fleet_advertise",
+        "tsdb", "tsdb_interval_s", "alerts", "incidents_dir",
+        "incidents_retention_s",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -92,6 +94,9 @@ def cmd_fleet_router(args: argparse.Namespace) -> int:
         "autoscale_cooldown_up_s", "autoscale_cooldown_down_s",
         "autoscale_up_burn", "autoscale_up_queue",
         "autoscale_qos_budget_ms",
+        # round 23 fleet memory: retention, alerting, forensics
+        "tsdb", "tsdb_interval_s", "alerts", "incidents_dir",
+        "incidents_retention_s",
     ):
         val = getattr(args, flag, None)
         if val is not None:
@@ -360,6 +365,34 @@ def main(argv: list[str] | None = None) -> int:
         help="latency SLO objects "
         "('name=<threshold_ms>:<objective_pct>[:<route>]'): burn-rate "
         "gauges on /metrics + an slo block on /readyz (default none)",
+    )
+    s.add_argument(
+        "--tsdb", default=None, dest="tsdb", choices=("off", "on"),
+        help="embedded metric history (round 23): self-scrape into "
+        "bounded ring buffers, queryable at GET /v1/metrics/history "
+        "(default off — byte-identical to the prior dialect)",
+    )
+    s.add_argument(
+        "--tsdb-interval-s", type=float, default=None,
+        dest="tsdb_interval_s",
+        help="self-scrape interval for the raw tier (default 1.0)",
+    )
+    s.add_argument(
+        "--alerts", default=None, dest="alerts", metavar="JSON|PATH",
+        help="declarative alert rules (inline JSON or file), validated "
+        "at boot; non-empty implies --tsdb on",
+    )
+    s.add_argument(
+        "--incidents-dir", default=None, dest="incidents_dir",
+        metavar="PATH",
+        help="digest-verified incident bundle store snapshot on firing "
+        "transitions (GET /v1/debug/incidents)",
+    )
+    s.add_argument(
+        "--incidents-retention-s", type=float, default=None,
+        dest="incidents_retention_s",
+        help="seconds an incident bundle survives the sweep "
+        "(default 86400)",
     )
     s.add_argument(
         "--fault", action="append", default=None, metavar="SITE=SPEC",
@@ -768,6 +801,34 @@ def main(argv: list[str] | None = None) -> int:
         dest="autoscale_qos_budget_ms",
         help="per-backend device-ms/s budget gating scale-down "
         "(default 800)",
+    )
+    s.add_argument(
+        "--tsdb", default=None, dest="tsdb", choices=("off", "on"),
+        help="embedded metric history (round 23): self-scrape into "
+        "bounded ring buffers, GET /v1/metrics/history with per-backend "
+        "federation (default off)",
+    )
+    s.add_argument(
+        "--tsdb-interval-s", type=float, default=None,
+        dest="tsdb_interval_s",
+        help="self-scrape interval for the raw tier (default 1.0)",
+    )
+    s.add_argument(
+        "--alerts", default=None, dest="alerts", metavar="JSON|PATH",
+        help="declarative alert rules (inline JSON or file), validated "
+        "at boot; non-empty implies --tsdb on",
+    )
+    s.add_argument(
+        "--incidents-dir", default=None, dest="incidents_dir",
+        metavar="PATH",
+        help="digest-verified incident bundle store snapshot on firing "
+        "transitions (GET /v1/debug/incidents)",
+    )
+    s.add_argument(
+        "--incidents-retention-s", type=float, default=None,
+        dest="incidents_retention_s",
+        help="seconds an incident bundle survives the sweep "
+        "(default 86400)",
     )
     s.set_defaults(fn=cmd_fleet_router)
 

@@ -142,6 +142,37 @@ class ServerConfig:
     # request_duration_seconds histogram either way; '' = no SLO
     # objects (zero extra state).  Validated at boot.
     slos: str = ""
+    # --- metric history + alerting (round 23: serving/tsdb.py,
+    #     serving/alerts.py) ---
+    # Embedded TSDB master switch: 'on' starts a periodic self-scrape
+    # task sampling Metrics.snapshot() into two fixed-size ring tiers
+    # (raw 1×tsdb_interval_s × 600 slots, rolled min/mean/max at
+    # 15×interval × 960 slots) and registers GET /v1/metrics/history.
+    # 'off' = nothing registered, no task, byte-parity with the
+    # pre-round-23 surface (pinned by the --incident drill).  A
+    # non-empty `alerts` spec implies 'on'.
+    tsdb: str = "off"
+    # Self-scrape cadence in seconds.  Both ring tiers scale with it
+    # (the rollup interval is always 15× the raw interval), so drills
+    # shrink history by shrinking this one knob.
+    tsdb_interval_s: float = 1.0
+    # Declarative alert rules: inline JSON ('{"rules": [...]}' or a
+    # bare list) or a path to a JSON file — validated at boot like
+    # `tenants` (a typo'd kind/key/SLO fails the process).  Rule kinds:
+    # threshold (aggregate one TSDB series over a window and compare),
+    # burn (multi-window SLO error-budget overspend), absence (series
+    # staleness).  Evaluated every scrape tick with for_s hold-downs;
+    # surfaced at GET /v1/alerts, as alert_state{rule=} gauges, and on
+    # /readyz.  Empty = no engine.
+    alerts: str = ""
+    # Directory for digest-verified incident bundles written when a
+    # rule transitions to firing (tmp-then-rename, torn-tail-tolerant
+    # replay — the SpillStore idiom).  Empty = alerts still evaluate
+    # but nothing is recorded; /v1/debug/incidents 404s.
+    incidents_dir: str = ""
+    # Incident bundle retention: bundles older than this (or beyond the
+    # newest 64) are swept on the scrape tick.
+    incidents_retention_s: float = 86400.0
     # --- robustness layer (round 9: serving/faults.py + supervision) ---
     # Fault injection master switch: enables the registry, the module
     # hook, and the POST /v1/debug/faults arm endpoint (404 while off).

@@ -659,6 +659,57 @@ class DeconvService:
             self.server.route_prefix("GET", "/v1/internal/cache/")(
                 self._internal_cache
             )
+        # Embedded metric history + alerting (round 23, serving/tsdb.py
+        # + serving/alerts.py): a self-scrape task samples the metrics
+        # registries into two ring tiers, the alert engine evaluates
+        # its boot-validated rules on the same tick, and a rule
+        # transitioning to firing snapshots a digest-verified incident
+        # bundle.  'off' (and no alerts spec) = nothing constructed,
+        # no routes, no task — byte-parity with the pre-round surface.
+        if self.cfg.tsdb not in ("off", "on"):
+            raise ValueError(
+                f"tsdb must be 'off' or 'on', got {self.cfg.tsdb!r}"
+            )
+        if self.cfg.tsdb_interval_s <= 0:
+            raise ValueError(
+                f"tsdb_interval_s must be > 0, got {self.cfg.tsdb_interval_s}"
+            )
+        self.tsdb = None
+        self.alert_engine = None
+        self.incidents = None
+        self._tsdb_task: asyncio.Task | None = None
+        if self.cfg.tsdb == "on" or self.cfg.alerts:
+            from deconv_api_tpu.serving.alerts import (
+                AlertEngine,
+                IncidentStore,
+                parse_alert_rules,
+            )
+            from deconv_api_tpu.serving.tsdb import Tsdb
+
+            self.tsdb = Tsdb(self.cfg.tsdb_interval_s)
+            try:
+                rules = parse_alert_rules(
+                    self.cfg.alerts,
+                    known_slos=frozenset(t.name for t in self.slos),
+                )
+            except ValueError as e:
+                raise ValueError(f"invalid alerts spec: {e}") from e
+            if rules:
+                self.alert_engine = AlertEngine(
+                    rules, self.tsdb, slos=self.slos
+                )
+            if self.cfg.incidents_dir:
+                self.incidents = IncidentStore(
+                    self.cfg.incidents_dir,
+                    retention_s=self.cfg.incidents_retention_s,
+                )
+                self.server.route("GET", "/v1/debug/incidents")(
+                    self._debug_incidents
+                )
+            self.server.route("GET", "/v1/metrics/history")(
+                self._metrics_history
+            )
+            self.server.route("GET", "/v1/alerts")(self._alerts)
 
     # ------------------------------------------------- multi-model plumbing
 
@@ -1675,6 +1726,7 @@ class DeconvService:
                     ("route", "qos_class"),
                     (route, req.tclass or "default"),
                     dt,
+                    exemplar=req.id,
                 )
                 for t in slos:
                     t.observe(dt, 500)
@@ -1682,12 +1734,16 @@ class DeconvService:
             dt = time.perf_counter() - t0
             # tclass is stamped by the QoS admission wrap (inside this
             # one), so by completion it names the request's class;
-            # "default" with QoS off keeps the label set bounded
+            # "default" with QoS off keeps the label set bounded.
+            # The request id rides along as the bucket's exemplar
+            # (round 23): the exposition names the most recent request
+            # that landed in each latency bucket.
             self.metrics.observe_hist(
                 "request_duration_seconds",
                 ("route", "qos_class"),
                 (route, req.tclass or "default"),
                 dt,
+                exemplar=req.id,
             )
             for t in slos:
                 t.observe(dt, status)
@@ -2289,6 +2345,18 @@ class DeconvService:
                 t.name: {**t.snapshot(), "ok": t.burn_rates()["5m"] <= 1.0}
                 for t in self.slos
             }
+        if self.alert_engine is not None:
+            # round 23: the alert picture on the probe.  Informational
+            # like the slo block — a firing alert must NOT fail
+            # readiness (pulling capacity mid-incident makes it worse);
+            # it names itself so the LB dashboard sees WHY it's red
+            # elsewhere.
+            snap = self.alert_engine.snapshot()
+            body["alerts"] = {
+                "firing": self.alert_engine.firing(),
+                "pending": snap["pending"],
+                "eval_errors": snap["eval_errors_total"],
+            }
         return Response.json(body, status=200 if ok else 503)
 
     async def _debug_faults(self, req: Request) -> Response:
@@ -2323,9 +2391,174 @@ class DeconvService:
         # SLO burn-rate gauges + good/breach totals (round 19) — the
         # alerting surface the runbook's multiwindow rules scrape
         text += slo_prometheus(self.slos, "deconv")
+        if self.alert_engine is not None:
+            # alert lifecycle state (round 23): alert_state{rule=} +
+            # fired/resolved/eval-error totals
+            text += self.alert_engine.prometheus("deconv")
         return Response.text(
             text, content_type="text/plain; version=0.0.4"
         )
+
+    # ------------------------- metric history + alerting (round 23)
+
+    def _tsdb_samples(self) -> dict:
+        """One scrape tick's flattened sample set: the primary metrics
+        registry plus the SLO burn-rate gauges (so burn history is
+        queryable and threshold rules can range over it)."""
+        from deconv_api_tpu.serving.tsdb import KIND_GAUGE, flatten_snapshot
+
+        samples = flatten_snapshot(self.metrics.snapshot())
+        for t in self.slos:
+            for window, rate in t.burn_rates().items():
+                samples[("slo_burn_rate", f"slo={t.name},window={window}")] = (
+                    KIND_GAUGE, rate,
+                )
+        return samples
+
+    def _incident_bundle(self, ctx: dict) -> dict:
+        """Everything a 3 a.m. operator needs frozen at fire time: the
+        triggering rule + its query window, the flight recorder's
+        slow/error rings, and the effective config.  (The router-side
+        analogue adds fleet membership + the autoscale journal tail.)"""
+        import dataclasses
+
+        rule = ctx.get("rule") or {}
+        bundle = dict(ctx)
+        if rule.get("kind") == "threshold" and self.tsdb is not None:
+            bundle["window"] = self.tsdb.query(
+                rule.get("family", ""), rule.get("label") or None,
+                range_s=rule.get("range_s", 60.0),
+            )
+        elif self.tsdb is not None:
+            bundle["window"] = self.tsdb.query(
+                "requests_total", None, range_s=120.0
+            )
+        if self.recorder is not None:
+            bundle["slow"] = self.recorder.query(slow=True, limit=16)
+            bundle["errors"] = self.recorder.query(error=True, limit=16)
+        cfg = dataclasses.asdict(self.cfg)
+        for key in (
+            "weights_path", "compilation_cache_dir", "profile_dir",
+            "jobs_dir", "calibration_dir", "aot_dir", "incidents_dir",
+        ):
+            cfg[key] = bool(cfg[key])
+        bundle["config"] = cfg
+        if self.alert_engine is not None:
+            bundle["alerts"] = self.alert_engine.snapshot()
+        return bundle
+
+    def _tsdb_tick(self) -> None:
+        """Ingest + evaluate + record: the self-scrape tick body
+        (sync — called from the loop task; tests call it directly
+        under an injected clock)."""
+        self.tsdb.ingest(self._tsdb_samples())
+        if self.alert_engine is None:
+            return
+        from deconv_api_tpu.utils import slog as _slog
+
+        for ctx in self.alert_engine.evaluate():
+            if self.incidents is not None:
+                try:
+                    rule_name = (ctx.get("rule") or {}).get("name", "rule")
+                    self.incidents.record(
+                        rule_name, self._incident_bundle(ctx)
+                    )
+                    self.metrics.inc_counter("incidents_recorded_total")
+                except OSError as e:
+                    self.metrics.inc_counter("incident_write_errors_total")
+                    _slog.event(
+                        _slog.get_logger("deconv.app"),
+                        "incident_write_failed",
+                        level=40, error=f"{type(e).__name__}: {e}",
+                    )
+
+    async def _tsdb_loop(self) -> None:
+        interval = self.cfg.tsdb_interval_s
+        sweep_every = max(1, int(60.0 / interval))
+        tick = 0
+        while True:
+            await asyncio.sleep(interval)
+            t0 = time.perf_counter()
+            try:
+                self._tsdb_tick()
+                tick += 1
+                if self.incidents is not None and tick % sweep_every == 0:
+                    self.incidents.sweep()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — the tick must not die
+                from deconv_api_tpu.utils import slog as _slog
+
+                self.metrics.inc_counter("tsdb_tick_errors_total")
+                _slog.event(
+                    _slog.get_logger("deconv.app"), "tsdb_tick_error",
+                    level=40, error=f"{type(e).__name__}: {e}",
+                )
+            # the self-scrape's own cost, the drill's ≤1% duty-cycle
+            # budget: scrape_seconds_total / elapsed
+            self.tsdb.scrapes_total += 1
+            self.tsdb.scrape_seconds_total += time.perf_counter() - t0
+
+    async def _metrics_history(self, req: Request) -> Response:
+        """GET /v1/metrics/history — the embedded TSDB's query surface.
+        No ``family`` = the catalog; with one, series points over the
+        trailing ``range_s`` at ``step_s`` resolution (tier-selected)."""
+        q = req.query
+        family = q.get("family", "")
+        if not family:
+            return Response.json({
+                "families": self.tsdb.families(),
+                "stats": self.tsdb.stats(),
+            })
+        label = q.get("label")
+        try:
+            range_s = float(q.get("range_s", "60"))
+            step_raw = q.get("step_s", "")
+            step_s = float(step_raw) if step_raw else None
+        except ValueError:
+            return _error_response(
+                errors.BadRequest("range_s/step_s must be numeric"), req.id
+            )
+        if range_s <= 0 or (step_s is not None and step_s <= 0):
+            return _error_response(
+                errors.BadRequest("range_s/step_s must be > 0"), req.id
+            )
+        series = self.tsdb.query(
+            family, label, range_s=range_s, step_s=step_s
+        )
+        return Response.json({
+            "family": family,
+            "range_s": range_s,
+            "series": series,
+        })
+
+    async def _alerts(self, _req: Request) -> Response:
+        """GET /v1/alerts — rule states, lifecycle counters, and the
+        engine's eval-error ledger."""
+        if self.alert_engine is None:
+            return Response.json({
+                "rules": [], "firing": 0, "pending": 0,
+                "evals_total": 0, "eval_errors_total": 0,
+            })
+        return Response.json(self.alert_engine.snapshot())
+
+    async def _debug_incidents(self, req: Request) -> Response:
+        """GET /v1/debug/incidents — the black box.  ``?id=`` fetches
+        one digest-verified bundle; without it, the summary list."""
+        inc_id = req.query.get("id", "")
+        if inc_id:
+            doc = self.incidents.load(inc_id)
+            if doc is None:
+                return _error_response(
+                    errors.BadRequest(f"unknown incident {inc_id!r}"), req.id
+                )
+            return Response.json(doc)
+        return Response.json({
+            "incidents": self.incidents.list(),
+            "writes_total": self.incidents.writes_total,
+            "corrupt_total": self.incidents.corrupt_total,
+            "swept_total": self.incidents.swept_total,
+        })
 
     async def _config(self, _req: Request) -> Response:
         """GET /v1/config — the EFFECTIVE server configuration (after env,
@@ -2423,6 +2656,28 @@ class DeconvService:
         cfg["slos"] = bool(cfg["slos"])  # raw spec may be long; no leak
         if self.slos:
             cfg["slo_state"] = {t.name: t.snapshot() for t in self.slos}
+        # metric history + alerting (round 23): live ring occupancy,
+        # rule count, incident ledger — the spec strings themselves stay
+        # unleaked (an alerts file path is a path)
+        cfg["alerts"] = bool(cfg["alerts"])
+        cfg["incidents_dir"] = bool(cfg["incidents_dir"])
+        cfg["tsdb_active"] = self.tsdb is not None
+        if self.tsdb is not None:
+            cfg["tsdb_state"] = self.tsdb.stats()
+        if self.alert_engine is not None:
+            snap = self.alert_engine.snapshot()
+            cfg["alerts_state"] = {
+                "rules": len(snap["rules"]),
+                "firing": snap["firing"],
+                "pending": snap["pending"],
+                "eval_errors_total": snap["eval_errors_total"],
+            }
+        if self.incidents is not None:
+            cfg["incidents_state"] = {
+                "writes_total": self.incidents.writes_total,
+                "corrupt_total": self.incidents.corrupt_total,
+                "swept_total": self.incidents.swept_total,
+            }
         # robustness layer (round 9): live breaker / fault / drain state
         cfg["breaker_active"] = self.cfg.breaker_threshold > 0
         if cfg["breaker_active"]:
@@ -3422,6 +3677,12 @@ class DeconvService:
             # runner tasks need the dispatchers (each job stage rides
             # them); boot already re-queued reclaimed jobs
             self.jobs.start()
+        if self.tsdb is not None and self._tsdb_task is None:
+            # the self-scrape tick: ingest → evaluate → record.  One
+            # task; its body is exception-proof (tsdb_tick_errors_total)
+            self._tsdb_task = asyncio.get_running_loop().create_task(
+                self._tsdb_loop(), name="tsdb-scrape"
+            )
         bind_host = host if host is not None else self.cfg.host
         bound_port = await self.server.start(
             bind_host, self.cfg.port if port is None else port
@@ -3451,6 +3712,11 @@ class DeconvService:
         # faster, authoritative signal than their next probe tick, so
         # they stop routing here before the listener starts dying
         await self.announce_to_routers("drain")
+        if self._tsdb_task is not None:
+            self._tsdb_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._tsdb_task
+            self._tsdb_task = None
         if self.jobs is not None:
             # BEFORE the dispatchers die: a runner parking mid-octave
             # journals from its cancellation handler, and any in-flight
@@ -3595,6 +3861,33 @@ def main(argv: list[str] | None = None) -> None:
         help="latency SLO objects, "
         "'name=<threshold_ms>:<objective_pct>[:<route>]' — burn-rate "
         "gauges on /metrics, an slo block on /readyz (default none)",
+    )
+    p.add_argument(
+        "--tsdb", default=None, metavar="off|on",
+        help="embedded metric history: a self-scrape task samples the "
+        "registries into two ring tiers, queryable at GET "
+        "/v1/metrics/history (default off; --alerts implies on)",
+    )
+    p.add_argument(
+        "--tsdb-interval-s", type=float, default=None,
+        help="self-scrape cadence in seconds (default 1.0)",
+    )
+    p.add_argument(
+        "--alerts", default=None, metavar="JSON|PATH",
+        help="declarative alert rules (inline JSON or a JSON file), "
+        "validated at boot: threshold/burn/absence kinds with for_s "
+        "hold-downs — GET /v1/alerts, alert_state{rule=} gauges, an "
+        "alerts block on /readyz (default none)",
+    )
+    p.add_argument(
+        "--incidents-dir", default=None, metavar="DIR",
+        help="write a digest-verified incident bundle when a rule "
+        "transitions to firing; listable at /v1/debug/incidents "
+        "(default off)",
+    )
+    p.add_argument(
+        "--incidents-retention-s", type=float, default=None,
+        help="incident bundle retention in seconds (default 86400)",
     )
     p.add_argument(
         "--fault", action="append", default=None, metavar="SITE=SPEC",
@@ -3778,6 +4071,16 @@ def main(argv: list[str] | None = None) -> None:
         overrides["trace_sample"] = args.trace_sample
     if args.slo is not None:
         overrides["slos"] = args.slo
+    if args.tsdb is not None:
+        overrides["tsdb"] = args.tsdb
+    if args.tsdb_interval_s is not None:
+        overrides["tsdb_interval_s"] = args.tsdb_interval_s
+    if args.alerts is not None:
+        overrides["alerts"] = args.alerts
+    if args.incidents_dir is not None:
+        overrides["incidents_dir"] = args.incidents_dir
+    if args.incidents_retention_s is not None:
+        overrides["incidents_retention_s"] = args.incidents_retention_s
     if args.no_singleflight:
         overrides["singleflight"] = False
     if args.fault:

@@ -311,6 +311,103 @@ class ArrivalHistory:
         return cur, projected
 
 
+class TsdbArrivalHistory:
+    """ArrivalHistory's interface backed by the router's embedded TSDB
+    (round 23: "the forecaster reads the same history the operator
+    queries").
+
+    ``record`` increments a per-tenant ``arrivals_total`` counter in
+    the ROUTER's metrics registry (bounded cardinality: the tenant tail
+    folds into ``other``, the round 8 rule); the router's self-scrape
+    tick turns that into rate series, and ``rate``/``forecast`` read
+    those series back — so ``GET /v1/metrics/history?family=
+    arrivals_total`` shows exactly the per-tenant arrival curves the
+    predictive scale-up acted on, and the decision journal's forecast
+    numbers are reproducible from the same query after the fact.
+
+    The private accumulator (ArrivalHistory) remains the tsdb=off
+    fallback: byte-parity demands the off path not grow new state."""
+
+    def __init__(
+        self,
+        tsdb,
+        metrics: Metrics,
+        *,
+        bucket_s: float = 5.0,
+        max_tenants: int = 32,
+    ):
+        self.tsdb = tsdb
+        self.metrics = metrics
+        # a forecast bucket can't be finer than the scrape tick
+        self.bucket_s = max(float(bucket_s), tsdb.interval_s)
+        self.max_tenants = max(1, int(max_tenants))
+        self._tenants: set[str] = set()
+        # pre-register so the family exists from the first scrape
+        self.metrics.inc_labeled("arrivals_total", "tenant", "default", 0)
+
+    def record(self, tenant: str, n: int = 1) -> None:
+        t = tenant or "default"
+        if t not in self._tenants:
+            if len(self._tenants) >= self.max_tenants:
+                t = "other"
+            self._tenants.add(t)
+        self.metrics.inc_labeled("arrivals_total", "tenant", t, n)
+
+    def _rates(self, n: int) -> list[float]:
+        """Aggregate req/s per forecast bucket for the last ``n``
+        complete buckets, oldest first — ArrivalHistory._rates'
+        contract, reconstructed from the TSDB's raw rate ticks."""
+        tick = self.tsdb.interval_s
+        series = self.tsdb.query(
+            "arrivals_total", None, range_s=(n + 1) * self.bucket_s
+        )
+        # sum across tenant series per scrape tick (ages within one
+        # query share the same fractional offset, so the rounded tick
+        # ordinal is a stable join key)
+        per_tick: dict[int, float] = {}
+        for ent in series:
+            for p in ent["points"]:
+                key = round(p[0] / tick)
+                per_tick[key] = per_tick.get(key, 0.0) + p[1]
+        # fold ticks into buckets; bucket 0 is the current partial one
+        # and is skipped, like ArrivalHistory's current wall bucket
+        per_bucket: dict[int, list[float]] = {}
+        for key, rate in per_tick.items():
+            per_bucket.setdefault(int(key * tick / self.bucket_s), []).append(
+                rate
+            )
+        out = []
+        for b in range(n, 0, -1):
+            vals = per_bucket.get(b)
+            out.append(sum(vals) / len(vals) if vals else 0.0)
+        return out
+
+    def rate(self, n: int = 3) -> float:
+        rates = self._rates(n)
+        if not rates:
+            return 0.0
+        return sum(rates) / len(rates)
+
+    def forecast(self, horizon_s: float, n: int = 6) -> tuple[float, float]:
+        """Same least-squares extrapolation as ArrivalHistory.forecast,
+        over TSDB-reconstructed bucket rates."""
+        rates = self._rates(n)
+        cur = self.rate()
+        if len(rates) < 3:
+            return cur, cur
+        xs = [i * self.bucket_s for i in range(len(rates))]
+        mean_x = sum(xs) / len(xs)
+        mean_y = sum(rates) / len(rates)
+        sxx = sum((x - mean_x) ** 2 for x in xs)
+        if sxx <= 0:
+            return cur, cur
+        slope = sum(
+            (x - mean_x) * (y - mean_y) for x, y in zip(xs, rates)
+        ) / sxx
+        projected = max(0.0, cur + slope * float(horizon_s))
+        return cur, projected
+
+
 # ------------------------------------------------------------- journal
 
 
@@ -709,6 +806,8 @@ class AutoscaleController:
         drain_settle_s: float = 1.0,
         jobs_poll_timeout_s: float = 5.0,
         arrival_bucket_s: float = 5.0,
+        tsdb=None,
+        tsdb_metrics: Metrics | None = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if mode not in ("advisory", "enforce"):
@@ -745,9 +844,19 @@ class AutoscaleController:
         self.drain_grace_s = float(drain_grace_s)
         self.drain_settle_s = max(0.0, float(drain_settle_s))
         self.jobs_poll_timeout_s = float(jobs_poll_timeout_s)
-        self.arrivals = ArrivalHistory(
-            bucket_s=arrival_bucket_s, clock=clock
-        )
+        # Round 23: with a router-side TSDB, the forecaster reads
+        # per-tenant arrival history from it (TsdbArrivalHistory) — the
+        # same series an operator queries at /v1/metrics/history — and
+        # the private accumulator stays the tsdb=off fallback.
+        if tsdb is not None:
+            self.arrivals = TsdbArrivalHistory(
+                tsdb, tsdb_metrics or self.metrics,
+                bucket_s=arrival_bucket_s,
+            )
+        else:
+            self.arrivals = ArrivalHistory(
+                bucket_s=arrival_bucket_s, clock=clock
+            )
         self.journal = DecisionJournal(journal_path) if journal_path else None
         if journal_path:
             self.engine.restore(

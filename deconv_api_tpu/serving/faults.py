@@ -81,6 +81,12 @@ SITES = (
     # ever double-counting fleet capacity.  Both are drill-armable.
     "autoscale.decision_error",  # decision tick raises mid-evaluation
     "autoscale.launch_fail",     # backend launch attempt fails
+    # Alert-engine site (round 23, serving/alerts.py): the evaluator's
+    # failure contract is fail-STATIC — a crashing rule evaluation
+    # increments alerts_eval_errors_total and leaves every rule's
+    # lifecycle state EXACTLY where it was (a firing alert never flaps
+    # to resolved because the evaluator died).  Drill-armable.
+    "alerts.eval_error",         # alert rule evaluation raises mid-tick
 )
 
 

@@ -224,6 +224,11 @@ from typing import Callable
 
 from deconv_api_tpu import errors
 from deconv_api_tpu.serving import faults as faults_mod
+from deconv_api_tpu.serving.alerts import (
+    AlertEngine,
+    IncidentStore,
+    parse_alert_rules,
+)
 from deconv_api_tpu.serving.batcher import CircuitBreaker
 from deconv_api_tpu.serving.cache import canonical_digest
 from deconv_api_tpu.serving.http import HttpServer, Request, Response
@@ -240,6 +245,7 @@ from deconv_api_tpu.serving.trace import (
     assemble_timeline,
     debug_query_args,
 )
+from deconv_api_tpu.serving.tsdb import KIND_GAUGE, Tsdb, flatten_snapshot
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.fleet")
@@ -1331,6 +1337,11 @@ class FleetRouter:
         stream_relay_min_bytes: int = 262144,
         autoscale: str = "off",
         autoscale_opts: dict | None = None,
+        tsdb: str = "off",
+        tsdb_interval_s: float = 1.0,
+        alerts: str = "",
+        incidents_dir: str = "",
+        incidents_retention_s: float = 86400.0,
         worker: int | None = None,
         metrics: Metrics | None = None,
         clock: Callable[[], float] = time.monotonic,
@@ -1528,6 +1539,39 @@ class FleetRouter:
         # into the membership file so peers that DO know them converge.
         # Bounded; token-authenticated callers only.
         self._foreign_drains: OrderedDict[str, None] = OrderedDict()
+        # the fleet's memory (round 23): router-side retention/alerting
+        # plane over the SAME registry the federation scrape reads.
+        # tsdb=off (and no rules) is the escape hatch — no objects, no
+        # task, no routes; the router stays byte-identical to the
+        # round-22 dialect.  A non-empty rule spec implies the TSDB: a
+        # rule without history would be a dead object.
+        if tsdb not in ("off", "on"):
+            raise ValueError(f"tsdb={tsdb!r}: expected off|on")
+        if float(tsdb_interval_s) <= 0:
+            raise ValueError("tsdb_interval_s must be > 0")
+        self.tsdb: Tsdb | None = None
+        self.alert_engine: AlertEngine | None = None
+        self.incidents: IncidentStore | None = None
+        self._tsdb_task: asyncio.Task | None = None
+        self.tsdb_interval_s = float(tsdb_interval_s)
+        if tsdb == "on" or alerts:
+            self.tsdb = Tsdb(self.tsdb_interval_s, clock=clock)
+            try:
+                rules = parse_alert_rules(
+                    alerts,
+                    known_slos=frozenset(t.name for t in self.slos),
+                )
+            except ValueError as e:
+                raise ValueError(f"invalid alerts spec: {e}") from e
+            if rules:
+                self.alert_engine = AlertEngine(
+                    rules, self.tsdb, slos=self.slos, clock=clock
+                )
+            if incidents_dir:
+                self.incidents = IncidentStore(
+                    incidents_dir,
+                    retention_s=float(incidents_retention_s),
+                )
         # closed-loop elasticity (round 22): off is the escape hatch —
         # no controller object, no arrival recording, no config/readyz
         # block, no metric families; the router is byte-identical to
@@ -1549,6 +1593,12 @@ class FleetRouter:
                 fleet_token=fleet_token,
                 faults=self.faults,
                 clock=clock,
+                # round 23 closes the loop: with the TSDB on, the
+                # forecaster reads per-tenant arrivals back from the
+                # SAME history plane an operator queries, instead of a
+                # private accumulator nobody can inspect
+                tsdb=self.tsdb,
+                tsdb_metrics=self.metrics,
                 **(autoscale_opts or {}),
             )
 
@@ -1575,6 +1625,20 @@ class FleetRouter:
             self._debug_trace
         )
         self.server.route("GET", "/v1/metrics/fleet")(self._metrics_fleet)
+        if self.tsdb is not None:
+            # the fleet's memory (round 23).  Exact routes SHADOW
+            # proxying of these paths (the /v1/debug/requests
+            # precedent): the router answers with its OWN history and
+            # alerts plus a per-backend federation block — ask a member
+            # directly for its raw surface.
+            self.server.route("GET", "/v1/metrics/history")(
+                self._metrics_history
+            )
+            self.server.route("GET", "/v1/alerts")(self._alerts_route)
+            if self.incidents is not None:
+                self.server.route("GET", "/v1/debug/incidents")(
+                    self._debug_incidents
+                )
         if self.fleet_token:
             # self-registration surface (round 16): ONLY with a shared
             # token configured — a tokenless router keeps the whole
@@ -3808,6 +3872,15 @@ class FleetRouter:
             # controller last saw and decided, on the same probe an
             # operator already reads
             body["autoscale"] = self.autoscaler.ready_block()
+        if self.alert_engine is not None:
+            # round 23: informational ONLY — a firing alert must never
+            # pull router capacity out of the LB (the SLO-burn rule)
+            snap = self.alert_engine.snapshot()
+            body["alerts"] = {
+                "firing": self.alert_engine.firing(),
+                "pending": snap["pending"],
+                "eval_errors_total": snap["eval_errors_total"],
+            }
         return Response.json(body, status=200 if ok else 503)
 
     async def _config(self, _req: Request) -> Response:
@@ -3895,6 +3968,31 @@ class FleetRouter:
                 **(
                     {"autoscale": self.autoscaler.config_block()}
                     if self.autoscaler is not None
+                    else {}
+                ),
+                # round 23: the fleet-memory block — same ABSENT-when-
+                # off byte-identity pin
+                **(
+                    {
+                        "tsdb": {
+                            "interval_s": self.tsdb_interval_s,
+                            "stats": self.tsdb.stats(),
+                            "alert_rules": (
+                                len(self.alert_engine.rules)
+                                if self.alert_engine is not None
+                                else 0
+                            ),
+                            "alerts_firing": (
+                                self.alert_engine.firing()
+                                if self.alert_engine is not None
+                                else []
+                            ),
+                            "incidents_dir_set": (
+                                self.incidents is not None
+                            ),
+                        }
+                    }
+                    if self.tsdb is not None
                     else {}
                 ),
                 "members": {
@@ -4086,14 +4184,41 @@ class FleetRouter:
             if status == 200:
                 text = body.decode("utf-8", "replace")
                 self._scrape_cache[m.name] = (now, text)
+                self._stamp_scrape_health(m.name, True, 0.0)
                 return m.name, text, 0.0
         except _BackendError:
             pass
         cached = self._scrape_cache.get(m.name)
         if cached is not None:
             ts, text = cached
-            return m.name, text, round(now - ts, 3)
+            # floor the staleness of a FAILED scrape above 0: exactly
+            # 0.0 means "live" to every downstream consumer (scrape_ok,
+            # the absence rules), and a cache written sub-millisecond
+            # ago would otherwise round into masquerading as one
+            staleness = max(round(now - ts, 3), 0.001)
+            self._stamp_scrape_health(m.name, False, staleness)
+            return m.name, text, staleness
+        self._stamp_scrape_health(m.name, False, None)
         return m.name, None, None
+
+    def _stamp_scrape_health(
+        self, name: str, live: bool, staleness: float | None
+    ) -> None:
+        """Mirror per-member scrape health into the router's OWN
+        registry (round 23 satellite): the federation exposition always
+        stamped these, but only as ephemeral text — a dead member's
+        cached counters rode /v1/metrics/fleet with nothing durable
+        saying "this is a corpse".  As labeled gauges they ride the
+        router scrape AND the TSDB self-scrape, so an absence/threshold
+        rule over ``fleet_scrape_ok`` is trustworthy end-to-end."""
+        self.metrics.set_labeled_gauge(
+            "fleet_scrape_ok", "backend", name, 1.0 if live else 0.0
+        )
+        if staleness is not None:
+            self.metrics.set_labeled_gauge(
+                "fleet_scrape_staleness_seconds", "backend", name,
+                staleness,
+            )
 
     async def _metrics_fleet(self, req: Request) -> Response:
         """GET /v1/metrics/fleet — metrics federation (round 19): one
@@ -4192,11 +4317,14 @@ class FleetRouter:
         )
         lines.append("# TYPE fleet_scrape_staleness_seconds gauge")
         for name, text, staleness in results:
-            if staleness is None:
-                continue  # never scraped: no last-good to be stale
+            # never-scraped members stamp +Inf (round 23 satellite): an
+            # ABSENT staleness sample next to a present (cached) counter
+            # set read as "live and idle" — a member dead from birth
+            # must be visibly, infinitely stale instead of invisible
+            val = "+Inf" if staleness is None else f"{staleness:g}"
             lines.append(
                 "fleet_scrape_staleness_seconds"
-                f'{{backend="{escape_label(name)}"}} {staleness:g}'
+                f'{{backend="{escape_label(name)}"}} {val}'
             )
         lines.append("# TYPE fleet_backends_scraped gauge")
         lines.append(
@@ -4209,6 +4337,253 @@ class FleetRouter:
             content_type="text/plain; version=0.0.4",
         )
 
+    # ------------------------------------------- fleet memory (round 23)
+
+    def _tsdb_samples(self) -> dict:
+        """One self-scrape tick's flattened sample set: the router
+        registry, the live SLO burn gauges, the autoscaler's registry
+        under an ``autoscaler_`` prefix (two registries, one series
+        universe — no family collisions), and per-member ring state
+        straight from the probe loop, so an absence or threshold rule
+        sees membership without anyone hitting the federation scrape."""
+        samples = flatten_snapshot(self.metrics.snapshot())
+        for t in self.slos:
+            for window, rate in t.burn_rates().items():
+                samples[
+                    ("slo_burn_rate", f"slo={t.name},window={window}")
+                ] = (KIND_GAUGE, rate)
+        if self.autoscaler is not None:
+            auto = flatten_snapshot(self.autoscaler.metrics.snapshot())
+            for (fam, label), kv in auto.items():
+                samples[(f"autoscaler_{fam}", label)] = kv
+        samples[("fleet_members", "")] = (
+            KIND_GAUGE, float(len(self.members)),
+        )
+        for m in self.members.values():
+            samples[("fleet_member_in_ring", f"backend={m.name}")] = (
+                KIND_GAUGE, 1.0 if m.in_ring else 0.0,
+            )
+        return samples
+
+    def _incident_bundle(self, ctx: dict) -> dict:
+        """The router's black box: the triggering rule + its query
+        window, the router recorder's slow/error rings, ring membership
+        with per-member state, and the autoscale journal tail — the
+        fleet-shaped forensics a backend bundle cannot see."""
+        rule = ctx.get("rule") or {}
+        bundle = dict(ctx)
+        if rule.get("kind") == "threshold":
+            bundle["window"] = self.tsdb.query(
+                rule.get("family", ""), rule.get("label") or None,
+                range_s=rule.get("range_s", 60.0),
+            )
+        else:
+            bundle["window"] = self.tsdb.query(
+                "requests_total", None, range_s=120.0
+            )
+        if self.recorder is not None:
+            bundle["slow"] = self.recorder.query(slow=True, limit=16)
+            bundle["errors"] = self.recorder.query(error=True, limit=16)
+        bundle["members"] = {
+            m.name: {
+                "state": m.state,
+                "in_ring": m.in_ring,
+                "source": self._member_source.get(m.name, "static"),
+                "announced_drain": m.announced_drain,
+            }
+            for m in self.members.values()
+        }
+        if self.autoscaler is not None:
+            bundle["autoscale"] = self.autoscaler.ready_block()
+            if self.autoscaler.journal is not None:
+                from deconv_api_tpu.serving.autoscale import (
+                    DecisionJournal,
+                )
+
+                bundle["autoscale_journal"] = DecisionJournal.replay(
+                    self.autoscaler.journal.path
+                )[-16:]
+        if self.alert_engine is not None:
+            bundle["alerts"] = self.alert_engine.snapshot()
+        return bundle
+
+    def _tsdb_tick(self) -> None:
+        """Ingest + evaluate + record (sync — the loop task calls it;
+        tests drive it directly under an injected clock)."""
+        self.tsdb.ingest(self._tsdb_samples())
+        if self.alert_engine is None:
+            return
+        for ctx in self.alert_engine.evaluate():
+            if self.incidents is not None:
+                try:
+                    rule_name = (ctx.get("rule") or {}).get("name", "rule")
+                    self.incidents.record(
+                        rule_name, self._incident_bundle(ctx)
+                    )
+                    self.metrics.inc_counter("incidents_recorded_total")
+                except OSError as e:
+                    self.metrics.inc_counter("incident_write_errors_total")
+                    slog.event(
+                        _log, "incident_write_failed",
+                        level=40, error=f"{type(e).__name__}: {e}",
+                    )
+
+    async def _tsdb_loop(self) -> None:
+        interval = self.tsdb_interval_s
+        sweep_every = max(1, int(60.0 / interval))
+        tick = 0
+        while True:
+            await asyncio.sleep(interval)
+            t0 = time.perf_counter()
+            try:
+                self._tsdb_tick()
+                tick += 1
+                if self.incidents is not None and tick % sweep_every == 0:
+                    self.incidents.sweep()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — the tick must not die
+                self.metrics.inc_counter("tsdb_tick_errors_total")
+                slog.event(
+                    _log, "tsdb_tick_error",
+                    level=40, error=f"{type(e).__name__}: {e}",
+                )
+            # the self-scrape's own cost: the drill's ≤1% duty-cycle
+            # budget reads scrape_seconds_total / elapsed
+            self.tsdb.scrapes_total += 1
+            self.tsdb.scrape_seconds_total += time.perf_counter() - t0
+
+    def _bad_request(self, message: str, rid: str) -> Response:
+        return Response.json(
+            {"error": "bad_request", "message": message,
+             "request_id": rid},
+            400,
+        )
+
+    async def _metrics_history(self, req: Request) -> Response:
+        """GET /v1/metrics/history — the router's OWN history under
+        ``router``, federated per-backend histories under ``backends``
+        (the /v1/metrics/fleet shape applied to retention).
+        ``backend=<name>`` restricts the fan-out to one member;
+        ``backend=none`` skips it (router-local only)."""
+        q = dict(req.query)
+        backend_sel = q.pop("backend", "all")
+        family = q.get("family", "")
+        label = q.get("label")
+        try:
+            range_s = float(q.get("range_s", "60"))
+            step_raw = q.get("step_s", "")
+            step_s = float(step_raw) if step_raw else None
+        except ValueError:
+            return self._bad_request(
+                "range_s/step_s must be numeric", req.id
+            )
+        if range_s <= 0 or (step_s is not None and step_s <= 0):
+            return self._bad_request("range_s/step_s must be > 0", req.id)
+        if family:
+            own: dict = {
+                "family": family,
+                "range_s": range_s,
+                "series": self.tsdb.query(
+                    family, label, range_s=range_s, step_s=step_s
+                ),
+            }
+        else:
+            own = {
+                "families": self.tsdb.families(),
+                "stats": self.tsdb.stats(),
+            }
+        body: dict = {"router": own}
+        if backend_sel != "none":
+            targets = [
+                m for m in self.members.values()
+                if backend_sel in ("all", m.name)
+            ]
+            if not targets and backend_sel != "all":
+                return self._bad_request(
+                    f"unknown backend {backend_sel!r}", req.id
+                )
+            path = "/v1/metrics/history"
+            if q:
+                path += "?" + urllib.parse.urlencode(q)
+
+            async def fetch(m: BackendMember):
+                try:
+                    status, _h, b = await raw_request(
+                        m.host, m.port, "GET", path, {}, b"",
+                        self.walk_timeout_s,
+                    )
+                    if status == 200:
+                        return m.name, json.loads(b.decode("utf-8"))
+                    # a member without its own TSDB answers 404 — a
+                    # federation hole, not an error
+                    return m.name, {"error": f"status_{status}"}
+                except (_BackendError, ValueError):
+                    return m.name, {"error": "unreachable"}
+
+            results = await asyncio.gather(*(fetch(m) for m in targets))
+            body["backends"] = {name: doc for name, doc in results}
+        return Response.json(body)
+
+    async def _alerts_route(self, req: Request) -> Response:
+        """GET /v1/alerts — the router engine's rule states plus every
+        member's alert document federated under ``backends`` (each key
+        is the ``backend=`` label the fleet exposition uses): one
+        surface answers "is anything firing anywhere".  ``?self=1``
+        skips the fan-out."""
+        if self.alert_engine is not None:
+            own = self.alert_engine.snapshot()
+        else:
+            own = {
+                "rules": [], "firing": 0, "pending": 0,
+                "evals_total": 0, "eval_errors_total": 0,
+            }
+        body: dict = {"router": own}
+        firing = int(own.get("firing", 0))
+        if req.query.get("self", "") not in ("1", "true"):
+
+            async def fetch(m: BackendMember):
+                try:
+                    status, _h, b = await raw_request(
+                        m.host, m.port, "GET", "/v1/alerts", {}, b"",
+                        self.walk_timeout_s,
+                    )
+                    if status == 200:
+                        return m.name, json.loads(b.decode("utf-8"))
+                    return m.name, {"error": f"status_{status}"}
+                except (_BackendError, ValueError):
+                    return m.name, {"error": "unreachable"}
+
+            results = await asyncio.gather(
+                *(fetch(m) for m in self.members.values())
+            )
+            body["backends"] = {name: doc for name, doc in results}
+            for doc in body["backends"].values():
+                if isinstance(doc.get("firing"), int):
+                    firing += doc["firing"]
+        body["firing_anywhere"] = firing
+        return Response.json(body)
+
+    async def _debug_incidents(self, req: Request) -> Response:
+        """GET /v1/debug/incidents — the router's black box (exact
+        route shadows proxying: a BACKEND's bundles live on the backend,
+        ask it directly).  ``?id=`` fetches one digest-verified bundle;
+        without it, the summary list."""
+        inc_id = req.query.get("id", "")
+        if inc_id:
+            doc = self.incidents.load(inc_id)
+            if doc is None:
+                return self._bad_request(
+                    f"unknown incident {inc_id!r}", req.id
+                )
+            return Response.json(doc)
+        return Response.json({
+            "incidents": self.incidents.list(),
+            "writes_total": self.incidents.writes_total,
+            "corrupt_total": self.incidents.corrupt_total,
+            "swept_total": self.incidents.swept_total,
+        })
+
     async def _metrics_route(self, _req: Request) -> Response:
         text = self.metrics.prometheus()
         if self.recorder is not None:
@@ -4216,6 +4591,10 @@ class FleetRouter:
             # aggregates + ring occupancy, the backend precedent
             text += self.recorder.prometheus("router")
         text += slo_prometheus(self.slos, "router")
+        if self.alert_engine is not None:
+            # round 23: rule lifecycle states as gauges — the fleet's
+            # alarm rides the same scrape as everything it watches
+            text += self.alert_engine.prometheus("router")
         if self.autoscaler is not None:
             # round 22: the controller's own registry (autoscaler_*
             # families) rides the router scrape — decisions land on the
@@ -4249,6 +4628,10 @@ class FleetRouter:
         self._probe_task = asyncio.create_task(self._probe_loop())
         if self.autoscaler is not None:
             self.autoscaler.start()
+        if self.tsdb is not None and self._tsdb_task is None:
+            self._tsdb_task = asyncio.get_running_loop().create_task(
+                self._tsdb_loop(), name="router-tsdb-scrape"
+            )
         return bound
 
     def begin_drain(self) -> None:
@@ -4259,6 +4642,13 @@ class FleetRouter:
         self.begin_drain()
         if self.autoscaler is not None:
             await self.autoscaler.stop()
+        if self._tsdb_task is not None:
+            self._tsdb_task.cancel()
+            try:
+                await self._tsdb_task
+            except asyncio.CancelledError:
+                pass
+            self._tsdb_task = None
         if self._probe_task is not None:
             self._probe_task.cancel()
             try:
@@ -4539,6 +4929,35 @@ def main(argv: list[str] | None = None) -> int:
         help="per-backend device-ms/s capacity budget gating "
         "scale-down (default 800)",
     )
+    p.add_argument(
+        "--tsdb", choices=("off", "on"), default="off",
+        help="embedded metric history (round 23): a self-scrape task "
+        "samples the router registry into bounded ring buffers, "
+        "queryable at GET /v1/metrics/history with per-backend "
+        "federation; off (default) is byte-identical to the round-22 "
+        "router",
+    )
+    p.add_argument(
+        "--tsdb-interval-s", type=float, default=1.0,
+        help="self-scrape interval for the raw tier (default 1.0; the "
+        "rollup tier is 15x coarser)",
+    )
+    p.add_argument(
+        "--alerts", default="", metavar="JSON|PATH",
+        help="declarative alert rules (inline JSON or a file path), "
+        "validated at boot; non-empty implies --tsdb on",
+    )
+    p.add_argument(
+        "--incidents-dir", default="", metavar="PATH",
+        help="directory for digest-verified incident bundles snapshot "
+        "on firing transitions (GET /v1/debug/incidents); empty = "
+        "evaluate but never record",
+    )
+    p.add_argument(
+        "--incidents-retention-s", type=float, default=86400.0,
+        help="seconds an incident bundle survives the sweep "
+        "(default 86400)",
+    )
     args = p.parse_args(argv)
     if args.slo:
         try:
@@ -4547,6 +4966,18 @@ def main(argv: list[str] | None = None) -> int:
                 args.slo,
                 observable_routes=frozenset(
                     (*_ROUTE_FAMILIES, "/v1/jobs/{id}", "other")
+                ),
+            )
+        except ValueError as e:
+            p.error(str(e))
+    if args.alerts:
+        try:
+            # validate BEFORE binding a listener on a typo'd rule
+            parse_alert_rules(
+                args.alerts,
+                known_slos=frozenset(
+                    s.split("=", 1)[0].strip()
+                    for s in args.slo.split(",") if s.strip()
                 ),
             )
         except ValueError as e:
@@ -4607,6 +5038,11 @@ def main(argv: list[str] | None = None) -> int:
             pool_size=args.pool_size,
             pool_idle_s=args.pool_idle_s,
             stream_relay_min_bytes=args.stream_relay_min_bytes,
+            tsdb=args.tsdb,
+            tsdb_interval_s=args.tsdb_interval_s,
+            alerts=args.alerts,
+            incidents_dir=args.incidents_dir,
+            incidents_retention_s=args.incidents_retention_s,
             autoscale=args.autoscale,
             autoscale_opts={
                 "interval_s": args.autoscale_interval_s,

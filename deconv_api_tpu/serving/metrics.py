@@ -224,7 +224,8 @@ class Metrics:
             return dict(series)
 
     def observe_hist(
-        self, family: str, label, value, seconds: float
+        self, family: str, label, value, seconds: float,
+        exemplar: str | None = None,
     ) -> None:
         """Fixed-bucket latency histogram observation (round 19).
 
@@ -233,7 +234,14 @@ class Metrics:
         for the request-duration family).  Buckets are the module-level
         ``HIST_BUCKETS_S`` vocabulary for EVERY histogram family, which
         is what makes the fleet federation sum them meaningfully.
-        O(1): one bisect + three increments under the registry lock."""
+        O(1): one bisect + three increments under the registry lock.
+
+        ``exemplar`` (round 23) is a request id: each bucket keeps the
+        MOST RECENT id that landed in it, rendered as an OpenMetrics
+        exemplar on the exposition — the metrics→trace join (a bad p99
+        bucket names a request you can fetch at /v1/debug/trace/{id}).
+        One tuple store per observation; bounded by construction (one
+        slot per bucket per labelset, newest wins)."""
         if isinstance(label, tuple) != isinstance(value, tuple):
             raise TypeError("label and value must both be str or both tuple")
         if isinstance(label, tuple) and len(label) != len(value):
@@ -254,11 +262,14 @@ class Metrics:
             h = series.get(value)
             if h is None:
                 h = series[value] = [
-                    [0] * (len(HIST_BUCKETS_S) + 1), 0.0, 0
+                    [0] * (len(HIST_BUCKETS_S) + 1), 0.0, 0,
+                    [None] * (len(HIST_BUCKETS_S) + 1),
                 ]
             h[0][i] += 1
             h[1] += seconds
             h[2] += 1
+            if exemplar is not None:
+                h[3][i] = (exemplar, seconds)
 
     def hist_series(self, family: str) -> dict:
         """{label values: {"buckets": non-cumulative counts, "sum":
@@ -367,7 +378,7 @@ class Metrics:
                         fam: (
                             label,
                             {
-                                k: [list(h[0]), h[1], h[2]]
+                                k: [list(h[0]), h[1], h[2], list(h[3])]
                                 for k, h in series.items()
                             },
                         )
@@ -456,7 +467,10 @@ class Metrics:
         # fixed-bucket histograms (round 19): one TYPE header per
         # family, cumulative le= buckets + _sum/_count per labelset —
         # the exposition shape Prometheus aggregates across processes,
-        # which is exactly what the fleet federation endpoint does
+        # which is exactly what the fleet federation endpoint does.
+        # Round 23: each bucket carries its most-recent request id as an
+        # OpenMetrics exemplar (``... N # {trace_id="..."} <seconds>``)
+        # so a bad bucket is joinable against /v1/debug/trace/{id}.
         for fam, (label, series) in sorted(s["histograms"].items()):
             lines.append(
                 f"# HELP {p}_{fam} fixed-bucket latency histogram "
@@ -464,20 +478,28 @@ class Metrics:
             )
             lines.append(f"# TYPE {p}_{fam} histogram")
             names = label if isinstance(label, tuple) else (label,)
-            for value, (buckets, total, count) in sorted(series.items()):
+            for value, (buckets, total, count, exem) in sorted(
+                series.items()
+            ):
                 values = value if isinstance(value, tuple) else (value,)
                 block = ",".join(
                     f'{k}="{escape_label(v)}"' for k, v in zip(names, values)
                 )
                 cum = 0
-                for bound, n in zip(HIST_BUCKETS_S, buckets):
+                for i, (bound, n) in enumerate(zip(HIST_BUCKETS_S, buckets)):
                     cum += n
-                    lines.append(
-                        f'{p}_{fam}_bucket{{{block},le="{bound:g}"}} {cum}'
-                    )
-                lines.append(
-                    f'{p}_{fam}_bucket{{{block},le="+Inf"}} {count}'
-                )
+                    line = f'{p}_{fam}_bucket{{{block},le="{bound:g}"}} {cum}'
+                    if exem[i] is not None:
+                        rid, obs = exem[i]
+                        line += (
+                            f' # {{trace_id="{escape_label(rid)}"}} {obs:.6f}'
+                        )
+                    lines.append(line)
+                line = f'{p}_{fam}_bucket{{{block},le="+Inf"}} {count}'
+                if exem[-1] is not None:
+                    rid, obs = exem[-1]
+                    line += f' # {{trace_id="{escape_label(rid)}"}} {obs:.6f}'
+                lines.append(line)
                 lines.append(f"{p}_{fam}_sum{{{block}}} {total:.6f}")
                 lines.append(f"{p}_{fam}_count{{{block}}} {count}")
         # labeled gauges (round 10): per-lane in-flight depth and breaker

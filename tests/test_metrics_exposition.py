@@ -31,6 +31,14 @@ _SAMPLE_RE = re.compile(
     r"(?:\{(.*)\})?"  # optional label block
     r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|NaN|[+-]?Inf))$"
 )
+# round 23: OpenMetrics exemplar suffix — '<sample> # {labels} value'.
+# Stripped off BEFORE _SAMPLE_RE (the sample's own label block is
+# greedy, so one combined pattern would mis-group); greedy (.*) binds
+# to the LAST ' # {' so exemplar label values stay intact.
+_EXEMPLAR_RE = re.compile(
+    r"^(.*) # \{(.*)\} "
+    r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)$"
+)
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
 _KINDS = ("counter", "gauge", "summary", "histogram", "untyped")
 
@@ -75,9 +83,28 @@ def lint_exposition(text: str) -> tuple[dict[str, str], dict[tuple, float]]:
         elif line.startswith("#"):
             raise AssertionError(f"unknown comment line {line!r}")
         else:
+            ex_labels = None
+            ex = _EXEMPLAR_RE.match(line)
+            if ex is not None:
+                line, ex_labels, ex_value = ex.groups()
             m = _SAMPLE_RE.match(line)
             assert m, f"unparseable sample line {line!r}"
             name, labels, value = m.groups()
+            if ex_labels is not None:
+                # exemplars only make sense on cumulative bucket
+                # samples, their label block must round-trip the same
+                # escaping grammar, and the observation value must
+                # parse (round 23: the metrics->trace join)
+                assert name.endswith("_bucket"), (
+                    f"exemplar on non-bucket sample {line!r}"
+                )
+                rebuilt = ",".join(
+                    f'{k}="{v}"' for k, v in _LABEL_RE.findall(ex_labels)
+                )
+                assert rebuilt == ex_labels, (
+                    f"bad exemplar label escaping in {line!r}"
+                )
+                float(ex_value)
             if labels:
                 # the whole label block must round-trip through the
                 # escaping grammar — an unescaped quote/backslash/newline
@@ -330,3 +357,75 @@ def test_escape_label_helper():
     assert escape_label("a\\b") == "a\\\\b"
     assert escape_label("a\nb") == "a\\nb"
     assert escape_label("plain_code") == "plain_code"
+
+
+def test_exemplar_syntax_lints_and_joins_to_request_id():
+    """Round 23: ``observe_hist(..., exemplar=rid)`` renders the most
+    recent request id per bucket as an OpenMetrics exemplar — the
+    metrics→trace join — and the lint validates the suffix without
+    disturbing the sample's own parse."""
+    m = Metrics()
+    _traffic(m)
+    m.observe_hist(
+        "request_duration_seconds", ("route", "qos_class"),
+        ("/v1/deconv", "standard"), 0.012, exemplar="r-abc123",
+    )
+    text = m.prometheus()
+    families, samples = lint_exposition(text)
+    assert families["deconv_request_duration_seconds"] == "histogram"
+    # the exemplar rides the matching bucket line and only that line
+    ex_lines = [
+        line for line in text.splitlines() if ' # {trace_id="r-abc123"}' in line
+    ]
+    assert ex_lines, "exemplar missing from exposition"
+    for line in ex_lines:
+        assert "_bucket{" in line
+    # newest-wins: a later observation into the same bucket replaces it
+    m.observe_hist(
+        "request_duration_seconds", ("route", "qos_class"),
+        ("/v1/deconv", "standard"), 0.012, exemplar="r-newer",
+    )
+    text2 = m.prometheus()
+    lint_exposition(text2)
+    assert ' # {trace_id="r-newer"}' in text2
+    le_of = [
+        line for line in text2.splitlines()
+        if ' # {trace_id="r-newer"}' in line
+    ]
+    assert len(le_of) == 1
+    # values without exemplars stay byte-identical to the classic shape
+    assert "deconv_request_duration_seconds_sum" in text2
+
+
+def test_exemplar_on_non_bucket_sample_rejected():
+    with pytest.raises(AssertionError):
+        lint_exposition(
+            "# TYPE deconv_cache_hits_total counter\n"
+            'deconv_cache_hits_total 3 # {trace_id="r-1"} 0.5\n'
+        )
+
+
+def test_alert_state_families_lint():
+    """Round 23: the alert engine's gauge/counter families hold the
+    exposition contract from the first scrape (every rule
+    pre-registered, no family duplicated)."""
+    import json
+
+    from deconv_api_tpu.serving.alerts import AlertEngine, parse_alert_rules
+    from deconv_api_tpu.serving.tsdb import Tsdb
+
+    rules = parse_alert_rules(json.dumps([
+        {"name": "hot", "kind": "threshold", "family": "errors_total",
+         "agg": "mean", "op": ">", "value": 1.0, "range_s": 30.0,
+         "for_s": 5.0, "severity": "warn"},
+        {"name": "gone", "kind": "absence", "family": "requests_total",
+         "stale_s": 30.0, "for_s": 0.0, "severity": "page"},
+    ]))
+    engine = AlertEngine(rules, Tsdb(1.0), clock=lambda: 100.0)
+    families, samples = lint_exposition(engine.prometheus("deconv"))
+    assert families["deconv_alert_state"] == "gauge"
+    assert families["deconv_alerts_fired_total"] == "counter"
+    assert families["deconv_alerts_resolved_total"] == "counter"
+    assert families["deconv_alerts_eval_errors_total"] == "counter"
+    assert samples[("deconv_alert_state", 'rule="hot"')] == 0.0
+    assert samples[("deconv_alert_state", 'rule="gone"')] == 0.0

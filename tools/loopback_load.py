@@ -184,6 +184,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import hashlib
 import io
 import json
 import os
@@ -4870,6 +4871,407 @@ def run_autoscale_diurnal_drill(
     return asyncio.run(drive())
 
 
+def run_incident_drill(
+    n_healthy: int = 96,
+    fault_stream_s: float = 3.0,
+    tsdb_interval_s: float = 0.2,
+) -> dict:
+    """The round-23 alerting drill: ONE in-process backend with the
+    embedded TSDB self-scraping, a declarative rule page, and the
+    incident black box — driven through a healthy phase, a gray
+    failure, and recovery:
+
+    - **zero false positives**: the healthy phase runs the full rule
+      page (threshold + absence) over live traffic and must end with
+      zero alerts ever fired;
+    - **detection**: ``device.dispatch_delay_ms=p1:150`` armed through
+      the live debug endpoint must take the matching threshold rule
+      ok → pending → firing within the detection budget;
+    - **forensics**: the firing transition must have recorded exactly
+      one incident bundle whose on-disk digest verifies, whose frozen
+      rule/window name the triggering family, and whose slow-ring
+      capture contains a request id the CLIENT saw during the fault —
+      joinable back through ``/v1/debug/requests?id=``;
+    - **resolution**: disarming must resolve the rule within budget
+      (rates age out of the window; no operator reset);
+    - **cost**: the self-scrape's mean tick cost, normalized to the
+      shipped 1 s default interval, must stay under the 1% duty-cycle
+      budget — and a ``tsdb=off`` twin must keep the seed surface
+      (no history/alerts/incidents routes, no live stats in /v1/config).
+    """
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import urllib.parse
+
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.config import ServerConfig
+    from deconv_api_tpu.models.spec import init_params
+    from deconv_api_tpu.serving.app import DeconvService
+
+    detect_budget_s = float(os.environ.get("INCIDENT_DETECT_BUDGET_S", "8"))
+    resolve_budget_s = float(os.environ.get("INCIDENT_RESOLVE_BUDGET_S", "12"))
+    overhead_budget_pct = 1.0
+
+    # the rule page: the gray-failure detector (dispatch stalls per
+    # second, a counter the TSDB stores as a rate — it decays to zero
+    # on its own when the fault clears, so resolution needs no reset)
+    # plus an absence rule that must stay quiet while traffic flows
+    rules = json.dumps([
+        {
+            "name": "dispatch-stall", "kind": "threshold",
+            "family": "faults_injected_total",
+            "label": "site=device.dispatch_delay_ms",
+            "agg": "max", "op": ">", "value": 0.5,
+            "range_s": 2.0, "for_s": 0.4, "severity": "page",
+        },
+        {
+            "name": "traffic-absent", "kind": "absence",
+            "family": "requests_total", "stale_s": 30.0, "for_s": 1.0,
+            "severity": "warn",
+        },
+    ])
+
+    spec = _tiny_spec()
+    size = spec.input_shape[0]
+    params = init_params(spec, jax.random.PRNGKey(0))
+    incidents_dir = tempfile.mkdtemp(prefix="deconv-incidents-drill-")
+
+    def build_cfg(**memory) -> ServerConfig:
+        return ServerConfig(
+            image_size=size,
+            max_batch=16,
+            batch_window_ms=3.0,
+            platform="cpu",
+            compilation_cache_dir="",
+            # no cache: every request must DISPATCH, or the armed
+            # dispatch-delay site never sees them
+            cache_bytes=0,
+            warmup_all_buckets=False,
+            fault_injection=True,
+            **memory,
+        )
+
+    cfg_on = build_cfg(
+        tsdb="on", tsdb_interval_s=tsdb_interval_s, alerts=rules,
+        incidents_dir=incidents_dir,
+    )
+    cfg_off = build_cfg()
+    service = DeconvService(cfg_on, spec=spec, params=params)
+
+    uris: dict[int, str] = {}
+    for idx in range(16):
+        img = Image.fromarray(
+            np.random.default_rng(idx).integers(
+                0, 255, (size, size, 3), np.uint8
+            ),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        uris[idx] = (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+
+    async def drive() -> dict:
+        port = await service.start(host="127.0.0.1", port=0)
+        await asyncio.to_thread(service.warmup, "c3")
+        t_boot = time.perf_counter()
+
+        async def one(port_: int, idx: int) -> tuple[float, int, str]:
+            body = urllib.parse.urlencode(
+                {"file": uris[idx % len(uris)], "layer": "c3"}
+            ).encode()
+            t0 = time.perf_counter()
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port_
+            )
+            writer.write(
+                b"POST /v1/deconv HTTP/1.1\r\nHost: x\r\nContent-Type: "
+                b"application/x-www-form-urlencoded\r\nContent-Length: "
+                + str(len(body)).encode()
+                + b"\r\nConnection: close\r\n\r\n" + body
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            _kind, rid = _resp_meta(raw)
+            status, _code = _resp_status_code(raw)
+            return time.perf_counter() - t0, status, rid
+
+        async def alerts_doc() -> dict:
+            _s, doc = await _http(port, "GET", "/v1/alerts")
+            return doc or {}
+
+        errs: list[str] = []
+
+        # ---- phase A: healthy traffic, zero false positives --------
+        healthy_lat: list[float] = []
+        sem = asyncio.Semaphore(8)
+
+        async def healthy_one(i: int):
+            async with sem:
+                dt, status, _rid = await one(port, i)
+                if status == 200:
+                    healthy_lat.append(dt)
+                else:
+                    errs.append(f"healthy request {i} answered {status}")
+                # pace the stream across several self-scrape ticks
+                await asyncio.sleep(0.01)
+
+        await asyncio.gather(*(healthy_one(i) for i in range(n_healthy)))
+        # let a few evaluation ticks observe the healthy steady state
+        await asyncio.sleep(tsdb_interval_s * 6)
+        doc = await alerts_doc()
+        healthy_fired = sum(
+            r.get("fires_total", 0) for r in doc.get("rules", [])
+        )
+        if doc.get("firing", 0) or healthy_fired:
+            errs.append(
+                f"healthy phase raised alerts: {doc.get('firing')} firing,"
+                f" {healthy_fired} fires_total"
+            )
+        if len(doc.get("rules", [])) != 2:
+            errs.append(f"rule page lost rules: {doc.get('rules')}")
+
+        # ---- phase B: the gray failure ------------------------------
+        s, _ = await _http(
+            port, "POST", "/v1/debug/faults",
+            {"arm": "device.dispatch_delay_ms=p1:150"},
+        )
+        assert s == 200, f"fault arm endpoint answered {s}"
+        t_arm = time.perf_counter()
+        fault_rids: list[str] = []
+        stop_stream = asyncio.Event()
+
+        async def fault_stream():
+            i = 0
+            while not stop_stream.is_set():
+                _dt, status, rid = await one(port, i)
+                if status == 200 and rid:
+                    fault_rids.append(rid)
+                i += 1
+
+        streamers = [asyncio.create_task(fault_stream()) for _ in range(4)]
+        firing_latency_s = None
+        while time.perf_counter() - t_arm < detect_budget_s:
+            doc = await alerts_doc()
+            state = {
+                r["name"]: r["state"] for r in doc.get("rules", [])
+            }
+            if state.get("dispatch-stall") == "firing":
+                firing_latency_s = time.perf_counter() - t_arm
+                break
+            await asyncio.sleep(0.05)
+        if firing_latency_s is None:
+            errs.append(
+                f"dispatch-stall never fired within {detect_budget_s}s"
+            )
+        # keep the degraded stream up briefly so the slow ring holds
+        # fault-phase captures, then quiesce
+        await asyncio.sleep(min(fault_stream_s, 1.0))
+        stop_stream.set()
+        await asyncio.gather(*streamers, return_exceptions=True)
+
+        # ---- the black box -----------------------------------------
+        s, inc = await _http(port, "GET", "/v1/debug/incidents")
+        incidents = (inc or {}).get("incidents", [])
+        bundle_digest_ok = False
+        bundle_has_affected_trace = False
+        trace_join_ok = False
+        if s != 200 or not incidents:
+            errs.append(f"no incident recorded (status {s})")
+        else:
+            newest = incidents[0]
+            if newest.get("rule") != "dispatch-stall":
+                errs.append(f"incident names wrong rule: {newest}")
+            # digest check against the RAW file, not the parsed doc:
+            # first line is the blake2b of the remainder
+            path = os.path.join(incidents_dir, newest["id"] + ".json")
+            blob = open(path, "rb").read()
+            head, _, rest = blob.partition(b"\n")
+            bundle_digest_ok = (
+                hashlib.blake2b(rest, digest_size=16).hexdigest()
+                == head.decode()
+            )
+            if not bundle_digest_ok:
+                errs.append("incident bundle digest does not verify")
+            s, bundle = await _http(
+                port, "GET", f"/v1/debug/incidents?id={newest['id']}"
+            )
+            if s != 200 or bundle is None:
+                errs.append(f"bundle load answered {s}")
+            else:
+                if bundle.get("rule", {}).get("name") != "dispatch-stall":
+                    errs.append("bundle froze the wrong rule")
+                if not bundle.get("window"):
+                    errs.append("bundle carries no metric window")
+                slow_ids = {t.get("id") for t in bundle.get("slow", [])}
+                affected = slow_ids & set(fault_rids)
+                bundle_has_affected_trace = bool(affected)
+                if not affected:
+                    errs.append(
+                        "no fault-phase request id in the bundle's slow ring"
+                    )
+                else:
+                    rid = sorted(affected)[0]
+                    s, tr = await _http(
+                        port, "GET", f"/v1/debug/requests?id={rid}"
+                    )
+                    traces = (tr or {}).get("requests", [])
+                    trace_join_ok = s == 200 and any(
+                        t.get("id") == rid for t in traces
+                    )
+                    if not trace_join_ok:
+                        errs.append(
+                            f"bundle id {rid} does not join to the recorder"
+                        )
+        if len(incidents) > 1:
+            errs.append(
+                f"{len(incidents)} incidents for one firing transition"
+            )
+
+        # ---- recovery ----------------------------------------------
+        s, _ = await _http(port, "POST", "/v1/debug/faults", {"disarm": "all"})
+        assert s == 200
+        t_disarm = time.perf_counter()
+        resolve_latency_s = None
+        while time.perf_counter() - t_disarm < resolve_budget_s:
+            doc = await alerts_doc()
+            rule = next(
+                (r for r in doc.get("rules", [])
+                 if r["name"] == "dispatch-stall"), {},
+            )
+            if rule.get("state") == "ok" and rule.get("resolved_total"):
+                resolve_latency_s = time.perf_counter() - t_disarm
+                break
+            await asyncio.sleep(0.1)
+        if resolve_latency_s is None:
+            errs.append(
+                f"dispatch-stall never resolved within {resolve_budget_s}s"
+            )
+
+        # ---- exemplars: the metrics→trace join on the exposition ----
+        s, text = await _http_text(port, "/v1/metrics")
+        exemplar_seen = s == 200 and any(
+            "_bucket{" in ln and "# {trace_id=" in ln
+            for ln in text.splitlines()
+        )
+        if not exemplar_seen:
+            errs.append("no bucket exemplar on the exposition")
+
+        # ---- self-scrape cost --------------------------------------
+        elapsed = time.perf_counter() - t_boot
+        s, hist = await _http(port, "GET", "/v1/metrics/history")
+        stats = (hist or {}).get("stats", {})
+        scrapes = stats.get("scrapes_total", 0)
+        scrape_s = stats.get("scrape_seconds_total", 0.0)
+        duty_cycle_pct = 100.0 * scrape_s / elapsed if elapsed else 0.0
+        # the budgeted number: mean tick cost at the SHIPPED default
+        # 1 s interval (the drill scrapes 5x faster for detection
+        # latency, which would overstate the production duty cycle)
+        overhead_pct = (
+            100.0 * (scrape_s / scrapes) / 1.0 if scrapes else 0.0
+        )
+        if overhead_pct > overhead_budget_pct:
+            errs.append(
+                f"self-scrape overhead {round(overhead_pct, 3)}% over the"
+                f" {overhead_budget_pct}% budget"
+            )
+        if not scrapes:
+            errs.append("self-scrape loop never ticked")
+
+        # ---- tsdb=off twin: the seed surface, unchanged -------------
+        # constructed only NOW: fault_injection installs the process-
+        # global module hook at construction, and a twin built up front
+        # would clobber the primary server's armed registry
+        twin = DeconvService(cfg_off, spec=spec, params=params)
+        tport = await twin.start(host="127.0.0.1", port=0)
+        twin.ready = True
+        s_hist, _ = await _http(tport, "GET", "/v1/metrics/history")
+        s_alerts, _ = await _http(tport, "GET", "/v1/alerts")
+        s_inc, _ = await _http(tport, "GET", "/v1/debug/incidents")
+        _s, off_cfg = await _http(tport, "GET", "/v1/config")
+        off_parity = (
+            s_hist == 404 and s_alerts == 404 and s_inc == 404
+            and off_cfg is not None
+            and off_cfg.get("tsdb_active") is False
+            and "tsdb_state" not in off_cfg
+        )
+        if not off_parity:
+            errs.append(
+                f"tsdb=off twin leaks the subsystem: history={s_hist}"
+                f" alerts={s_alerts} incidents={s_inc}"
+            )
+        # off/on hot-path A/B over the same healthy workload
+        off_lat: list[float] = []
+
+        async def off_one(i: int):
+            async with sem:
+                dt, status, _rid = await one(tport, i)
+                if status == 200:
+                    off_lat.append(dt)
+                await asyncio.sleep(0.01)
+
+        await asyncio.gather(*(off_one(i) for i in range(n_healthy)))
+        await twin.stop()
+
+        final = await alerts_doc()
+        row = {
+            "which": "incident-drill",
+            "platform": "cpu-loopback",
+            "tsdb_interval_s": tsdb_interval_s,
+            "healthy_requests": len(healthy_lat),
+            "healthy_fires_total": healthy_fired,
+            "firing_latency_s": (
+                round(firing_latency_s, 3)
+                if firing_latency_s is not None else None
+            ),
+            "detect_budget_s": detect_budget_s,
+            "resolve_latency_s": (
+                round(resolve_latency_s, 3)
+                if resolve_latency_s is not None else None
+            ),
+            "resolve_budget_s": resolve_budget_s,
+            "incidents_recorded": len(incidents),
+            "bundle_digest_ok": bundle_digest_ok,
+            "bundle_has_affected_trace": bundle_has_affected_trace,
+            "trace_join_ok": trace_join_ok,
+            "exemplar_seen": exemplar_seen,
+            "evals_total": final.get("evals_total", 0),
+            "eval_errors_total": final.get("eval_errors_total", 0),
+            "scrapes_total": scrapes,
+            "scrape_overhead_pct": round(overhead_pct, 4),
+            "scrape_duty_cycle_pct": round(duty_cycle_pct, 4),
+            "overhead_budget_pct": overhead_budget_pct,
+            "p50_ms_tsdb_on": round(
+                _quantiles_ms(healthy_lat)["p50_ms"], 3
+            ) if healthy_lat else None,
+            "p50_ms_tsdb_off": round(
+                _quantiles_ms(off_lat)["p50_ms"], 3
+            ) if off_lat else None,
+            "off_parity_ok": off_parity,
+        }
+        if final.get("eval_errors_total", 0):
+            errs.append(
+                f"{final['eval_errors_total']} rule evaluation errors"
+            )
+        if errs:
+            row["error"] = "; ".join(errs)
+        await service.stop()
+        import shutil
+
+        shutil.rmtree(incidents_dir, ignore_errors=True)
+        return row
+
+    return asyncio.run(drive())
+
+
 def main() -> int:
     args = sys.argv[1:]
     passes = 1
@@ -4896,6 +5298,7 @@ def main() -> int:
     fleet_trace = False
     fleet_fastpath = False
     diurnal = False
+    incident = False
     stub_port: int | None = None
     stub_routers = ""
     stub_token = ""
@@ -5002,6 +5405,14 @@ def main() -> int:
             # jobs-gated scale-downs, burn < 1 throughout
             diurnal = True
             i += 1
+        elif args[i] == "--incident":
+            # the round-23 alerting drill: healthy phase with zero
+            # false positives, a gray dispatch stall detected by the
+            # declarative rule page, a digest-verified incident bundle
+            # joinable to the affected request's trace, rule resolution
+            # after disarm, and the self-scrape ≤1% cost budget
+            incident = True
+            i += 1
         elif args[i] == "--stub-backend":
             # internal: the drill's launched-backend entrypoint (a real
             # process with the fleet protocol surface and no device)
@@ -5084,6 +5495,10 @@ def main() -> int:
         )
     if diurnal:
         row = run_autoscale_diurnal_drill(service_ms=service_ms)
+        print(json.dumps(row), flush=True)
+        return 0
+    if incident:
+        row = run_incident_drill(n_healthy=n_requests or 96)
         print(json.dumps(row), flush=True)
         return 0
     if quant_drill:

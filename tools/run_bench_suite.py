@@ -148,6 +148,17 @@ AUTOSCALE_BURN_BUDGET = 1.0
 AUTOSCALE_COLD_5XX_BUDGET = 0
 AUTOSCALE_BOOT_WARM_BUDGET_S = 15.0
 
+# Alerting / incident-forensics budgets (round 23): the healthy phase
+# of the drill must raise ZERO alerts (a rule page that cries wolf is
+# worse than none), the armed dispatch-stall must take its rule to
+# firing inside the detection budget and back to ok inside the resolve
+# budget after disarm, and the TSDB self-scrape must price under 1% of
+# a 1 s interval tick (the shipped default) — observability that costs
+# real capacity gets turned off in the first incident.
+INCIDENT_DETECT_BUDGET_S = 8.0
+INCIDENT_RESOLVE_BUDGET_S = 12.0
+TSDB_OVERHEAD_BUDGET_PCT = 1.0
+
 # Channel-packed backward-tail budget (round 12): the packed path must
 # not run SLOWER than the vmapped path it would replace — a recorded
 # regression (like the r3 prototype's 280-vs-368 img/s) keeps the
@@ -813,6 +824,63 @@ def run_autoscale_guard(timeout_s: float = 1800.0) -> dict:
     )
     # the drill assembles its own violation list against the same
     # budgets; carry it verbatim — the guard's job is the recorded row
+    if "error" in drill:
+        row["error"] = drill["error"]
+    return row
+
+
+def run_alerting_guard(timeout_s: float = 900.0) -> dict:
+    """Alerting + incident-forensics drill guard (round 23):
+    tools/loopback_load.py --incident — one backend with the embedded
+    TSDB self-scraping and a two-rule page (threshold + absence),
+    driven healthy -> gray dispatch stall -> recovery.
+
+    The row fails LOUDLY (`error` field) when:
+    - the healthy phase fires ANY alert (zero-false-positive budget);
+    - the armed ``device.dispatch_delay_ms`` does not take the
+      dispatch-stall rule to firing within INCIDENT_DETECT_BUDGET_S,
+      or disarming does not resolve it within
+      INCIDENT_RESOLVE_BUDGET_S;
+    - the firing transition recorded no incident, the bundle's on-disk
+      digest fails to verify, or the bundle's slow-ring capture holds
+      no request id the client saw during the fault (the trace join is
+      the whole point of the black box);
+    - the self-scrape's mean tick cost exceeds
+      TSDB_OVERHEAD_BUDGET_PCT of the default 1 s interval, or a
+      ``tsdb=off`` twin leaks any of the new surfaces."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "INCIDENT_DETECT_BUDGET_S": str(INCIDENT_DETECT_BUDGET_S),
+        "INCIDENT_RESOLVE_BUDGET_S": str(INCIDENT_RESOLVE_BUDGET_S),
+    }
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--incident"], timeout_s, env=env
+    )
+    row = {"config": "alerting", "which": "loopback_incident_drill"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    row.update(
+        healthy_requests=drill.get("healthy_requests"),
+        healthy_fires_total=drill.get("healthy_fires_total"),
+        firing_latency_s=drill.get("firing_latency_s"),
+        detect_budget_s=drill.get("detect_budget_s"),
+        resolve_latency_s=drill.get("resolve_latency_s"),
+        resolve_budget_s=drill.get("resolve_budget_s"),
+        incidents_recorded=drill.get("incidents_recorded"),
+        bundle_digest_ok=drill.get("bundle_digest_ok"),
+        bundle_has_affected_trace=drill.get("bundle_has_affected_trace"),
+        trace_join_ok=drill.get("trace_join_ok"),
+        exemplar_seen=drill.get("exemplar_seen"),
+        eval_errors_total=drill.get("eval_errors_total"),
+        scrape_overhead_pct=drill.get("scrape_overhead_pct"),
+        scrape_duty_cycle_pct=drill.get("scrape_duty_cycle_pct"),
+        overhead_budget_pct=drill.get("overhead_budget_pct"),
+        p50_ms_tsdb_on=drill.get("p50_ms_tsdb_on"),
+        p50_ms_tsdb_off=drill.get("p50_ms_tsdb_off"),
+        off_parity_ok=drill.get("off_parity_ok"),
+    )
     if "error" in drill:
         row["error"] = drill["error"]
     return row
@@ -1512,6 +1580,14 @@ def main() -> int:
             # scale-downs, boot-to-first-warm-hit under budget
             result = run_autoscale_guard()
             result["date"] = date
+        elif tok == "alerting":
+            # alerting + incident forensics drill (round 23): zero
+            # false positives healthy, armed dispatch stall detected
+            # within budget, digest-verified bundle joined to the
+            # affected request, resolution after disarm, self-scrape
+            # cost <= 1% of the default interval
+            result = run_alerting_guard()
+            result["date"] = date
         elif tok == "models":
             # multi-model paging drill (round 15): three backbones from
             # one pool under a budget that forces paging + the
@@ -1558,7 +1634,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'router-fastpath', 'autoscale', 'models', 'quant', 'aot-boot'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'router-fastpath', 'autoscale', 'alerting', 'models', 'quant', 'aot-boot'])}",
             }
         else:
             n = int(tok)

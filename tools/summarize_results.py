@@ -92,6 +92,26 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "firing_latency_s" in row:
+        # alerting / incident-forensics rows (round 23): the whole
+        # contract in one line — zero false positives healthy, fault →
+        # firing latency vs budget, the digest-verified bundle with its
+        # trace join, resolution after disarm, and the self-scrape cost
+        # vs the 1% budget; error kept visible
+        line = (
+            f"alerting fp={row.get('healthy_fires_total')}, fault→firing "
+            f"{row.get('firing_latency_s')}s "
+            f"(budget {row.get('detect_budget_s')}s), resolved "
+            f"{row.get('resolve_latency_s')}s, bundle digest="
+            f"{row.get('bundle_digest_ok')} trace_join="
+            f"{row.get('trace_join_ok')}, scrape "
+            f"{row.get('scrape_overhead_pct')}% "
+            f"(budget {row.get('overhead_budget_pct', 1)}%), off_parity="
+            f"{row.get('off_parity_ok')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "boot_to_warm_s" in row or "fleet_max" in row:
         # closed-loop elasticity rows (round 22): the whole contract in
         # one line — the swing the fleet tracked, burn vs budget, the
