@@ -46,6 +46,7 @@ import os
 
 import numpy as np
 
+from deconv_api_tpu.serving import durable
 from deconv_api_tpu.utils.quantize import Q8_LEVELS, int8_scale
 
 __all__ = [
@@ -144,17 +145,27 @@ def save_calibration(
     image_size: int = 0,
     n_images: int = 0,
     source: str = "",
+    metrics=None,
 ) -> tuple[str, str]:
-    """Write one model's calibration artifact (tmp-then-rename — the
-    SpillStore idiom; a crash leaves either the old complete file or a
-    stale ``.tmp``) and return ``(path, digest)``.  The file lives at
+    """Write one model's calibration artifact through
+    ``serving/durable.py`` (round 24: tmp + fsync + rename + dir fsync;
+    a crash leaves either the old complete file or a swept ``.tmp``)
+    and return ``(path, digest)``.  The file lives at
     ``<calib_dir>/<model>.calib.json`` so the server finds it by model
     name; the content digest inside addresses the range set and is
-    verified on every load."""
+    verified on every load.  BEST-EFFORT durable surface: a failed
+    write counts into ``durable_write_errors_total{surface=
+    "quant.calib"}`` and the artifact simply reads absent — the server
+    falls back to dynamic ranges."""
     os.makedirs(calib_dir, exist_ok=True)
+    durable.sweep_tmp(calib_dir)
     canon = _canonical_ranges(ranges)
     digest = ranges_digest(canon)
+    # JSON-document artifact: the {format, version} vocabulary rides
+    # in-document ("v" kept for pre-round-24 readers)
     payload = {
+        "format": "quant.calib",
+        "version": _CALIB_VERSION,
         "v": _CALIB_VERSION,
         "model": model,
         "image_size": int(image_size),
@@ -164,29 +175,33 @@ def save_calibration(
         "digest": digest,
     }
     path = os.path.join(calib_dir, f"{model}.calib.json")
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, sort_keys=True, separators=(",", ":"))
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    data = json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+    surface = durable.Surface("quant.calib", metrics=metrics)
+    durable.atomic_write(path, data, surface=surface)
     return path, digest
 
 
 def load_calibration(calib_dir: str, model: str) -> dict | None:
     """One model's verified calibration artifact, or None — a missing,
-    torn, or digest-mismatched file reads as ABSENT (the server then
-    falls back to dynamic ranges), never as an error: calibration is an
-    accuracy optimization, it must not be able to fail requests."""
+    torn, digest-mismatched, or FUTURE-version file reads as ABSENT
+    (the server then falls back to dynamic ranges), never as an error:
+    calibration is an accuracy optimization, it must not be able to
+    fail requests."""
     path = os.path.join(calib_dir, f"{model}.calib.json")
-    try:
-        with open(path) as f:
-            payload = json.load(f)
-    except (OSError, ValueError):
+    raw = durable.read_bytes(path, "quant.calib")
+    if raw is None:
         return None
+    try:
+        payload = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(payload, dict):
+        return None
+    version = payload.get("version", payload.get("v"))
     if (
-        not isinstance(payload, dict)
-        or payload.get("v") != _CALIB_VERSION
+        payload.get("format", "quant.calib") != "quant.calib"
+        or not isinstance(version, int)
+        or version != _CALIB_VERSION  # future version: fail-static absent
         or not isinstance(payload.get("ranges"), dict)
         or not payload.get("ranges")
     ):

@@ -230,6 +230,23 @@ class BackendUnavailable(DeconvError):
         self.retry_after_s = retry_after_s
 
 
+class UndurableWrite(DeconvError):
+    """A fail-loud persistence surface could not make a pre-ack write
+    durable (round 24, serving/durable.py): a job submit whose journal
+    append cannot fsync, a registration whose membership persist fails.
+    Answering 202/200 would acknowledge work the server cannot promise
+    to remember across a crash, so the request 503s with a Retry-After
+    instead — the disk fault is the server's problem, retried work is
+    the client's contribution to surviving it."""
+
+    status = 503
+    code = "undurable_write"
+
+    def __init__(self, message: str, retry_after_s: float | None = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class FaultInjected(DeconvError):
     """An armed fault-injection site fired (serving/faults.py).  Its own
     taxonomy code so a chaos run's error budget can split EXPECTED

@@ -44,14 +44,13 @@ gives it an alarm and a flight-data recorder.  Three pieces:
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import re
 import threading
 import time
 
-from deconv_api_tpu.serving import faults
+from deconv_api_tpu.serving import durable, faults
 from deconv_api_tpu.serving.metrics import SLO_WINDOWS, escape_label
 from deconv_api_tpu.utils import slog
 
@@ -484,12 +483,21 @@ _INC_NAME_RE = re.compile(r"inc-\d+-\d+-[A-Za-z0-9_\-]{1,64}\.json\Z")
 class IncidentStore:
     """Digest-verified incident bundles on disk — the black box.
 
-    File format: first line is the blake2b-128 hexdigest of everything
-    after it; the rest is the JSON payload.  Writes are tmp-then-rename
-    with fsync (the SpillStore idiom) so a bundle either exists whole
-    or not at all; a torn/corrupted file fails its digest on read and
-    is treated as ABSENT (counted, logged, never an error) — restart
-    replay tolerates a torn tail by construction."""
+    File format (round 24): one ``durable.frame`` artifact per bundle —
+    a versioned ``{"format": "alerts.incidents", "version", "len",
+    "digest"}`` header line followed by the JSON payload.  Writes go
+    through ``durable.atomic_write`` (tmp + fsync + rename + dir fsync)
+    so a bundle either exists whole or not at all; a torn/corrupted
+    file fails its digest on read and is treated as ABSENT (counted,
+    logged, never an error) — restart replay tolerates a torn tail by
+    construction.  BEST-EFFORT durable surface: a failed write returns
+    None instead of raising (the black box must never take down the
+    thing it is recording), counted in ``durable_write_errors_total
+    {surface="alerts.incidents"}``; a FUTURE-version bundle reads as
+    absent without deletion."""
+
+    _FORMAT = "alerts.incidents"
+    _VERSION = 1
 
     def __init__(
         self,
@@ -498,6 +506,7 @@ class IncidentStore:
         retention_s: float = 86400.0,
         max_bundles: int = 64,
         clock=time.time,
+        metrics=None,
     ):
         self.root = root
         self.retention_s = float(retention_s)
@@ -508,14 +517,16 @@ class IncidentStore:
         self.writes_total = 0
         self.corrupt_total = 0
         self.swept_total = 0
+        self.surface = durable.Surface("alerts.incidents", metrics=metrics)
         os.makedirs(root, exist_ok=True)
+        # stale .tmp from a writer that died mid-bundle: the uniform
+        # boot sweep (the periodic sweep() also sheds them)
+        durable.sweep_tmp(root)
 
-    @staticmethod
-    def _digest(data: bytes) -> str:
-        return hashlib.blake2b(data, digest_size=16).hexdigest()
-
-    def record(self, rule_name: str, bundle: dict) -> str:
-        """Write one bundle; returns its incident id."""
+    def record(self, rule_name: str, bundle: dict) -> str | None:
+        """Write one bundle durably; returns its incident id, or None
+        when the write could not be made durable (best-effort — the
+        caller counts, the request path never sees an exception)."""
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -527,13 +538,9 @@ class IncidentStore:
             sort_keys=True,
         ).encode()
         path = os.path.join(self.root, inc_id + ".json")
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(self._digest(payload).encode() + b"\n")
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        data = durable.frame(self._FORMAT, self._VERSION, payload)
+        if not durable.atomic_write(path, data, surface=self.surface):
+            return None
         self.writes_total += 1
         slog.event(
             _log, "incident_recorded", id=inc_id, bytes=len(payload)
@@ -541,14 +548,16 @@ class IncidentStore:
         return inc_id
 
     def _read(self, path: str) -> dict | None:
-        try:
-            with open(path, "rb") as f:
-                head, _, payload = f.read().partition(b"\n")
-        except OSError:
+        raw = durable.read_bytes(path, "alerts.incidents")
+        if raw is None:
             return None
-        if not payload or head.decode("ascii", "replace") != self._digest(
-            payload
-        ):
+        try:
+            framed = durable.unframe(raw, self._FORMAT, self._VERSION)
+        except durable.FutureVersionError:
+            # fail-static (best-effort): a newer binary's bundle reads
+            # as absent — never deleted, never counted corrupt
+            return None
+        if framed is None:
             self.corrupt_total += 1
             slog.event(
                 _log, "incident_digest_mismatch",
@@ -556,7 +565,7 @@ class IncidentStore:
             )
             return None
         try:
-            return json.loads(payload)
+            return json.loads(framed[1])
         except json.JSONDecodeError:
             self.corrupt_total += 1
             return None
@@ -596,22 +605,15 @@ class IncidentStore:
         """Drop bundles past retention (and the oldest beyond
         ``max_bundles``), plus any orphaned ``.tmp`` halves.  Returns
         the number removed."""
-        removed = 0
+        removed = durable.sweep_tmp(self.root)
         now = self._clock()
         entries = []
         try:
             names = os.listdir(self.root)
         except OSError:
-            return 0
+            return removed
         for name in names:
             path = os.path.join(self.root, name)
-            if name.endswith(".tmp"):
-                try:
-                    os.unlink(path)
-                    removed += 1
-                except OSError:
-                    pass
-                continue
             if not _INC_NAME_RE.match(name):
                 continue
             try:

@@ -8,14 +8,18 @@ TVM's framing (PAPERS.md) treats ahead-of-time compilation and artifact
 *distribution* as a first-class serving concern — this module is that
 tier for the visualizer programs:
 
-- ``ArtifactStore``: one file per artifact under ``aot_dir``, the
-  L2/SpillStore idiom end to end — tmp-then-rename with fsync (a crash
-  leaves a complete entry or a swept ``.tmp``), a JSON header line
-  carrying the payload's blake2b digest (ANY defect — torn header,
-  short body, digest mismatch — deletes the file and reads as a miss,
-  never an error), an mtime-LRU byte budget, and
+- ``ArtifactStore``: one file per artifact under ``aot_dir``, stored
+  through ``serving/durable.py`` (round 24) — ``durable.atomic_write``
+  (tmp + fsync + rename + dir fsync; a crash leaves a complete entry or
+  a swept ``.tmp``) under a versioned ``{"format": "aot.store", ...}``
+  frame carrying the payload's blake2b digest (ANY defect — torn
+  header, short body, digest mismatch — deletes the file and reads as
+  a miss, never an error; a FUTURE version reads as a miss without
+  deletion), an mtime-LRU byte budget, and
   ``aot_cache_{hits,misses,stores,corrupt,errors}_total`` counters plus
   resident-bytes/entries gauges through the injected Metrics registry.
+  Best-effort durable surface: a failed write degrades to a recompile,
+  counted in ``durable_write_errors_total{surface="aot.store"}``.
 
 - ``AotExecutor``: the dispatch-side resolver.  Keyed by the canonical
   program metadata — (model, program tuple, quality/calibration tag,
@@ -50,12 +54,13 @@ import pickle
 import re
 import threading
 
+from deconv_api_tpu.serving import durable
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.aot")
 
 _KEY_RE = re.compile(r"^[0-9a-f]{16,128}$")
-_HEADER_MAX = 4096
+_FORMAT = "aot.store"
 _VERSION = 1
 
 
@@ -77,19 +82,17 @@ class ArtifactStore:
         self.root = root
         self.max_bytes = int(max_bytes)
         self._metrics = metrics
+        # BEST-EFFORT surface (round 24): a failed write degrades to a
+        # recompile, counted through the durable families
+        self.surface = durable.Surface("aot.store", metrics=metrics)
         self._lock = threading.Lock()
         os.makedirs(root, exist_ok=True)
-        # sweep stale .tmp from a crashed writer; size the ledger
+        # stale .tmp from a crashed writer: the uniform boot sweep
+        durable.sweep_tmp(root)
         self._resident = 0
         self._entries = 0
         for fn in self._listdir():
             path = os.path.join(self.root, fn)
-            if fn.endswith(".tmp"):
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-                continue
             if fn.endswith(".aot"):
                 try:
                     self._resident += os.stat(path).st_size
@@ -134,36 +137,25 @@ class ArtifactStore:
         if not _KEY_RE.match(key):
             return None
         path = self._path(key)
-        try:
-            with open(path, "rb") as f:
-                raw = f.read()
-        except OSError:
-            # absent: the RESOLVER counts the miss (one miss per
-            # program resolution, not per probe)
+        raw = durable.read_bytes(path, "aot.store")
+        if raw is None:
+            # absent (or an injected EIO): the RESOLVER counts the miss
+            # (one miss per program resolution, not per probe)
             return None
-        head, sep, body = raw.partition(b"\n")
-        ok = bool(sep) and len(head) <= _HEADER_MAX
-        meta = None
-        if ok:
-            try:
-                meta = json.loads(head)
-            except ValueError:
-                ok = False
-        if ok:
-            ok = (
-                isinstance(meta, dict)
-                and meta.get("v") == _VERSION
-                and meta.get("len") == len(body)
-                and meta.get("digest")
-                == hashlib.blake2b(body, digest_size=16).hexdigest()
-            )
-        if not ok:
+        try:
+            framed = durable.unframe(raw, _FORMAT, _VERSION)
+        except durable.FutureVersionError:
+            # fail-static (best-effort): a newer binary's artifact reads
+            # as a miss WITHOUT deletion — recompile, don't destroy
+            return None
+        if framed is None:
             slog.event(
                 _log, "aot_corrupt_artifact", level=logging.WARNING, key=key
             )
             self.invalidate(key)
             self._count("aot_cache_corrupt_total")
             return None
+        _meta, body = framed
         try:
             # recency survives restarts: the budget sweep is mtime-LRU
             os.utime(path)
@@ -182,34 +174,13 @@ class ArtifactStore:
         stored (an artifact larger than the whole budget is not)."""
         if not _KEY_RE.match(key):
             return False
-        head = json.dumps(
-            {
-                "v": _VERSION,
-                "len": len(payload),
-                "digest": hashlib.blake2b(payload, digest_size=16).hexdigest(),
-            },
-            separators=(",", ":"),
-        ).encode()
-        data = head + b"\n" + payload
+        data = durable.frame(_FORMAT, _VERSION, payload)
         if self.max_bytes and len(data) > self.max_bytes:
             return False
-        path = self._path(key)
-        tmp = path + ".tmp"
-        try:
-            with open(tmp, "wb") as f:
-                f.write(data)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-        except OSError as e:
-            slog.event(
-                _log, "aot_write_error", level=logging.ERROR,
-                key=key, error=f"{type(e).__name__}: {e}",
-            )
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+        # best-effort: a failed write counts into the durable families
+        # and flips durable_degraded{surface="aot.store"} once per
+        # episode — the tier degrades to recompiling, never raises
+        if not durable.atomic_write(self._path(key), data, surface=self.surface):
             return False
         self._count("aot_cache_stores_total")
         self._resweep()

@@ -35,6 +35,7 @@ import numpy as np
 from deconv_api_tpu import errors
 from deconv_api_tpu.config import ServerConfig, apply_platform, enable_compilation_cache
 from deconv_api_tpu.serving import codec
+from deconv_api_tpu.serving import durable
 from deconv_api_tpu.serving import faults as faults_mod
 from deconv_api_tpu.serving.batcher import (
     BatchingDispatcher,
@@ -200,6 +201,13 @@ class DeconvService:
             )
             self.bundle.mesh = self.mesh
         self.metrics = Metrics()
+        # round 24: every declared durable surface's families present
+        # at zero from the first scrape, configured store or not
+        durable.register_metrics(self.metrics)
+        if self.cfg.calibration_dir:
+            # the one store READ here but written by tools/calibrate.py:
+            # its boot .tmp sweep lives with the reader
+            durable.sweep_tmp(self.cfg.calibration_dir)
         # Executor lanes (round 10, parallel/lanes.py + batcher.LanePool):
         # when no whole-pool mesh is configured, the visible devices
         # partition into independent lanes — params replicated per lane
@@ -702,6 +710,7 @@ class DeconvService:
                 self.incidents = IncidentStore(
                     self.cfg.incidents_dir,
                     retention_s=self.cfg.incidents_retention_s,
+                    metrics=self.metrics,
                 )
                 self.server.route("GET", "/v1/debug/incidents")(
                     self._debug_incidents
@@ -2357,6 +2366,27 @@ class DeconvService:
                 "pending": snap["pending"],
                 "eval_errors": snap["eval_errors_total"],
             }
+        # round 24: the durability picture on the probe — each active
+        # persistence surface's contract, degraded bit and write-error
+        # count.  Informational like the slo/alerts blocks: a degraded
+        # best-effort tier must NOT fail readiness (that is the whole
+        # point of the degradation contract), and a degraded fail-loud
+        # surface already answers 503 on the writes themselves.
+        dur: dict[str, dict] = {}
+        if self.jobs is not None:
+            dur["jobs.journal"] = self.jobs.journal.surface.snapshot()
+            dur["jobs.spill"] = self.jobs.spill.surface.snapshot()
+        if self.l2 is not None:
+            dur["cache.l2"] = self.l2.surface.snapshot()
+        if self.aot is not None:
+            dur["aot.store"] = self.aot.store.surface.snapshot()
+        if self.incidents is not None:
+            dur["alerts.incidents"] = self.incidents.surface.snapshot()
+        if dur:
+            body["durability"] = {
+                "ok": not any(s["degraded"] for s in dur.values()),
+                "surfaces": dur,
+            }
         return Response.json(body, status=200 if ok else 503)
 
     async def _debug_faults(self, req: Request) -> Response:
@@ -2458,18 +2488,18 @@ class DeconvService:
 
         for ctx in self.alert_engine.evaluate():
             if self.incidents is not None:
-                try:
-                    rule_name = (ctx.get("rule") or {}).get("name", "rule")
-                    self.incidents.record(
-                        rule_name, self._incident_bundle(ctx)
-                    )
+                rule_name = (ctx.get("rule") or {}).get("name", "rule")
+                # best-effort durable surface: a failed write returns
+                # None (counted in the durable families by the store)
+                if self.incidents.record(
+                    rule_name, self._incident_bundle(ctx)
+                ) is not None:
                     self.metrics.inc_counter("incidents_recorded_total")
-                except OSError as e:
+                else:
                     self.metrics.inc_counter("incident_write_errors_total")
                     _slog.event(
                         _slog.get_logger("deconv.app"),
-                        "incident_write_failed",
-                        level=40, error=f"{type(e).__name__}: {e}",
+                        "incident_write_failed", level=40, rule=rule_name,
                     )
 
     async def _tsdb_loop(self) -> None:

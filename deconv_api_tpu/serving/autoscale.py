@@ -96,6 +96,7 @@ import subprocess
 import time
 from typing import Callable
 
+from deconv_api_tpu.serving import durable
 from deconv_api_tpu.serving import faults as faults_mod
 from deconv_api_tpu.serving import fleet as fleet_mod
 from deconv_api_tpu.serving.metrics import Metrics
@@ -411,50 +412,37 @@ class TsdbArrivalHistory:
 # ------------------------------------------------------------- journal
 
 
-class DecisionJournal:
-    """Append-only fsync'd JSONL of every decision (the round 11
-    job-journal idiom): the record is DURABLE before the action runs,
-    so a controller that dies mid-action can never have acted on a
-    decision it has no memory of."""
+class DecisionJournal(durable.Journal):
+    """Append-only fsync'd JSONL of every decision, on the shared
+    ``durable.Journal`` body since round 24: the record is DURABLE
+    before the action runs, so a controller that dies mid-action can
+    never have acted on a decision it has no memory of.  FAIL-LOUD
+    durable surface — an append that cannot fsync raises
+    ``DurableWriteError`` out of the controller tick rather than
+    acting on an unremembered decision; a journal written by a NEWER
+    binary raises ``FutureVersionError`` at replay (refuse rather than
+    misparse)."""
 
-    def __init__(self, path: str):
-        self.path = path
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        self._f = open(path, "a", encoding="utf-8")
+    _FORMAT = "autoscale.journal"
+    _VERSION = 1
 
-    def append(self, record: dict) -> None:
-        self._f.write(json.dumps(record, sort_keys=True) + "\n")
-        self._f.flush()
-        os.fsync(self._f.fileno())
-
-    def close(self) -> None:
-        try:
-            self._f.close()
-        except Exception:  # noqa: BLE001 — double-close is fine
-            pass
+    def __init__(self, path: str, *, metrics=None):
+        super().__init__(
+            path,
+            durable.Surface("autoscale.journal", metrics=metrics),
+            fmt=self._FORMAT,
+            version=self._VERSION,
+        )
 
     @staticmethod
     def replay(path: str) -> list[dict]:
-        """All intact records; a torn tail (the crash-mid-append case)
-        or an interleaved bad line is skipped, never an error."""
-        out: list[dict] = []
-        try:
-            with open(path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    if isinstance(rec, dict):
-                        out.append(rec)
-        except FileNotFoundError:
-            pass
-        return out
+        """All intact data records; a torn tail (the crash-mid-append
+        case) or an interleaved bad line is skipped, never an error.
+        The version-header record is validated and excluded."""
+        records, _torn = durable.Journal.replay(
+            path, DecisionJournal._FORMAT, DecisionJournal._VERSION
+        )
+        return records
 
 
 # ------------------------------------------------------------- engine
@@ -857,7 +845,10 @@ class AutoscaleController:
             self.arrivals = ArrivalHistory(
                 bucket_s=arrival_bucket_s, clock=clock
             )
-        self.journal = DecisionJournal(journal_path) if journal_path else None
+        self.journal = (
+            DecisionJournal(journal_path, metrics=self.metrics)
+            if journal_path else None
+        )
         if journal_path:
             self.engine.restore(
                 DecisionJournal.replay(journal_path), clock()

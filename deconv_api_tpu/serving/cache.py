@@ -76,6 +76,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from deconv_api_tpu import errors
+from deconv_api_tpu.serving import durable
 from deconv_api_tpu.serving import trace as trace_mod
 from deconv_api_tpu.serving.http import Request, Response
 from deconv_api_tpu.utils import slog
@@ -444,21 +445,23 @@ class Singleflight:
 # reach the filesystem layer as a file name
 _L2_KEY_RE = re.compile(r"^[0-9a-f]{16,128}$")
 
-# sanity bound on the header line of an .l2 file: a corrupt file whose
-# first newline is megabytes in must read as corrupt, not allocate-and-parse
-_L2_HEADER_MAX = 4096
-
-
 class L2Store:
-    """Durable disk tier behind the in-memory ``ResponseCache`` (round 16).
+    """Durable disk tier behind the in-memory ``ResponseCache`` (round 16;
+    storage through ``serving/durable.py`` since round 24).
 
-    One file per key under ``root``: a single JSON header line (status,
-    content type, body digest, body length) followed by the raw payload
-    bytes.  Every write is tmp-then-rename with fsync (the SpillStore
-    idiom — a crash leaves either a complete entry or a stale ``.tmp``
-    the next boot sweeps); every read verifies the recorded blake2b
-    digest and length, and ANY defect — torn header, short body, digest
-    mismatch — deletes the file and reads as a miss, never an error.
+    One file per key under ``root``: a ``durable.frame`` artifact — the
+    versioned ``{"format": "cache.l2", "version", "len", "digest"}``
+    header line carrying status + content type as extras, followed by
+    the raw payload bytes.  Every write goes through
+    ``durable.atomic_write`` (tmp + fsync + rename + dir fsync — a crash
+    leaves either a complete entry or a stale ``.tmp`` the next boot
+    sweeps); every read verifies the recorded blake2b digest and length,
+    and ANY defect — torn header, short body, digest mismatch — deletes
+    the file and reads as a miss, never an error.  A FUTURE-version
+    header reads as a miss without deletion (fail-static, best-effort
+    side of the round-24 split); a failed write degrades to a counted
+    no-op — ``durable_write_errors_total{surface="cache.l2"}`` counts it
+    and ``durable_degraded{surface="cache.l2"}`` flips once per episode.
 
     Budgeting: ``max_bytes`` bounds resident bytes (0 = unbounded); the
     in-memory index (rebuilt from the directory at boot, ordered by
@@ -476,6 +479,9 @@ class L2Store:
     ``cache_l2_{hits,misses,stores,sweeps,corrupt}_total`` and
     ``cache_l2_resident_bytes``."""
 
+    _FORMAT = "cache.l2"
+    _VERSION = 1
+
     def __init__(
         self,
         root: str,
@@ -487,6 +493,11 @@ class L2Store:
         self.root = root
         self.max_bytes = int(max_bytes)
         self._metrics = metrics
+        # BEST-EFFORT surface (round 24): a failing disk degrades the
+        # tier to counted no-op writes — durable_degraded{surface=
+        # "cache.l2"} flips once per episode instead of one log line
+        # per swallowed writer-thread error
+        self.surface = durable.Surface("cache.l2", metrics=metrics)
         self._lock = threading.Lock()
         # key -> charged bytes, oldest-mtime first (the LRU order)
         self._index: OrderedDict[str, int] = OrderedDict()
@@ -518,18 +529,14 @@ class L2Store:
         ``.tmp`` files from a crashed writer are swept, complete entries
         come back oldest-mtime-first so LRU order survives the restart."""
         entries: list[tuple[float, str, int]] = []
+        # stale .tmp debris from a crashed writer: the uniform boot sweep
+        durable.sweep_tmp(self.root)
         try:
             names = os.listdir(self.root)
         except OSError:
             names = []
         for fn in names:
             path = os.path.join(self.root, fn)
-            if fn.endswith(".tmp"):
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-                continue
             if not fn.endswith(".l2"):
                 continue
             key = fn[: -len(".l2")]
@@ -581,31 +588,22 @@ class L2Store:
         if not known:
             self._count("cache_l2_misses_total")
             return None
-        try:
-            with open(self._path(key), "rb") as f:
-                raw = f.read()
-        except OSError:
-            # raced a sweep, or the file vanished underneath us: a miss
+        raw = durable.read_bytes(self._path(key), "cache.l2")
+        if raw is None:
+            # raced a sweep, the file vanished, or an injected EIO: a miss
             with self._lock:
                 self._index.pop(key, None)
             self._count("cache_l2_misses_total")
             return None
-        head, sep, body = raw.partition(b"\n")
-        ok = bool(sep) and len(head) <= _L2_HEADER_MAX
-        meta = None
-        if ok:
-            try:
-                meta = json.loads(head)
-            except ValueError:
-                ok = False
-        if ok:
-            ok = (
-                isinstance(meta, dict)
-                and isinstance(meta.get("status"), int)
-                and meta.get("len") == len(body)
-                and meta.get("digest")
-                == hashlib.blake2b(body, digest_size=16).hexdigest()
-            )
+        try:
+            framed = durable.unframe(raw, self._FORMAT, self._VERSION)
+        except durable.FutureVersionError:
+            # fail-static (best-effort contract): an entry written by a
+            # NEWER binary reads as a miss WITHOUT deletion — the newer
+            # binary sharing the directory can still serve it
+            self._count("cache_l2_misses_total")
+            return None
+        ok = framed is not None and isinstance(framed[0].get("status"), int)
         if not ok:
             slog.event(
                 _log, "l2_corrupt_entry", level=logging.WARNING, key=key
@@ -616,6 +614,7 @@ class L2Store:
             self._count("cache_l2_misses_total")
             self._publish()
             return None
+        meta, body = framed
         with self._lock:
             if key in self._index:
                 self._index.move_to_end(key)
@@ -632,37 +631,17 @@ class L2Store:
         thread's body; tests call it directly).  Returns whether stored."""
         if status != 200 or not _L2_KEY_RE.match(key):
             return False
-        head = json.dumps(
-            {
-                "v": 1,
-                "status": status,
-                "ct": content_type,
-                "len": len(body),
-                "digest": hashlib.blake2b(body, digest_size=16).hexdigest(),
-            },
-            separators=(",", ":"),
-        ).encode()
-        data = head + b"\n" + body
+        data = durable.frame(
+            self._FORMAT, self._VERSION, body,
+            extra={"status": status, "ct": content_type},
+        )
         if self.max_bytes and len(data) > self.max_bytes:
             # one oversized payload must not evict the whole durable set
             return False
-        path = self._path(key)
-        tmp = path + ".tmp"
-        try:
-            with open(tmp, "wb") as f:
-                f.write(data)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-        except OSError as e:
-            slog.event(
-                _log, "l2_write_error", level=logging.ERROR,
-                key=key, error=f"{type(e).__name__}: {e}",
-            )
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+        # best-effort contract: a failed write counts into the durable
+        # families and flips durable_degraded{surface="cache.l2"} once
+        # per episode — no per-write log line, no exception, no store
+        if not durable.atomic_write(self._path(key), data, surface=self.surface):
             return False
         swept = 0
         with self._lock:
@@ -701,6 +680,9 @@ class L2Store:
             try:
                 self.put(*item)
             except Exception as e:  # noqa: BLE001 — writer must survive
+                # disk errors never reach here (durable.atomic_write
+                # absorbs them into the cache.l2 degraded machinery);
+                # this is the last-resort net for programming errors
                 slog.event(
                     _log, "l2_writer_error", level=logging.ERROR,
                     error=f"{type(e).__name__}: {e}",

@@ -59,7 +59,7 @@ SITES = (
     "device.dispatch_delay_ms",  # device batch dispatch stalls :param ms
     "http.slow_write",         # response write stalls :param ms
     "jobs.runner_crash",       # job runner dies at a checkpoint boundary
-    "jobs.journal_write_error",  # job journal append raises (disk fault)
+    "jobs.journal_write_error",  # LEGACY: alias of fs.fsync_error@jobs.journal
     "qos.admission_raise",     # QoS admission layer crashes (fails OPEN
                                # to the default tenant — availability
                                # over accounting; serving/qos.py)
@@ -87,7 +87,26 @@ SITES = (
     # lifecycle state EXACTLY where it was (a firing alert never flaps
     # to resolved because the evaluator died).  Drill-armable.
     "alerts.eval_error",         # alert rule evaluation raises mid-tick
+    # Filesystem fault sites (round 24, serving/durable.py): every
+    # durable write and verified read consults these with
+    # ``who=<surface>`` (jobs.journal, jobs.spill, cache.l2,
+    # fleet.membership, aot.store, autoscale.journal, alerts.incidents,
+    # quant.calib), so ``fs.enospc=p1@cache.l2`` starves exactly one
+    # surface and leaves the rest of the disk "healthy".
+    "fs.enospc",        # write raises ENOSPC before any byte lands
+    "fs.eio_read",      # read raises EIO (reads as absent by contract)
+    "fs.short_write",   # write silently truncates (digest catches it)
+    "fs.fsync_error",   # fsync raises EIO (data not durable)
+    "fs.crash_point",   # SIGKILL self at crashpoint :param (durable.CRASH_*)
 )
+
+# Legacy spelling of the one pre-round-24 disk fault site.  Arming it
+# rewrites to ``fs.fsync_error@jobs.journal`` (see FaultRegistry.arm)
+# so old drill scripts and OPERATIONS recipes keep working while the
+# fault vocabulary has one owner — durable.py consults only ``fs.*``.
+_LEGACY_ALIASES = {
+    "jobs.journal_write_error": ("fs.fsync_error", "jobs.journal"),
+}
 
 
 @dataclass
@@ -197,9 +216,20 @@ class FaultRegistry:
             )
         if isinstance(spec, str):
             spec = parse_spec(spec)
+        if site in _LEGACY_ALIASES:
+            # round 24: the legacy disk-fault spelling rewrites onto the
+            # fs.* vocabulary (an explicit @target on the old spelling
+            # is preserved — it can only have meant the same surface)
+            site, target = _LEGACY_ALIASES[site]
+            if spec.target is None:
+                spec.target = target
         with self._lock:
             self._armed[site] = spec
         slog.event(_log, "fault_armed", site=site, spec=str(spec))
+        if self._metrics is not None:
+            # the armed site's counter is present at zero from the
+            # first scrape after arming (round 24 exposition lint)
+            self._metrics.inc_labeled("faults_injected_total", "site", site, 0)
         self._publish()
 
     def arm_string(self, raw: str) -> None:

@@ -223,6 +223,7 @@ from collections import OrderedDict, deque
 from typing import Callable
 
 from deconv_api_tpu import errors
+from deconv_api_tpu.serving import durable
 from deconv_api_tpu.serving import faults as faults_mod
 from deconv_api_tpu.serving.alerts import (
     AlertEngine,
@@ -1534,6 +1535,16 @@ class FleetRouter:
         self._probe_task: asyncio.Task | None = None
         self.bound: tuple[str, int] | None = None
         self._mf_mtime_ns = -1  # membership-file watch state
+        # FAIL-LOUD durable surface (round 24): a registration whose
+        # membership persist cannot be made durable answers 503, never
+        # a 200 the fleet would forget across a crash
+        self._membership_surface = durable.Surface(
+            "fleet.membership", metrics=self.metrics
+        )
+        if membership_file:
+            # boot sweep of OUR .tmp half only — the membership file
+            # lives in a shared, operator-provided directory
+            durable.sweep_tmp_file(membership_file)
         # drains announced for members THIS router never knew (the
         # announcement raced ahead of the registration relay): carried
         # into the membership file so peers that DO know them converge.
@@ -1571,6 +1582,7 @@ class FleetRouter:
                 self.incidents = IncidentStore(
                     incidents_dir,
                     retention_s=float(incidents_retention_s),
+                    metrics=self.metrics,
                 )
         # closed-loop elasticity (round 22): off is the escape hatch —
         # no controller object, no arrival recording, no config/readyz
@@ -1809,12 +1821,16 @@ class FleetRouter:
                 self._foreign_drains[name] = None
                 while len(self._foreign_drains) > 1024:
                     self._foreign_drains.popitem(last=False)
-                self._persist_membership()
+                if not self._persist_membership():
+                    return self._undurable_register(req)
                 return Response.json(
                     {"ok": False, "known": False, "request_id": req.id}
                 )
             self._mark_announced_drain(m, "self_announced")
-        self._persist_membership(clear_drain=cleared)
+        if not self._persist_membership(clear_drain=cleared):
+            # fail-loud contract (round 24): a 200 would acknowledge a
+            # membership change the fleet cannot remember across a crash
+            return self._undurable_register(req)
         return Response.json(
             {
                 "ok": True,
@@ -1824,6 +1840,19 @@ class FleetRouter:
                 "request_id": req.id,
             }
         )
+
+    @staticmethod
+    def _undurable_register(req: Request) -> Response:
+        resp = Response.json(
+            {
+                "error": "undurable_write",
+                "message": "membership persist failed; retry",
+                "request_id": req.id,
+            },
+            503,
+        )
+        resp.headers["retry-after"] = "1"
+        return resp
 
     # ------------------------------------------------------ membership file
 
@@ -1853,6 +1882,17 @@ class FleetRouter:
                 path=path, error=f"{type(e).__name__}: {e}",
             )
             return
+        if isinstance(doc, dict):
+            v = doc.get("version", 1)
+            if isinstance(v, int) and v > 1:
+                # fail-static (round 24): a file written by a NEWER
+                # binary is ignored, never misparsed — and never
+                # rewritten by our older merge (see _persist_membership)
+                slog.event(
+                    _log, "membership_file_error", level=logging.ERROR,
+                    path=path, error=f"future membership version {v}",
+                )
+                return
         members = doc.get("members") if isinstance(doc, dict) else None
         if not isinstance(members, dict):
             slog.event(
@@ -1872,12 +1912,13 @@ class FleetRouter:
             else:
                 self._clear_announced_drain(m, "membership_file")
 
-    def _persist_membership(self, clear_drain: str | None = None) -> None:
-        """Write the shared membership view tmp-then-rename (the
-        SpillStore idiom — peers never observe a torn file), under an
-        exclusive flock on a sidecar lockfile so two router PROCESSES
-        persisting concurrently serialize their read-merge-write instead
-        of erasing each other's registrations.
+    def _persist_membership(self, clear_drain: str | None = None) -> bool:
+        """Write the shared membership view through
+        ``durable.atomic_write`` (round 24: tmp + fsync + rename + dir
+        fsync — peers never observe a torn file), under an exclusive
+        flock on a sidecar lockfile so two router PROCESSES persisting
+        concurrently serialize their read-merge-write instead of
+        erasing each other's registrations.
 
         Merge rules: membership only GROWS here (a dead member is a
         probe-ejection concern, not a file edit); a ``draining`` flag is
@@ -1886,10 +1927,15 @@ class FleetRouter:
         a peer's fresher drain with its own stale false.  The ONE signal
         allowed to downgrade the flag is an explicit re-registration
         (``clear_drain`` names the member), because only the restarted
-        backend itself knows the drain is over."""
+        backend itself knows the drain is over.
+
+        Returns whether the write is durable.  FAIL-LOUD surface: the
+        error is counted and the degraded gauge flips here; callers on
+        the request path (``_register``) turn False into a 503 +
+        Retry-After, periodic callers log-and-continue."""
         path = self.membership_file
         if not path:
-            return
+            return True
         try:
             import fcntl
 
@@ -1905,7 +1951,18 @@ class FleetRouter:
             merged: dict[str, dict] = {}
             try:
                 with open(path, encoding="utf-8") as f:
-                    current = json.loads(f.read()).get("members", {})
+                    doc = json.loads(f.read())
+                cur_v = doc.get("version", 1) if isinstance(doc, dict) else 1
+                if isinstance(cur_v, int) and cur_v > 1:
+                    # fail-static: never rewrite (and so destroy) a
+                    # NEWER binary's membership document
+                    slog.event(
+                        _log, "membership_file_error", level=logging.ERROR,
+                        path=path,
+                        error=f"future membership version {cur_v}",
+                    )
+                    return False
+                current = doc.get("members", {}) if isinstance(doc, dict) else {}
                 if isinstance(current, dict):
                     for name, info in current.items():
                         if isinstance(name, str) and BACKEND_RE.match(name):
@@ -1925,28 +1982,29 @@ class FleetRouter:
                     merged[name] = {"draining": True}
             if clear_drain is not None and clear_drain in merged:
                 merged[clear_drain] = {"draining": False}
+            # JSON-document artifact: {format, version} ride in-document
             data = json.dumps(
-                {"version": 1, "members": merged}, separators=(",", ":")
+                {
+                    "format": "fleet.membership",
+                    "version": 1,
+                    "members": merged,
+                },
+                separators=(",", ":"),
             ).encode()
-            tmp = path + ".tmp"
             try:
-                with open(tmp, "wb") as f:
-                    f.write(data)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)
+                durable.atomic_write(
+                    path, data, surface=self._membership_surface
+                )
                 # inside the lock no peer write can interleave, so this
                 # mtime is OUR content — safe to skip on the next watch
                 self._mf_mtime_ns = os.stat(path).st_mtime_ns
-            except OSError as e:
+            except durable.DurableWriteError as e:
                 slog.event(
                     _log, "membership_file_error", level=logging.ERROR,
                     path=path, error=f"{type(e).__name__}: {e}",
                 )
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+                return False
+            return True
         finally:
             if lock is not None:
                 lock.close()  # closing drops the flock
@@ -4415,17 +4473,18 @@ class FleetRouter:
             return
         for ctx in self.alert_engine.evaluate():
             if self.incidents is not None:
-                try:
-                    rule_name = (ctx.get("rule") or {}).get("name", "rule")
-                    self.incidents.record(
-                        rule_name, self._incident_bundle(ctx)
-                    )
+                rule_name = (ctx.get("rule") or {}).get("name", "rule")
+                # best-effort durable surface: a failed write returns
+                # None (counted in the durable families by the store)
+                if self.incidents.record(
+                    rule_name, self._incident_bundle(ctx)
+                ) is not None:
                     self.metrics.inc_counter("incidents_recorded_total")
-                except OSError as e:
+                else:
                     self.metrics.inc_counter("incident_write_errors_total")
                     slog.event(
                         _log, "incident_write_failed",
-                        level=40, error=f"{type(e).__name__}: {e}",
+                        level=40, rule=rule_name,
                     )
 
     async def _tsdb_loop(self) -> None:

@@ -68,7 +68,7 @@ import time
 from dataclasses import dataclass, field
 
 from deconv_api_tpu import errors
-from deconv_api_tpu.serving import faults
+from deconv_api_tpu.serving import durable, faults
 from deconv_api_tpu.utils import slog
 
 _log = slog.get_logger("deconv.jobs")
@@ -136,113 +136,89 @@ class Job:
     _trace: object | None = field(default=None, repr=False)
 
 
-class JobJournal:
+class JobJournal(durable.Journal):
     """Append-only JSONL write-ahead journal with torn-tail-tolerant
-    replay and whole-file compaction.
+    replay and whole-file compaction, written through the unified
+    durable layer (round 24) under the ``jobs.journal`` surface.
 
     Appends run on the event loop: one small line + flush + fsync per
     STATE EDGE (submits, checkpoints, transitions) — microseconds-to-
     low-milliseconds against jobs that run for seconds, and exactly the
-    durability the resume contract needs.  ``jobs.journal_write_error``
-    is the armable disk-fault site."""
+    durability the resume contract needs.  The surface is FAIL-LOUD: an
+    append that cannot fsync raises ``durable.DurableWriteError`` (the
+    submit path turns the pre-202 case into a 503 + Retry-After), and a
+    journal whose header declares a future format version refuses boot
+    (``durable.FutureVersionError`` out of ``replay``).  The armable
+    disk-fault sites are ``fs.*@jobs.journal``; the legacy
+    ``jobs.journal_write_error`` spelling aliases onto
+    ``fs.fsync_error@jobs.journal``."""
 
-    def __init__(self, path: str):
-        self.path = path
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._f = None
-        self._lock = threading.Lock()
+    _FORMAT = "jobs.journal"
+    _VERSION = 1
 
-    def _handle(self):
-        if self._f is None or self._f.closed:
-            self._f = open(self.path, "ab")
-        return self._f
-
-    def append(self, rec: dict) -> None:
-        act = faults.check("jobs.journal_write_error")
-        if act is not None:
-            raise OSError("injected fault at jobs.journal_write_error")
-        line = json.dumps(rec, separators=(",", ":")).encode() + b"\n"
-        with self._lock:
-            f = self._handle()
-            f.write(line)
-            f.flush()
-            os.fsync(f.fileno())
+    def __init__(self, path: str, *, metrics=None):
+        super().__init__(
+            path,
+            durable.Surface("jobs.journal", metrics=metrics),
+            fmt=self._FORMAT,
+            version=self._VERSION,
+        )
 
     @staticmethod
     def replay(path: str) -> tuple[list[dict], int]:
         """(decodable records in order, undecodable line count).  A torn
         final record — the crash-mid-append case — is skipped, never
-        fatal: the preceding fsync'd edge is the recovered state."""
-        if not os.path.exists(path):
-            return [], 0
-        records: list[dict] = []
-        torn = 0
-        with open(path, "rb") as f:
-            for raw in f.read().split(b"\n"):
-                raw = raw.strip()
-                if not raw:
-                    continue
-                try:
-                    rec = json.loads(raw)
-                except ValueError:
-                    torn += 1
-                    continue
-                if isinstance(rec, dict):
-                    records.append(rec)
-                else:
-                    torn += 1
-        return records, torn
-
-    def rewrite(self, records: list[dict]) -> None:
-        """Compaction: replace the journal with ``records`` atomically
-        (tmp + fsync + rename), so a crash mid-compaction leaves either
-        the old journal or the new one, never a mix."""
-        tmp = self.path + ".tmp"
-        with self._lock:
-            if self._f is not None and not self._f.closed:
-                self._f.close()
-            with open(tmp, "wb") as f:
-                for rec in records:
-                    f.write(json.dumps(rec, separators=(",", ":")).encode())
-                    f.write(b"\n")
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
+        fatal: the preceding fsync'd edge is the recovered state.  A
+        future-version header record raises (refuse boot, fail-static):
+        replaying a journal this binary cannot fully parse could
+        re-run acknowledged work."""
+        return durable.Journal.replay(
+            path, JobJournal._FORMAT, JobJournal._VERSION
+        )
 
 
 class SpillStore:
-    """Checkpoint/result staging under one directory, content-digested.
+    """Checkpoint/result staging under one directory, content-digested,
+    written through the unified durable layer (round 24) under the
+    ``jobs.spill`` surface.
 
-    Every write is tmp-then-rename (a crash leaves either a complete
-    file or a stale .tmp the next compaction sweeps); every read
-    verifies the digest recorded in the journal — a corrupt spill reads
-    as None, which executors treat as "that checkpoint never happened"
-    (resume falls back to an earlier one)."""
+    Every file is a framed artifact (a versioned ``{format, version,
+    len, digest}`` header line + payload); every read verifies both the
+    frame digest and the digest recorded in the journal — a corrupt or
+    future-version spill reads as None, which executors treat as "that
+    checkpoint never happened" (resume falls back to an earlier one).
+    The surface is FAIL-LOUD on writes: a spill that cannot be made
+    durable raises, and the submit path refuses the job rather than
+    acknowledge work it cannot resume."""
 
-    def __init__(self, root: str):
+    _FORMAT = "jobs.spill"
+    _VERSION = 1
+
+    def __init__(self, root: str, *, metrics=None):
         self.root = root
+        self.surface = durable.Surface("jobs.spill", metrics=metrics)
         os.makedirs(root, exist_ok=True)
+        durable.sweep_tmp(root)
 
     @staticmethod
     def _digest(data: bytes) -> str:
         return hashlib.blake2b(data, digest_size=16).hexdigest()
 
     def _write(self, fname: str, data: bytes) -> None:
-        path = os.path.join(self.root, fname)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        durable.atomic_write(
+            os.path.join(self.root, fname),
+            durable.frame(self._FORMAT, self._VERSION, data),
+            surface=self.surface,
+        )
 
     def _read(self, fname: str, digest: str | None) -> bytes | None:
-        path = os.path.join(self.root, fname)
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-        except OSError:
+        got = durable.read_framed(
+            os.path.join(self.root, fname), self._FORMAT, self._VERSION,
+            surface="jobs.spill",
+        )
+        if got is None:
             return None
+        _meta, data = got
         if digest is not None and self._digest(data) != digest:
             slog.event(
                 _log, "spill_digest_mismatch", level=logging.ERROR,
@@ -351,8 +327,17 @@ class JobManager:
         self.retention_s = float(retention_s)
         self.max_attempts = max(1, int(max_attempts))
         self._clock = clock
-        self.journal = JobJournal(os.path.join(jobs_dir, "journal.jsonl"))
-        self.spill = SpillStore(os.path.join(jobs_dir, "spill"))
+        # the manager OWNS jobs_dir (it creates journal + spill inside):
+        # the uniform boot sweep may take the whole directory, not just
+        # the journal's own <path>.tmp
+        os.makedirs(jobs_dir, exist_ok=True)
+        durable.sweep_tmp(jobs_dir)
+        self.journal = JobJournal(
+            os.path.join(jobs_dir, "journal.jsonl"), metrics=metrics
+        )
+        self.spill = SpillStore(
+            os.path.join(jobs_dir, "spill"), metrics=metrics
+        )
         self._jobs: dict[str, Job] = {}
         self._idem: dict[str, str] = {}
         self._queue: asyncio.Queue[str] = asyncio.Queue()
@@ -679,7 +664,10 @@ class JobManager:
             tenant=tenant,
         )
         # journal FIRST: a submit whose record cannot be made durable is
-        # refused — an accepted job must survive a crash
+        # refused — an accepted job must survive a crash.  The refusal
+        # is a 503 + Retry-After (round 24), NOT a 500: answering 202
+        # would acknowledge work this process cannot promise to
+        # remember, and the client's retry is the recovery path.
         try:
             self.journal.append(
                 {
@@ -690,7 +678,7 @@ class JobManager:
             )
         except OSError as e:
             self._journal_error(e)
-            raise errors.DeconvError(
+            raise errors.UndurableWrite(
                 f"job journal write failed: {e}"
             ) from e
         self._jobs[job.id] = job
@@ -729,7 +717,7 @@ class JobManager:
                     }
                 )
                 self._journal_error(e)
-                raise errors.DeconvError(
+                raise errors.UndurableWrite(
                     f"job input spill write failed: {e}"
                 ) from e
         self._queue.put_nowait(job.id)

@@ -5272,6 +5272,417 @@ def run_incident_drill(
     return asyncio.run(drive())
 
 
+# The (surface, crashpoint) combos the torture drill arms — one SIGKILL
+# each, all distinct, covering every instant serving/durable.py
+# distinguishes on the surfaces this workload writes: the L2 atomic
+# ladder (pre / written / fsynced / renamed), the jobs journal append
+# ladder (pre / written / fsynced), and the spill store's atomic writes.
+CRASH_COMBOS = (
+    ("cache.l2", 1), ("cache.l2", 2), ("cache.l2", 3), ("cache.l2", 4),
+    ("jobs.journal", 5), ("jobs.journal", 6), ("jobs.journal", 7),
+    ("jobs.spill", 2), ("jobs.spill", 4),
+)
+
+
+def run_crash_torture_drill(
+    cycles: int = 9,
+    seed: int = 0,
+    recovery_budget_s: float = 5.0,
+    enospc_requests: int = 24,
+    timeout_s: float = 900.0,
+) -> dict:
+    """The round-24 crash-anywhere drill: a REAL backend subprocess
+    (`python -m deconv_api_tpu.serving.app`, jobs + L2 enabled) is
+    SIGKILLed — by its own armed ``fs.crash_point`` fault inside
+    serving/durable.py — at a seeded shuffle of distinct (surface,
+    crashpoint) combos while live zipf load and job submits are in
+    flight, then restarted over the SAME directories.  Per cycle the
+    drill verifies the whole durability contract:
+
+    - every 202-acknowledged job reaches ``done`` exactly once across
+      the restart (journal replay + checkpoint resume, zero lost);
+    - no digest-corrupt artifact is ever served: every 200 is
+      byte-identical to the key's pre-crash baseline (a torn L2 entry
+      must read as a miss, never as bytes);
+    - no ``.tmp`` debris survives the boot sweep;
+    - recovery stays under budget — measured as the EXCESS of each
+      post-crash ready time over the clean first boot (the cold
+      python+jax start is the floor; what the budget bounds is what
+      recovery ADDS: journal replay, L2 rescan, tmp sweeps).
+
+    Then an ENOSPC soak on the surviving server: ``fs.enospc=p1`` at
+    cache.l2 only, under which every request must still answer a
+    byte-identical 200 (best-effort degradation) with
+    ``cache_l2_stores_total`` frozen and ``durable_degraded`` set, and
+    clear again after disarm."""
+    import re
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.parse
+
+    import numpy as np
+    from PIL import Image
+
+    root = tempfile.mkdtemp(prefix="deconv-crash-torture-")
+    jobs_dir = os.path.join(root, "jobs")
+    l2_dir = os.path.join(root, "l2")
+    compile_dir = os.path.join(root, "compile-cache")
+
+    rng = np.random.default_rng(seed)
+    combos = list(CRASH_COMBOS)
+    rng.shuffle(combos)
+
+    # zipf over the baseline key pool: the parity check needs every
+    # served key's reference bytes up front
+    pool = 12
+    w = 1.0 / np.arange(1, pool + 1) ** 1.1
+    zipf_keys = [int(x) for x in rng.choice(pool, 4096, p=w / w.sum())]
+
+    def uri_for(idx: int) -> str:
+        img = Image.fromarray(
+            np.random.default_rng(idx).integers(0, 255, (32, 32, 3), np.uint8),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        return (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+
+    dream = {"type": "dream", "layers": "block2_conv2", "steps": "2",
+             "octaves": "2"}
+
+    def boot(ready_timeout_s: float):
+        """One real backend process over the shared dirs; returns
+        (proc, port, ready_s) — ready_s is Popen-to-/readyz-200."""
+        port = _free_port()
+        argv = [
+            sys.executable, "-m", "deconv_api_tpu.serving.app",
+            "--model", "vgg_tiny", "--platform", "cpu",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--jobs-dir", jobs_dir, "--l2-dir", l2_dir,
+            "--compile-cache-dir", compile_dir,
+            # enables fault injection (the /v1/debug/faults arm channel)
+            # without anything able to fire: the @target never matches
+            "--fault", "fs.eio_read=p1@__never__",
+            "--fault-seed", str(seed),
+        ]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["JAX_PLATFORMS"] = "cpu"
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env,
+        )
+        return proc, port, t0
+
+    async def wait_ready(proc, port, t0, ready_timeout_s: float) -> float:
+        while time.monotonic() - t0 < ready_timeout_s:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"backend died during boot (rc={proc.returncode})"
+                )
+            try:
+                status, _ = await _http(port, "GET", "/readyz")
+            except OSError:
+                status = 0
+            if status == 200:
+                return time.monotonic() - t0
+            await asyncio.sleep(0.05)
+        proc.kill()
+        raise RuntimeError("backend never became ready")
+
+    async def post_sync(port, idx: int, no_cache: bool = False):
+        """(status|None, body|None): one sync deconv POST; None status
+        = connection refused/reset (expected around the SIGKILL)."""
+        form = {"file": uri_for(idx), "layer": "block2_conv1"}
+        body = urllib.parse.urlencode(form).encode()
+        head = (
+            "POST / HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+            "Content-Type: application/x-www-form-urlencoded\r\n"
+            f"Content-Length: {len(body)}\r\n"
+        )
+        if no_cache:
+            head += "Cache-Control: no-cache\r\n"
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(head.encode() + b"\r\n" + body)
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+        except OSError:
+            return None, None
+        if not raw:
+            return None, None
+        status, _ = _resp_status_code(raw)
+        return status, raw.split(b"\r\n\r\n", 1)[1]
+
+    async def submit_job(port, idx: int):
+        try:
+            return await _http(
+                port, "POST", "/v1/jobs", dict(dream, file=uri_for(idx))
+            )
+        except OSError:
+            return None, None
+
+    async def metric_value(port, family: str, label: str = "") -> float:
+        """One sample out of the live /v1/metrics exposition."""
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                b"GET /v1/metrics HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: close\r\n\r\n"
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+        except OSError:
+            return float("nan")
+        text = raw.split(b"\r\n\r\n", 1)[1].decode()
+        # line-anchored: '# TYPE <family> counter' must not match
+        pat = "^" + re.escape(family) + (
+            r"\{" + re.escape(label) + r"\}" if label else ""
+        ) + r" (\S+)$"
+        m = re.search(pat, text, re.M)
+        return float(m.group(1)) if m else float("nan")
+
+    def tmp_debris() -> list[str]:
+        found = []
+        for base in (jobs_dir, l2_dir):
+            for dirpath, _dirs, files in os.walk(base):
+                found += [
+                    os.path.join(dirpath, f)
+                    for f in files
+                    if f.endswith(".tmp")
+                ]
+        return found
+
+    async def drive() -> dict:
+        deadline = time.monotonic() + timeout_s
+        proc, port, t0 = boot(300.0)
+        boot_baseline_s = await wait_ready(proc, port, t0, 300.0)
+
+        # reference bytes per key, from the healthy first boot
+        baselines: dict[int, bytes] = {}
+        for k in range(pool):
+            status, body = await post_sync(port, k)
+            assert status == 200, f"baseline key {k} answered {status}"
+            baselines[k] = body
+
+        acked: dict[str, int] = {}  # job id -> cycle acknowledged
+        zi = 0  # zipf stream cursor
+        corrupt_served = 0
+        debris_total = 0
+        jobs_lost = 0
+        jobs_failed = 0
+        cycle_rows: list[dict] = []
+
+        async def drain_jobs() -> tuple[int, int]:
+            """Poll /v1/jobs until every acknowledged job is terminal;
+            (lost, failed) — lost = acknowledged but unknown or still
+            non-terminal at the deadline (the 202 was a lie)."""
+            while time.monotonic() < deadline:
+                s, listing = await _http(port, "GET", "/v1/jobs")
+                if s != 200:
+                    await asyncio.sleep(0.2)
+                    continue
+                states = {j["id"]: j["state"] for j in listing["jobs"]}
+                live = [
+                    j for j in acked
+                    if states.get(j) not in ("done", "failed", "cancelled")
+                ]
+                if not live:
+                    return (
+                        sum(1 for j in acked if j not in states),
+                        sum(
+                            1 for j in acked
+                            if states.get(j) in ("failed", "cancelled")
+                        ),
+                    )
+                await asyncio.sleep(0.1)
+            return len(acked), 0
+
+        for c, (surface, point) in enumerate(combos[:cycles]):
+            # settle: everything acknowledged so far must be durable-done
+            # BEFORE the next crash, so each cycle's verdict is its own
+            lost, failed = await drain_jobs()
+            jobs_lost += lost
+            jobs_failed += failed
+            # one job acknowledged BEFORE the crashpoint arms: the kill
+            # lands on a live 202 every cycle (the jobs-surface points
+            # fire on the submit's own append, pre-ack, so in-flight
+            # coverage cannot come from submits inside the fire window)
+            s, doc = await submit_job(port, 500 + c)
+            if s == 202:
+                acked[doc["id"]] = c
+            s, _ = await _http(
+                port, "POST", "/v1/debug/faults",
+                {"arm": f"fs.crash_point=n1:{point}@{surface}"},
+            )
+            assert s == 200, "fault arm channel unavailable"
+
+            # live fire: zipf sync load + job submits until the armed
+            # crashpoint takes the process down
+            fired = False
+            kill_deadline = time.monotonic() + 60.0
+            while time.monotonic() < kill_deadline:
+                if proc.poll() is not None:
+                    fired = True
+                    break
+                s, doc = await submit_job(port, 100 + zi)
+                if s == 202:
+                    acked[doc["id"]] = c
+                key = zipf_keys[zi % len(zipf_keys)]
+                zi += 1
+                # no-cache recomputes force write-through (a memory hit
+                # would never reach the L2 tier's crashpoint)
+                status, body = await post_sync(
+                    port, key, no_cache=bool(zi % 2)
+                )
+                if status == 200 and body != baselines[key]:
+                    corrupt_served += 1
+                await asyncio.sleep(0.01)
+            rc = proc.returncode if fired else None
+            if not fired:
+                proc.kill()
+                proc.wait()
+
+            # restart over the same dirs; recovery = what replay/rescan/
+            # sweep ADD over the clean-boot floor
+            proc, port, t0 = boot(300.0)
+            ready_s = await wait_ready(proc, port, t0, 300.0)
+            recovery_s = max(0.0, ready_s - boot_baseline_s)
+            debris = tmp_debris()
+            debris_total += len(debris)
+
+            # post-crash parity: L2-hit reads (digest-verified) AND
+            # forced recomputes must both reproduce the baseline bytes
+            for k in (0, 1, 2):
+                for nc in (False, True):
+                    status, body = await post_sync(port, k, no_cache=nc)
+                    if status != 200 or body != baselines[k]:
+                        corrupt_served += 1
+            cycle_rows.append({
+                "surface": surface, "point": point, "fired": fired,
+                "rc": rc, "ready_s": round(ready_s, 3),
+                "recovery_s": round(recovery_s, 3),
+                "tmp_debris": len(debris),
+            })
+
+        lost, failed = await drain_jobs()
+        jobs_lost += lost
+        jobs_failed += failed
+
+        # ---- ENOSPC soak: best-effort degradation, byte-for-byte ----
+        stores0 = await metric_value(port, "deconv_cache_l2_stores_total")
+        s, _ = await _http(
+            port, "POST", "/v1/debug/faults",
+            {"arm": "fs.enospc=p1@cache.l2"},
+        )
+        assert s == 200
+        non_200 = 0
+        mismatch = 0
+        for i in range(enospc_requests):
+            key = zipf_keys[(zi + i) % len(zipf_keys)]
+            status, body = await post_sync(port, key, no_cache=True)
+            if status != 200:
+                non_200 += 1
+            elif body != baselines[key]:
+                mismatch += 1
+        await asyncio.sleep(0.3)  # let the async L2 writer drain
+        stores1 = await metric_value(port, "deconv_cache_l2_stores_total")
+        degraded = await metric_value(
+            port, "deconv_durable_degraded", 'surface="cache.l2"'
+        )
+        write_errors = await metric_value(
+            port, "deconv_durable_write_errors_total", 'surface="cache.l2"'
+        )
+        await _http(port, "POST", "/v1/debug/faults", {"disarm": "all"})
+        # recovery: the next successful write-through clears the episode
+        await post_sync(port, 0, no_cache=True)
+        cleared = float("nan")
+        clear_deadline = time.monotonic() + 10.0
+        while time.monotonic() < clear_deadline:
+            cleared = await metric_value(
+                port, "deconv_durable_degraded", 'surface="cache.l2"'
+            )
+            if cleared == 0.0:
+                break
+            await post_sync(port, 0, no_cache=True)
+            await asyncio.sleep(0.1)
+
+        proc.kill()
+        proc.wait()
+        shutil.rmtree(root, ignore_errors=True)
+
+        fired_combos = [
+            (r["surface"], r["point"]) for r in cycle_rows if r["fired"]
+        ]
+        recov_max = max((r["recovery_s"] for r in cycle_rows), default=0.0)
+        row = {
+            "which": "loopback_crash_torture_drill",
+            "platform": "cpu-subprocess",
+            "seed": seed,
+            "cycles": len(cycle_rows),
+            "cycles_fired": len(fired_combos),
+            "distinct_crashpoints": len(set(fired_combos)),
+            "boot_baseline_s": round(boot_baseline_s, 3),
+            "recovery_s_max": round(recov_max, 3),
+            "recovery_budget_s": recovery_budget_s,
+            "jobs_acknowledged": len(acked),
+            "jobs_lost": jobs_lost,
+            "jobs_failed": jobs_failed,
+            "corrupt_served": corrupt_served,
+            "tmp_debris": debris_total,
+            "enospc": {
+                "requests": enospc_requests,
+                "non_200": non_200,
+                "byte_mismatch": mismatch,
+                "stores_delta": (
+                    stores1 - stores0
+                    if stores1 == stores1 and stores0 == stores0 else None
+                ),
+                "write_errors": write_errors,
+                "degraded_during": degraded,
+                "degraded_after_clear": cleared,
+            },
+            "cycles_detail": cycle_rows,
+        }
+        errs = []
+        if len(fired_combos) < min(cycles, 8):
+            errs.append(
+                f"only {len(fired_combos)} crashpoints fired (want >= 8)"
+            )
+        if jobs_lost:
+            errs.append(f"{jobs_lost} acknowledged jobs LOST")
+        if jobs_failed:
+            errs.append(f"{jobs_failed} acknowledged jobs failed")
+        if corrupt_served:
+            errs.append(f"{corrupt_served} non-baseline bytes served")
+        if debris_total:
+            errs.append(f"{debris_total} .tmp files survived boot sweeps")
+        if recov_max > recovery_budget_s:
+            errs.append(
+                f"recovery {recov_max:.2f}s over the "
+                f"{recovery_budget_s:g}s budget"
+            )
+        if non_200 or mismatch:
+            errs.append("ENOSPC soak violated best-effort degradation")
+        if degraded != 1.0 or (stores1 == stores1 and stores1 != stores0):
+            errs.append("ENOSPC soak: stores moved or gauge never flipped")
+        if cleared != 0.0:
+            errs.append("degraded gauge never cleared after disarm")
+        if errs:
+            row["error"] = "; ".join(errs)
+        return row
+
+    return asyncio.run(drive())
+
+
 def main() -> int:
     args = sys.argv[1:]
     passes = 1
@@ -5299,6 +5710,9 @@ def main() -> int:
     fleet_fastpath = False
     diurnal = False
     incident = False
+    crash_torture = False
+    torture_cycles = 9
+    torture_seed = 0
     stub_port: int | None = None
     stub_routers = ""
     stub_token = ""
@@ -5405,6 +5819,21 @@ def main() -> int:
             # jobs-gated scale-downs, burn < 1 throughout
             diurnal = True
             i += 1
+        elif args[i] == "--crash-torture":
+            # the round-24 durability drill: SIGKILL a real backend
+            # subprocess at seeded fs.crash_point combos under live
+            # zipf + jobs load, restart over the same dirs, verify
+            # zero acknowledged-job loss / zero corrupt serves / zero
+            # .tmp debris / recovery under budget, then the ENOSPC
+            # best-effort soak (run_crash_torture_drill)
+            crash_torture = True
+            i += 1
+        elif args[i] == "--cycles":
+            torture_cycles = int(args[i + 1])
+            i += 2
+        elif args[i] == "--seed":
+            torture_seed = int(args[i + 1])
+            i += 2
         elif args[i] == "--incident":
             # the round-23 alerting drill: healthy phase with zero
             # false positives, a gray dispatch stall detected by the
@@ -5501,6 +5930,12 @@ def main() -> int:
         row = run_incident_drill(n_healthy=n_requests or 96)
         print(json.dumps(row), flush=True)
         return 0
+    if crash_torture:
+        row = run_crash_torture_drill(
+            cycles=torture_cycles, seed=torture_seed
+        )
+        print(json.dumps(row), flush=True)
+        return 0 if "error" not in row else 1
     if quant_drill:
         row = run_quant_drill(
             n_requests=n_requests or 240,

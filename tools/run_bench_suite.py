@@ -159,6 +159,20 @@ INCIDENT_DETECT_BUDGET_S = 8.0
 INCIDENT_RESOLVE_BUDGET_S = 12.0
 TSDB_OVERHEAD_BUDGET_PCT = 1.0
 
+# Crash-anywhere durability budgets (round 24): the SIGKILL torture
+# drill (tools/loopback_load.py --crash-torture) must fire >= 8 seeded
+# cycles at DISTINCT (surface, crashpoint) combos with zero
+# 202-acknowledged jobs lost, zero non-baseline bytes served, zero
+# .tmp debris surviving a boot sweep, and each post-crash recovery
+# adding at most this many seconds over the clean-boot floor (journal
+# replay + L2 rescan + sweeps are what the budget bounds — the cold
+# python+jax start is the floor, not the recovery).  The ENOSPC soak
+# phase must answer EVERY request 200 byte-identical with
+# cache_l2_stores_total frozen (best-effort surfaces degrade to
+# counted no-ops, never to user-visible failures).
+CRASH_TORTURE_MIN_CYCLES = 8
+CRASH_RECOVERY_BUDGET_S = 5.0
+
 # Channel-packed backward-tail budget (round 12): the packed path must
 # not run SLOWER than the vmapped path it would replace — a recorded
 # regression (like the r3 prototype's 280-vs-368 img/s) keeps the
@@ -886,6 +900,60 @@ def run_alerting_guard(timeout_s: float = 900.0) -> dict:
     return row
 
 
+def run_crash_torture_guard(timeout_s: float = 1800.0) -> dict:
+    """Crash-anywhere durability drill guard (round 24):
+    tools/loopback_load.py --crash-torture — one real backend
+    subprocess (jobs + L2 over serving/durable.py) SIGKILLed by its own
+    armed ``fs.crash_point`` faults at >= CRASH_TORTURE_MIN_CYCLES
+    seeded distinct (surface, crashpoint) combos under live zipf + job
+    load, restarted over the same directories each time, then an
+    ``fs.enospc`` best-effort soak on the survivor.
+
+    The row fails LOUDLY (`error` field) when:
+    - fewer than CRASH_TORTURE_MIN_CYCLES crashpoints actually fired;
+    - ANY 202-acknowledged job is lost or failed across a restart
+      (the write-ahead journal's whole contract);
+    - ANY 200 carried bytes differing from the key's pre-crash
+      baseline (a torn artifact served instead of read-as-miss);
+    - ANY ``.tmp`` file survives a boot sweep;
+    - a post-crash recovery exceeds CRASH_RECOVERY_BUDGET_S over the
+      clean-boot floor;
+    - the ENOSPC soak answers any non-200, drifts any byte, moves
+      ``cache_l2_stores_total``, or fails to flip (and later clear)
+      ``durable_degraded{surface="cache.l2"}``."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--crash-torture", "--cycles", "9",
+         "--seed", "0"],
+        timeout_s, env={"JAX_PLATFORMS": "cpu"},
+    )
+    row = {"config": "crash-torture",
+           "which": "loopback_crash_torture_drill"}
+    if "error" in drill and "which" not in drill:
+        row["error"] = drill["error"]
+        return row
+    row.update(
+        seed=drill.get("seed"),
+        cycles=drill.get("cycles"),
+        cycles_fired=drill.get("cycles_fired"),
+        distinct_crashpoints=drill.get("distinct_crashpoints"),
+        min_cycles_budget=CRASH_TORTURE_MIN_CYCLES,
+        jobs_acknowledged=drill.get("jobs_acknowledged"),
+        jobs_lost=drill.get("jobs_lost"),
+        jobs_failed=drill.get("jobs_failed"),
+        corrupt_served=drill.get("corrupt_served"),
+        tmp_debris=drill.get("tmp_debris"),
+        boot_baseline_s=drill.get("boot_baseline_s"),
+        recovery_s_max=drill.get("recovery_s_max"),
+        recovery_budget_s=drill.get("recovery_budget_s"),
+        enospc=drill.get("enospc"),
+        cycles_detail=drill.get("cycles_detail"),
+    )
+    if "error" in drill:
+        row["error"] = drill["error"]
+    return row
+
+
 def run_fleet_trace_guard(timeout_s: float = 1800.0) -> dict:
     """Observability-plane drill guard (round 19):
     tools/loopback_load.py --fleet-trace — two routers over three
@@ -1588,6 +1656,14 @@ def main() -> int:
             # cost <= 1% of the default interval
             result = run_alerting_guard()
             result["date"] = date
+        elif tok == "crash-torture":
+            # crash-anywhere durability drill (round 24): >= 8 seeded
+            # SIGKILLs at distinct durable-layer crashpoints under live
+            # load — zero acknowledged-job loss, zero corrupt serves,
+            # zero .tmp debris, recovery under budget, then the ENOSPC
+            # best-effort soak (zero non-200s, frozen store counter)
+            result = run_crash_torture_guard()
+            result["date"] = date
         elif tok == "models":
             # multi-model paging drill (round 15): three backbones from
             # one pool under a budget that forces paging + the
@@ -1634,7 +1710,7 @@ def main() -> int:
             result = {
                 "config": tok, "date": date,
                 "error": f"unknown config token {tok!r}; numeric or one of "
-                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'router-fastpath', 'autoscale', 'alerting', 'models', 'quant', 'aot-boot'])}",
+                         f"{sorted([*LOOPBACK_CONFIGS, 'trace-on', 'chaos', 'chaos-lanes', 'lanes', 'compile-cache', 'jobs', 'kpack', 'fused', 'qos', 'fleet', 'fleet-ha', 'fleet-tail', 'fleet-trace', 'router-fastpath', 'autoscale', 'alerting', 'models', 'quant', 'aot-boot', 'crash-torture'])}",
             }
         else:
             n = int(tok)

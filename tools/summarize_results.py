@@ -92,6 +92,27 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "distinct_crashpoints" in row:
+        # crash-torture durability rows (round 24): the whole contract
+        # in one line — distinct SIGKILL crashpoints fired vs the
+        # minimum, the zero-loss ledger (acknowledged jobs / corrupt
+        # serves / .tmp debris), recovery vs budget, and the ENOSPC
+        # best-effort soak; error kept visible
+        soak = row.get("enospc") or {}
+        line = (
+            f"crash-torture {row.get('distinct_crashpoints')} crashpoints "
+            f"(min {row.get('min_cycles_budget', 8)}): acked="
+            f"{row.get('jobs_acknowledged')} lost={row.get('jobs_lost')} "
+            f"corrupt={row.get('corrupt_served')} "
+            f"debris={row.get('tmp_debris')}, recovery "
+            f"{row.get('recovery_s_max')}s "
+            f"(budget {row.get('recovery_budget_s', 5)}s), enospc "
+            f"non200={soak.get('non_200')} stores_delta="
+            f"{soak.get('stores_delta')} degraded={soak.get('degraded_during')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "firing_latency_s" in row:
         # alerting / incident-forensics rows (round 23): the whole
         # contract in one line — zero false positives healthy, fault →
