@@ -299,6 +299,53 @@ def cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_pod_worker(args: argparse.Namespace) -> int:
+    """A pod follower process (round 25, parallel/pod.py): builds the
+    SAME model bundle as the coordinator (the multi-controller contract —
+    identical programs resolved from identical state), then runs the thin
+    dispatch loop instead of the HTTP service.  Exits 0 on coordinator
+    drain, 1 on coordinator loss or a failed dispatch."""
+    from deconv_api_tpu.config import ServerConfig
+
+    overrides: dict = {}
+    if args.coordinator:
+        overrides["pod_coordinator"] = args.coordinator
+    if args.hosts is not None:
+        overrides["pod_hosts"] = args.hosts
+    if args.process_id is not None:
+        overrides["pod_process_id"] = args.process_id
+    if args.control_port is not None:
+        overrides["pod_control_port"] = args.control_port
+    if args.model:
+        overrides["model"] = args.model
+    if args.weights:
+        overrides["weights"] = args.weights
+    if args.platform:
+        overrides["platform"] = args.platform
+    cfg = ServerConfig.from_env(**overrides)
+    if cfg.pod_hosts < 2 or cfg.pod_process_id == 0:
+        print(
+            "pod-worker needs pod_hosts >= 2 and pod_process_id >= 1 "
+            f"(got hosts={cfg.pod_hosts} process_id={cfg.pod_process_id}); "
+            "process 0 is the coordinator — run `serve` there",
+            file=sys.stderr,
+        )
+        return 2
+
+    from deconv_api_tpu.serving.app import DeconvService
+
+    svc = DeconvService(cfg)
+    try:
+        reason = svc.run_pod_follower()
+    finally:
+        svc.codec_pool.close()
+    print(json.dumps({"role": "pod-worker",
+                      "process_id": cfg.pod_process_id, "exit": reason}))
+    # "drain" is the clean path (coordinator stopped on purpose); "lost"
+    # and "failed" are operational faults an orchestrator should restart
+    return 0 if reason == "drain" else 1
+
+
 def cmd_doctor(args: argparse.Namespace) -> int:
     """Environment diagnostics: backend liveness (under a hard timeout —
     a wedged remote backend HANGS rather than raising), per-fetch RTT,
@@ -917,6 +964,33 @@ def main(argv: list[str] | None = None) -> int:
 
     s = sub.add_parser("models", help="list registered models")
     s.set_defaults(fn=cmd_models)
+
+    s = sub.add_parser(
+        "pod-worker",
+        help="pod follower: mirror the coordinator's sharded dispatches "
+        "(thin loop, no HTTP service)",
+    )
+    s.add_argument(
+        "--coordinator", default=None,
+        help="jax coordinator host:port (same value the coordinator's "
+        "serve got via DECONV_POD_COORDINATOR)",
+    )
+    s.add_argument(
+        "--hosts", type=int, default=None,
+        help="total pod processes including the coordinator",
+    )
+    s.add_argument(
+        "--process-id", type=int, default=None, dest="process_id",
+        help="this follower's process id (1..hosts-1)",
+    )
+    s.add_argument(
+        "--control-port", type=int, default=None, dest="control_port",
+        help="pod control channel port (default: coordinator port + 1)",
+    )
+    s.add_argument("--model", default=None)
+    s.add_argument("--weights", default=None)
+    s.add_argument("--platform", default=None)
+    s.set_defaults(fn=cmd_pod_worker)
 
     s = sub.add_parser(
         "doctor", help="environment diagnostics (backend, RTT, cache, selftest)"

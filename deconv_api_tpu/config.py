@@ -374,6 +374,38 @@ class ServerConfig:
     # many small mixed-key batches; a whole-pool mesh_shape suits few
     # huge single-key batches (docs/OPERATIONS.md "Scaling across chips").
     serve_lanes: str = "auto"
+    # --- pod tier (round 25: parallel/pod.py) ---
+    # Multi-host sharded execution: pod_hosts >= 2 processes (one
+    # coordinator + followers) bring up jax.distributed, build ONE global
+    # (batch x model) mesh over every host's devices and run each batched
+    # program as ONE sharded XLA program spanning hosts.  The coordinator
+    # (pod_process_id 0) runs the full HTTP service and joins the fleet
+    # as ONE member advertising pod_hosts capacity; followers run the
+    # `pod-worker` CLI role.  0/1 = no pod (the default single-host
+    # server, byte-identical to pre-round-25).  Mutually exclusive with
+    # mesh_shape and explicit serve_lanes (validate_parallel_config).
+    pod_hosts: int = 0
+    # This process's rank in the pod: 0 = coordinator, 1..N-1 followers.
+    pod_process_id: int = 0
+    # host:port every pod process dials for jax.distributed rendezvous
+    # (the coordinator binds its port).  Required when pod_hosts >= 2.
+    pod_coordinator: str = ""
+    # The coordinator's TCP dispatch/control channel (HELLO/DISPATCH/
+    # PING/SHUTDOWN — deliberately not a jax collective, so follower
+    # loss degrades the pod loudly instead of wedging a collective).
+    # 0 = the jax coordinator port + 1.
+    pod_control_port: int = 0
+    # Model-parallel axis of the pod mesh: global_devices // pod_model_axis
+    # shards the batch, pod_model_axis shards the model.  Must divide the
+    # global device count (make_pod_mesh validates loudly).
+    pod_model_axis: int = 1
+    # How long boot waits for the pod to assemble (followers build their
+    # model bundle before dialing in, so this budgets their boot too).
+    pod_join_timeout_s: float = 120.0
+    # Capacity this member advertises when self-registering with fleet
+    # routers: the ring grants vnodes proportionally (capacity 3 ~ 3x the
+    # keyspace).  0 = auto: pod_hosts for a pod coordinator, else 1.
+    fleet_capacity: int = 0
     dtype: str = "float32"  # forward/selection dtype: 'float32' | 'bfloat16'
     # Backward-projection dtype. bfloat16 is the default: selection and
     # switches stay exact (forward runs in `dtype`), and the projection
@@ -445,6 +477,65 @@ def _coerce(raw: str, annotation: Any, default: Any):
     if isinstance(default, tuple):
         return tuple(int(x) for x in raw.split(",") if x)
     return raw
+
+
+def validate_parallel_config(cfg: ServerConfig) -> None:
+    """Boot-time validation of the parallel layout (round 25).
+
+    Two classes of error die HERE, at service construction, with a
+    config-shaped message instead of a ValueError deep in lane/mesh
+    resolution: (1) the mesh/lanes/pod mutual exclusion the lanes
+    docstring always stated, now enforced end-to-end from config
+    (parallel/mesh.py validate_parallel_layout); (2) pod-incompatible
+    features — anything whose per-host state could make the coordinator
+    and followers compile or stage DIVERGENT programs, breaking the
+    multi-controller SPMD contract."""
+    from deconv_api_tpu.parallel.mesh import validate_parallel_layout
+
+    validate_parallel_layout(cfg.mesh_shape, cfg.serve_lanes, cfg.pod_hosts)
+    if cfg.pod_hosts == 1:
+        raise ValueError(
+            "pod_hosts=1 is not a pod — leave DECONV_POD_HOSTS unset (0) "
+            "for single-host serving, or set >= 2 for a real pod"
+        )
+    if cfg.pod_hosts > 1:
+        if not cfg.pod_coordinator:
+            raise ValueError(
+                f"pod_hosts={cfg.pod_hosts} requires pod_coordinator "
+                "(host:port of the jax.distributed rendezvous, e.g. "
+                "DECONV_POD_COORDINATOR=10.0.0.1:9911)"
+            )
+        if not (0 <= cfg.pod_process_id < cfg.pod_hosts):
+            raise ValueError(
+                f"pod_process_id={cfg.pod_process_id} out of range "
+                f"[0, {cfg.pod_hosts})"
+            )
+        for field, why in (
+            ("calibration_dir", "calibrated int8 scales are per-host state"),
+            ("hbm_budget_bytes", "LRU weight paging would diverge across "
+                                 "processes"),
+            ("aot_dir", "AOT executables resolve per-host"),
+            ("serve_models", "multi-model routing is not yet descriptor-"
+                             "replicated"),
+        ):
+            if getattr(cfg, field):
+                raise ValueError(
+                    f"pod_hosts={cfg.pod_hosts} is incompatible with "
+                    f"{field}={getattr(cfg, field)!r}: {why} — every pod "
+                    "process must compile and stage the identical program "
+                    "(docs/OPERATIONS.md 'Pod-scale serving')"
+                )
+        if cfg.weight_dtype != "f32":
+            raise ValueError(
+                f"pod_hosts={cfg.pod_hosts} is incompatible with "
+                f"weight_dtype={cfg.weight_dtype!r}: the pod replicates the "
+                "bundle's f32 host tree; quantized weight stores live in "
+                "the per-host weight manager"
+            )
+    if cfg.fleet_capacity < 0:
+        raise ValueError(
+            f"fleet_capacity must be >= 0 (0 = auto), got {cfg.fleet_capacity}"
+        )
 
 
 def apply_platform(cfg: ServerConfig) -> None:

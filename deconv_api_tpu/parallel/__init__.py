@@ -19,8 +19,10 @@ from deconv_api_tpu.parallel.mesh import (
     batch_sharding,
     init_distributed,
     make_mesh,
+    make_pod_mesh,
     param_shardings,
     replicated,
+    validate_parallel_layout,
 )
 from deconv_api_tpu.parallel.batch import sharded_visualizer
 from deconv_api_tpu.parallel.lanes import lane_placements, resolve_lane_count
@@ -30,8 +32,10 @@ __all__ = [
     "init_distributed",
     "lane_placements",
     "make_mesh",
+    "make_pod_mesh",
     "param_shardings",
     "replicated",
     "resolve_lane_count",
     "sharded_visualizer",
+    "validate_parallel_layout",
 ]

@@ -33,11 +33,24 @@ def shard_batched_fn(fn, mesh):
     lax.map over chunks — under dp sharding that serializes work GSPMD
     should spread across the mesh, and the per-device carry is already
     B/dp so the guard is unnecessary.  (serving/models.py and
-    __graft_entry__.py both do this.)"""
+    __graft_entry__.py both do this.)
+
+    When the mesh spans processes (the pod tier), outputs are REPLICATED
+    instead of batch-sharded: a batch-sharded output would leave each
+    process holding only its addressable shards, and the coordinator's
+    ``device_get`` would fail on the non-addressable remainder.  Fully
+    replicating the outputs makes XLA emit one all-gather at program tail
+    and every process materialises the complete result — the coordinator
+    serves it, followers discard theirs (the cost of keeping the serving
+    dispatch path process-count agnostic)."""
+    spans_processes = any(
+        d.process_index != jax.process_index() for d in mesh.devices.flat
+    )
+    out_sh = replicated(mesh) if spans_processes else batch_sharding(mesh)
     return jax.jit(
         fn,
         in_shardings=(replicated(mesh), batch_sharding(mesh)),
-        out_shardings=batch_sharding(mesh),
+        out_shardings=out_sh,
     )
 
 

@@ -35,12 +35,110 @@ def make_mesh(
     return Mesh(arr, axis_names)
 
 
+def make_pod_mesh(
+    hosts: int,
+    local_devices: int,
+    model_axis: int = 1,
+    axis_names: tuple[str, str] = ("batch", "model"),
+    devices=None,
+) -> Mesh:
+    """Build the pod tier's global 2-D ``(batch × model)`` mesh spanning
+    every cooperating process's devices.
+
+    ``hosts × local_devices`` is the global device count (after
+    ``jax.distributed`` initialisation, ``jax.devices()`` is already the
+    global list in process-major order — host 0's chips first).  The mesh
+    is ``(total // model_axis, model_axis)``: batch parallelism over rows,
+    optional model parallelism over columns.  The device matrix is a plain
+    row-major reshape of the global list so every process constructs the
+    IDENTICAL mesh without communication — a prerequisite for the
+    multi-controller SPMD contract (all processes must launch the same
+    sharded program over the same mesh).
+
+    Every non-divisible shape is a loud config error, never a truncation.
+    """
+    if hosts < 1:
+        raise ValueError(f"pod needs at least 1 host, got hosts={hosts}")
+    if local_devices < 1:
+        raise ValueError(
+            f"pod needs at least 1 device per host, got local_devices={local_devices}"
+        )
+    if model_axis < 1:
+        raise ValueError(f"pod model axis must be >= 1, got {model_axis}")
+    total = hosts * local_devices
+    if total % model_axis != 0:
+        raise ValueError(
+            f"pod mesh: model_axis={model_axis} does not divide the global "
+            f"device count {total} ({hosts} hosts x {local_devices} devices) "
+            "— pick a model axis that divides hosts*local_devices"
+        )
+    batch = total // model_axis
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if len(devices) != total:
+        raise ValueError(
+            f"pod mesh expects {total} global devices "
+            f"({hosts} hosts x {local_devices} each), jax reports "
+            f"{len(devices)} — check --xla_force_host_platform_device_count "
+            "and that every process joined jax.distributed"
+        )
+    arr = np.asarray(devices, dtype=object).reshape(batch, model_axis)
+    return Mesh(arr, axis_names)
+
+
+def validate_parallel_layout(
+    mesh_shape: tuple[int, ...] | None,
+    serve_lanes: str | int,
+    pod_hosts: int = 0,
+) -> None:
+    """Boot-time mutual-exclusion check across the three parallel layouts.
+
+    The rule the lanes docstring states — a whole-pool mesh and executor
+    lanes cannot coexist — is enforced HERE, from config validation, so a
+    bad combination dies at boot with a config error instead of surfacing
+    as a lane-resolution ValueError deep in service construction.  The pod
+    tier joins the same exclusion: a pod already owns every global device
+    as one ``(batch × model)`` mesh, so neither a single-host ``mesh_shape``
+    nor explicit lanes may be stacked on top.
+
+    Pure argument checks — no jax import, callable from ``config.py``.
+    """
+    mesh_set = bool(mesh_shape)
+    lanes_explicit = str(serve_lanes).strip().lower() not in ("auto", "", "0", "1", "off")
+    pod_set = pod_hosts > 1
+    if mesh_set and lanes_explicit:
+        raise ValueError(
+            f"mesh_shape={tuple(mesh_shape)} and serve_lanes={serve_lanes!r} are "
+            "mutually exclusive: the whole-pool mesh already spans every "
+            "device; drop one of DECONV_MESH_SHAPE / DECONV_SERVE_LANES"
+        )
+    if pod_set and mesh_set:
+        raise ValueError(
+            f"pod_hosts={pod_hosts} and mesh_shape={tuple(mesh_shape)} are "
+            "mutually exclusive: the pod constructs its own global "
+            "(batch x model) mesh over every host's devices; drop "
+            "DECONV_MESH_SHAPE"
+        )
+    if pod_set and lanes_explicit:
+        raise ValueError(
+            f"pod_hosts={pod_hosts} and serve_lanes={serve_lanes!r} are "
+            "mutually exclusive: the pod's global mesh owns every device, "
+            "lanes would double-subscribe chips; drop DECONV_SERVE_LANES"
+        )
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
-    """Shard the leading (batch) axis over the data-parallel mesh axis."""
+def batch_sharding(mesh: Mesh, axis: str | None = None) -> NamedSharding:
+    """Shard the leading (batch) axis over the data-parallel mesh axis.
+
+    Default axis: ``dp`` when the mesh has one (the single-host serving
+    layout), else the mesh's FIRST axis — the pod tier names its axes
+    ``(batch, model)`` and the leading axis is the data-parallel one in
+    both conventions."""
+    if axis is None:
+        axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
     return NamedSharding(mesh, P(axis))
 
 

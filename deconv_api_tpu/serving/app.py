@@ -33,7 +33,12 @@ import urllib.parse
 import numpy as np
 
 from deconv_api_tpu import errors
-from deconv_api_tpu.config import ServerConfig, apply_platform, enable_compilation_cache
+from deconv_api_tpu.config import (
+    ServerConfig,
+    apply_platform,
+    enable_compilation_cache,
+    validate_parallel_config,
+)
 from deconv_api_tpu.serving import codec
 from deconv_api_tpu.serving import durable
 from deconv_api_tpu.serving import faults as faults_mod
@@ -80,6 +85,22 @@ class DeconvService:
         self.cfg = cfg or ServerConfig.from_env()
         apply_platform(self.cfg)
         enable_compilation_cache(self.cfg)
+        # Parallel-layout validation (round 25): the mesh/lanes/pod
+        # mutual exclusion and every pod incompatibility die HERE with a
+        # config-shaped message.  For a pod process the jax distributed
+        # runtime must come up before the FIRST backend touch (device
+        # discovery below must see the global device list), so this runs
+        # ahead of everything that imports jax.
+        validate_parallel_config(self.cfg)
+        if self.cfg.pod_hosts >= 2:
+            from deconv_api_tpu.parallel.pod import init_pod_runtime
+
+            self._pod_info = init_pod_runtime(
+                self.cfg.pod_coordinator,
+                self.cfg.pod_hosts,
+                self.cfg.pod_process_id,
+                init_timeout_s=int(self.cfg.pod_join_timeout_s),
+            )
         # Fail a mistyped packing policy at BOOT, not at the first
         # dispatch (resolve_kpack_chan owns the off|auto|forced|<chan>
         # vocabulary; the same call validates per-visualizer later).
@@ -204,6 +225,66 @@ class DeconvService:
         # round 24: every declared durable surface's families present
         # at zero from the first scrape, configured store or not
         durable.register_metrics(self.metrics)
+        # Pod tier (round 25, parallel/pod.py): one global (batch x
+        # model) mesh over every cooperating process's devices; the
+        # coordinator (process 0) runs this full service and broadcasts
+        # each dispatch descriptor to followers over the TCP control
+        # channel so all processes launch the same sharded program in
+        # the same order (the multi-controller SPMD contract).
+        # ``self.mesh`` deliberately stays None: dreams and the
+        # _stage_batch lane path keep their LOCAL programs — only the
+        # bundle's batched visualizers (deconv + sweep) go pod-wide.
+        self.pod = None
+        self._pod_params = None
+        self._pod_follower_loop = None
+        self._loop = None
+        if self.cfg.pod_hosts >= 2:
+            import jax as _pjax
+
+            from deconv_api_tpu.parallel import pod as pod_mod
+            from deconv_api_tpu.parallel.mesh import make_pod_mesh
+
+            pod_mesh = make_pod_mesh(
+                self.cfg.pod_hosts,
+                _pjax.local_device_count(),
+                model_axis=self.cfg.pod_model_axis,
+            )
+            self.bundle.mesh = pod_mesh
+            control_port = self.cfg.pod_control_port or (
+                int(self.cfg.pod_coordinator.rsplit(":", 1)[1]) + 1
+            )
+            if self.cfg.pod_process_id == 0:
+                self.pod = pod_mod.PodCoordinator(
+                    hosts=self.cfg.pod_hosts,
+                    control_port=control_port,
+                    metrics=self.metrics,
+                    on_degrade=self._on_pod_degrade,
+                )
+                # blocks until every follower HELLOs (they dial in from
+                # run_pod_follower after building the same bundle) —
+                # boot fails loudly on a half pod
+                self.pod.start(timeout_s=self.cfg.pod_join_timeout_s)
+                self._pod_params = {
+                    model_name: pod_mod.replicate_tree(
+                        pod_mesh, self.bundle.params
+                    )
+                }
+                self.pod.attach_mesh(pod_mesh)
+            else:
+                coord_host = self.cfg.pod_coordinator.rsplit(":", 1)[0]
+                executor = pod_mod.make_follower_executor(
+                    self.bundle,
+                    self.cfg,
+                    pod_mesh,
+                    pod_mod.replicate_tree(pod_mesh, self.bundle.params),
+                )
+                self._pod_follower_loop = pod_mod.PodFollower(
+                    coord_host,
+                    control_port,
+                    self.cfg.pod_process_id,
+                    executor,
+                    connect_timeout_s=self.cfg.pod_join_timeout_s,
+                )
         if self.cfg.calibration_dir:
             # the one store READ here but written by tools/calibrate.py:
             # its boot .tmp sweep lives with the reader
@@ -225,7 +306,11 @@ class DeconvService:
         from deconv_api_tpu.serving.batcher import LanePool
 
         self.lane_count = resolve_lane_count(
-            self.cfg.serve_lanes, _jax.device_count(), self.mesh is not None
+            self.cfg.serve_lanes,
+            _jax.device_count(),
+            # the pod's global mesh owns every device exactly like a
+            # whole-pool mesh_shape does — lanes stay single-stream
+            self.mesh is not None or self.cfg.pod_hosts >= 2,
         )
         self._lane_dp = 1
         lane_places = None
@@ -1018,6 +1103,56 @@ class DeconvService:
         finally:
             self._profile_lock.release()
 
+    def _on_pod_degrade(self, reason: str) -> None:
+        """Follower loss (round 25): fall back to single-host serving
+        LOUDLY — runs on a pod reader/heartbeat thread, never raises.
+        The sharded program cache is dropped (its collectives would
+        wedge on the dead peer), the replicated param tree is released,
+        and the member re-registers with the fleet at capacity=1 so the
+        ring stops granting it a pod's keyspace."""
+        self.bundle.reset_mesh()
+        self._pod_params = None
+        loop = self._loop
+        if loop is not None and self.cfg.fleet_routers and not self.draining:
+            import asyncio as _asyncio
+
+            _asyncio.run_coroutine_threadsafe(
+                self.announce_to_routers("register"), loop
+            )
+
+    def run_pod_follower(self) -> str:
+        """A pod follower's whole serving life (the `pod-worker` CLI
+        role): connect to the coordinator's control channel and mirror
+        every dispatch until drain or coordinator loss.  Returns the
+        exit reason ("drain" | "lost" | "failed")."""
+        if self._pod_follower_loop is None:
+            raise RuntimeError(
+                "not a pod follower: pod_hosts < 2 or pod_process_id == 0"
+            )
+        return self._pod_follower_loop.run_forever()
+
+    def _pod_dispatch(
+        self, model, fn, batch: np.ndarray, fwd_dtype, desc: dict
+    ):
+        """One pod-wide dispatch: cast the padded batch on the host,
+        hand it (with the program descriptor) to the coordinator's
+        broadcast, and launch the sharded program over the replicated
+        params.  Raises PodDegraded when the pod is (or goes) down —
+        the caller retries on the local path."""
+        import jax.numpy as jnp
+
+        from deconv_api_tpu.parallel.pod import PodDegraded, _np_dtype
+
+        host = np.ascontiguousarray(
+            np.asarray(batch, dtype=_np_dtype(jnp.dtype(fwd_dtype).name))
+        )
+        gparams = (self._pod_params or {}).get(model)
+        if gparams is None:
+            # degrade raced this dispatch: the params were released
+            # between the caller's pod-active check and here
+            raise PodDegraded("pod params released (degraded)")
+        return self.pod.run(desc, host, lambda gx: fn(gparams, gx))
+
     def _run_batch(self, key, images: list[np.ndarray], lane: int = 0):
         """Execute one request group as a single device dispatch and block
         for its results.
@@ -1171,10 +1306,40 @@ class DeconvService:
         batch = None
         try:
             batch = self.input_ring.assemble(images, bucket)
-            out_all = fn(
-                params,
-                self._stage_batch(bundle, batch, fwd_dtype, lane),
-            )
+            if self.pod is not None and self.pod.active:
+                from deconv_api_tpu.parallel.pod import PodDegraded
+
+                desc = {
+                    "kind": "deconv", "model": model, "layer": layer_name,
+                    "mode": mode, "k": top_k, "post": post,
+                    "sweep": bool(sweep), "quant": quant,
+                }
+                try:
+                    out_all = self._pod_dispatch(
+                        model, fn, batch, fwd_dtype, desc
+                    )
+                except PodDegraded:
+                    # the pod died under this batch: the degrade hook
+                    # already dropped the sharded program cache, so a
+                    # fresh resolution compiles the LOCAL program and
+                    # the request never sees the follower's failure
+                    self.metrics.inc_counter("pod_fallback_dispatches_total")
+                    fn = bundle.batched_visualizer(
+                        layer_name, mode, top_k, self.cfg.bug_compat,
+                        self.cfg.backward_dtype or None, post, sweep,
+                        donate=False, lane=lane,
+                        lowc_kpack=self.cfg.lowc_kpack, quant=quant,
+                        fused_unpool=self.cfg.fused_unpool,
+                    )
+                    out_all = fn(
+                        params,
+                        self._stage_batch(bundle, batch, fwd_dtype, lane),
+                    )
+            else:
+                out_all = fn(
+                    params,
+                    self._stage_batch(bundle, batch, fwd_dtype, lane),
+                )
         except BaseException:
             self.weights.release(model, lane)
             if batch is not None:
@@ -1445,7 +1610,12 @@ class DeconvService:
         dispatch shards evenly — one rule for deconv and dream paths.
         The axis is the whole-pool mesh's, or (round 10) a mesh-slice
         lane's; lanes are equal-sized, so one rule covers every lane."""
-        if self.mesh is not None:
+        if self.pod is not None and self.pod.active:
+            # the pod mesh's leading axis is the batch axis; after a
+            # degrade the local programs take any size again
+            mesh = self.pod.mesh
+            dp = mesh.shape[mesh.axis_names[0]]
+        elif self.mesh is not None:
             dp = self.mesh.shape["dp"]
         elif self._lane_dp > 1:
             dp = self._lane_dp
@@ -2321,6 +2491,25 @@ class DeconvService:
                 m for m, (_q, tag) in calib.items() if tag != "dynamic"
             ),
         }
+        if self.cfg.pod_hosts >= 2:
+            # pod health on the probe (round 20): hosts expected vs
+            # connected and the global mesh shape — an operator (or the
+            # fleet drill) sees a degraded pod here without scraping
+            # metrics.  Ready stays TRUE through degrade: the member
+            # still serves on the single-host fallback path.
+            pod_body = {
+                "role": "coordinator" if self.pod is not None else "follower",
+                "hosts_expected": self.cfg.pod_hosts,
+            }
+            if self.pod is not None:
+                pod_body["hosts_connected"] = self.pod.hosts_connected()
+                pod_body["degraded"] = self.pod.degraded
+                if self.pod.degraded and self.pod.degrade_reason:
+                    pod_body["degrade_reason"] = self.pod.degrade_reason
+                if self.pod.mesh is not None and not self.pod.degraded:
+                    pod_body["mesh_shape"] = dict(self.pod.mesh.shape)
+                pod_body["dispatches"] = self.pod.dispatches
+            body["pod"] = pod_body
         if self.aot is not None:
             # artifact-store state on the probe (round 18): an
             # autoscaler's warm-boot gate reads "did this boot hit the
@@ -2747,6 +2936,21 @@ class DeconvService:
         if self.faults is not None:
             cfg["faults_state"] = self.faults.snapshot()
         cfg["draining"] = self.draining
+        if self.cfg.pod_hosts >= 2:
+            # pod tier (round 20): role + live membership so fleet drills
+            # and operators read pod state off the same config snapshot
+            cfg["pod"] = {
+                "role": "coordinator" if self.pod is not None else "follower",
+                "hosts_expected": self.cfg.pod_hosts,
+                "process_id": self.cfg.pod_process_id,
+                "coordinator": self.cfg.pod_coordinator,
+                "model_axis": self.cfg.pod_model_axis,
+            }
+            if self.pod is not None:
+                cfg["pod"]["hosts_connected"] = self.pod.hosts_connected()
+                cfg["pod"]["degraded"] = self.pod.degraded
+                cfg["pod"]["dispatches"] = self.pod.dispatches
+                cfg["pod"]["capacity"] = self.fleet_capacity()
         cfg["codec_workers_live"] = self.codec_pool.live_workers
         if self.cache is not None:
             cfg["cache_resident_bytes"] = self.cache.resident_bytes
@@ -3620,6 +3824,16 @@ class DeconvService:
         port = self.bound[1] if self.bound else self.cfg.port
         return f"{socket.gethostname()}:{port}"
 
+    def fleet_capacity(self) -> int:
+        """The capacity this member advertises on register: the explicit
+        cfg.fleet_capacity when set, else the pod's live host count (a
+        degraded pod is one host again), else 1."""
+        if self.cfg.fleet_capacity > 0:
+            return self.cfg.fleet_capacity
+        if self.pod is not None and self.pod.active:
+            return self.pod.hosts
+        return 1
+
     async def announce_to_routers(self, action: str) -> int:
         """Backend self-registration (round 16): POST
         /v1/internal/register (authenticated by the shared fleet token)
@@ -3638,9 +3852,15 @@ class DeconvService:
         from deconv_api_tpu.utils import slog as _slog
 
         adv = self._advertise_name()
-        body = urllib.parse.urlencode(
-            {"backend": adv, "action": action}
-        ).encode()
+        fields = {"backend": adv, "action": action}
+        if action == "register":
+            # capacity-weighted placement (round 25): a pod coordinator
+            # advertises the whole pod's host count so the ring grants
+            # it proportional keyspace; after a degrade the re-register
+            # carries 1 and the ring shrinks it back.  Explicit
+            # fleet_capacity overrides (heterogeneous single hosts).
+            fields["capacity"] = str(self.fleet_capacity())
+        body = urllib.parse.urlencode(fields).encode()
         headers = {
             "content-type": "application/x-www-form-urlencoded",
             "x-fleet-token": self.cfg.fleet_token,
@@ -3700,6 +3920,9 @@ class DeconvService:
                 self.cfg.l2_dir, self.cfg.l2_bytes, metrics=self.metrics
             )
         self._drain_announced = False
+        # the pod degrade hook re-announces capacity from its own thread
+        # via run_coroutine_threadsafe — it needs the serving loop
+        self._loop = asyncio.get_running_loop()
         await self.dispatcher.start()
         await self.dream_dispatcher.start()
         await self.sweep_dispatcher.start()
@@ -3742,6 +3965,11 @@ class DeconvService:
         # faster, authoritative signal than their next probe tick, so
         # they stop routing here before the listener starts dying
         await self.announce_to_routers("drain")
+        if self.pod is not None:
+            # draining the pod member drains the whole pod: followers get
+            # SHUTDOWN and exit "drain" before the coordinator's own
+            # dispatchers stop, so no follower blocks on a dead socket
+            self.pod.shutdown()
         if self._tsdb_task is not None:
             self._tsdb_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):

@@ -256,6 +256,12 @@ _log = slog.get_logger("deconv.fleet")
 # (serving/app.py), so a hint can never smuggle a URL or a header
 BACKEND_RE = re.compile(r"^[A-Za-z0-9_.\-]+:\d{1,5}$")
 
+# Ceiling on a member's advertised capacity (round 25): vnode count per
+# member is vnodes * capacity, so an unbounded registration could bloat
+# the ring to millions of points.  1024 hosts behind one coordinator is
+# past any real pod; anything larger is a typo or an attack.
+MAX_MEMBER_CAPACITY = 1024
+
 # Hop-by-hop / recomputed headers never forwarded in either direction.
 _HOP_HEADERS = frozenset(
     ("connection", "content-length", "transfer-encoding", "keep-alive",
@@ -559,14 +565,27 @@ class HashRing:
     out.  Rebuilt (cheap — N*vnodes points) on membership change; the
     router keeps the previous instance for rebalance accounting and
     peer-fill hints.  Placement depends only on (member name, vnode
-    index, key), so two routers over the same member set agree."""
+    index, key), so two routers over the same member set agree.
 
-    def __init__(self, members=(), vnodes: int = 64):
+    ``capacities`` (round 25, pod-scale members) weights placement: a
+    member advertising capacity C gets C × vnodes virtual nodes, so a
+    pod coordinator fronting N hosts owns ~N× the keyspace of a
+    single-host peer.  Weighting multiplies the COUNT of a member's
+    vnodes — vnode i's ring position is still ``blake2b(name#i)``, so a
+    member's first ``vnodes`` points are IDENTICAL at any capacity and
+    capacity changes only add/remove the tail points (minimal keyspace
+    movement, same property as member join/leave)."""
+
+    def __init__(self, members=(), vnodes: int = 64, capacities=None):
         self.vnodes = max(1, int(vnodes))
         self.members: tuple[str, ...] = tuple(sorted(set(members)))
+        caps = capacities or {}
+        self.capacities: dict[str, int] = {
+            m: max(1, int(caps.get(m, 1))) for m in self.members
+        }
         points: list[tuple[int, str]] = []
         for m in self.members:
-            for i in range(self.vnodes):
+            for i in range(self.vnodes * self.capacities[m]):
                 points.append((_ring_point(f"{m}#{i}".encode()), m))
         points.sort()
         self._points = points
@@ -661,6 +680,10 @@ class BackendMember:
         self.fwd_latency = LatencyDigest(latency_window_s, clock=clock)
         self.probe_latency = LatencyDigest(latency_window_s, clock=clock)
         self.slow_since = 0.0
+        # round 25 capacity weighting: how many hosts' worth of devices
+        # this member fronts (a pod coordinator registers capacity=N).
+        # The ring grants vnodes proportionally; 1 = the classic member.
+        self.capacity = 1
 
     @property
     def in_ring(self) -> bool:
@@ -1693,6 +1716,9 @@ class FleetRouter:
         self.metrics.set_labeled_gauge(
             "backend_state", "backend", m.name, _STATE_GAUGE[m.state]
         )
+        self.metrics.set_labeled_gauge(
+            "member_capacity", "backend", m.name, m.capacity
+        )
         self.metrics.set_gauge(
             "backends_in_ring",
             sum(1 for b in self.members.values() if b.in_ring),
@@ -1762,7 +1788,14 @@ class FleetRouter:
         admission stays probe-gated) and clears an announced drain on a
         known one; drain marks the member gone NOW.  Either action
         persists the shared membership file so peer routers converge on
-        their next watch tick."""
+        their next watch tick.
+
+        ``capacity=N`` (round 25, optional, default 1) weights ring
+        placement: a pod coordinator fronting N hosts registers the
+        whole pod's capacity and the ring grants it N x vnodes.  A
+        re-registration with a DIFFERENT capacity (a pod degrading to
+        capacity=1 after follower loss) rebuilds the ring immediately —
+        the registration is authoritative, same rule as clear_drain."""
         token = req.headers.get("x-fleet-token", "")
         if not self.fleet_token or not hmac.compare_digest(
             token, self.fleet_token
@@ -1798,6 +1831,25 @@ class FleetRouter:
                 },
                 400,
             )
+        raw_cap = (form.get("capacity") or "").strip()
+        capacity = None
+        if raw_cap:
+            try:
+                capacity = int(raw_cap)
+            except ValueError:
+                capacity = -1
+            if not 1 <= capacity <= MAX_MEMBER_CAPACITY:
+                return Response.json(
+                    {
+                        "error": "bad_request",
+                        "message": (
+                            "capacity must be an integer in "
+                            f"[1, {MAX_MEMBER_CAPACITY}]"
+                        ),
+                        "request_id": req.id,
+                    },
+                    400,
+                )
         m = self.members.get(name)
         cleared = None
         if action == "register":
@@ -1806,6 +1858,15 @@ class FleetRouter:
                 m = self._add_member(name, source="announce")
             else:
                 self._clear_announced_drain(m, "re_registered")
+            if capacity is not None and capacity != m.capacity:
+                was = m.capacity
+                m.capacity = capacity
+                slog.event(
+                    _log, "member_capacity", level=logging.WARNING,
+                    backend=name, capacity=capacity, was=was,
+                )
+                self._publish_state(m)
+                self._rebuild_ring("capacity_changed")
             cleared = name  # a register is the one signal that may
             # DOWNGRADE a persisted draining flag to false
         else:
@@ -1911,6 +1972,21 @@ class FleetRouter:
                 self._mark_announced_drain(m, "membership_file")
             else:
                 self._clear_announced_drain(m, "membership_file")
+            # capacity relays like the drain flag: the router that took
+            # the registration wrote it; peers converge here
+            cap = info.get("capacity", 1) if isinstance(info, dict) else 1
+            if (
+                isinstance(cap, int)
+                and 1 <= cap <= MAX_MEMBER_CAPACITY
+                and cap != m.capacity
+            ):
+                m.capacity = cap
+                slog.event(
+                    _log, "member_capacity", level=logging.WARNING,
+                    backend=name, capacity=cap, was=None, source="file",
+                )
+                self._publish_state(m)
+                self._rebuild_ring("capacity_file")
 
     def _persist_membership(self, clear_drain: str | None = None) -> bool:
         """Write the shared membership view through
@@ -1966,22 +2042,38 @@ class FleetRouter:
                 if isinstance(current, dict):
                     for name, info in current.items():
                         if isinstance(name, str) and BACKEND_RE.match(name):
+                            cap = (
+                                info.get("capacity", 1)
+                                if isinstance(info, dict)
+                                else 1
+                            )
+                            if not (
+                                isinstance(cap, int)
+                                and 1 <= cap <= MAX_MEMBER_CAPACITY
+                            ):
+                                cap = 1
                             merged[name] = {
                                 "draining": bool(
                                     isinstance(info, dict)
                                     and info.get("draining")
-                                )
+                                ),
+                                "capacity": cap,
                             }
             except (OSError, ValueError):
                 pass
             for m in self.members.values():
                 flag = merged.get(m.name, {}).get("draining", False)
-                merged[m.name] = {"draining": flag or m.announced_drain}
+                # our member view is authoritative for capacity — it came
+                # from a direct registration or an earlier file relay
+                merged[m.name] = {
+                    "draining": flag or m.announced_drain,
+                    "capacity": m.capacity,
+                }
             for name in self._foreign_drains:
                 if name in merged:
-                    merged[name] = {"draining": True}
+                    merged[name]["draining"] = True
             if clear_drain is not None and clear_drain in merged:
-                merged[clear_drain] = {"draining": False}
+                merged[clear_drain]["draining"] = False
             # JSON-document artifact: {format, version} ride in-document
             data = json.dumps(
                 {
@@ -2041,7 +2133,11 @@ class FleetRouter:
 
     def _rebuild_ring(self, reason: str) -> None:
         live = [n for n, m in self.members.items() if m.in_ring]
-        if tuple(sorted(live)) == self.ring.members:
+        caps = {n: self.members[n].capacity for n in live}
+        if (
+            tuple(sorted(live)) == self.ring.members
+            and caps == self.ring.capacities
+        ):
             return
         # keep the old topology around: rebalance accounting and the
         # peer-fill hints both ask "who owned this key BEFORE the move".
@@ -2057,10 +2153,11 @@ class FleetRouter:
             self._prev_ring = self.ring
             self._prev_ring_at = self._clock()
         self._moved_seen.clear()
-        self.ring = HashRing(live, self.vnodes)
+        self.ring = HashRing(live, self.vnodes, capacities=caps)
         slog.event(
             _log, "ring_rebalance", level=logging.WARNING,
             members=sorted(live), vnodes=self.vnodes, reason=reason,
+            capacities={n: c for n, c in sorted(caps.items()) if c != 1},
         )
 
     def _observe_latency(
@@ -4057,7 +4154,10 @@ class FleetRouter:
                     m.name: {
                         "state": m.state,
                         "in_ring": m.in_ring,
-                        "vnodes": self.vnodes if m.in_ring else 0,
+                        "capacity": m.capacity,
+                        "vnodes": (
+                            self.vnodes * m.capacity if m.in_ring else 0
+                        ),
                         "requests_total": m.requests_total,
                         "breaker": m.breaker.state_name,
                         "source": self._member_source.get(

@@ -128,6 +128,14 @@ class ModelBundle:
             names = [n for n in order if n in known]
         return tuple(reversed(names[: names.index(layer) + 1]))
 
+    def reset_mesh(self) -> None:
+        """Drop the mesh and EVERY compiled program built against it —
+        the pod degrade path (round 25): after follower loss the sharded
+        programs' collectives would wedge on a dead peer, so the next
+        dispatch must re-resolve a local program from a clean cache."""
+        self.mesh = None
+        self._vis_cache.clear()
+
     def check_layer(self, layer: str) -> None:
         """Single source of truth for layer-name validation — surfaced as
         UnknownLayer (422) by the route and as a clean stderr message by

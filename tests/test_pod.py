@@ -1,0 +1,664 @@
+"""Pod tier tests (round 25, parallel/pod.py).
+
+Three layers, cheapest first:
+
+- pure units: ``make_pod_mesh`` shape validation, the mesh/lanes/pod
+  mutual exclusion, pod-incompatible config knobs, control-channel
+  framing, descriptor resolution guards, advertised fleet capacity;
+- control-plane integration IN PROCESS (no jax, real sockets): follower
+  rendezvous, dispatch mirroring, heartbeat, loud degrade on follower
+  loss, coordinator drain propagating SHUTDOWN;
+- capacity-weighted ring membership: HashRing weighting + determinism,
+  the register route's capacity field, /v1/config + metric surfaces,
+  membership-file relay;
+- one slow 2-process spawn drill: real ``jax.distributed`` over gloo
+  with 2 fake devices per process — global-mesh construction, sharded
+  output parity against the single-process program, follower death
+  degrading the pod WITHOUT wedging, and a clean coordinator exit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deconv_api_tpu.config import ServerConfig, validate_parallel_config
+from deconv_api_tpu.parallel.mesh import validate_parallel_layout
+from deconv_api_tpu.parallel.pod import (
+    PodCoordinator,
+    PodDegraded,
+    PodError,
+    PodFollower,
+    PROTOCOL_VERSION,
+    _recv_msg,
+    _send_msg,
+    resolve_pod_program,
+)
+from deconv_api_tpu.serving.fleet import (
+    MAX_MEMBER_CAPACITY,
+    FleetRouter,
+    HashRing,
+)
+from deconv_api_tpu.serving.http import Request
+from tests.test_metrics_exposition import lint_exposition
+
+TOKEN = "pod-fleet-token-1"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------- mesh units
+
+
+def test_make_pod_mesh_shapes_and_axis_names():
+    from deconv_api_tpu.parallel import make_pod_mesh
+
+    # conftest forces 8 virtual CPU devices: 2 hosts x 4 devices
+    mesh = make_pod_mesh(2, 4)
+    assert mesh.axis_names == ("batch", "model")
+    assert mesh.shape["batch"] == 8 and mesh.shape["model"] == 1
+
+    mesh2 = make_pod_mesh(2, 4, model_axis=2)
+    assert mesh2.shape["batch"] == 4 and mesh2.shape["model"] == 2
+    # plain row-major reshape of the global device list: process-major
+    # order is preserved, so every process builds the identical mesh
+    import jax
+
+    assert list(mesh2.devices.flat) == list(jax.devices())
+
+
+def test_make_pod_mesh_rejects_bad_shapes():
+    from deconv_api_tpu.parallel import make_pod_mesh
+
+    with pytest.raises(ValueError, match="at least 1 host"):
+        make_pod_mesh(0, 4)
+    with pytest.raises(ValueError, match="at least 1 device"):
+        make_pod_mesh(2, 0)
+    with pytest.raises(ValueError, match="model axis"):
+        make_pod_mesh(2, 4, model_axis=0)
+    # non-divisible model axis: loud config error, never a truncation
+    with pytest.raises(ValueError, match="does not divide"):
+        make_pod_mesh(2, 4, model_axis=3)
+    # device-count mismatch vs hosts x local_devices
+    with pytest.raises(ValueError, match="global devices"):
+        make_pod_mesh(2, 16)
+
+
+def test_pod_mesh_batch_sharding_uses_leading_axis():
+    from deconv_api_tpu.parallel import batch_sharding, make_mesh, make_pod_mesh
+
+    pod = make_pod_mesh(2, 4, model_axis=2)
+    assert batch_sharding(pod).spec == ("batch",)
+    # the single-host serving layout still shards over dp
+    dp = make_mesh((8, 1))
+    assert batch_sharding(dp).spec == ("dp",)
+
+
+# ----------------------------------------------- layout mutual exclusion
+
+
+def test_validate_parallel_layout_exclusions():
+    # each pair dies loudly; every single layout is fine
+    validate_parallel_layout(None, "auto", 0)
+    validate_parallel_layout((8, 1), "auto", 0)
+    validate_parallel_layout(None, "4", 0)
+    validate_parallel_layout(None, "auto", 4)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        validate_parallel_layout((8, 1), "4", 0)
+    with pytest.raises(ValueError, match="mesh_shape"):
+        validate_parallel_layout((8, 1), "auto", 2)
+    with pytest.raises(ValueError, match="serve_lanes"):
+        validate_parallel_layout(None, "2", 2)
+
+
+def test_validate_parallel_config_pod_rules():
+    def cfg(**kw):
+        base = dict(pod_hosts=2, pod_coordinator="127.0.0.1:9911")
+        base.update(kw)
+        return ServerConfig.from_env(**base)
+
+    validate_parallel_config(cfg())  # a minimal pod config is legal
+    with pytest.raises(ValueError, match="pod_hosts=1 is not a pod"):
+        validate_parallel_config(cfg(pod_hosts=1, pod_coordinator=""))
+    with pytest.raises(ValueError, match="requires pod_coordinator"):
+        validate_parallel_config(cfg(pod_coordinator=""))
+    with pytest.raises(ValueError, match="out of range"):
+        validate_parallel_config(cfg(pod_process_id=2))
+    # per-host state that would break the multi-controller contract
+    for field, value in (
+        ("calibration_dir", "/tmp/calib"),
+        ("hbm_budget_bytes", 1 << 20),
+        ("aot_dir", "/tmp/aot"),
+        ("serve_models", "vgg16,resnet50"),
+    ):
+        with pytest.raises(ValueError, match=field):
+            validate_parallel_config(cfg(**{field: value}))
+    with pytest.raises(ValueError, match="weight_dtype"):
+        validate_parallel_config(cfg(weight_dtype="bf16"))
+    with pytest.raises(ValueError, match="fleet_capacity"):
+        validate_parallel_config(cfg(fleet_capacity=-1))
+
+
+def test_resolve_pod_program_rejects_non_string_quant():
+    # calibrated scale tuples are per-host state — the descriptor check
+    # fires before the bundle is ever touched
+    with pytest.raises(PodError, match="string quant"):
+        resolve_pod_program(None, None, {"quant": ("int8", (1.0, 2.0))})
+
+
+def test_fleet_capacity_advertisement():
+    from deconv_api_tpu.serving.app import DeconvService
+
+    class _Pod:
+        def __init__(self, active):
+            self.active = active
+            self.hosts = 4
+
+    class _Svc:
+        fleet_capacity = DeconvService.fleet_capacity
+
+    s = _Svc()
+    s.cfg = ServerConfig.from_env()
+    s.pod = None
+    assert s.fleet_capacity() == 1
+    s.cfg.pod_hosts = 4
+    s.pod = _Pod(active=True)
+    assert s.fleet_capacity() == 4
+    s.pod = _Pod(active=False)  # degraded pod is one host again
+    assert s.fleet_capacity() == 1
+    s.cfg.fleet_capacity = 7  # explicit override wins
+    s.pod = _Pod(active=True)
+    assert s.fleet_capacity() == 7
+
+
+# ------------------------------------------------------- control framing
+
+
+def test_control_frame_roundtrip_and_limits():
+    a, b = socket.socketpair()
+    try:
+        payload = bytes(range(256)) * 11
+        _send_msg(a, {"t": "DISPATCH", "seq": 3, "desc": {"layer": "b2c1"}},
+                  payload)
+        header, got = _recv_msg(b)
+        assert header == {"t": "DISPATCH", "seq": 3, "desc": {"layer": "b2c1"}}
+        assert got == payload
+        # empty payload frames (PING et al) round-trip too
+        _send_msg(b, {"t": "PONG"})
+        header, got = _recv_msg(a)
+        assert header == {"t": "PONG"} and got == b""
+        # an oversized header length dies as PodError, not a giant alloc
+        a.sendall(b"\x7f\xff\xff\xff\x00\x00\x00\x00")
+        with pytest.raises(PodError, match="frame too large"):
+            _recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------- control plane in process (no jax)
+
+
+def _local_mesh():
+    """A real single-host mesh for control-plane tests: ``run()`` stages
+    the batch as a genuinely sharded global array, while all the pod
+    sockets stay on localhost."""
+    from deconv_api_tpu.parallel import make_mesh
+
+    return make_mesh((8, 1))
+
+
+def _metrics():
+    from deconv_api_tpu.serving.metrics import Metrics
+
+    return Metrics()
+
+
+def _start_pod_pair(port, *, heartbeat_s=5.0, executor=None, metrics=None,
+                    on_degrade=None):
+    """A real coordinator + a real follower thread over localhost."""
+    coord = PodCoordinator(
+        hosts=2, control_port=port, bind_host="127.0.0.1",
+        heartbeat_s=heartbeat_s, metrics=metrics, on_degrade=on_degrade,
+    )
+    result: dict = {}
+    follower = PodFollower(
+        "127.0.0.1", port, 1,
+        executor or (lambda desc, batch: None), connect_timeout_s=10.0,
+    )
+
+    def run():
+        result["exit"] = follower.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    coord.start(timeout_s=10.0)
+    return coord, t, result
+
+
+def test_pod_rendezvous_dispatch_and_drain():
+    seen: list[tuple] = []
+
+    def executor(desc, batch):
+        seen.append((desc, batch.copy()))
+
+    metrics = _metrics()
+    coord, t, result = _start_pod_pair(
+        _free_port(), executor=executor, metrics=metrics
+    )
+    try:
+        coord.attach_mesh(_local_mesh())
+        assert coord.active and coord.hosts_connected() == 2
+        batch = np.arange(48, dtype=np.float32).reshape(8, 6)
+        out = coord.run({"layer": "b2c1", "k": 4}, batch, lambda gx: "ran")
+        assert out == "ran"
+        deadline = time.monotonic() + 5
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        desc, got = seen[0]
+        assert desc == {"layer": "b2c1", "k": 4}
+        np.testing.assert_array_equal(got, batch)
+        assert got.dtype == batch.dtype
+        assert coord.dispatches == 1
+        assert metrics.counter("pod_dispatches_total") == 1
+        # drain: every follower gets SHUTDOWN and exits the clean way
+        coord.shutdown()
+        t.join(timeout=5)
+        assert result["exit"] == "drain"
+        assert not coord.degraded
+    finally:
+        coord.close()
+
+
+def test_pod_heartbeat_keeps_link_alive():
+    coord, t, result = _start_pod_pair(_free_port(), heartbeat_s=0.05)
+    try:
+        coord.attach_mesh(_local_mesh())
+        time.sleep(0.5)  # ~10 PING/PONG exchanges
+        assert not coord.degraded and coord.hosts_connected() == 2
+        coord.shutdown()
+        t.join(timeout=5)
+        assert result["exit"] == "drain"
+    finally:
+        coord.close()
+
+
+def test_follower_loss_degrades_loudly_and_never_wedges():
+    degrade_reasons: list[str] = []
+    metrics = _metrics()
+    port = _free_port()
+    coord = PodCoordinator(
+        hosts=2, control_port=port, bind_host="127.0.0.1",
+        heartbeat_s=0.05, metrics=metrics,
+        on_degrade=degrade_reasons.append,
+    )
+    # a bare-socket follower we can kill abruptly
+    fake = socket.socket()
+
+    def join():
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                fake.connect(("127.0.0.1", port))
+                break
+            except OSError:
+                time.sleep(0.02)
+        _send_msg(fake, {"t": "HELLO", "v": PROTOCOL_VERSION, "process_id": 1})
+
+    t = threading.Thread(target=join, daemon=True)
+    t.start()
+    coord.start(timeout_s=10.0)
+    try:
+        coord.attach_mesh(_local_mesh())
+        assert coord.active
+        fake.close()  # the follower "crashes"
+        deadline = time.monotonic() + 5
+        while not coord.degraded and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert coord.degraded, "follower loss never detected"
+        # the on_degrade callback runs after the flag flips — poll it too
+        while not degrade_reasons and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert degrade_reasons and "follower 1" in degrade_reasons[0]
+        # a dispatch AFTER degrade raises immediately — no wedge, no
+        # blocking on the dead socket
+        t0 = time.monotonic()
+        with pytest.raises(PodDegraded):
+            coord.run({}, np.zeros((8, 2), np.float32), lambda gx: "never")
+        assert time.monotonic() - t0 < 1.0
+        # observability plane: gauges flipped, loss counted
+        assert coord.hosts_connected() == 1
+        assert metrics.counter("pod_follower_loss_total") == 1
+        text = metrics.prometheus()
+        _kinds, values = lint_exposition(text)
+        assert values[("deconv_pod_degraded", "")] == 1.0
+        assert values[("deconv_pod_hosts_connected", "")] == 1.0
+        assert values[("deconv_pod_mesh_devices", "")] == 0.0
+    finally:
+        coord.close()
+
+
+def test_follower_failed_dispatch_acks_and_degrades():
+    def executor(desc, batch):
+        raise RuntimeError("device on fire")
+
+    metrics = _metrics()
+    coord, t, result = _start_pod_pair(
+        _free_port(), executor=executor, metrics=metrics
+    )
+    try:
+        coord.attach_mesh(_local_mesh())
+        # the coordinator's own half of the dispatch still runs; the
+        # follower's failed DONE then degrades the pod asynchronously
+        coord.run({}, np.zeros((8, 2), np.float32), lambda gx: "local-ok")
+        deadline = time.monotonic() + 5
+        while not coord.degraded and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert coord.degraded
+        assert "device on fire" in (coord.degrade_reason or "")
+        t.join(timeout=5)
+        assert result["exit"] == "failed"
+        with pytest.raises(PodDegraded):
+            coord.run({}, np.zeros((8, 2), np.float32), lambda gx: "never")
+    finally:
+        coord.close()
+
+
+def test_pod_rendezvous_timeout_is_loud():
+    coord = PodCoordinator(
+        hosts=2, control_port=_free_port(), bind_host="127.0.0.1"
+    )
+    with pytest.raises(PodError, match="rendezvous timed out"):
+        coord.start(timeout_s=0.2)
+
+
+def test_pod_protocol_version_mismatch_rejected():
+    port = _free_port()
+    coord = PodCoordinator(hosts=2, control_port=port, bind_host="127.0.0.1")
+    err: list[Exception] = []
+
+    def boot():
+        try:
+            coord.start(timeout_s=10.0)
+        except Exception as e:  # noqa: BLE001 — asserted below
+            err.append(e)
+
+    t = threading.Thread(target=boot, daemon=True)
+    t.start()
+    fake = socket.socket()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            fake.connect(("127.0.0.1", port))
+            break
+        except OSError:
+            time.sleep(0.02)
+    _send_msg(fake, {"t": "HELLO", "v": PROTOCOL_VERSION + 1, "process_id": 1})
+    t.join(timeout=10)
+    fake.close()
+    assert err and isinstance(err[0], PodError)
+    assert "protocol" in str(err[0])
+
+
+# ---------------------------------------- capacity-weighted ring members
+
+
+def test_ring_capacity_weights_vnodes_and_keyspace():
+    members = ["h0:8000", "h1:8001", "h2:8002"]
+    ring = HashRing(members, 64, capacities={"h1:8001": 4})
+    assert len(ring) == 64 * (1 + 4 + 1)
+    counts = {m: 0 for m in members}
+    for i in range(6000):
+        counts[ring.owner(f"{i:040x}")] += 1
+    # capacity 4 ~= 4x the keyspace of a capacity-1 peer (hash variance
+    # allows slop; the pin is proportionality, not exact quarters)
+    share = counts["h1:8001"] / 6000
+    assert 0.5 < share < 0.82, counts
+
+
+def test_ring_capacity_prefix_stability_and_determinism():
+    m = "h0:8000"
+    base = HashRing([m], 8)
+    grown = HashRing([m], 8, capacities={m: 3})
+    # first `vnodes` points identical at any capacity: a capacity change
+    # only adds/removes tail points (minimal keyspace movement)
+    assert set(base._keys).issubset(set(grown._keys))
+    assert len(grown) == 24
+    again = HashRing([m], 8, capacities={m: 3})
+    assert grown._points == again._points
+    # absent/invalid capacities default to 1
+    assert HashRing([m], 8, capacities={}).capacities[m] == 1
+    assert HashRing([m], 8, capacities={m: 0}).capacities[m] == 1
+
+
+def _register_req(body: str, token: str = TOKEN) -> Request:
+    return Request(
+        method="POST", path="/v1/internal/register", query={},
+        headers={
+            "content-type": "application/x-www-form-urlencoded",
+            "x-fleet-token": token,
+        },
+        body=body.encode(), id="rid-pod-register",
+    )
+
+
+def test_register_capacity_weights_membership(monkeypatch):
+    router = FleetRouter(["b0:8000"], fleet_token=TOKEN)
+
+    async def go():
+        r = await router._register(_register_req(
+            "backend=127.0.0.1:9001&action=register&capacity=3"
+        ))
+        assert r.status == 200
+        m = router.members["127.0.0.1:9001"]
+        assert m.capacity == 3
+        # bad capacities are a 400, never a silent clamp
+        for bad in ("0", "-2", "x", str(MAX_MEMBER_CAPACITY + 1)):
+            r = await router._register(_register_req(
+                f"backend=127.0.0.1:9001&action=register&capacity={bad}"
+            ))
+            assert r.status == 400, bad
+        assert m.capacity == 3
+        # metric surface: the advertised capacity per backend
+        _kinds, values = lint_exposition(router.metrics.prometheus())
+        assert values[
+            ("router_member_capacity", 'backend="127.0.0.1:9001"')
+        ] == 3.0
+        # a re-registration with a different capacity (pod degrade to 1)
+        # takes effect immediately
+        r = await router._register(_register_req(
+            "backend=127.0.0.1:9001&action=register&capacity=1"
+        ))
+        assert r.status == 200 and m.capacity == 1
+        # capacity omitted keeps the current value (plain re-announce)
+        r = await router._register(_register_req(
+            "backend=127.0.0.1:9001&action=register"
+        ))
+        assert r.status == 200 and m.capacity == 1
+
+    asyncio.run(go())
+
+
+def test_capacity_in_ring_and_config_snapshot(monkeypatch):
+    router = FleetRouter(["b0:8000", "b1:8001"], fleet_token=TOKEN, vnodes=16)
+
+    async def go():
+        await router._register(_register_req(
+            "backend=b0:8000&action=register&capacity=4"
+        ))
+        # admit both members to the ring (probe-gated normally)
+        for m in router.members.values():
+            m.state = "healthy"
+        router._rebuild_ring("test")
+        assert router.ring.capacities["b0:8000"] == 4
+        assert len(router.ring) == 16 * 4 + 16
+        cfg = json.loads((await router._config(None)).body)
+        assert cfg["members"]["b0:8000"]["capacity"] == 4
+        assert cfg["members"]["b0:8000"]["vnodes"] == 64
+        assert cfg["members"]["b1:8001"]["capacity"] == 1
+        assert cfg["members"]["b1:8001"]["vnodes"] == 16
+
+    asyncio.run(go())
+
+
+def test_capacity_relays_through_membership_file(tmp_path):
+    mf = str(tmp_path / "members.json")
+    ra = FleetRouter([], membership_file=mf, fleet_token=TOKEN)
+    rb = FleetRouter([], membership_file=mf)
+
+    async def go():
+        r = await ra._register(_register_req(
+            "backend=127.0.0.1:9001&action=register&capacity=5"
+        ))
+        assert r.status == 200
+        rb._load_membership_file()
+        assert rb.members["127.0.0.1:9001"].capacity == 5
+        # degrade relays too: the pod re-registers at capacity 1 on A,
+        # B converges from the file
+        await ra._register(_register_req(
+            "backend=127.0.0.1:9001&action=register&capacity=1"
+        ))
+        rb._load_membership_file()
+        assert rb.members["127.0.0.1:9001"].capacity == 1
+        # a router booting later seeds capacity straight from the file
+        await ra._register(_register_req(
+            "backend=127.0.0.1:9001&action=register&capacity=5"
+        ))
+        rc = FleetRouter([], membership_file=mf)
+        assert rc.members["127.0.0.1:9001"].capacity == 5
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------ 2-process spawn drill
+
+
+@pytest.mark.slow  # two cold jax processes + gloo rendezvous + compiles
+def test_pod_two_process_parity_and_degrade():
+    """The tentpole drill: a real 2-process pod over gloo/CPU (2 fake
+    devices each).  Pins (a) identical global-mesh construction on both
+    processes, (b) the sharded pod program's outputs matching the
+    single-process program (indices byte-identical, projections to float
+    tolerance), (c) follower death flipping the pod to degraded within
+    seconds WITHOUT wedging dispatch, local compute surviving, and (d) a
+    CLEAN coordinator exit (the default runtime would abort)."""
+    import subprocess
+    import sys
+
+    jax_port, ctrl_port = _free_port(), _free_port()
+    common = """
+import os, sys, threading, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import numpy as np
+import jax, jax.numpy as jnp
+from deconv_api_tpu.parallel.pod import (
+    PodCoordinator, PodDegraded, PodFollower, init_pod_runtime,
+    global_batch, replicate_tree,
+)
+from deconv_api_tpu.parallel.mesh import make_pod_mesh
+from deconv_api_tpu.parallel.batch import shard_batched_fn
+from deconv_api_tpu.engine import get_visualizer
+from deconv_api_tpu.models.spec import init_params
+from tests.test_engine_parity import TINY
+
+JAX_PORT = %d
+CTRL_PORT = %d
+info = init_pod_runtime("127.0.0.1:%%d" %% JAX_PORT, 2, PID)
+assert info["process_count"] == 2, info
+assert info["global_devices"] == 4, info
+mesh = make_pod_mesh(2, 2)
+assert dict(mesh.shape) == {"batch": 4, "model": 1}
+params = init_params(TINY, jax.random.PRNGKey(1))
+batch = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (4, 16, 16, 3)))
+raw = get_visualizer(TINY, "b2c1", 4, "all", True, batched=True)
+sharded = shard_batched_fn(raw, mesh)
+gparams = replicate_tree(mesh, params)
+""" % (jax_port, ctrl_port)
+
+    code0 = "PID = 0\n" + common + """
+coord = PodCoordinator(hosts=2, control_port=CTRL_PORT,
+                       bind_host="127.0.0.1", heartbeat_s=0.1)
+coord.start(timeout_s=60.0)
+coord.attach_mesh(mesh)
+def runner(gx):
+    out = sharded(gparams, gx)["b2c1"]
+    return {k: np.asarray(v) for k, v in out.items()}
+got = coord.run({"n": 1}, batch, runner)
+# single-process reference on one local device
+want = jax.jit(raw)(params, batch)["b2c1"]
+np.testing.assert_array_equal(got["indices"], np.asarray(want["indices"]))
+np.testing.assert_allclose(got["images"], np.asarray(want["images"]),
+                           rtol=1e-4, atol=1e-5)
+print("POD-PARITY-OK", flush=True)
+# the follower self-destructs after its 2nd dispatch ack; detect the
+# loss via the control channel, degrade, and keep serving locally
+coord.run({"n": 2}, batch, runner)
+deadline = time.monotonic() + 30
+while not coord.degraded and time.monotonic() < deadline:
+    time.sleep(0.05)
+assert coord.degraded, "follower death never detected"
+t0 = time.monotonic()
+try:
+    coord.run({"n": 3}, batch, runner)
+    raise SystemExit("dispatch after degrade did not raise")
+except PodDegraded:
+    pass
+assert time.monotonic() - t0 < 1.0, "degraded dispatch blocked"
+# local compute survives the dead peer
+local = jax.jit(raw)(params, batch)["b2c1"]
+np.testing.assert_array_equal(np.asarray(local["indices"]),
+                              np.asarray(want["indices"]))
+coord.close()
+print("POD-DEGRADE-OK", flush=True)
+"""
+
+    code1 = "PID = 1\n" + common + """
+count = {"n": 0}
+def executor(desc, b):
+    out = sharded(gparams, global_batch(mesh, b))
+    jax.block_until_ready(out)
+    count["n"] += 1
+    if count["n"] == 2:
+        # ack goes out first (run_forever sends DONE after executor
+        # returns); then die abruptly, like a SIGKILLed host
+        threading.Thread(
+            target=lambda: (time.sleep(0.3), os._exit(7)), daemon=True
+        ).start()
+follower = PodFollower("127.0.0.1", CTRL_PORT, 1, executor,
+                       connect_timeout_s=60.0)
+follower.run_forever()
+"""
+    cwd = str(Path(__file__).resolve().parent.parent)
+    p1 = subprocess.Popen(
+        [sys.executable, "-c", code1], cwd=cwd,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    p0 = subprocess.run(
+        [sys.executable, "-c", code0], cwd=cwd,
+        capture_output=True, timeout=300,
+    )
+    p1.wait(timeout=30)
+    assert b"POD-PARITY-OK" in p0.stdout, (
+        p0.stdout.decode()[-500:] + p0.stderr.decode()[-1500:]
+    )
+    assert b"POD-DEGRADE-OK" in p0.stdout, (
+        p0.stdout.decode()[-500:] + p0.stderr.decode()[-1500:]
+    )
+    # the clean-exit guarantee: a degraded coordinator exits 0 (the
+    # default runtime aborts in the shutdown barrier)
+    assert p0.returncode == 0, p0.stderr.decode()[-1500:]
+    assert p1.returncode == 7  # the scripted abrupt death

@@ -526,7 +526,10 @@ def test_admission_fail_open_e2e():
     cfg = ServerConfig(
         image_size=16, max_batch=4, batch_window_ms=1.0,
         compilation_cache_dir="", qos=True,
-        tenants='{"blocked": {"class": "bulk", "rate_ms": 0.001,'
+        # rate far below burst: the first request's compile can take
+        # over a second of wall, and at rate_ms == burst_ms that is a
+        # FULL bucket refill — the second request would admit again
+        tenants='{"blocked": {"class": "bulk", "rate_ms": 1e-9,'
         ' "burst_ms": 0.001}}',
         fault_injection=True,
         cache_bytes=0,

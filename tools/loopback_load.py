@@ -5683,6 +5683,346 @@ def run_crash_torture_drill(
     return asyncio.run(drive())
 
 
+def run_pod_drill(
+    n_requests: int = 24,
+    overhead_budget_pct: float | None = None,
+    timeout_s: float = 600.0,
+) -> dict:
+    """The round-25 pod drill: single-host vs 2-process-pod A/B on an
+    oversized batch class, through the fleet router.
+
+    Phase A boots ONE real backend subprocess with 4 virtual CPU
+    devices and a local ``mesh_shape=(4,)`` — the single-process
+    reference program.  Phase B boots a 2-process pod (coordinator
+    `serving.app` + `cli pod-worker` follower, 2 virtual devices each,
+    gloo collectives) spanning the SAME 4-device (4, 1) mesh, joined to
+    the router as ONE member advertising capacity=2.  Both phases
+    replay an identical request set whose program batch (top_k=8
+    feature maps) exceeds any single pod host's 2 local shards — the
+    batch only exists pod-wide.  The drill pins:
+
+    - BYTE PARITY: every pod response identical to the single-process
+      reference (one sharded XLA program, not an approximation);
+    - dispatch overhead: pod p50 vs single p50 within the
+      ``POD_OVERHEAD_BUDGET_PCT`` budget (the cost of the control-plane
+      broadcast + gloo collectives on the hot path);
+    - capacity-weighted placement: the router's /v1/config view shows
+      capacity=2 while the pod is whole, re-registered to 1 on degrade;
+    - follower loss degrades LOUDLY, never wedges: SIGKILL the
+      follower, the very next request must still answer 200 (local
+      single-host fallback), /readyz flips pod.degraded, and the
+      coordinator still exits 0 on SIGTERM."""
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.parse
+
+    import numpy as np
+    from PIL import Image
+
+    from deconv_api_tpu.serving.fleet import FleetRouter
+
+    if overhead_budget_pct is None:
+        overhead_budget_pct = float(
+            os.environ.get("POD_OVERHEAD_BUDGET_PCT", "300")
+        )
+    token = "pod-drill-token"
+    tmp = tempfile.mkdtemp(prefix="pod_drill_")
+
+    # the request set: unique seeded 32px images, each asking for a
+    # top_k=8 sweep — program batch 8, sharded 2-per-device over the
+    # (4, 1) mesh, so in phase B no single host ever holds the batch
+    bodies: list[bytes] = []
+    for idx in range(n_requests):
+        img = Image.fromarray(
+            np.random.default_rng(1000 + idx).integers(
+                0, 255, (32, 32, 3), np.uint8
+            ),
+            "RGB",
+        )
+        buf = io.BytesIO()
+        img.save(buf, "JPEG")
+        uri = (
+            "data:image/jpeg;base64,"
+            + base64.b64encode(buf.getvalue()).decode()
+        )
+        bodies.append(
+            urllib.parse.urlencode(
+                {"file": uri, "layer": "block2_conv1", "top_k": "8"}
+            ).encode()
+        )
+
+    def backend_env(
+        rport: int, devices: int, http_port: int, extra: dict
+    ) -> dict:
+        env = dict(os.environ)
+        env.update({
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (
+                f"--xla_force_host_platform_device_count={devices}"
+            ),
+            "DECONV_PLATFORM": "cpu",
+            "DECONV_MODEL": "vgg_tiny",
+            "DECONV_WARMUP_ALL_BUCKETS": "0",
+            "DECONV_CACHE_BYTES": "0",
+            "DECONV_FLEET_TOKEN": token,
+            "DECONV_FLEET_ROUTERS": f"127.0.0.1:{rport}",
+            # the default advertise name is the hostname; the drill
+            # keys ring lookups by the loopback address it dials
+            "DECONV_FLEET_ADVERTISE": f"127.0.0.1:{http_port}",
+        })
+        env.update(extra)
+        return env
+
+    def spawn(argv: list[str], env: dict) -> subprocess.Popen:
+        return subprocess.Popen(
+            argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env,
+        )
+
+    def serve_argv(port: int) -> list[str]:
+        return [
+            sys.executable, "-m", "deconv_api_tpu.serving.app",
+            "--host", "127.0.0.1", "--port", str(port),
+        ]
+
+    async def drive() -> dict:
+        deadline = time.monotonic() + timeout_s
+        router = FleetRouter(
+            [],
+            membership_file=os.path.join(tmp, "members.json"),
+            fleet_token=token,
+            probe_interval_s=0.2,
+            probe_timeout_s=1.0,
+            eject_threshold=2,
+            cooldown_s=1.0,
+            forward_timeout_s=120.0,
+        )
+        rport = await router.start("127.0.0.1", 0)
+        procs: list[subprocess.Popen] = []
+
+        async def wait_http_ready(proc, port, budget_s: float) -> None:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < budget_s:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"backend died during boot (rc={proc.returncode})"
+                    )
+                try:
+                    status, _ = await _http(port, "GET", "/readyz")
+                except OSError:
+                    status = 0
+                if status == 200:
+                    return
+                await asyncio.sleep(0.1)
+            raise RuntimeError("backend never became ready")
+
+        async def wait_capacity(name, cap, budget_s: float = 30.0) -> bool:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < budget_s:
+                m = router.members.get(name)
+                if m is not None and m.in_ring and m.capacity == cap:
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        async def wait_out_of_ring(name, budget_s: float = 30.0) -> bool:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < budget_s:
+                m = router.members.get(name)
+                if m is None or not m.in_ring:
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        async def post_router(
+            body: bytes, per_req_timeout_s: float = 120.0
+        ) -> tuple[int | None, bytes]:
+            async def go():
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", rport
+                )
+                head = (
+                    "POST / HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+                    "Content-Type: application/x-www-form-urlencoded\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n"
+                )
+                writer.write(head.encode() + body)
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                return raw
+            raw = await asyncio.wait_for(go(), per_req_timeout_s)
+            status, _ = _resp_status_code(raw)
+            return status, raw.split(b"\r\n\r\n", 1)[1]
+
+        async def measure(tag: str) -> tuple[list[bytes], list[float]]:
+            # compile the batch-8 bucket off the clock
+            for _ in range(2):
+                s, _ = await post_router(bodies[0])
+                assert s == 200, f"{tag} warmup answered {s}"
+            outs, lats = [], []
+            for b in bodies:
+                t0 = time.perf_counter()
+                s, payload = await post_router(b)
+                lats.append((time.perf_counter() - t0) * 1e3)
+                assert s == 200, f"{tag} request answered {s}"
+                outs.append(payload)
+            return outs, lats
+
+        def p50(xs: list[float]) -> float:
+            return sorted(xs)[len(xs) // 2]
+
+        try:
+            # ---- phase A: the single-process reference program
+            port_a = _free_port()
+            proc_a = spawn(
+                serve_argv(port_a),
+                backend_env(
+                    rport, 4, port_a, {"DECONV_MESH_SHAPE": "4"}
+                ),
+            )
+            procs.append(proc_a)
+            await wait_http_ready(proc_a, port_a, 300.0)
+            name_a = f"127.0.0.1:{port_a}"
+            assert await wait_capacity(name_a, 1), (
+                "single-host member never admitted at capacity 1"
+            )
+            outs_a, lats_a = await measure("single")
+            proc_a.send_signal(signal.SIGTERM)
+            rc_a = await asyncio.to_thread(proc_a.wait, 60)
+            assert await wait_out_of_ring(name_a), (
+                "drained single-host member still in ring"
+            )
+
+            # ---- phase B: the 2-process pod, ONE ring member
+            port_b = _free_port()
+            dist_port = _free_port()
+            ctrl_port = _free_port()
+            pod_env = {
+                "DECONV_POD_HOSTS": "2",
+                "DECONV_POD_COORDINATOR": f"127.0.0.1:{dist_port}",
+                "DECONV_POD_CONTROL_PORT": str(ctrl_port),
+            }
+            coord = spawn(
+                serve_argv(port_b),
+                backend_env(
+                    rport, 2, port_b,
+                    dict(pod_env, DECONV_POD_PROCESS_ID="0"),
+                ),
+            )
+            procs.append(coord)
+            follower = spawn(
+                [sys.executable, "-m", "deconv_api_tpu.cli", "pod-worker"],
+                backend_env(
+                    rport, 2, port_b,
+                    dict(pod_env, DECONV_POD_PROCESS_ID="1"),
+                ),
+            )
+            procs.append(follower)
+            await wait_http_ready(coord, port_b, 300.0)
+            name_b = f"127.0.0.1:{port_b}"
+            capacity_whole = await wait_capacity(name_b, 2)
+            _, ready_doc = await _http(port_b, "GET", "/readyz")
+            pod_view = (ready_doc or {}).get("pod", {})
+
+            outs_b, lats_b = await measure("pod")
+            mismatches = sum(
+                1 for a, b in zip(outs_a, outs_b) if a != b
+            )
+
+            # ---- follower loss: loud, never a wedge
+            t_kill = time.monotonic()
+            follower.send_signal(signal.SIGKILL)
+            t0 = time.perf_counter()
+            post_kill_status, post_kill_body = await post_router(
+                bodies[0], per_req_timeout_s=60.0
+            )
+            post_kill_ms = (time.perf_counter() - t0) * 1e3
+            degrade_detect_s = None
+            while time.monotonic() - t_kill < 15.0:
+                _, doc = await _http(port_b, "GET", "/readyz")
+                if (doc or {}).get("pod", {}).get("degraded"):
+                    degrade_detect_s = time.monotonic() - t_kill
+                    break
+                await asyncio.sleep(0.1)
+            capacity_degraded = await wait_capacity(name_b, 1, 20.0)
+
+            # ---- the clean-exit guarantee survives the degrade
+            coord.send_signal(signal.SIGTERM)
+            rc_b = await asyncio.to_thread(coord.wait, 60)
+
+            overhead_pct = (
+                (p50(lats_b) - p50(lats_a)) / p50(lats_a) * 100.0
+            )
+            row = {
+                "drill": "pod",
+                "requests": n_requests,
+                "batch_class": 8,
+                "hosts": 2,
+                "pod_devices": 4,
+                "pod_ready": pod_view,
+                "parity_mismatches": mismatches,
+                "p50_single_ms": round(p50(lats_a), 2),
+                "p50_pod_ms": round(p50(lats_b), 2),
+                "scaling_factor": round(p50(lats_a) / p50(lats_b), 3),
+                "overhead_pct": round(overhead_pct, 1),
+                "overhead_budget_pct": overhead_budget_pct,
+                "capacity_whole": capacity_whole,
+                "post_kill_status": post_kill_status,
+                "post_kill_ms": round(post_kill_ms, 1),
+                "post_kill_parity": post_kill_body == outs_a[0],
+                "degrade_detect_s": (
+                    round(degrade_detect_s, 2)
+                    if degrade_detect_s is not None else None
+                ),
+                "capacity_degraded": capacity_degraded,
+                "single_exit": rc_a,
+                "coordinator_exit": rc_b,
+            }
+            errs = []
+            if mismatches:
+                errs.append(
+                    f"{mismatches}/{n_requests} pod responses differ "
+                    "from the single-process reference"
+                )
+            if overhead_pct > overhead_budget_pct:
+                errs.append(
+                    f"pod dispatch overhead {overhead_pct:.0f}% over "
+                    f"the {overhead_budget_pct:g}% budget"
+                )
+            if not capacity_whole:
+                errs.append("router never saw the pod at capacity 2")
+            if post_kill_status != 200:
+                errs.append(
+                    "post-kill request answered "
+                    f"{post_kill_status} (want 200, never a hang)"
+                )
+            if degrade_detect_s is None:
+                errs.append("/readyz never reported pod.degraded")
+            if not capacity_degraded:
+                errs.append(
+                    "degraded pod never re-registered at capacity 1"
+                )
+            if rc_b != 0:
+                errs.append(
+                    f"coordinator exit {rc_b} after degrade (want 0)"
+                )
+            if time.monotonic() > deadline:
+                errs.append(f"drill overran its {timeout_s:g}s budget")
+            if errs:
+                row["error"] = "; ".join(errs)
+            return row
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            await router.stop()
+
+    return asyncio.run(drive())
+
+
 def main() -> int:
     args = sys.argv[1:]
     passes = 1
@@ -5711,6 +6051,7 @@ def main() -> int:
     diurnal = False
     incident = False
     crash_torture = False
+    pod_drill = False
     torture_cycles = 9
     torture_seed = 0
     stub_port: int | None = None
@@ -5828,6 +6169,14 @@ def main() -> int:
             # best-effort soak (run_crash_torture_drill)
             crash_torture = True
             i += 1
+        elif args[i] == "--pod":
+            # the round-25 pod drill: single-host vs 2-process-pod A/B
+            # on an oversized batch class through the fleet router —
+            # byte parity, dispatch-overhead budget, capacity-weighted
+            # placement, and follower-loss-degrades-loudly
+            # (run_pod_drill)
+            pod_drill = True
+            i += 1
         elif args[i] == "--cycles":
             torture_cycles = int(args[i + 1])
             i += 2
@@ -5934,6 +6283,10 @@ def main() -> int:
         row = run_crash_torture_drill(
             cycles=torture_cycles, seed=torture_seed
         )
+        print(json.dumps(row), flush=True)
+        return 0 if "error" not in row else 1
+    if pod_drill:
+        row = run_pod_drill(n_requests=n_requests or 24)
         print(json.dumps(row), flush=True)
         return 0 if "error" not in row else 1
     if quant_drill:

@@ -954,6 +954,60 @@ def run_crash_torture_guard(timeout_s: float = 1800.0) -> dict:
     return row
 
 
+def run_pod_guard(timeout_s: float = 1800.0) -> dict:
+    """Pod-scale serving drill guard (round 25):
+    tools/loopback_load.py --pod — a single-process 4-device reference
+    backend vs a 2-process pod (coordinator + `pod-worker` follower,
+    gloo collectives, 2 virtual CPU devices each) spanning one (4, 1)
+    mesh, both serving an oversized batch class (top_k=8) through the
+    fleet router; then the follower is SIGKILLed.
+
+    The row fails LOUDLY (`error` field) when:
+    - ANY pod response differs byte-wise from the single-process
+      reference (the pod must be the SAME program, sharded);
+    - the pod's p50 dispatch overhead exceeds POD_OVERHEAD_BUDGET_PCT
+      (control-plane broadcast + cross-host collectives on the path);
+    - the router never saw the whole pod at capacity 2, or the
+      degraded pod never re-registered at capacity 1;
+    - the first post-kill request fails or hangs (follower loss must
+      degrade loudly to single-host serving, never wedge);
+    - /readyz never flipped pod.degraded, or the coordinator exited
+      non-zero on SIGTERM after the degrade."""
+    loopback = os.path.join(REPO, "tools", "loopback_load.py")
+    drill = run_cmd_json(
+        [sys.executable, loopback, "--pod"],
+        timeout_s, env={"JAX_PLATFORMS": "cpu"},
+        # the drill exits 1 on a budget/parity violation while still
+        # printing its row — the guard needs the ROW to say which
+        json_on_error=True,
+    )
+    row = {"config": "pod", "which": "loopback_pod_drill"}
+    if "error" in drill and "drill" not in drill:
+        row["error"] = drill["error"]
+        return row
+    row.update(
+        requests=drill.get("requests"),
+        batch_class=drill.get("batch_class"),
+        hosts=drill.get("hosts"),
+        pod_devices=drill.get("pod_devices"),
+        parity_mismatches=drill.get("parity_mismatches"),
+        p50_single_ms=drill.get("p50_single_ms"),
+        p50_pod_ms=drill.get("p50_pod_ms"),
+        scaling_factor=drill.get("scaling_factor"),
+        overhead_pct=drill.get("overhead_pct"),
+        overhead_budget_pct=drill.get("overhead_budget_pct"),
+        capacity_whole=drill.get("capacity_whole"),
+        post_kill_status=drill.get("post_kill_status"),
+        post_kill_ms=drill.get("post_kill_ms"),
+        degrade_detect_s=drill.get("degrade_detect_s"),
+        capacity_degraded=drill.get("capacity_degraded"),
+        coordinator_exit=drill.get("coordinator_exit"),
+    )
+    if "error" in drill:
+        row["error"] = drill["error"]
+    return row
+
+
 def run_fleet_trace_guard(timeout_s: float = 1800.0) -> dict:
     """Observability-plane drill guard (round 19):
     tools/loopback_load.py --fleet-trace — two routers over three
@@ -1663,6 +1717,14 @@ def main() -> int:
             # zero .tmp debris, recovery under budget, then the ENOSPC
             # best-effort soak (zero non-200s, frozen store counter)
             result = run_crash_torture_guard()
+            result["date"] = date
+        elif tok == "pod":
+            # pod-scale serving drill (round 25): 2-process pod vs
+            # single-process reference on an oversized batch class —
+            # byte parity, dispatch overhead within budget, capacity-
+            # weighted placement (2 -> 1 on degrade), follower loss
+            # degrades loudly with a clean coordinator exit
+            result = run_pod_guard()
             result["date"] = date
         elif tok == "models":
             # multi-model paging drill (round 15): three backbones from

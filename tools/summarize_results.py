@@ -113,6 +113,28 @@ def headline_of(row: dict) -> str:
         if "error" in row:
             line += f" ERROR: {str(row['error'])[:60]}"
         return line
+    if "p50_pod_ms" in row:
+        # pod-scale serving rows (round 25): the whole contract in one
+        # line — byte parity vs the single-process reference, the pod
+        # dispatch overhead vs budget, capacity-weighted placement
+        # (2 whole -> 1 degraded), and the follower-loss behaviour
+        # (post-kill status + the coordinator's clean exit); error
+        # kept visible
+        line = (
+            f"pod {row.get('hosts')}x hosts b{row.get('batch_class')}: "
+            f"parity_mismatches={row.get('parity_mismatches')}, p50 "
+            f"{row.get('p50_single_ms')}→{row.get('p50_pod_ms')}ms "
+            f"(+{row.get('overhead_pct')}%, budget "
+            f"{row.get('overhead_budget_pct')}%), capacity "
+            f"{'2' if row.get('capacity_whole') else 'MISSING'}→"
+            f"{'1' if row.get('capacity_degraded') else 'STUCK'}, "
+            f"post-kill {row.get('post_kill_status')} in "
+            f"{row.get('post_kill_ms')}ms, coord_exit="
+            f"{row.get('coordinator_exit')}"
+        )
+        if "error" in row:
+            line += f" ERROR: {str(row['error'])[:60]}"
+        return line
     if "firing_latency_s" in row:
         # alerting / incident-forensics rows (round 23): the whole
         # contract in one line — zero false positives healthy, fault →
